@@ -1,0 +1,21 @@
+"""kubernetes_tpu — a TPU-native cluster-orchestration framework.
+
+A ground-up re-design of the reference Kubernetes control plane (≈v1.18) with
+the kube-scheduler as the north star: the per-pod scheduling cycle
+(prefilter → filter → score → normalize → select; see reference
+pkg/scheduler/core/generic_scheduler.go:150) becomes a batched pods×nodes
+JAX/XLA data plane over an HBM-resident, delta-updated columnar NodeInfo
+snapshot, sharded over a TPU mesh on the node axis.
+
+Layout (mirrors SURVEY.md §7 build plan):
+  api/        — object model: Pod/Node/quantities/selectors (apimachinery-lite)
+  runtime/    — scheme/watch/store primitives
+  client/     — in-memory API server, informers, workqueue, leader election
+  scheduler/  — cache, queue, framework (plugin API + host plugins), top loop
+  ops/        — device kernels: columnar encoding + filter/score lattice
+  parallel/   — mesh construction + node-axis sharded scheduling step
+  utils/      — featuregates, metrics, trace, backoff
+  perf/       — scheduler_perf-equivalent benchmark harness
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,421 @@
+"""Core typed objects: Pod, Node, and the scheduling-relevant sub-structures.
+
+Covers the slice of staging/src/k8s.io/api/core/v1/types.go the control plane
+consumes: metadata, resources, taints/tolerations, node & pod affinity,
+topology spread constraints, host ports, images, conditions. Plain mutable
+dataclasses; deep-copy is `copy.deepcopy`; defaulting happens in
+constructors; conversion layers are unnecessary (single internal version).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .resources import (
+    CPU,
+    DEFAULT_MILLI_CPU_REQUEST,
+    DEFAULT_MEMORY_REQUEST,
+    MEMORY,
+    PODS,
+    Quantity,
+    ResourceList,
+)
+from .selectors import LabelSelector
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = "v1"
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        """namespace/name cache key (cache.MetaNamespaceKeyFunc)."""
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+# ---------------------------------------------------------------------------
+# Taints and tolerations (v1 types.go Taint/Toleration)
+# ---------------------------------------------------------------------------
+
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TAINT_NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""  # empty key + Exists tolerates everything
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""  # empty effect matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """v1/toleration.go ToleratesTaint."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == TOLERATION_OP_EXISTS:
+            return True
+        return self.value == taint.value
+
+
+def tolerations_tolerate_taint(
+    tolerations: Sequence[Toleration], taint: Taint
+) -> bool:
+    return any(t.tolerates(taint) for t in tolerations)
+
+
+def find_untolerated_taint(
+    taints: Sequence[Taint],
+    tolerations: Sequence[Toleration],
+    effects: Sequence[str] = (TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE),
+) -> Optional[Taint]:
+    """v1helper.FindMatchingUntoleratedTaint (filter path)."""
+    for taint in taints:
+        if taint.effect not in effects:
+            continue
+        if not tolerations_tolerate_taint(tolerations, taint):
+            return taint
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Node affinity (v1 NodeSelector*)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In/NotIn/Exists/DoesNotExist/Gt/Lt
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    # AND of expressions; matchFields (metadata.name) folded into
+    # match_fields for the single supported field.
+    match_expressions: Tuple[NodeSelectorRequirement, ...] = ()
+    match_fields: Tuple[NodeSelectorRequirement, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeSelector:
+    # OR of terms (nodeSelectorTerms)
+    terms: Tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: Tuple[PreferredSchedulingTerm, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Pod affinity (v1 PodAffinity*)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: Tuple[str, ...] = ()  # empty => pod's own namespace
+    topology_key: str = ""
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: Tuple[PodAffinityTerm, ...] = ()
+    preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAntiAffinity:
+    required: Tuple[PodAffinityTerm, ...] = ()
+    preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# ---------------------------------------------------------------------------
+# Topology spread (v1 TopologySpreadConstraint)
+# ---------------------------------------------------------------------------
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+
+
+# ---------------------------------------------------------------------------
+# Containers & pods
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    container_port: int
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+    limits: Dict[str, Quantity] = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Dict[str, Quantity] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
+    host_network: bool = False
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: int = 30
+    volumes: List["Volume"] = field(default_factory=list)
+    service_account_name: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # A tiny union: exactly one of these set.
+    persistent_volume_claim: Optional[str] = None  # claim name
+    host_path: Optional[str] = None
+    empty_dir: bool = False
+    config_map: Optional[str] = None
+    secret: Optional[str] = None
+
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+COND_POD_SCHEDULED = "PodScheduled"
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+    reason: str = ""
+    message: str = ""
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+
+    def deep_copy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+    @property
+    def priority(self) -> int:
+        """pod priority with default 0 (podutil.GetPodPriority)."""
+        return self.spec.priority if self.spec.priority is not None else 0
+
+
+def compute_pod_resource_request(
+    pod: Pod, non_zero: bool = False
+) -> ResourceList:
+    """Pod effective resource request.
+
+    max(sum(containers), max(initContainers)) + overhead — the formula at
+    reference pkg/scheduler/framework/plugins/noderesources/fit.go:99-116
+    (computePodResourceRequest) and nodeinfo calculateResource
+    (node_info.go:568). With non_zero=True, cpu/memory requests of 0 are
+    replaced by the scoring defaults (100m / 200MB).
+    """
+    total = ResourceList()
+    for c in pod.spec.containers:
+        req = ResourceList.parse(c.requests)
+        if non_zero:
+            if req.get(CPU, 0) == 0:
+                req[CPU] = DEFAULT_MILLI_CPU_REQUEST
+            if req.get(MEMORY, 0) == 0:
+                req[MEMORY] = DEFAULT_MEMORY_REQUEST
+        total.add(req)
+    init_max = ResourceList()
+    for c in pod.spec.init_containers:
+        req = ResourceList.parse(c.requests)
+        init_max.set_max(req)
+    total.set_max(init_max)
+    if pod.spec.overhead:
+        total.add(ResourceList.parse(pod.spec.overhead))
+    return total
+
+
+def pod_host_ports(pod: Pod) -> List[Tuple[str, str, int]]:
+    """(hostIP, protocol, hostPort) triples a pod occupies (schedutil.GetContainerPorts)."""
+    out = []
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                out.append((p.host_ip or "0.0.0.0", p.protocol or "TCP", p.host_port))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+    pod_cidr: str = ""
+    provider_id: str = ""
+
+
+@dataclass
+class ContainerImage:
+    names: List[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+
+NODE_READY = "Ready"
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+    last_heartbeat_time: float = field(default_factory=time.time)
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    allocatable: Dict[str, Quantity] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    images: List[ContainerImage] = field(default_factory=list)
+    addresses: List[Tuple[str, str]] = field(default_factory=list)
+    node_info: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    kind: str = "Node"
+
+    def deep_copy(self) -> "Node":
+        return copy.deepcopy(self)
+
+    def allocatable(self) -> ResourceList:
+        src = self.status.allocatable or self.status.capacity
+        rl = ResourceList.parse(src)
+        rl.setdefault(PODS, 110)
+        return rl
+
+
+@dataclass
+class Binding:
+    """pods/{name}/binding subresource payload (DefaultBinder.Bind)."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    target_node: str
+    kind: str = "Binding"

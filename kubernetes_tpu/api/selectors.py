@@ -1,0 +1,113 @@
+"""Label selectors and host-side matching.
+
+The moral equivalent of apimachinery's labels.Selector / metav1.LabelSelector
+(staging/src/k8s.io/apimachinery/pkg/labels, pkg/apis/meta/v1/types.go).
+Selectors here are plain data with a canonical key so they can be interned
+into the device-side selector vocabulary (see ops/encoding.py): per-node
+match-count tensors are maintained per interned selector, which is how
+InterPodAffinity / PodTopologySpread matching becomes integer gathers on TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+# Operators (metav1.LabelSelectorOperator + node-selector extras)
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"  # node-selector only
+OP_LT = "Lt"  # node-selector only
+
+
+@dataclass(frozen=True)
+class Requirement:
+    key: str
+    operator: str
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        if self.operator == OP_IN:
+            return has and labels[self.key] in self.values
+        if self.operator == OP_NOT_IN:
+            # metav1 semantics via LabelSelectorAsSelector: NotIn requires ...
+            # labels.Selector semantics: NotIn matches if key absent OR value
+            # not in set (apimachinery labels/selector.go Matches).
+            return (not has) or labels[self.key] not in self.values
+        if self.operator == OP_EXISTS:
+            return has
+        if self.operator == OP_DOES_NOT_EXIST:
+            return not has
+        if self.operator in (OP_GT, OP_LT):
+            if not has:
+                return False
+            try:
+                lv = int(labels[self.key])
+                rv = int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lv > rv if self.operator == OP_GT else lv < rv
+        raise ValueError(f"unknown operator {self.operator!r}")
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """metav1.LabelSelector: AND of match_labels and match_expressions.
+
+    An empty selector matches everything; None (no selector) matches nothing
+    — callers encode that distinction themselves, mirroring
+    LabelSelectorAsSelector.
+    """
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[Requirement, ...] = ()
+
+    @classmethod
+    def make(
+        cls,
+        match_labels: Optional[Mapping[str, str]] = None,
+        match_expressions: Sequence[Requirement] = (),
+    ) -> "LabelSelector":
+        ml = tuple(sorted((match_labels or {}).items()))
+        return cls(match_labels=ml, match_expressions=tuple(match_expressions))
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for r in self.match_expressions:
+            if not r.matches(labels):
+                return False
+        return True
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+    def canonical(self) -> Tuple:
+        """Hashable canonical form used for selector interning."""
+        return (
+            self.match_labels,
+            tuple(
+                (r.key, r.operator, tuple(sorted(r.values)))
+                for r in sorted(
+                    self.match_expressions, key=lambda r: (r.key, r.operator)
+                )
+            ),
+        )
+
+
+def selector_from_match_labels(labels: Mapping[str, str]) -> LabelSelector:
+    return LabelSelector.make(match_labels=dict(labels))
+
+
+def labels_match_selector(
+    labels: Mapping[str, str], selector: Optional[LabelSelector]
+) -> bool:
+    """None selector matches nothing (LabelSelectorAsSelector(nil))."""
+    if selector is None:
+        return False
+    return selector.matches(labels)

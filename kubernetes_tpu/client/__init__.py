@@ -1,0 +1,18 @@
+"""Client machinery: in-memory API server, informers, workqueue, leader election.
+
+The functional equivalent of the reference's kube-apiserver + client-go for
+in-process topologies (the same shape its integration tests use: real event
+pipeline, no network). The APIServer is the storage/watch layer
+(etcd3 store + watch cacher collapsed into one versioned in-memory store);
+informers replay its watch streams into local Indexers and user handlers.
+"""
+
+from .apiserver import APIServer, Conflict, NotFound, AlreadyExists  # noqa: F401
+from .informers import SharedInformer, SharedInformerFactory  # noqa: F401
+from .workqueue import (  # noqa: F401
+    RateLimitingQueue,
+    ExponentialBackoffRateLimiter,
+    parallelize_until,
+)
+from .leaderelection import LeaderElector, LeaderElectionConfig  # noqa: F401
+from .events import EventRecorder, ClusterEvent  # noqa: F401

@@ -1,0 +1,209 @@
+"""Versioned in-memory API store with watch fan-out.
+
+Collapses the reference's persistence stack — etcd (gRPC) + etcd3 store
+(staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go) + watch cacher
+(storage/cacher/cacher.go:448) — into one process-local component with the
+same observable semantics the control plane depends on:
+
+  * monotonically increasing resourceVersion per write
+  * optimistic concurrency: update conflicts on stale resource_version
+  * list + watch-from-version with ordered event delivery per watcher
+  * per-(kind, namespace) keying
+
+Components talk to it through plain method calls instead of REST; the handler
+chain (authn/authz/admission) is represented by pluggable admit hooks.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(ValueError):
+    """Stale resource_version on update (optimistic-concurrency failure)."""
+
+
+AdmitHook = Callable[[str, str, Any], None]  # (verb, kind, obj) -> raise to deny
+
+
+class APIServer:
+    def __init__(self, watch_history: int = 200000):
+        self._lock = threading.RLock()
+        self._rv = 0
+        # kind -> key -> object
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        # kind -> list of live watchers
+        self._watchers: Dict[str, List[Watcher]] = {}
+        # kind -> ring buffer of past events for watch-from-version replay
+        self._history: Dict[str, deque] = {}
+        self._history_len = watch_history
+        self.admit_hooks: List[AdmitHook] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _key(obj: Any) -> str:
+        return obj.metadata.key
+
+    def _bump(self, obj: Any) -> int:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+        return self._rv
+
+    def _admit(self, verb: str, kind: str, obj: Any) -> None:
+        for hook in self.admit_hooks:
+            hook(verb, kind, obj)
+
+    def _notify(self, kind: str, ev: Event) -> None:
+        hist = self._history.setdefault(kind, deque(maxlen=self._history_len))
+        hist.append(ev)
+        for w in list(self._watchers.get(kind, [])):
+            if w.stopped:
+                self._watchers[kind].remove(w)
+            else:
+                w.push(ev)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            self._admit("create", kind, obj)
+            store = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in store:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            self._bump(obj)
+            stored = copy.deepcopy(obj)
+            store[key] = stored
+            self._notify(
+                kind,
+                Event(ADDED, copy.deepcopy(stored), stored.metadata.resource_version),
+            )
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            store = self._objects.get(kind, {})
+            if key not in store:
+                raise NotFound(f"{kind} {key} not found")
+            return copy.deepcopy(store[key])
+
+    def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
+        with self._lock:
+            self._admit("update", kind, obj)
+            store = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key not in store:
+                raise NotFound(f"{kind} {key} not found")
+            cur = store[key]
+            if (
+                check_version
+                and obj.metadata.resource_version
+                and obj.metadata.resource_version != cur.metadata.resource_version
+            ):
+                raise Conflict(
+                    f"{kind} {key}: rv {obj.metadata.resource_version} != "
+                    f"{cur.metadata.resource_version}"
+                )
+            self._bump(obj)
+            stored = copy.deepcopy(obj)
+            store[key] = stored
+            self._notify(
+                kind,
+                Event(
+                    MODIFIED, copy.deepcopy(stored), stored.metadata.resource_version
+                ),
+            )
+            return copy.deepcopy(stored)
+
+    def guaranteed_update(
+        self, kind: str, namespace: str, name: str, mutate: Callable[[Any], Any]
+    ) -> Any:
+        """Retry-on-conflict read-modify-write (etcd3 GuaranteedUpdate)."""
+        while True:
+            cur = self.get(kind, namespace, name)
+            new = mutate(cur)
+            if new is None:
+                return cur
+            try:
+                return self.update(kind, new)
+            except Conflict:
+                continue
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            store = self._objects.get(kind, {})
+            if key not in store:
+                raise NotFound(f"{kind} {key} not found")
+            obj = store.pop(key)
+            self._admit("delete", kind, obj)
+            self._rv += 1
+            self._notify(kind, Event(DELETED, copy.deepcopy(obj), self._rv))
+            return obj
+
+    def list(
+        self, kind: str, namespace: Optional[str] = None
+    ) -> Tuple[List[Any], int]:
+        """Returns (objects, resourceVersion-at-list-time)."""
+        with self._lock:
+            store = self._objects.get(kind, {})
+            objs = [
+                copy.deepcopy(o)
+                for o in store.values()
+                if namespace is None or o.metadata.namespace == namespace
+            ]
+            return objs, self._rv
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, from_version: int = 0) -> Watcher:
+        """Watch a kind; events with rv > from_version are replayed first."""
+        with self._lock:
+            w = Watcher()
+            for ev in self._history.get(kind, ()):
+                if ev.resource_version > from_version:
+                    w.push(ev)
+            self._watchers.setdefault(kind, []).append(w)
+            return w
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- typed convenience used by the scheduler ----------------------------
+
+    def bind_pod(self, binding) -> None:
+        """POST pods/{name}/binding: set spec.nodeName if not already bound.
+
+        Reference: registry/core/pod/storage BindingREST -> assignPod; the
+        scheduler calls it via DefaultBinder
+        (framework/plugins/defaultbinder/default_binder.go:50).
+        """
+
+        def mutate(pod):
+            if pod.spec.node_name:
+                raise Conflict(
+                    f"pod {binding.pod_namespace}/{binding.pod_name} already bound"
+                )
+            if binding.pod_uid and pod.metadata.uid != binding.pod_uid:
+                raise Conflict("uid mismatch on binding")
+            pod.spec.node_name = binding.target_node
+            return pod
+
+        self.guaranteed_update("pods", binding.pod_namespace, binding.pod_name, mutate)

@@ -1,0 +1,81 @@
+"""Cluster event recording.
+
+Equivalent of client-go tools/events EventBroadcaster/EventRecorder
+(wired for the scheduler at reference pkg/scheduler/profile/profile.go:85):
+structured Events ("Scheduled", "FailedScheduling", scheduler.go:378,544)
+written to the API store under kind "events", with same-event aggregation by
+(object, reason) count.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..api.objects import ObjectMeta
+from .apiserver import APIServer, NotFound
+
+
+@dataclass
+class ClusterEvent:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_key: str = ""
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    action: str = ""
+    note: str = ""
+    count: int = 1
+    first_timestamp: float = field(default_factory=time.time)
+    last_timestamp: float = field(default_factory=time.time)
+    kind: str = "Event"
+
+
+class EventRecorder:
+    def __init__(self, server: Optional[APIServer], component: str = "scheduler"):
+        self._server = server
+        self._component = component
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def eventf(
+        self,
+        obj: Any,
+        event_type: str,
+        reason: str,
+        action: str,
+        note: str = "",
+    ) -> None:
+        if self._server is None:
+            return
+        key = obj.metadata.key if hasattr(obj, "metadata") else str(obj)
+        agg_name = f"{key.replace('/', '.')}.{reason}"
+        try:
+            existing = self._server.get("events", "default", agg_name)
+            existing.count += 1
+            existing.last_timestamp = time.time()
+            existing.note = note
+            try:
+                self._server.update("events", existing)
+                return
+            except Exception:
+                return
+        except NotFound:
+            pass
+        with self._lock:
+            self._seq += 1
+        ev = ClusterEvent(
+            metadata=ObjectMeta(name=agg_name, namespace="default"),
+            involved_kind=getattr(obj, "kind", ""),
+            involved_key=key,
+            type=event_type,
+            reason=reason,
+            action=action,
+            note=note,
+        )
+        try:
+            self._server.create("events", ev)
+        except Exception:
+            pass

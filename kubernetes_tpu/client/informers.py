@@ -1,0 +1,181 @@
+"""Shared informers: list+watch replay into local indexers and handlers.
+
+Equivalent of client-go's Reflector (tools/cache/reflector.go:210
+ListAndWatch) + DeltaFIFO + sharedIndexInformer (shared_informer.go), with
+the simplification the in-process store allows: the watch stream is lossless
+and ordered, so the delta queue collapses into direct dispatch on the
+informer thread. Handlers see the same contract: OnAdd/OnUpdate/OnDelete
+after an initial synthetic Add per listed object, HasSynced after the initial
+list is delivered.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..runtime.store import Indexer, IndexFunc
+from ..runtime.watch import ADDED, DELETED, MODIFIED
+
+from .apiserver import APIServer
+
+
+class ResourceEventHandler:
+    """Duck-typed handler; subclass or pass callables to SharedInformer.add_handler."""
+
+    def on_add(self, obj: Any) -> None:  # pragma: no cover - interface
+        pass
+
+    def on_update(self, old: Any, new: Any) -> None:  # pragma: no cover
+        pass
+
+    def on_delete(self, obj: Any) -> None:  # pragma: no cover
+        pass
+
+
+class _FuncHandler(ResourceEventHandler):
+    def __init__(self, on_add=None, on_update=None, on_delete=None, filter_fn=None):
+        self._add, self._update, self._delete = on_add, on_update, on_delete
+        self._filter = filter_fn
+
+    def on_add(self, obj):
+        if self._add and (self._filter is None or self._filter(obj)):
+            self._add(obj)
+
+    def on_update(self, old, new):
+        if self._filter is None:
+            if self._update:
+                self._update(old, new)
+            return
+        # FilteringResourceEventHandler semantics (client-go shared_informer):
+        # filter old and new independently; add/delete on transition.
+        old_ok = self._filter(old)
+        new_ok = self._filter(new)
+        if old_ok and new_ok:
+            if self._update:
+                self._update(old, new)
+        elif not old_ok and new_ok and self._add:
+            self._add(new)
+        elif old_ok and not new_ok and self._delete:
+            self._delete(old)
+
+    def on_delete(self, obj):
+        if self._delete and (self._filter is None or self._filter(obj)):
+            self._delete(obj)
+
+
+class SharedInformer:
+    def __init__(
+        self,
+        server: APIServer,
+        kind: str,
+        indexers: Optional[Dict[str, IndexFunc]] = None,
+    ):
+        self.kind = kind
+        self._server = server
+        self.indexer = Indexer(indexers=indexers)
+        self._handlers: List[ResourceEventHandler] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watcher = None
+
+    def add_handler(
+        self,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+        filter_fn: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        self._handlers.append(_FuncHandler(on_add, on_update, on_delete, filter_fn))
+
+    def add_event_handler(self, handler: ResourceEventHandler) -> None:
+        self._handlers.append(handler)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        objs, rv = self._server.list(self.kind)
+        for obj in objs:
+            self.indexer.add(obj)
+            for h in self._handlers:
+                h.on_add(obj)
+        self._synced.set()
+        self._watcher = self._server.watch(self.kind, from_version=rv)
+        for ev in self._watcher:
+            if self._stop.is_set():
+                return
+            key = ev.object.metadata.key
+            if ev.type == ADDED:
+                self.indexer.add(ev.object)
+                for h in self._handlers:
+                    h.on_add(ev.object)
+            elif ev.type == MODIFIED:
+                old = self.indexer.get(key)
+                self.indexer.update(ev.object)
+                for h in self._handlers:
+                    h.on_update(old, ev.object)
+            elif ev.type == DELETED:
+                self.indexer.delete(ev.object)
+                for h in self._handlers:
+                    h.on_delete(ev.object)
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.stop()
+
+    # Lister surface
+    def list(self) -> List[Any]:
+        return self.indexer.list()
+
+    def get(self, key: str) -> Optional[Any]:
+        return self.indexer.get(key)
+
+
+class SharedInformerFactory:
+    """informers.NewSharedInformerFactory: one informer per kind, shared."""
+
+    def __init__(self, server: APIServer):
+        self._server = server
+        self._informers: Dict[str, SharedInformer] = {}
+        self._lock = threading.Lock()
+
+    def informer(
+        self, kind: str, indexers: Optional[Dict[str, IndexFunc]] = None
+    ) -> SharedInformer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = SharedInformer(self._server, kind, indexers)
+                self._informers[kind] = inf
+            return inf
+
+    def start(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        with self._lock:
+            informers = list(self._informers.values())
+        return all(inf.wait_for_sync(timeout) for inf in informers)
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()

@@ -1,0 +1,147 @@
+"""Lease-based leader election.
+
+Equivalent of client-go tools/leaderelection/leaderelection.go:111 with the
+same invariants (leaderelection.go:78-96): leaseDuration > renewDeadline >
+retryPeriod; a candidate acquires the Lease record if it is unheld or
+expired, renews every retry_period, and calls on_stopped_leading (fatal in
+the scheduler) if it cannot renew within renew_deadline. The Lease record
+lives in the in-memory API server under kind "leases", so HA semantics are
+testable in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.objects import ObjectMeta
+from .apiserver import APIServer, AlreadyExists, Conflict, NotFound
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+    kind: str = "Lease"
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_name: str = "kube-scheduler"
+    lock_namespace: str = "kube-system"
+    identity: str = "scheduler-0"
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+    def validate(self) -> None:
+        if not self.lease_duration > self.renew_deadline:
+            raise ValueError("leaseDuration must be greater than renewDeadline")
+        if not self.renew_deadline > self.retry_period * 1.2:
+            raise ValueError("renewDeadline must be greater than retryPeriod*JitterFactor")
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        server: APIServer,
+        config: LeaderElectionConfig,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        config.validate()
+        self._server = server
+        self._cfg = config
+        self._on_started = on_started_leading
+        self._on_stopped = on_stopped_leading
+        self._clock = clock
+        self._stop = threading.Event()
+        self._is_leader = threading.Event()
+        self._observed_renew = 0.0
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        """Block: acquire, then start leading; return when leadership lost/stopped."""
+        if not self._acquire():
+            return
+        started = threading.Thread(
+            target=self._on_started, daemon=True, name="leading"
+        )
+        self._is_leader.set()
+        started.start()
+        self._renew_loop()
+        self._is_leader.clear()
+        if self._on_stopped:
+            self._on_stopped()
+
+    # -- internals ----------------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self._clock()
+        cfg = self._cfg
+        try:
+            lease = self._server.get("leases", cfg.lock_namespace, cfg.lock_name)
+        except NotFound:
+            lease = Lease(
+                metadata=ObjectMeta(name=cfg.lock_name, namespace=cfg.lock_namespace),
+                holder_identity=cfg.identity,
+                lease_duration_seconds=cfg.lease_duration,
+                acquire_time=now,
+                renew_time=now,
+            )
+            try:
+                self._server.create("leases", lease)
+                return True
+            except AlreadyExists:
+                return False
+        if (
+            lease.holder_identity != cfg.identity
+            and lease.renew_time + lease.lease_duration_seconds > now
+        ):
+            return False  # held by someone else and not expired
+        if lease.holder_identity != cfg.identity:
+            lease.lease_transitions += 1
+            lease.acquire_time = now
+        lease.holder_identity = cfg.identity
+        lease.renew_time = now
+        lease.lease_duration_seconds = cfg.lease_duration
+        try:
+            self._server.update("leases", lease)
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    def _acquire(self) -> bool:
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                self._observed_renew = self._clock()
+                return True
+            self._stop.wait(self._cfg.retry_period)
+        return False
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            deadline = self._observed_renew + self._cfg.renew_deadline
+            renewed = False
+            while self._clock() < deadline and not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    self._observed_renew = self._clock()
+                    renewed = True
+                    break
+                self._stop.wait(self._cfg.retry_period)
+            if not renewed:
+                return  # leadership lost
+            self._stop.wait(self._cfg.retry_period)

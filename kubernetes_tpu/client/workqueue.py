@@ -1,0 +1,155 @@
+"""Work queues and bounded parallel helpers.
+
+Equivalent of client-go util/workqueue: de-duplicating work queue with
+rate-limited re-adds (default_rate_limiters.go ItemExponentialFailureRateLimiter)
+and ParallelizeUntil (parallelizer.go:30) — the reference's 16-goroutine
+fan-out that the TPU build replaces on the hot path but still uses for
+host-side controllers.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ExponentialBackoffRateLimiter:
+    """per-item exponential backoff: base * 2^failures, capped."""
+
+    def __init__(self, base: float = 0.005, cap: float = 1000.0):
+        self._base = base
+        self._cap = cap
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self._base * (2**n), self._cap)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class RateLimitingQueue:
+    """Deduplicating FIFO with delayed adds and dirty/processing sets.
+
+    Semantics match workqueue.Type: an item added while being processed is
+    re-queued when Done is called; duplicate adds coalesce.
+    """
+
+    def __init__(self, rate_limiter: Optional[ExponentialBackoffRateLimiter] = None):
+        self._cond = threading.Condition()
+        self._queue: List[Any] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutdown = False
+        self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
+        # delayed adds: heap of (ready_time, seq, item)
+        self._delayed: List = []
+        self._seq = 0
+        self._delay_thread = threading.Thread(
+            target=self._delay_loop, daemon=True, name="workqueue-delay"
+        )
+        self._delay_thread.start()
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self._limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self._limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self._limiter.num_requeues(item)
+
+    def _delay_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, item = heapq.heappop(self._delayed)
+                    if item not in self._dirty:
+                        self._dirty.add(item)
+                        if item not in self._processing:
+                            self._queue.append(item)
+                            self._cond.notify()
+                timeout = (
+                    max(0.0, self._delayed[0][0] - now) if self._delayed else 0.05
+                )
+            time.sleep(min(timeout, 0.05))
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutdown:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._shutdown and not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+
+def parallelize_until(
+    workers: int, pieces: int, do_work: Callable[[int], None]
+) -> None:
+    """workqueue.ParallelizeUntil: chunked fan-out of `pieces` index calls."""
+    if pieces == 0:
+        return
+    workers = max(1, min(workers, pieces))
+    if workers == 1:
+        for i in range(pieces):
+            do_work(i)
+        return
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        list(pool.map(do_work, range(pieces)))

@@ -1,0 +1,4 @@
+"""Runtime primitives: watch events and thread-safe stores (apimachinery-lite)."""
+
+from .watch import Event, ADDED, MODIFIED, DELETED, Watcher  # noqa: F401
+from .store import ThreadSafeStore, Indexer  # noqa: F401

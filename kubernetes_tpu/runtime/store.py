@@ -1,0 +1,103 @@
+"""Thread-safe keyed stores with indexers.
+
+Equivalent of client-go tools/cache thread_safe_store.go / index.go: a
+locked map keyed by namespace/name with pluggable index functions, used as
+the informer-backed local cache every component reads instead of the API
+server (reference pattern: Reflector -> DeltaFIFO -> Indexer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+IndexFunc = Callable[[Any], List[str]]
+
+
+def meta_namespace_key(obj: Any) -> str:
+    return obj.metadata.key
+
+
+class ThreadSafeStore:
+    def __init__(self, key_func: Callable[[Any], str] = meta_namespace_key):
+        self._lock = threading.RLock()
+        self._items: Dict[str, Any] = {}
+        self._key_func = key_func
+
+    def add(self, obj: Any) -> None:
+        with self._lock:
+            self._items[self._key_func(obj)] = obj
+
+    update = add
+
+    def delete(self, obj: Any) -> None:
+        with self._lock:
+            self._items.pop(self._key_func(obj), None)
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._items.values())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class Indexer(ThreadSafeStore):
+    """Store + secondary indices (cache.Indexer)."""
+
+    def __init__(
+        self,
+        key_func: Callable[[Any], str] = meta_namespace_key,
+        indexers: Optional[Dict[str, IndexFunc]] = None,
+    ):
+        super().__init__(key_func)
+        self._indexers = indexers or {}
+        self._indices: Dict[str, Dict[str, set]] = {
+            name: {} for name in self._indexers
+        }
+
+    def add(self, obj: Any) -> None:
+        key = self._key_func(obj)
+        with self._lock:
+            old = self._items.get(key)
+            if old is not None:
+                self._remove_from_indices(old, key)
+            self._items[key] = obj
+            self._add_to_indices(obj, key)
+
+    update = add
+
+    def delete(self, obj: Any) -> None:
+        key = self._key_func(obj)
+        with self._lock:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._remove_from_indices(old, key)
+
+    def by_index(self, index_name: str, index_value: str) -> List[Any]:
+        with self._lock:
+            keys = self._indices.get(index_name, {}).get(index_value, set())
+            return [self._items[k] for k in keys if k in self._items]
+
+    def _add_to_indices(self, obj: Any, key: str) -> None:
+        for name, fn in self._indexers.items():
+            for val in fn(obj):
+                self._indices[name].setdefault(val, set()).add(key)
+
+    def _remove_from_indices(self, obj: Any, key: str) -> None:
+        for name, fn in self._indexers.items():
+            for val in fn(obj):
+                s = self._indices[name].get(val)
+                if s is not None:
+                    s.discard(key)
+                    if not s:
+                        del self._indices[name][val]

@@ -1,0 +1,61 @@
+"""Watch interface: typed change events over a queue.
+
+Equivalent of apimachinery's watch.Interface
+(staging/src/k8s.io/apimachinery/pkg/watch/watch.go): a result channel of
+{Added, Modified, Deleted} events plus Stop. Bookmark/Error events are not
+needed by the in-memory store (no relist windows to optimise).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class Event:
+    type: str
+    object: Any
+    resource_version: int = 0
+
+
+class Watcher:
+    """A single watch stream; the store pushes events, the consumer iterates."""
+
+    def __init__(self, maxsize: int = 100000):
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize)
+        self._stopped = threading.Event()
+
+    def push(self, ev: Event) -> None:
+        if not self._stopped.is_set():
+            self._q.put(ev)
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._q.put(None)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event, or None if stopped / timed out."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            yield ev

@@ -1,0 +1,122 @@
+"""Unit tests for the api object model: quantities, selectors, taints, requests."""
+
+from kubernetes_tpu.api import (
+    LabelSelector,
+    Requirement,
+    ResourceList,
+    parse_quantity,
+)
+from kubernetes_tpu.api.objects import (
+    Container,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+    TOLERATION_OP_EXISTS,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    compute_pod_resource_request,
+    find_untolerated_taint,
+    pod_host_ports,
+    ContainerPort,
+)
+from kubernetes_tpu.api.resources import cpu_to_millis, DEFAULT_MILLI_CPU_REQUEST
+
+
+def test_parse_quantity_forms():
+    assert parse_quantity("100m") == 0.1
+    assert parse_quantity("1") == 1
+    assert parse_quantity("1Gi") == 1024**3
+    assert parse_quantity("1G") == 10**9
+    assert parse_quantity("500Mi") == 500 * 1024**2
+    assert parse_quantity("2.5") == 2.5
+    assert parse_quantity(42) == 42.0
+    assert parse_quantity("1e3") == 1000.0
+
+
+def test_cpu_to_millis_ceils():
+    assert cpu_to_millis("100m") == 100
+    assert cpu_to_millis("1") == 1000
+    assert cpu_to_millis("1.5") == 1500
+    assert cpu_to_millis("0.0001") == 1  # ceil like resource.MilliValue
+
+
+def test_resource_list_arithmetic():
+    a = ResourceList.parse({"cpu": "1", "memory": "1Gi"})
+    b = ResourceList.parse({"cpu": "500m", "memory": "512Mi", "pods": 1})
+    a.add(b)
+    assert a["cpu"] == 1500
+    assert a["memory"] == 1024**3 + 512 * 1024**2
+    a.set_max({"cpu": 2000})
+    assert a["cpu"] == 2000
+
+
+def test_label_selector_semantics():
+    sel = LabelSelector.make(
+        match_labels={"app": "web"},
+        match_expressions=[
+            Requirement("tier", "In", ("frontend", "edge")),
+            Requirement("env", "NotIn", ("dev",)),
+            Requirement("ready", "Exists"),
+        ],
+    )
+    assert sel.matches({"app": "web", "tier": "edge", "ready": "1"})
+    assert not sel.matches({"app": "web", "tier": "db", "ready": "1"})
+    assert not sel.matches({"app": "web", "tier": "edge", "env": "dev", "ready": "1"})
+    # NotIn matches when key absent
+    assert sel.matches({"app": "web", "tier": "frontend", "ready": "y"})
+    # empty selector matches everything
+    assert LabelSelector.make().matches({"anything": "x"})
+
+
+def test_selector_canonical_interning_key():
+    s1 = LabelSelector.make(match_labels={"a": "1", "b": "2"})
+    s2 = LabelSelector.make(match_labels={"b": "2", "a": "1"})
+    assert s1.canonical() == s2.canonical()
+
+
+def test_tolerations():
+    t_all = Toleration(operator=TOLERATION_OP_EXISTS)
+    assert t_all.tolerates(Taint("k", "v", TAINT_NO_SCHEDULE))
+    t = Toleration(key="k", operator="Equal", value="v", effect=TAINT_NO_SCHEDULE)
+    assert t.tolerates(Taint("k", "v", TAINT_NO_SCHEDULE))
+    assert not t.tolerates(Taint("k", "other", TAINT_NO_SCHEDULE))
+    assert not t.tolerates(Taint("k", "v", TAINT_NO_EXECUTE))
+    taint = find_untolerated_taint(
+        [Taint("k", "v", TAINT_NO_SCHEDULE), Taint("p", "q", "PreferNoSchedule")],
+        [t],
+    )
+    assert taint is None  # PreferNoSchedule not in filter effects
+
+
+def test_pod_request_formula():
+    pod = Pod(
+        spec=PodSpec(
+            containers=[
+                Container(requests={"cpu": "1", "memory": "1Gi"}),
+                Container(requests={"cpu": "500m"}),
+            ],
+            init_containers=[Container(requests={"cpu": "2", "memory": "256Mi"})],
+            overhead={"cpu": "100m"},
+        )
+    )
+    req = compute_pod_resource_request(pod)
+    # max(1500, 2000) + 100 overhead
+    assert req["cpu"] == 2100
+    assert req["memory"] == 1024**3  # max(1Gi, 256Mi)
+    nz = compute_pod_resource_request(
+        Pod(spec=PodSpec(containers=[Container()])), non_zero=True
+    )
+    assert nz["cpu"] == DEFAULT_MILLI_CPU_REQUEST
+
+
+def test_pod_host_ports():
+    pod = Pod(
+        spec=PodSpec(
+            containers=[
+                Container(ports=[ContainerPort(80, host_port=8080)]),
+                Container(ports=[ContainerPort(443)]),
+            ]
+        )
+    )
+    assert pod_host_ports(pod) == [("0.0.0.0", "TCP", 8080)]

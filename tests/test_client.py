@@ -1,0 +1,192 @@
+"""Tests for the in-memory API server, informers, workqueue, leader election."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.objects import Binding, Node, ObjectMeta, Pod, PodSpec
+from kubernetes_tpu.client import (
+    APIServer,
+    Conflict,
+    LeaderElectionConfig,
+    LeaderElector,
+    NotFound,
+    RateLimitingQueue,
+    SharedInformerFactory,
+    parallelize_until,
+)
+
+
+def make_pod(name, ns="default", node=""):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns), spec=PodSpec(node_name=node))
+
+
+def test_crud_and_resource_versions():
+    s = APIServer()
+    p = make_pod("a")
+    created = s.create("pods", p)
+    assert created.metadata.resource_version == 1
+    got = s.get("pods", "default", "a")
+    got.spec.node_name = "n1"
+    updated = s.update("pods", got)
+    assert updated.metadata.resource_version == 2
+    # stale update conflicts
+    stale = created
+    stale.spec.node_name = "n2"
+    with pytest.raises(Conflict):
+        s.update("pods", stale)
+    s.delete("pods", "default", "a")
+    with pytest.raises(NotFound):
+        s.get("pods", "default", "a")
+
+
+def test_store_isolation_from_caller_mutation():
+    s = APIServer()
+    p = make_pod("a")
+    s.create("pods", p)
+    p.spec.node_name = "mutated-after-create"
+    assert s.get("pods", "default", "a").spec.node_name == ""
+
+
+def test_watch_replay_and_live_events():
+    s = APIServer()
+    s.create("pods", make_pod("a"))
+    _, rv = s.list("pods")
+    w = s.watch("pods", from_version=rv)
+    s.create("pods", make_pod("b"))
+    s.delete("pods", "default", "a")
+    ev1 = w.get(timeout=1)
+    ev2 = w.get(timeout=1)
+    assert ev1.type == "ADDED" and ev1.object.metadata.name == "b"
+    assert ev2.type == "DELETED" and ev2.object.metadata.name == "a"
+    # watch from 0 replays history
+    w0 = s.watch("pods", from_version=0)
+    types = [w0.get(timeout=1).type for _ in range(3)]
+    assert types == ["ADDED", "ADDED", "DELETED"]
+
+
+def test_bind_pod_subresource():
+    s = APIServer()
+    p = make_pod("a")
+    s.create("pods", p)
+    s.bind_pod(Binding("a", "default", p.metadata.uid, "node-1"))
+    assert s.get("pods", "default", "a").spec.node_name == "node-1"
+    with pytest.raises(Conflict):
+        s.bind_pod(Binding("a", "default", p.metadata.uid, "node-2"))
+
+
+def test_informer_sync_and_events():
+    s = APIServer()
+    s.create("pods", make_pod("pre"))
+    factory = SharedInformerFactory(s)
+    inf = factory.informer("pods")
+    adds, updates, deletes = [], [], []
+    inf.add_handler(
+        on_add=lambda o: adds.append(o.metadata.name),
+        on_update=lambda old, new: updates.append(new.metadata.name),
+        on_delete=lambda o: deletes.append(o.metadata.name),
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    s.create("pods", make_pod("live"))
+    live = s.get("pods", "default", "live")
+    live.spec.node_name = "n1"
+    s.update("pods", live)
+    s.delete("pods", "default", "pre")
+    deadline = time.time() + 5
+    while time.time() < deadline and (
+        "live" not in updates or "pre" not in deletes
+    ):
+        time.sleep(0.01)
+    assert adds == ["pre", "live"]
+    assert updates == ["live"]
+    assert deletes == ["pre"]
+    assert inf.get("default/live").spec.node_name == "n1"
+    factory.stop()
+
+
+def test_informer_filtering_handler_transitions():
+    s = APIServer()
+    factory = SharedInformerFactory(s)
+    inf = factory.informer("pods")
+    scheduled_adds, scheduled_deletes = [], []
+    inf.add_handler(
+        on_add=lambda o: scheduled_adds.append(o.metadata.name),
+        on_delete=lambda o: scheduled_deletes.append(o.metadata.name),
+        filter_fn=lambda o: bool(o.spec.node_name),
+    )
+    factory.start()
+    factory.wait_for_cache_sync()
+    s.create("pods", make_pod("p"))
+    p = s.get("pods", "default", "p")
+    p.spec.node_name = "n1"
+    s.update("pods", p)  # unscheduled -> scheduled transition == add
+    deadline = time.time() + 5
+    while time.time() < deadline and "p" not in scheduled_adds:
+        time.sleep(0.01)
+    assert scheduled_adds == ["p"]
+    factory.stop()
+
+
+def test_workqueue_dedup_and_requeue_while_processing():
+    q = RateLimitingQueue()
+    q.add("x")
+    q.add("x")
+    assert len(q) == 1
+    item = q.get(timeout=1)
+    assert item == "x"
+    q.add("x")  # re-add while processing: must come back after done
+    assert q.get(timeout=0.05) is None
+    q.done("x")
+    assert q.get(timeout=1) == "x"
+    q.shut_down()
+
+
+def test_workqueue_add_after():
+    q = RateLimitingQueue()
+    q.add_after("later", 0.15)
+    assert q.get(timeout=0.05) is None
+    assert q.get(timeout=1.0) == "later"
+    q.shut_down()
+
+
+def test_parallelize_until():
+    out = [0] * 100
+    def work(i):
+        out[i] = i * i
+    parallelize_until(16, 100, work)
+    assert out[7] == 49 and out[99] == 99 * 99
+
+
+def test_leader_election_single_winner_and_failover():
+    s = APIServer()
+    now = [0.0]
+    clock = lambda: now[0]
+    leaders = []
+
+    def make(identity):
+        cfg = LeaderElectionConfig(
+            identity=identity, lease_duration=3.0, renew_deadline=2.0, retry_period=0.05
+        )
+        return LeaderElector(
+            s, cfg, on_started_leading=lambda: leaders.append(identity), clock=clock
+        )
+
+    e1, e2 = make("one"), make("two")
+    assert e1._try_acquire_or_renew()
+    assert not e2._try_acquire_or_renew()
+    # lease expires -> failover
+    now[0] += 10.0
+    assert e2._try_acquire_or_renew()
+    lease = s.get("leases", "kube-system", "kube-scheduler")
+    assert lease.holder_identity == "two"
+    assert lease.lease_transitions == 1
+    # holder renews fine
+    now[0] += 1.0
+    assert e2._try_acquire_or_renew()
+
+
+def test_leader_election_config_invariants():
+    with pytest.raises(ValueError):
+        LeaderElectionConfig(lease_duration=5, renew_deadline=6).validate()
