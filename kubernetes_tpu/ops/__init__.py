@@ -1,0 +1,9 @@
+"""Device data plane: columnar snapshot encoding + the filter/score lattice kernels."""
+
+from .encoding import (  # noqa: F401
+    EncodingConfig,
+    SnapshotEncoder,
+    DeviceSnapshot,
+    PodBatch,
+    Vocab,
+)

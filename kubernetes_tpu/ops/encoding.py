@@ -1,0 +1,742 @@
+"""Columnar snapshot encoding: the host↔device contract.
+
+This is the TPU-native replacement for the reference's per-cycle Snapshot of
+NodeInfo structs (pkg/scheduler/internal/cache/snapshot.go:31,
+nodeinfo/node_info.go:48). Instead of a list of structs walked by 16
+goroutines, cluster state is maintained as a set of fixed-capacity device
+tensors, updated incrementally (the analogue of the cache's generation-based
+UpdateSnapshot delta protocol, cache.go:203), so a scheduling batch launches
+with zero host→device snapshot traffic beyond the pod batch itself.
+
+Key design moves (SURVEY.md §7 stage 2):
+
+* **Dictionary encoding.** Label keys, label values, resource names, host
+  ports, images, and controller-refs are interned into growable vocabularies;
+  node labels become a dense [N, K] int32 matrix of value-ids (-1 = absent),
+  so selector matching is integer compares/gathers on the VPU.
+
+* **Interned pod-predicates.** Every distinct (namespaces, label-selector)
+  pair referenced by a PodTopologySpread constraint or InterPodAffinity term
+  is interned to a selector id `sid`; the device holds `sel_counts[N, S]` =
+  number of pods on node n matching predicate s, maintained incrementally on
+  pod add/remove. The reference's O(all-nodes × pods-per-node) PreFilter scan
+  (interpodaffinity/filtering.go:212,256) becomes a column gather + one
+  segment-sum per term over topology domains.
+
+* **Existing-pod terms ("eterms").** Anti-affinity/affinity terms *of pods
+  already placed* are interned as (namespaces, selector, topology_key, kind);
+  `eterm_w[N, T]` holds the per-node count (required terms) or weight-sum
+  (preferred terms) of pods carrying each term. An incoming pod is matched
+  against the small set of eterm predicates on the host (O(T) string work),
+  yielding a boolean vector the kernel combines with domain segment-sums —
+  this is the "incrementally-maintained device-side count structure" that
+  replaces the existing-pods half of InterPodAffinity's PreFilter.
+
+Units: cpu in millicores, memory/ephemeral-storage quantised to KiB
+(requests ceil, allocatable floor — conservative), pods/extended raw counts;
+all int32. Nodes with >2 TiB of a single resource clamp to int32 max.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+from typing import Any, Dict, FrozenSet, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import objects as v1
+from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, ResourceList
+from ..api.selectors import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    LabelSelector,
+)
+
+# Node-selector operator codes used by the kernel.
+ENC_OP_IN = 0
+ENC_OP_NOT_IN = 1
+ENC_OP_EXISTS = 2
+ENC_OP_NOT_EXISTS = 3
+ENC_OP_GT = 4
+ENC_OP_LT = 5
+_OP_CODES = {
+    OP_IN: ENC_OP_IN,
+    OP_NOT_IN: ENC_OP_NOT_IN,
+    OP_EXISTS: ENC_OP_EXISTS,
+    OP_DOES_NOT_EXIST: ENC_OP_NOT_EXISTS,
+    OP_GT: ENC_OP_GT,
+    OP_LT: ENC_OP_LT,
+}
+
+# Taint effects.
+EFFECT_NO_SCHEDULE = 0
+EFFECT_PREFER_NO_SCHEDULE = 1
+EFFECT_NO_EXECUTE = 2
+_EFFECT_CODES = {
+    v1.TAINT_NO_SCHEDULE: EFFECT_NO_SCHEDULE,
+    v1.TAINT_PREFER_NO_SCHEDULE: EFFECT_PREFER_NO_SCHEDULE,
+    v1.TAINT_NO_EXECUTE: EFFECT_NO_EXECUTE,
+}
+
+# eterm kinds (terms carried by existing pods, matched against incoming pods)
+ETERM_ANTI_REQ = 0  # existing pod's required anti-affinity -> filter
+ETERM_ANTI_PREF = 1  # preferred anti-affinity -> negative score
+ETERM_AFF_PREF = 2  # preferred affinity -> positive score
+ETERM_AFF_REQ = 3  # required affinity -> score × hardPodAffinityWeight
+
+# Base resource columns (fixed order); extended resources follow.
+RES_CPU = 0
+RES_MEM = 1
+RES_STORAGE = 2
+RES_PODS = 3
+N_BASE_RES = 4
+
+_KIB = 1024
+I32_MAX = np.int32(2**31 - 1)
+
+
+def zpad(a: np.ndarray, n: int) -> np.ndarray:
+    """Zero-pad a 1-D array to length n (np.resize repeats — never use it here)."""
+    if len(a) >= n:
+        return a[:n]
+    out = np.zeros(n, a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def _to_col_units(name: str, value: int, ceil: bool) -> int:
+    if name in (MEMORY, EPHEMERAL_STORAGE):
+        value = (value + _KIB - 1) // _KIB if ceil else value // _KIB
+    return int(min(value, int(I32_MAX)))
+
+
+@dataclass(frozen=True)
+class EncodingConfig:
+    """Static bucket capacities. All array shapes derive from these; growing
+    any capacity doubles it and forces a device re-upload + kernel recompile
+    (rare: vocabularies saturate quickly in steady state)."""
+
+    n_cap: int = 128  # node rows
+    k_cap: int = 32  # label keys
+    v_cap: int = 256  # label values (also topology-domain segment count)
+    r_cap: int = 6  # resource columns (4 base + extended)
+    s_cap: int = 8  # interned pod-predicates (sel_counts columns)
+    t_cap: int = 8  # interned eterms
+    pv_cap: int = 8  # interned (proto, port) host-port slots
+    im_cap: int = 32  # interned images
+    av_cap: int = 8  # interned avoid-controller refs
+    taints_max: int = 8  # taints per node
+    # pod-side buckets
+    ns_max: int = 8  # nodeSelector entries per pod
+    tol_max: int = 8  # tolerations per pod
+    aff_terms: int = 4  # required node-affinity terms (OR)
+    aff_exprs: int = 6  # expressions per term (AND)
+    aff_vals: int = 8  # values per expression
+    pref_terms: int = 4  # preferred node-affinity terms
+    spread_max: int = 4  # topology-spread constraints per pod
+    pod_aff_max: int = 4  # incoming required affinity terms
+    pod_anti_max: int = 4  # incoming required anti-affinity terms
+    pod_pref_max: int = 4  # incoming preferred (anti-)affinity terms (signed w)
+    images_max: int = 8  # images per pod
+
+
+class Vocab:
+    """Growable string->id intern table."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[Any, int] = {}
+        self.items: List[Any] = []
+
+    def intern(self, item: Any) -> int:
+        i = self._ids.get(item)
+        if i is None:
+            i = len(self.items)
+            self._ids[item] = i
+            self.items.append(item)
+        return i
+
+    def get(self, item: Any) -> int:
+        """-1 if unknown (lookup without interning)."""
+        return self._ids.get(item, -1)
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class PodPredicate(NamedTuple):
+    """Interned match unit: pod matches iff namespace ∈ namespaces and labels
+    match selector. Namespaces resolved at intern time (term.namespaces or
+    the owning pod's namespace, PodAffinityTerm semantics)."""
+
+    namespaces: FrozenSet[str]
+    selector: LabelSelector
+
+    def matches(self, namespace: str, labels: Dict[str, str]) -> bool:
+        return namespace in self.namespaces and self.selector.matches(labels)
+
+
+class ETerm(NamedTuple):
+    predicate: PodPredicate
+    topo_key_id: int
+    kind: int
+
+
+class DeviceSnapshot(NamedTuple):
+    """The HBM-resident cluster state the lattice kernel reads. All shapes are
+    capacity-padded; `valid` masks live rows. This is a pytree (NamedTuple of
+    arrays) so it flows through jit/pjit and can be donated across updates."""
+
+    valid: Any  # [N] bool
+    unschedulable: Any  # [N] bool (node.spec.unschedulable)
+    allocatable: Any  # [N, R] int32
+    requested: Any  # [N, R] int32 (sum of pod requests; PODS col = pod count)
+    nonzero_req: Any  # [N, R] int32 (requests with scoring defaults applied)
+    label_vals: Any  # [N, K] int32 value-id per key, -1 absent
+    label_numvals: Any  # [N, K] int32 numeric value for Gt/Lt, INT_MIN sentinel
+    taint_key: Any  # [N, TA] int32 key-id, -1 empty
+    taint_val: Any  # [N, TA] int32
+    taint_effect: Any  # [N, TA] int32
+    sel_counts: Any  # [N, S] int32 pods-matching-predicate counts
+    eterm_w: Any  # [N, T] float32 count/weight-sum of existing-pod terms
+    eterm_topo_key: Any  # [T] int32 key-id of each eterm's topology key
+    eterm_kind: Any  # [T] int32 ETERM_*
+    port_counts: Any  # [N, PV] int32 host-port usage counts
+    image_bytes: Any  # [N, I] float32 image size if present else 0
+    avoid: Any  # [N, AV] bool node-avoids-controller flags
+
+
+class PodBatch(NamedTuple):
+    """A batch of P pods encoded for the kernel (built per scheduling cycle)."""
+
+    valid: Any  # [P] bool
+    req: Any  # [P, R] int32
+    nonzero_req: Any  # [P, R] int32
+    node_name_row: Any  # [P] int32 row of spec.nodeName, -1 unset, -2 unknown node
+    tolerates_unschedulable: Any  # [P] bool
+    # node selector (AND of exprs) — metadata.name matchFields folded to rows
+    ns_key: Any  # [P, E] int32
+    ns_op: Any  # [P, E] int32
+    ns_vals: Any  # [P, E, V] int32
+    ns_num: Any  # [P, E] int32
+    # required node-affinity terms (OR of terms, AND of exprs)
+    aff_has: Any  # [P] bool — has required node-affinity terms
+    aff_key: Any  # [P, T, E] int32
+    aff_op: Any  # [P, T, E] int32
+    aff_vals: Any  # [P, T, E, V] int32
+    aff_num: Any  # [P, T, E] int32
+    aff_term_valid: Any  # [P, T] bool
+    aff_match_name_row: Any  # [P, T] int32: matchFields metadata.name row (-1 none)
+    # preferred node-affinity
+    pref_key: Any  # [P, PT, E] int32
+    pref_op: Any  # [P, PT, E] int32
+    pref_vals: Any  # [P, PT, E, V] int32
+    pref_num: Any  # [P, PT, E] int32
+    pref_weight: Any  # [P, PT] float32 (0 = slot empty)
+    pref_term_valid: Any  # [P, PT] bool
+    # tolerations
+    tol_key: Any  # [P, TO] int32 (-2 empty slot, -1 wildcard key)
+    tol_op: Any  # [P, TO] int32 (0 Equal, 1 Exists)
+    tol_val: Any  # [P, TO] int32
+    tol_effect: Any  # [P, TO] int32 (-1 all effects)
+    # topology spread constraints
+    spread_key: Any  # [P, C] int32 topo key-id, -1 empty
+    spread_sid: Any  # [P, C] int32 predicate id
+    spread_skew: Any  # [P, C] int32 max skew
+    spread_hard: Any  # [P, C] bool (DoNotSchedule)
+    spread_self: Any  # [P, C] bool pod matches its own constraint selector
+    # incoming interpod affinity
+    paff_sid: Any  # [P, A] int32 (-1 empty)
+    paff_key: Any  # [P, A] int32 topo key-id
+    paff_self: Any  # [P, A] bool pod matches own selector (carve-out)
+    panti_sid: Any  # [P, B] int32
+    panti_key: Any  # [P, B] int32
+    ppref_sid: Any  # [P, W] int32 preferred terms of incoming pod
+    ppref_key: Any  # [P, W] int32
+    ppref_w: Any  # [P, W] float32 signed weight (negative = anti)
+    # cross-match tensors
+    match_sel: Any  # [P, S] bool pod matches interned predicate s
+    match_eterm: Any  # [P, T] bool pod matches eterm t's predicate
+    eterm_add: Any  # [P, T] float32 pod's own term contributions if placed
+    port_mask: Any  # [P, PV] bool host ports the pod occupies
+    image_ids: Any  # [P, IM] int32 -1 empty
+    image_total: Any  # [P] float32 total bytes of pod images
+    ctrl_id: Any  # [P] int32 avoid-controller id, -1 none
+    priority: Any  # [P] int32
+
+
+# --------------------------------------------------------------------------
+# Host-side master state
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _PodEntry:
+    namespace: str
+    labels: Dict[str, str]
+    req: np.ndarray  # [R] request columns at add time
+    nonzero: np.ndarray
+    eterm_ids: List[int]
+    eterm_ws: List[float]
+    port_ids: List[int]
+    match_cache_len: int  # sids evaluated so far (== len(sel vocab) at update)
+    match_vec: np.ndarray  # [<=S] bool
+
+
+class SnapshotEncoder:
+    """Maintains host numpy masters + vocabularies; emits DeviceSnapshot.
+
+    Driven by the scheduler cache (add/update/remove node, add/remove pod on
+    node). `flush()` returns an up-to-date DeviceSnapshot, applying
+    incremental row scatters when capacities are unchanged, mirroring the
+    reference's generation-diff UpdateSnapshot (cache.go:203-303).
+    """
+
+    def __init__(self, config: Optional[EncodingConfig] = None):
+        self.cfg = config or EncodingConfig()
+        self.key_vocab = Vocab()
+        self.val_vocab = Vocab()
+        self.res_vocab = Vocab()  # extended resource name -> idx-N_BASE_RES
+        self.sel_vocab = Vocab()  # PodPredicate -> sid
+        self.eterm_vocab = Vocab()  # ETerm -> tid
+        self.port_vocab = Vocab()  # (proto, port) -> pid
+        self.image_vocab = Vocab()
+        self.avoid_vocab = Vocab()  # controller-ref "kind/name" -> aid
+
+        self.row_names: List[Optional[str]] = []
+        self._row_by_name: Dict[str, int] = {}
+        self._free_rows: List[int] = []
+        self._pods: Dict[int, Dict[str, _PodEntry]] = {}  # row -> pod-key -> entry
+
+        self._alloc_masters()
+        self._dirty_rows: set = set()
+        self._full_upload = True
+        self._device: Optional[DeviceSnapshot] = None
+        self.generation = 0  # bumped on every mutation
+
+    # -- master allocation / growth ---------------------------------------
+
+    def _alloc_masters(self) -> None:
+        c = self.cfg
+        n = c.n_cap
+        self.m_valid = np.zeros(n, np.bool_)
+        self.m_unsched = np.zeros(n, np.bool_)
+        self.m_alloc = np.zeros((n, c.r_cap), np.int32)
+        self.m_req = np.zeros((n, c.r_cap), np.int32)
+        self.m_nonzero = np.zeros((n, c.r_cap), np.int32)
+        self.m_label_vals = np.full((n, c.k_cap), -1, np.int32)
+        self.m_label_num = np.full((n, c.k_cap), np.iinfo(np.int32).min, np.int32)
+        self.m_taint_key = np.full((n, c.taints_max), -1, np.int32)
+        self.m_taint_val = np.zeros((n, c.taints_max), np.int32)
+        self.m_taint_eff = np.zeros((n, c.taints_max), np.int32)
+        self.m_sel_counts = np.zeros((n, c.s_cap), np.int32)
+        self.m_eterm_w = np.zeros((n, c.t_cap), np.float32)
+        self.m_eterm_topo = np.full(c.t_cap, -1, np.int32)
+        self.m_eterm_kind = np.full(c.t_cap, -1, np.int32)
+        self.m_port_counts = np.zeros((n, c.pv_cap), np.int32)
+        self.m_image_bytes = np.zeros((n, c.im_cap), np.float32)
+        self.m_avoid = np.zeros((n, c.av_cap), np.bool_)
+
+    def _grow(self, **caps: int) -> None:
+        """Grow one or more capacities; copies masters, forces full upload."""
+        old = {
+            "m_valid": self.m_valid,
+            "m_unsched": self.m_unsched,
+            "m_alloc": self.m_alloc,
+            "m_req": self.m_req,
+            "m_nonzero": self.m_nonzero,
+            "m_label_vals": self.m_label_vals,
+            "m_label_num": self.m_label_num,
+            "m_taint_key": self.m_taint_key,
+            "m_taint_val": self.m_taint_val,
+            "m_taint_eff": self.m_taint_eff,
+            "m_sel_counts": self.m_sel_counts,
+            "m_eterm_w": self.m_eterm_w,
+            "m_eterm_topo": self.m_eterm_topo,
+            "m_eterm_kind": self.m_eterm_kind,
+            "m_port_counts": self.m_port_counts,
+            "m_image_bytes": self.m_image_bytes,
+            "m_avoid": self.m_avoid,
+        }
+        self.cfg = replace(self.cfg, **caps)
+        self._alloc_masters()
+        for name, arr in old.items():
+            dst = getattr(self, name)
+            sl = tuple(slice(0, s) for s in arr.shape)
+            dst[sl] = arr
+        self._full_upload = True
+
+    def _ensure_cap(self, attr: str, needed: int) -> None:
+        cur = getattr(self.cfg, attr)
+        if needed <= cur:
+            return
+        new = cur
+        while new < needed:
+            new *= 2
+        self._grow(**{attr: new})
+
+    # -- vocab helpers ------------------------------------------------------
+
+    def intern_key(self, key: str) -> int:
+        i = self.key_vocab.intern(key)
+        self._ensure_cap("k_cap", len(self.key_vocab))
+        return i
+
+    def intern_val(self, val: str) -> int:
+        i = self.val_vocab.intern(val)
+        self._ensure_cap("v_cap", len(self.val_vocab))
+        return i
+
+    def intern_resource(self, name: str) -> int:
+        """Resource name -> column index (base resources fixed)."""
+        base = {CPU: RES_CPU, MEMORY: RES_MEM, EPHEMERAL_STORAGE: RES_STORAGE, PODS: RES_PODS}
+        if name in base:
+            return base[name]
+        i = N_BASE_RES + self.res_vocab.intern(name)
+        self._ensure_cap("r_cap", N_BASE_RES + len(self.res_vocab))
+        return i
+
+    def intern_predicate(self, namespaces: FrozenSet[str], sel: LabelSelector) -> int:
+        pred = PodPredicate(namespaces, sel)
+        known = self.sel_vocab.get(pred)
+        if known >= 0:
+            return known
+        sid = self.sel_vocab.intern(pred)
+        self._ensure_cap("s_cap", len(self.sel_vocab))
+        # back-fill counts for already-placed pods (one host scan, amortised)
+        for row, pods in self._pods.items():
+            cnt = sum(
+                1 for e in pods.values() if pred.matches(e.namespace, e.labels)
+            )
+            if cnt:
+                self.m_sel_counts[row, sid] = cnt
+                self._dirty_rows.add(row)
+        self.generation += 1
+        return sid
+
+    def intern_eterm(self, pred: PodPredicate, topo_key: str, kind: int) -> int:
+        key_id = self.intern_key(topo_key)
+        et = ETerm(pred, key_id, kind)
+        known = self.eterm_vocab.get(et)
+        if known >= 0:
+            return known
+        tid = self.eterm_vocab.intern(et)
+        self._ensure_cap("t_cap", len(self.eterm_vocab))
+        self.m_eterm_topo[tid] = key_id
+        self.m_eterm_kind[tid] = kind
+        self.generation += 1
+        return tid
+
+    def intern_port(self, proto: str, port: int) -> int:
+        i = self.port_vocab.intern((proto, port))
+        self._ensure_cap("pv_cap", len(self.port_vocab))
+        return i
+
+    def intern_image(self, name: str) -> int:
+        i = self.image_vocab.intern(name)
+        self._ensure_cap("im_cap", len(self.image_vocab))
+        return i
+
+    def intern_avoid(self, ref: str) -> int:
+        i = self.avoid_vocab.intern(ref)
+        self._ensure_cap("av_cap", len(self.avoid_vocab))
+        return i
+
+    # -- resource encoding ---------------------------------------------------
+
+    def encode_resources(self, rl: ResourceList, ceil: bool) -> np.ndarray:
+        cols = []
+        for name, val in rl.items():
+            col = self.intern_resource(name)  # may grow r_cap
+            if name in (CPU, PODS):
+                u = int(min(val, int(I32_MAX)))
+            else:
+                u = _to_col_units(name, val, ceil)
+            cols.append((col, u))
+        out = np.zeros(self.cfg.r_cap, np.int32)
+        for col, u in cols:
+            out[col] = u
+        return out
+
+    # -- node lifecycle ------------------------------------------------------
+
+    def row_of(self, node_name: str) -> int:
+        return self._row_by_name.get(node_name, -1)
+
+    def add_node(self, node: v1.Node) -> int:
+        name = node.metadata.name
+        if name in self._row_by_name:
+            return self.update_node(node)
+        if self._free_rows:
+            row = self._free_rows.pop()
+        else:
+            row = len(self.row_names)
+            self.row_names.append(None)
+            self._ensure_cap("n_cap", len(self.row_names))
+        self.row_names[row] = name
+        self._row_by_name[name] = row
+        self._pods.setdefault(row, {})
+        self._write_node_row(row, node)
+        return row
+
+    def update_node(self, node: v1.Node) -> int:
+        row = self._row_by_name[node.metadata.name]
+        self._write_node_row(row, node)
+        return row
+
+    def _write_node_row(self, row: int, node: v1.Node) -> None:
+        c = self.cfg
+        self.m_valid[row] = True
+        self.m_unsched[row] = node.spec.unschedulable
+        alloc = self.encode_resources(node.allocatable(), ceil=False)
+        self.m_alloc[row, : len(alloc)] = alloc
+        # labels — metadata.name is matchable as a field selector; expose it
+        # as a pseudo-label so matchFields shares the label path.
+        self.m_label_vals[row, :] = -1
+        self.m_label_num[row, :] = np.iinfo(np.int32).min
+        labels = dict(node.metadata.labels)
+        labels.setdefault("kubernetes.io/hostname", node.metadata.name)
+        for k, v in labels.items():
+            ki = self.intern_key(k)
+            vi = self.intern_val(v)
+            self.m_label_vals[row, ki] = vi
+            try:
+                self.m_label_num[row, ki] = int(v)
+            except ValueError:
+                pass
+        # taints
+        taints = node.spec.taints[: c.taints_max]
+        self.m_taint_key[row, :] = -1
+        for i, t in enumerate(taints):
+            self.m_taint_key[row, i] = self.intern_key(t.key)
+            self.m_taint_val[row, i] = self.intern_val(t.value)
+            self.m_taint_eff[row, i] = _EFFECT_CODES.get(t.effect, EFFECT_NO_SCHEDULE)
+        # images
+        self.m_image_bytes[row, :] = 0.0
+        for img in node.status.images:
+            for nm in img.names:
+                ii = self.intern_image(nm)
+                self.m_image_bytes[row, ii] = float(img.size_bytes)
+        # avoid-pods annotation: comma-separated "Kind/name" controller refs
+        # (simplified AvoidPods encoding; reference uses a JSON annotation,
+        # v1helper.GetAvoidPodsFromNodeAnnotations).
+        self.m_avoid[row, :] = False
+        ann = node.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods", "")
+        for ref in filter(None, (r.strip() for r in ann.split(","))):
+            ai = self.intern_avoid(ref)
+            self.m_avoid[row, ai] = True
+        self._dirty_rows.add(row)
+        self.generation += 1
+
+    def remove_node(self, node_name: str) -> None:
+        row = self._row_by_name.pop(node_name, None)
+        if row is None:
+            return
+        self.row_names[row] = None
+        self._free_rows.append(row)
+        self._pods[row] = {}
+        self.m_valid[row] = False
+        self.m_sel_counts[row, :] = 0
+        self.m_eterm_w[row, :] = 0
+        self.m_req[row, :] = 0
+        self.m_nonzero[row, :] = 0
+        self.m_port_counts[row, :] = 0
+        self._dirty_rows.add(row)
+        self.generation += 1
+
+    # -- pod lifecycle -------------------------------------------------------
+
+    def _pod_eterms(self, pod: v1.Pod) -> Tuple[List[int], List[float]]:
+        """Intern the anti/affinity terms *carried by* this pod."""
+        ids: List[int] = []
+        ws: List[float] = []
+        aff = pod.spec.affinity
+        ns = pod.metadata.namespace
+        if aff is None:
+            return ids, ws
+
+        def pred_of(term: v1.PodAffinityTerm) -> PodPredicate:
+            nss = frozenset(term.namespaces) if term.namespaces else frozenset({ns})
+            return PodPredicate(nss, term.label_selector or LabelSelector())
+
+        if aff.pod_anti_affinity:
+            for term in aff.pod_anti_affinity.required:
+                ids.append(self.intern_eterm(pred_of(term), term.topology_key, ETERM_ANTI_REQ))
+                ws.append(1.0)
+            for wt in aff.pod_anti_affinity.preferred:
+                ids.append(
+                    self.intern_eterm(pred_of(wt.term), wt.term.topology_key, ETERM_ANTI_PREF)
+                )
+                ws.append(float(wt.weight))
+        if aff.pod_affinity:
+            for term in aff.pod_affinity.required:
+                ids.append(self.intern_eterm(pred_of(term), term.topology_key, ETERM_AFF_REQ))
+                ws.append(1.0)
+            for wt in aff.pod_affinity.preferred:
+                ids.append(
+                    self.intern_eterm(pred_of(wt.term), wt.term.topology_key, ETERM_AFF_PREF)
+                )
+                ws.append(float(wt.weight))
+        return ids, ws
+
+    def add_pod(self, node_name: str, pod: v1.Pod) -> None:
+        row = self._row_by_name.get(node_name)
+        if row is None:
+            raise KeyError(f"unknown node {node_name}")
+        from ..api.objects import compute_pod_resource_request, pod_host_ports
+
+        req = self.encode_resources(compute_pod_resource_request(pod), ceil=True)
+        nz = self.encode_resources(
+            compute_pod_resource_request(pod, non_zero=True), ceil=True
+        )
+        req = zpad(req, self.cfg.r_cap)
+        nz = zpad(nz, self.cfg.r_cap)
+        req[RES_PODS] = 1
+        nz[RES_PODS] = 1
+        eids, ews = self._pod_eterms(pod)
+        pids = [self.intern_port(proto, port) for (_, proto, port) in pod_host_ports(pod)]
+        entry = _PodEntry(
+            namespace=pod.metadata.namespace,
+            labels=dict(pod.metadata.labels),
+            req=req,
+            nonzero=nz,
+            eterm_ids=eids,
+            eterm_ws=ews,
+            port_ids=pids,
+            match_cache_len=len(self.sel_vocab),
+            match_vec=self._match_vec(pod.metadata.namespace, pod.metadata.labels),
+        )
+        self._pods[row][pod.metadata.key] = entry
+        self.m_req[row, : len(req)] += req
+        self.m_nonzero[row, : len(nz)] += nz
+        for i, mv in enumerate(entry.match_vec):
+            if mv:
+                self.m_sel_counts[row, i] += 1
+        for tid, w in zip(eids, ews):
+            self.m_eterm_w[row, tid] += w
+        for pid in pids:
+            self.m_port_counts[row, pid] += 1
+        self._dirty_rows.add(row)
+        self.generation += 1
+
+    def remove_pod(self, node_name: str, pod_key: str) -> None:
+        row = self._row_by_name.get(node_name)
+        if row is None:
+            return
+        entry = self._pods[row].pop(pod_key, None)
+        if entry is None:
+            return
+        r = zpad(entry.req, self.cfg.r_cap)
+        z = zpad(entry.nonzero, self.cfg.r_cap)
+        self.m_req[row, :] -= r
+        self.m_nonzero[row, :] -= z
+        for i, mv in enumerate(entry.match_vec):
+            if mv:
+                self.m_sel_counts[row, i] -= 1
+        # predicates interned after this pod was added were back-filled by
+        # intern_predicate's scan, which saw this pod — account for them too.
+        for sid in range(entry.match_cache_len, len(self.sel_vocab)):
+            if self.sel_vocab.items[sid].matches(entry.namespace, entry.labels):
+                self.m_sel_counts[row, sid] -= 1
+        for tid, w in zip(entry.eterm_ids, entry.eterm_ws):
+            self.m_eterm_w[row, tid] -= w
+        for pid in entry.port_ids:
+            self.m_port_counts[row, pid] -= 1
+        self._dirty_rows.add(row)
+        self.generation += 1
+
+    def _match_vec(self, namespace: str, labels: Dict[str, str]) -> np.ndarray:
+        out = np.zeros(len(self.sel_vocab), np.bool_)
+        for i, pred in enumerate(self.sel_vocab.items):
+            out[i] = pred.matches(namespace, labels)
+        return out
+
+    # -- device sync ---------------------------------------------------------
+
+    def _masters(self) -> DeviceSnapshot:
+        return DeviceSnapshot(
+            valid=self.m_valid,
+            unschedulable=self.m_unsched,
+            allocatable=self.m_alloc,
+            requested=self.m_req,
+            nonzero_req=self.m_nonzero,
+            label_vals=self.m_label_vals,
+            label_numvals=self.m_label_num,
+            taint_key=self.m_taint_key,
+            taint_val=self.m_taint_val,
+            taint_effect=self.m_taint_eff,
+            sel_counts=self.m_sel_counts,
+            eterm_w=self.m_eterm_w,
+            eterm_topo_key=self.m_eterm_topo,
+            eterm_kind=self.m_eterm_kind,
+            port_counts=self.m_port_counts,
+            image_bytes=self.m_image_bytes,
+            avoid=self.m_avoid,
+        )
+
+    def flush(self) -> DeviceSnapshot:
+        """Return the device snapshot, applying pending row deltas.
+
+        Dirty-row scatter indices are padded to the next power of two so only
+        O(log N) distinct update programs are ever compiled; out-of-range pad
+        indices are dropped by the scatter. Capacity growth or first use
+        forces a full upload (the cold-start path, SURVEY.md §5 failure
+        recovery: device memory is a rebuildable cache).
+        """
+        masters = self._masters()
+        if self._device is None or self._full_upload:
+            self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
+            self._full_upload = False
+            self._dirty_rows.clear()
+            return self._device
+        if not self._dirty_rows:
+            return self._device
+        rows = sorted(self._dirty_rows)
+        self._dirty_rows.clear()
+        pad = 1
+        while pad < len(rows):
+            pad *= 2
+        n_cap = self.cfg.n_cap
+        idx = np.full(pad, n_cap, np.int32)  # OOB pad rows -> dropped
+        idx[: len(rows)] = rows
+        sel = idx.clip(0, n_cap - 1)
+
+        updates = DeviceSnapshot(
+            **{
+                name: jnp.asarray(
+                    getattr(masters, name)
+                    if name in _GLOBAL_FIELDS
+                    else np.ascontiguousarray(getattr(masters, name)[sel])
+                )
+                for name in DeviceSnapshot._fields
+            }
+        )
+        self._device = _scatter_rows(self._device, jnp.asarray(idx), updates)
+        return self._device
+
+    def invalidate_device(self) -> None:
+        self._full_upload = True
+
+
+# Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata
+# columns, replaced wholesale on flush instead of row-scattered).
+_GLOBAL_FIELDS = frozenset({"eterm_topo_key", "eterm_kind"})
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(snap: DeviceSnapshot, idx, updates: DeviceSnapshot) -> DeviceSnapshot:
+    out = {}
+    for name in DeviceSnapshot._fields:
+        dst = getattr(snap, name)
+        src = getattr(updates, name)
+        if name in _GLOBAL_FIELDS:
+            out[name] = src
+        else:
+            out[name] = dst.at[idx].set(src, mode="drop")
+    return DeviceSnapshot(**out)
