@@ -1,0 +1,9 @@
+"""TPU-native kube-scheduler.
+
+Public surface: Scheduler (top loop), KubeSchedulerConfiguration,
+GenericScheduler (host algorithm), the framework plugin API, cache & queue.
+"""
+
+from .config import KubeSchedulerConfiguration, ProfileConfig  # noqa: F401
+from .core import FitError, GenericScheduler, ScheduleResult  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401

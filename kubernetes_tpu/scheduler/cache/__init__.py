@@ -1,0 +1,4 @@
+"""Scheduler cache: NodeInfo aggregates, assume/expire protocol, snapshots."""
+
+from .nodeinfo import NodeInfo, Snapshot  # noqa: F401
+from .cache import SchedulerCache  # noqa: F401

@@ -1,0 +1,232 @@
+"""Scheduler cache: aggregated live cluster state + assume/expire protocol.
+
+Host twin of reference pkg/scheduler/internal/cache/cache.go:59 with the
+TPU-critical addition: every mutation is forwarded to the columnar
+SnapshotEncoder, so the HBM-resident snapshot is the same delta stream the
+host NodeInfos see (the generation-number incremental-snapshot idea of
+UpdateSnapshot, cache.go:203-303, realised as device scatters).
+
+Assume protocol (cache.go:344 AssumePod / FinishBinding / ForgetPod, 30s TTL
+wired at scheduler.go:240): optimistic placement before the API bind lands;
+confirmed by the informer's scheduled-pod Add, expired by a janitor loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ...api import objects as v1
+from ...ops.encoding import EncodingConfig, SnapshotEncoder
+from .nodeinfo import NodeInfo, Snapshot
+
+
+@dataclass
+class _AssumedInfo:
+    pod: v1.Pod
+    node_name: str
+    deadline: Optional[float]  # None until finish_binding arms the TTL
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        ttl_seconds: float = 30.0,
+        encoder: Optional[SnapshotEncoder] = None,
+        encoding_config: Optional[EncodingConfig] = None,
+    ):
+        self.lock = threading.RLock()
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._pod_to_node: Dict[str, str] = {}
+        self._assumed: Dict[str, _AssumedInfo] = {}
+        self._ttl = ttl_seconds
+        self.encoder = encoder or SnapshotEncoder(encoding_config)
+        self._generation = 0
+        self._stop = threading.Event()
+        self._janitor: Optional[threading.Thread] = None
+
+    # -- nodes --------------------------------------------------------------
+
+    def add_node(self, node: v1.Node) -> None:
+        with self.lock:
+            name = node.metadata.name
+            ni = self._nodes.get(name)
+            if ni is None:
+                ni = NodeInfo(node)
+                self._nodes[name] = ni
+            else:
+                ni.set_node(node)
+            self._bump(ni)
+            self.encoder.add_node(node)
+
+    def update_node(self, node: v1.Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, node_name: str) -> None:
+        with self.lock:
+            self._nodes.pop(node_name, None)
+            self.encoder.remove_node(node_name)
+            self._generation += 1
+
+    # -- pods ---------------------------------------------------------------
+
+    def add_pod(self, pod: v1.Pod) -> None:
+        """A scheduled pod appeared via the informer. Confirms the assume if
+        one is outstanding (expired assumes re-add cleanly)."""
+        key = pod.metadata.key
+        with self.lock:
+            a = self._assumed.pop(key, None)
+            if a is not None:
+                if a.node_name == pod.spec.node_name:
+                    # confirmation: host+device state already reflect it;
+                    # swap the stored pod for the API's copy
+                    ni = self._nodes.get(a.node_name)
+                    if ni is not None:
+                        ni.remove_pod(key)
+                        ni.add_pod(pod)
+                        self._bump(ni)
+                    self._pod_to_node[key] = pod.spec.node_name
+                    return
+                # scheduled somewhere else than assumed: undo and re-add
+                self._remove_pod_internal(key, a.node_name)
+            self._add_pod_internal(pod)
+
+    def update_pod(self, pod: v1.Pod) -> None:
+        key = pod.metadata.key
+        with self.lock:
+            old_node = self._pod_to_node.get(key)
+            if old_node is not None:
+                self._remove_pod_internal(key, old_node)
+            if pod.spec.node_name:
+                self._add_pod_internal(pod)
+
+    def remove_pod(self, pod: v1.Pod) -> None:
+        key = pod.metadata.key
+        with self.lock:
+            self._assumed.pop(key, None)
+            node = self._pod_to_node.get(key)
+            if node is not None:
+                self._remove_pod_internal(key, node)
+
+    def _add_pod_internal(self, pod: v1.Pod) -> None:
+        node = pod.spec.node_name
+        ni = self._nodes.get(node)
+        if ni is None:
+            # pod on unknown node: track mapping only (reference logs this)
+            self._pod_to_node[pod.metadata.key] = node
+            return
+        ni.add_pod(pod)
+        self._bump(ni)
+        self._pod_to_node[pod.metadata.key] = node
+        self.encoder.add_pod(node, pod)
+
+    def _remove_pod_internal(self, key: str, node: str) -> None:
+        ni = self._nodes.get(node)
+        if ni is not None:
+            if ni.remove_pod(key) is not None:
+                self._bump(ni)
+                self.encoder.remove_pod(node, key)
+        self._pod_to_node.pop(key, None)
+
+    # -- assume protocol -----------------------------------------------------
+
+    def assume_pod(self, pod: v1.Pod, node_name: str) -> None:
+        key = pod.metadata.key
+        with self.lock:
+            if key in self._assumed or key in self._pod_to_node:
+                raise ValueError(f"pod {key} already assumed/added")
+            assumed = pod.deep_copy()
+            assumed.spec.node_name = node_name
+            self._add_pod_internal(assumed)
+            self._assumed[key] = _AssumedInfo(assumed, node_name, None)
+
+    def finish_binding(self, pod: v1.Pod) -> None:
+        """Arms the expiry TTL (cache.go FinishBinding)."""
+        with self.lock:
+            a = self._assumed.get(pod.metadata.key)
+            if a is not None:
+                a.deadline = time.monotonic() + self._ttl
+
+    def forget_pod(self, pod: v1.Pod) -> None:
+        with self.lock:
+            a = self._assumed.pop(pod.metadata.key, None)
+            if a is not None:
+                self._remove_pod_internal(pod.metadata.key, a.node_name)
+
+    def is_assumed(self, pod_key: str) -> bool:
+        with self.lock:
+            return pod_key in self._assumed
+
+    def cleanup_expired(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.monotonic()
+        with self.lock:
+            expired = [
+                k
+                for k, a in self._assumed.items()
+                if a.deadline is not None and a.deadline < now
+            ]
+            for k in expired:
+                a = self._assumed.pop(k)
+                self._remove_pod_internal(k, a.node_name)
+            return len(expired)
+
+    def start_janitor(self, period: float = 1.0) -> None:
+        if self._janitor is not None:
+            return
+        def loop():
+            while not self._stop.wait(period):
+                self.cleanup_expired()
+        self._janitor = threading.Thread(target=loop, daemon=True, name="cache-janitor")
+        self._janitor.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- snapshots ----------------------------------------------------------
+
+    def _bump(self, ni: NodeInfo) -> None:
+        self._generation += 1
+        ni.generation = self._generation
+
+    def update_snapshot(self) -> Snapshot:
+        """Host snapshot for oracle/fallback/preemption paths. NodeInfos are
+        cloned so the cycle sees immutable state (snapshot.go semantics)."""
+        with self.lock:
+            snap = Snapshot([ni.clone() for ni in self._nodes.values()])
+            snap.generation = self._generation
+            return snap
+
+    def device_snapshot(self):
+        """Flush pending deltas, return HBM-resident DeviceSnapshot."""
+        with self.lock:
+            return self.encoder.flush()
+
+    @property
+    def node_count(self) -> int:
+        with self.lock:
+            return len(self._nodes)
+
+    def pod_count(self) -> int:
+        with self.lock:
+            return sum(len(ni.pods) for ni in self._nodes.values())
+
+    def node_names(self) -> List[str]:
+        with self.lock:
+            return list(self._nodes.keys())
+
+    def get_node_info(self, name: str) -> Optional[NodeInfo]:
+        with self.lock:
+            return self._nodes.get(name)
+
+    def dump(self) -> dict:
+        """Debugger support (internal/cache/debugger): cache contents."""
+        with self.lock:
+            return {
+                "nodes": {
+                    n: [p.metadata.key for p in ni.pods]
+                    for n, ni in self._nodes.items()
+                },
+                "assumed": sorted(self._assumed.keys()),
+            }

@@ -1,0 +1,122 @@
+"""NodeInfo: per-node aggregate state + the per-cycle Snapshot.
+
+Host twin of reference pkg/scheduler/nodeinfo/node_info.go:48 (NodeInfo,
+Resource, AddPod/RemovePod/calculateResource) and
+internal/cache/snapshot.go:31. The host plugins (oracle/fallback path)
+consume these; the device path consumes the columnar encoding built from the
+same mutations (ops/encoding.py) — both are fed by SchedulerCache so they
+cannot drift.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...api import objects as v1
+from ...api.resources import ResourceList
+from ...api.objects import compute_pod_resource_request, pod_host_ports
+
+
+class NodeInfo:
+    def __init__(self, node: Optional[v1.Node] = None):
+        self.node: Optional[v1.Node] = node
+        self.pods: List[v1.Pod] = []
+        self.pods_with_affinity: List[v1.Pod] = []
+        self.requested = ResourceList()
+        self.non_zero_requested = ResourceList()
+        self.allocatable = node.allocatable() if node else ResourceList()
+        self.used_ports: Dict[Tuple[str, str, int], int] = {}
+        self.generation: int = 0
+
+    def set_node(self, node: v1.Node) -> None:
+        self.node = node
+        self.allocatable = node.allocatable()
+
+    def add_pod(self, pod: v1.Pod) -> None:
+        self.requested.add(compute_pod_resource_request(pod))
+        self.non_zero_requested.add(compute_pod_resource_request(pod, non_zero=True))
+        self.pods.append(pod)
+        if _has_affinity(pod):
+            self.pods_with_affinity.append(pod)
+        for hp in pod_host_ports(pod):
+            self.used_ports[hp] = self.used_ports.get(hp, 0) + 1
+
+    def remove_pod(self, pod_key: str) -> Optional[v1.Pod]:
+        for i, p in enumerate(self.pods):
+            if p.metadata.key == pod_key:
+                self.pods.pop(i)
+                self.requested.sub(compute_pod_resource_request(p))
+                self.non_zero_requested.sub(
+                    compute_pod_resource_request(p, non_zero=True)
+                )
+                self.pods_with_affinity = [
+                    q for q in self.pods_with_affinity if q.metadata.key != pod_key
+                ]
+                for hp in pod_host_ports(p):
+                    c = self.used_ports.get(hp, 0) - 1
+                    if c <= 0:
+                        self.used_ports.pop(hp, None)
+                    else:
+                        self.used_ports[hp] = c
+                return p
+        return None
+
+    @property
+    def name(self) -> str:
+        return self.node.metadata.name if self.node else ""
+
+    def clone(self) -> "NodeInfo":
+        c = NodeInfo()
+        c.node = self.node
+        c.pods = list(self.pods)
+        c.pods_with_affinity = list(self.pods_with_affinity)
+        c.requested = self.requested.copy()
+        c.non_zero_requested = self.non_zero_requested.copy()
+        c.allocatable = self.allocatable.copy()
+        c.used_ports = dict(self.used_ports)
+        c.generation = self.generation
+        return c
+
+
+def _has_affinity(pod: v1.Pod) -> bool:
+    a = pod.spec.affinity
+    return a is not None and (
+        a.pod_affinity is not None or a.pod_anti_affinity is not None
+    )
+
+
+class Snapshot:
+    """Immutable-per-cycle view (SharedLister): nodeInfoMap + ordered list +
+    affinity sublist (snapshot.go:31, HavePodsWithAffinityList)."""
+
+    def __init__(self, node_infos: Optional[List[NodeInfo]] = None):
+        self.node_info_list: List[NodeInfo] = node_infos or []
+        self.node_info_map: Dict[str, NodeInfo] = {
+            ni.name: ni for ni in self.node_info_list
+        }
+        self.have_pods_with_affinity_list: List[NodeInfo] = [
+            ni for ni in self.node_info_list if ni.pods_with_affinity
+        ]
+        self.generation: int = 0
+
+    @classmethod
+    def from_literals(
+        cls, pods: List[v1.Pod], nodes: List[v1.Node]
+    ) -> "Snapshot":
+        """Test-injection constructor (internalcache.NewSnapshot,
+        snapshot.go:51): build snapshot state from literal pods/nodes."""
+        infos = {n.metadata.name: NodeInfo(n) for n in nodes}
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name in infos:
+                infos[p.spec.node_name].add_pod(p)
+        return cls(list(infos.values()))
+
+    def get(self, node_name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(node_name)
+
+    def list_pods(self) -> List[v1.Pod]:
+        return [p for ni in self.node_info_list for p in ni.pods]
+
+    def __len__(self) -> int:
+        return len(self.node_info_list)

@@ -1,0 +1,60 @@
+"""ComponentConfig: versioned scheduler configuration.
+
+KubeSchedulerConfiguration equivalent (reference
+pkg/scheduler/apis/config/types.go:46,111,178): leader election, profiles,
+DisablePreemption, PercentageOfNodesToScore (0 ⇒ adaptive),
+Pod{Initial,Max}BackoffSeconds — plus the TPU-native knobs (device batch
+size/window, encoding capacities)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..client.leaderelection import LeaderElectionConfig
+from ..ops.encoding import EncodingConfig
+
+
+@dataclass
+class ProfileConfig:
+    scheduler_name: str = "default-scheduler"
+    # plugin overrides: None = algorithm-provider defaults (a PluginSet)
+    plugin_set: Optional[object] = None
+    score_weights: Optional[Dict[str, float]] = None
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    leader_election: Optional[LeaderElectionConfig] = None
+    disable_preemption: bool = False
+    percentage_of_nodes_to_score: int = 0  # 0 => adaptive 50 - n/125
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    profiles: List[ProfileConfig] = field(
+        default_factory=lambda: [ProfileConfig()]
+    )
+    hard_pod_affinity_weight: float = 1.0
+    # --- TPU-native section -------------------------------------------------
+    use_device: bool = True  # TPUBatchScore profile gate
+    device_batch_size: int = 128
+    device_batch_window: float = 0.0  # linger seconds to let bursts accumulate
+    encoding: EncodingConfig = field(default_factory=EncodingConfig)
+    bind_workers: int = 16
+    assume_ttl_seconds: float = 30.0
+
+    def validate(self) -> None:
+        if self.percentage_of_nodes_to_score < 0 or self.percentage_of_nodes_to_score > 100:
+            raise ValueError("percentageOfNodesToScore must be in [0,100]")
+        if self.pod_initial_backoff_seconds <= 0:
+            raise ValueError("podInitialBackoffSeconds must be positive")
+        if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
+            raise ValueError("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
+        if not self.profiles:
+            raise ValueError("at least one profile required")
+        names = [p.scheduler_name for p in self.profiles]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate profile schedulerName")
+        if self.device_batch_size < 1:
+            raise ValueError("device_batch_size must be >= 1")
+        if self.leader_election is not None:
+            self.leader_election.validate()

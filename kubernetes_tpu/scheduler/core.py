@@ -1,0 +1,181 @@
+"""Host generic scheduler: the fallback/oracle scheduling algorithm.
+
+Mirrors reference pkg/scheduler/core/generic_scheduler.go — Schedule(:150):
+snapshot → PreFilter → findNodesThatFitPod(:414) with adaptive node sampling
+numFeasibleNodesToFind(:390: 50−n/125 %, floor 5%, min 100) → PreScore →
+prioritizeNodes(:626) → selectHost(:235, reservoir max). The device lattice
+replaces this wholesale for encodable pods; this path serves overflow pods,
+preemption what-ifs and differential tests. The reference's 16-goroutine
+ParallelizeUntil fan-out is a plain loop here — the bulk path is on device.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import objects as v1
+from .cache.nodeinfo import NodeInfo, Snapshot
+from .framework.interface import Code, CycleState, Status, is_success
+from .framework.runtime import Framework
+
+MIN_FEASIBLE_NODES_TO_FIND = 100
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
+
+
+@dataclass
+class ScheduleResult:
+    suggested_host: str = ""
+    evaluated_nodes: int = 0
+    feasible_nodes: int = 0
+
+
+@dataclass
+class FitError(Exception):
+    pod: v1.Pod = None
+    num_all_nodes: int = 0
+    filtered_nodes_statuses: Dict[str, Status] = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        reasons: Dict[str, int] = {}
+        for st in self.filtered_nodes_statuses.values():
+            reasons[st.message] = reasons.get(st.message, 0) + 1
+        parts = [f"{cnt} {msg}" for msg, cnt in sorted(reasons.items())]
+        return (
+            f"0/{self.num_all_nodes} nodes are available: {', '.join(parts)}."
+        )
+
+
+def num_feasible_nodes_to_find(
+    num_all_nodes: int, percentage_of_nodes_to_score: int = 0
+) -> int:
+    """generic_scheduler.go:390-410."""
+    if (
+        num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND
+        or percentage_of_nodes_to_score >= 100
+    ):
+        return num_all_nodes
+    adaptive = percentage_of_nodes_to_score
+    if adaptive <= 0:
+        adaptive = int(50 - num_all_nodes / 125)
+        if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    num = num_all_nodes * adaptive // 100
+    return max(num, MIN_FEASIBLE_NODES_TO_FIND)
+
+
+class GenericScheduler:
+    def __init__(
+        self,
+        framework: Framework,
+        percentage_of_nodes_to_score: int = 0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.framework = framework
+        self.percentage = percentage_of_nodes_to_score
+        self._next_start_index = 0  # round-robin start (generic_scheduler.go:429)
+        self._rng = rng or random.Random(0)
+
+    # -- public -------------------------------------------------------------
+
+    def schedule(
+        self,
+        pod: v1.Pod,
+        snapshot: Snapshot,
+        state: Optional[CycleState] = None,
+        nominated_pods_for_node=None,
+    ) -> ScheduleResult:
+        """Raises FitError when no node fits (Schedule, :150)."""
+        state = state or CycleState()
+        st = self.framework.run_pre_filter_plugins(state, pod)
+        if not is_success(st):
+            raise FitError(pod=pod, num_all_nodes=len(snapshot), filtered_nodes_statuses={"*prefilter*": st})
+        feasible, statuses, evaluated = self.find_nodes_that_fit(
+            pod, snapshot, state, nominated_pods_for_node
+        )
+        if not feasible:
+            raise FitError(
+                pod=pod,
+                num_all_nodes=len(snapshot),
+                filtered_nodes_statuses=statuses,
+            )
+        if len(feasible) == 1:
+            return ScheduleResult(feasible[0].name, evaluated, 1)
+        self.framework.run_pre_score_plugins(state, pod, feasible)
+        names = [ni.name for ni in feasible]
+        totals = self.framework.run_score_plugins(state, pod, names, snapshot)
+        host = self.select_host(totals)
+        return ScheduleResult(host, evaluated, len(feasible))
+
+    def find_nodes_that_fit(
+        self,
+        pod: v1.Pod,
+        snapshot: Snapshot,
+        state: CycleState,
+        nominated_pods_for_node=None,
+    ) -> Tuple[List[NodeInfo], Dict[str, Status], int]:
+        """findNodesThatPassFilters (:429): adaptive sampling + round-robin
+        start index; per-node double-pass with nominated pods (:570)."""
+        all_nodes = snapshot.node_info_list
+        num_to_find = num_feasible_nodes_to_find(len(all_nodes), self.percentage)
+        feasible: List[NodeInfo] = []
+        statuses: Dict[str, Status] = {}
+        evaluated = 0
+        n = len(all_nodes)
+        for i in range(n):
+            ni = all_nodes[(self._next_start_index + i) % n]
+            evaluated += 1
+            st = self._pod_passes_filters_on_node(
+                state, pod, ni, nominated_pods_for_node
+            )
+            if is_success(st):
+                feasible.append(ni)
+                if len(feasible) >= num_to_find:
+                    break
+            else:
+                statuses[ni.name] = st
+        self._next_start_index = (self._next_start_index + evaluated) % max(n, 1)
+        return feasible, statuses, evaluated
+
+    def _pod_passes_filters_on_node(
+        self, state: CycleState, pod: v1.Pod, ni: NodeInfo, nominated_pods_for_node
+    ) -> Optional[Status]:
+        """podPassesFiltersOnNode (:570): when higher-priority nominated pods
+        exist for the node, filter twice — once assuming they are placed
+        (resource safety), once without (affinity safety)."""
+        nominated = (
+            nominated_pods_for_node(ni.name) if nominated_pods_for_node else []
+        )
+        # exclude the pod being scheduled itself (addNominatedPods skips
+        # same-UID pods) and lower-priority nominees
+        nominated = [
+            p
+            for p in nominated
+            if p.priority >= pod.priority and p.metadata.uid != pod.metadata.uid
+        ]
+        if nominated:
+            ni2 = ni.clone()
+            state2 = state.clone()
+            for np_ in nominated:
+                ni2.add_pod(np_)
+                self.framework.run_pre_filter_extension_add_pod(state2, pod, np_, ni2)
+            st = self.framework.run_filter_plugins(state2, pod, ni2)
+            if not is_success(st):
+                return st
+        return self.framework.run_filter_plugins(state, pod, ni)
+
+    def select_host(self, totals: Dict[str, float]) -> str:
+        """reservoir-sample among max scorers (selectHost, :235)."""
+        best = None
+        count = 0
+        for name, score in totals.items():
+            if best is None or score > totals[best]:
+                best, count = name, 1
+            elif score == totals[best]:
+                count += 1
+                if self._rng.randrange(count) == 0:
+                    best = name
+        if best is None:
+            raise ValueError("empty priority list")
+        return best

@@ -1,0 +1,111 @@
+"""Informer event handlers: API events → cache + queue (+ device deltas).
+
+Mirrors reference pkg/scheduler/eventhandlers.go:350-460 addAllEventHandlers:
+scheduled-pod events maintain the cache (and therefore the device snapshot,
+via the encoder); unscheduled-pod events maintain the queue; node events do
+both and flush the unschedulable queue with the matching event name
+(internal/queue/events.go) so pods retry when the cluster changes.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..api import objects as v1
+from .queue import events as qevents
+
+if TYPE_CHECKING:
+    from .scheduler import Scheduler
+
+
+def _is_scheduled(pod: v1.Pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def add_all_event_handlers(sched: "Scheduler") -> None:
+    pods = sched.informer_factory.informer("pods")
+    nodes = sched.informer_factory.informer("nodes")
+
+    # -- scheduled pods -> cache (eventhandlers.go: assignedPod filter) ------
+    pods.add_handler(
+        on_add=lambda p: _on_scheduled_add(sched, p),
+        on_update=lambda old, new: _on_scheduled_update(sched, old, new),
+        on_delete=lambda p: _on_scheduled_delete(sched, p),
+        filter_fn=_is_scheduled,
+    )
+
+    # -- unscheduled pods -> queue (responsibleForPod filter) ----------------
+    def responsible(pod: v1.Pod) -> bool:
+        return not _is_scheduled(pod) and sched.profiles.for_pod(pod) is not None
+
+    pods.add_handler(
+        on_add=lambda p: _on_pending_add(sched, p),
+        on_update=lambda old, new: _on_pending_update(sched, old, new),
+        on_delete=lambda p: sched.queue.delete(p),
+        filter_fn=responsible,
+    )
+
+    # -- nodes ---------------------------------------------------------------
+    nodes.add_handler(
+        on_add=lambda n: _on_node_add(sched, n),
+        on_update=lambda old, new: _on_node_update(sched, old, new),
+        on_delete=lambda n: _on_node_delete(sched, n),
+    )
+
+
+def _on_scheduled_add(sched, pod):
+    sched.cache.add_pod(pod)
+    sched.queue.delete(pod)  # it may still sit in a queue from a race
+    sched.queue.move_all_to_active_or_backoff(qevents.ASSIGNED_POD_ADD)
+
+
+def _on_scheduled_update(sched, old, new):
+    sched.cache.update_pod(new)
+    sched.queue.move_all_to_active_or_backoff(qevents.ASSIGNED_POD_UPDATE)
+
+
+def _on_scheduled_delete(sched, pod):
+    sched.cache.remove_pod(pod)
+    sched.queue.move_all_to_active_or_backoff(qevents.ASSIGNED_POD_DELETE)
+
+
+def _on_pending_add(sched, pod):
+    # skip pods this scheduler has already assumed (skipPodUpdate,
+    # eventhandlers.go: the optimistic cache owns them now)
+    if sched.cache.is_assumed(pod.metadata.key):
+        return
+    if pod.metadata.deletion_timestamp is None:
+        sched.queue.add(pod)
+
+
+def _on_pending_update(sched, old, new):
+    if sched.cache.is_assumed(new.metadata.key):
+        return
+    sched.queue.update(old, new)
+
+
+def _node_event(old: v1.Node, new: v1.Node) -> str:
+    if old.spec.unschedulable != new.spec.unschedulable:
+        return qevents.NODE_SPEC_UNSCHEDULABLE_CHANGE
+    if old.status.allocatable != new.status.allocatable:
+        return qevents.NODE_ALLOCATABLE_CHANGE
+    if old.metadata.labels != new.metadata.labels:
+        return qevents.NODE_LABEL_CHANGE
+    if old.spec.taints != new.spec.taints:
+        return qevents.NODE_TAINT_CHANGE
+    return qevents.NODE_CONDITION_CHANGE
+
+
+def _on_node_add(sched, node):
+    sched.cache.add_node(node)
+    sched.queue.move_all_to_active_or_backoff(qevents.NODE_ADD)
+
+
+def _on_node_update(sched, old, new):
+    sched.cache.update_node(new)
+    sched.queue.move_all_to_active_or_backoff(_node_event(old, new))
+
+
+def _on_node_delete(sched, node):
+    sched.cache.remove_node(node.metadata.name)
+    sched.queue.move_all_to_active_or_backoff(qevents.NODE_DELETE)

@@ -1,0 +1,28 @@
+"""Scheduler framework: plugin API, registry, host runtime.
+
+The host-side twin of the device lattice. Mirrors the reference's
+pkg/scheduler/framework/v1alpha1 plugin contract (interface.go): the same
+extension points, Status codes and CycleState, with host plugins serving
+three roles: (1) semantic oracle for differential tests against the kernels,
+(2) fallback path for pods whose spec overflows the device encoding,
+(3) preemption what-if evaluation.
+"""
+
+from .interface import (  # noqa: F401
+    Status,
+    Code,
+    CycleState,
+    Plugin,
+    FilterPlugin,
+    PreFilterPlugin,
+    ScorePlugin,
+    PostFilterPlugin,
+    PermitPlugin,
+    ReservePlugin,
+    BindPlugin,
+    QueueSortPlugin,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+)
+from .registry import Registry, default_registry  # noqa: F401
+from .runtime import Framework  # noqa: F401

@@ -1,0 +1,175 @@
+"""Plugin API: extension points, Status codes, CycleState.
+
+Mirrors reference pkg/scheduler/framework/v1alpha1/interface.go: the 11
+extension points (QueueSort, PreFilter, Filter, PreScore, Score+Normalize,
+Reserve, Permit, PreBind, Bind, PostBind, Unreserve) and the Status code
+lattice (interface.go:54-99). Plugins are plain Python classes; the device
+lattice implements the Filter/Score semantics of the north-star plugins in
+bulk, while these interfaces serve the host path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+
+
+class Code:
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4
+    SKIP = 5
+
+
+class Status:
+    """Plugin verdict. None is treated as Success (reference convention)."""
+
+    def __init__(self, code: int = Code.SUCCESS, message: str = ""):
+        self.code = code
+        self.message = message
+
+    @classmethod
+    def success(cls) -> Optional["Status"]:
+        return None
+
+    @classmethod
+    def unschedulable(cls, msg: str = "") -> "Status":
+        return cls(Code.UNSCHEDULABLE, msg)
+
+    @classmethod
+    def unresolvable(cls, msg: str = "") -> "Status":
+        return cls(Code.UNSCHEDULABLE_AND_UNRESOLVABLE, msg)
+
+    @classmethod
+    def error(cls, msg: str = "") -> "Status":
+        return cls(Code.ERROR, msg)
+
+    @classmethod
+    def wait(cls, msg: str = "") -> "Status":
+        return cls(Code.WAIT, msg)
+
+
+def is_success(s: Optional[Status]) -> bool:
+    return s is None or s.code == Code.SUCCESS
+
+
+def is_unschedulable(s: Optional[Status]) -> bool:
+    return s is not None and s.code in (
+        Code.UNSCHEDULABLE,
+        Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
+    )
+
+
+class CycleState:
+    """Per-scheduling-cycle key/value store passed through all plugins
+    (cycle_state.go:44). Clone() supports preemption what-if simulation."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._data: Dict[str, Any] = {}
+        self.skip_filter_plugins: Optional[set] = None
+
+    def read(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._data:
+                raise KeyError(key)
+            return self._data[key]
+
+    def write(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        c = CycleState()
+        with self._lock:
+            for k, v in self._data.items():
+                c._data[k] = v.clone() if hasattr(v, "clone") else v
+        return c
+
+
+class Plugin:
+    name: str = "Plugin"
+
+
+class QueueSortPlugin(Plugin):
+    def less(self, pod_info1, pod_info2) -> bool:
+        raise NotImplementedError
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
+        raise NotImplementedError
+
+    # PreFilterExtensions (AddPod/RemovePod) for preemption simulation
+    def add_pod(self, state: CycleState, pod_to_schedule, pod_to_add, node_info) -> Optional[Status]:
+        return None
+
+    def remove_pod(self, state: CycleState, pod_to_schedule, pod_to_remove, node_info) -> Optional[Status]:
+        return None
+
+    def has_extensions(self) -> bool:
+        return False
+
+
+class FilterPlugin(Plugin):
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class PreScorePlugin(Plugin):
+    def pre_score(self, state: CycleState, pod, nodes) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class ScorePlugin(Plugin):
+    def score(self, state: CycleState, pod, node_name: str) -> Tuple[int, Optional[Status]]:
+        raise NotImplementedError
+
+    def normalize_scores(self, state: CycleState, pod, scores: List[Tuple[str, float]]) -> Optional[Status]:
+        """In-place normalization; default = none."""
+        return None
+
+
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state: CycleState, pod, filtered_node_status) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class ReservePlugin(Plugin):
+    def reserve(self, state: CycleState, pod, node_name: str) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class UnreservePlugin(Plugin):
+    def unreserve(self, state: CycleState, pod, node_name: str) -> None:
+        raise NotImplementedError
+
+
+class PermitPlugin(Plugin):
+    def permit(self, state: CycleState, pod, node_name: str) -> Tuple[Optional[Status], float]:
+        """Returns (status, timeout_seconds). Wait status parks the pod."""
+        raise NotImplementedError
+
+
+class PreBindPlugin(Plugin):
+    def pre_bind(self, state: CycleState, pod, node_name: str) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class BindPlugin(Plugin):
+    def bind(self, state: CycleState, pod, node_name: str) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class PostBindPlugin(Plugin):
+    def post_bind(self, state: CycleState, pod, node_name: str) -> None:
+        raise NotImplementedError

@@ -1,0 +1,31 @@
+"""Host-side (in-tree) plugins.
+
+One class per registered plugin name of the reference
+(framework/plugins/registry.go:46-77). These are the oracle the device
+kernels are differentially tested against, the fallback path for pods that
+overflow the static device encoding, and the evaluation engine for
+preemption what-ifs.
+"""
+
+from .helpers import node_labels, pod_matches_node_selector  # noqa: F401
+from .noderesources import (  # noqa: F401
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+    NodeResourcesMostAllocated,
+    NodeResourcesBalancedAllocation,
+    RequestedToCapacityRatio,
+)
+from .nodeaffinity import NodeAffinityPlugin  # noqa: F401
+from .tainttoleration import TaintTolerationPlugin  # noqa: F401
+from .podtopologyspread import PodTopologySpreadPlugin  # noqa: F401
+from .interpodaffinity import InterPodAffinityPlugin  # noqa: F401
+from .misc import (  # noqa: F401
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    ImageLocality,
+    NodePreferAvoidPods,
+    PrioritySort,
+    DefaultBinder,
+    SelectorSpread,
+)

@@ -1,0 +1,102 @@
+"""Shared matching helpers used by several plugins.
+
+The semantics here are the single source of truth shared with the device
+encoder/kernels: node labels include a defaulted kubernetes.io/hostname
+pseudo-label (the encoder does the same, ops/encoding.py _write_node_row),
+and node-selector matching mirrors v1helper.MatchNodeSelectorTerms as used
+by reference nodeaffinity/node_affinity.go:54.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ....api import objects as v1
+from ....api.selectors import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+
+
+def node_labels(node: v1.Node) -> Dict[str, str]:
+    labels = dict(node.metadata.labels)
+    labels.setdefault("kubernetes.io/hostname", node.metadata.name)
+    return labels
+
+
+def _req_matches(labels: Dict[str, str], r: v1.NodeSelectorRequirement) -> bool:
+    has = r.key in labels
+    if r.operator == OP_IN:
+        return has and labels[r.key] in r.values
+    if r.operator == OP_NOT_IN:
+        return not (has and labels[r.key] in r.values)
+    if r.operator == OP_EXISTS:
+        return has
+    if r.operator == OP_DOES_NOT_EXIST:
+        return not has
+    if r.operator in (OP_GT, OP_LT):
+        if not has:
+            return False
+        try:
+            lv, rv = int(labels[r.key]), int(r.values[0])
+        except (ValueError, IndexError):
+            return False
+        return lv > rv if r.operator == OP_GT else lv < rv
+    return False
+
+
+def node_matches_term(node: v1.Node, term: v1.NodeSelectorTerm) -> bool:
+    """Empty term (no expressions, no fields) matches nothing."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    labels = node_labels(node)
+    for r in term.match_expressions:
+        if not _req_matches(labels, r):
+            return False
+    for mf in term.match_fields:
+        if mf.key != "metadata.name":
+            return False
+        if mf.operator == OP_IN:
+            if node.metadata.name not in mf.values:
+                return False
+        elif mf.operator == OP_NOT_IN:
+            if node.metadata.name in mf.values:
+                return False
+        else:
+            return False
+    return True
+
+
+def pod_matches_node_selector(pod: v1.Pod, node: v1.Node) -> bool:
+    """nodeSelector AND (OR over required nodeSelectorTerms) —
+    PodMatchesNodeSelectorAndAffinityTerms."""
+    labels = node_labels(node)
+    for k, val in pod.spec.node_selector.items():
+        if labels.get(k) != val:
+            return False
+    aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+    if aff and aff.required and aff.required.terms:
+        if not any(node_matches_term(node, t) for t in aff.required.terms):
+            return False
+    return True
+
+
+def term_namespaces(pod: v1.Pod, term: v1.PodAffinityTerm) -> frozenset:
+    return frozenset(term.namespaces) if term.namespaces else frozenset(
+        {pod.metadata.namespace}
+    )
+
+
+def pod_matches_term(
+    target: v1.Pod, source_pod: v1.Pod, term: v1.PodAffinityTerm
+) -> bool:
+    """Does `target` match `term` (owned by source_pod, for ns defaulting)?"""
+    if target.metadata.namespace not in term_namespaces(source_pod, term):
+        return False
+    if term.label_selector is None:
+        return False
+    return term.label_selector.matches(target.metadata.labels)

@@ -1,0 +1,238 @@
+"""InterPodAffinity: required/preferred (anti-)affinity over topology domains.
+
+Reference: framework/plugins/interpodaffinity/ (filtering.go:51-58,212,256 —
+the three topologyToMatchedTermCount maps built by scanning all nodes' pods;
+scoring.go:81-178,287-310 — ±weight accumulation over incoming AND existing
+pods' terms, max-|score| normalization).
+
+Semantics shared with the device kernel (ops/lattice.py):
+  * incoming required affinity term satisfied on node n iff its topology
+    domain has ≥1 matching existing pod, OR no pod anywhere matches and the
+    pod matches its own selector (first-pod carve-out) and n has the key;
+  * incoming required anti-affinity violated iff the domain has ≥1 match;
+  * existing pods' required anti-affinity violated iff an existing pod in the
+    same domain carries a term matching the incoming pod;
+  * score: +w per matching existing pod in domain for preferred affinity
+    (incoming and existing), −w for preferred anti-affinity, and existing
+    pods' REQUIRED affinity terms contribute hard_pod_affinity_weight.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ....api import objects as v1
+from ..interface import CycleState, FilterPlugin, PreFilterPlugin, ScorePlugin, Status
+from .helpers import node_labels, pod_matches_term, term_namespaces
+
+_STATE_KEY = "PreFilterInterPodAffinity"
+DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1.0
+
+
+class _AffinityState:
+    def __init__(self):
+        # per incoming required affinity term i: {topo value: count}
+        self.aff_counts: Dict[int, Dict[str, int]] = {}
+        self.aff_total: Dict[int, int] = {}
+        self.aff_self: Dict[int, bool] = {}
+        # per incoming required anti-affinity term i
+        self.anti_counts: Dict[int, Dict[str, int]] = {}
+        # existing pods' required anti-affinity terms matching incoming pod:
+        # {(topology_key): {topo value: count}}
+        self.existing_anti: Dict[str, Dict[str, int]] = {}
+
+    def clone(self):
+        c = _AffinityState()
+        c.aff_counts = {k: dict(v) for k, v in self.aff_counts.items()}
+        c.aff_total = dict(self.aff_total)
+        c.aff_self = dict(self.aff_self)
+        c.anti_counts = {k: dict(v) for k, v in self.anti_counts.items()}
+        c.existing_anti = {k: dict(v) for k, v in self.existing_anti.items()}
+        return c
+
+
+def _incoming_terms(pod: v1.Pod):
+    aff = pod.spec.affinity
+    req_aff = list(aff.pod_affinity.required) if aff and aff.pod_affinity else []
+    req_anti = (
+        list(aff.pod_anti_affinity.required) if aff and aff.pod_anti_affinity else []
+    )
+    return req_aff, req_anti
+
+
+def _existing_anti_terms(p: v1.Pod):
+    a = p.spec.affinity
+    if a and a.pod_anti_affinity:
+        return a.pod_anti_affinity.required
+    return ()
+
+
+class InterPodAffinityPlugin(PreFilterPlugin, FilterPlugin, ScorePlugin):
+    name = "InterPodAffinity"
+
+    def __init__(self, snapshot_getter=None, hard_pod_affinity_weight: float = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+        self._snapshot = snapshot_getter
+        self.hard_weight = hard_pod_affinity_weight
+
+    def has_extensions(self) -> bool:
+        return True
+
+    def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
+        s = _AffinityState()
+        req_aff, req_anti = _incoming_terms(pod)
+        for i, term in enumerate(req_aff):
+            s.aff_counts[i] = {}
+            s.aff_total[i] = 0
+            s.aff_self[i] = pod_matches_term(pod, pod, term)
+        for i in range(len(req_anti)):
+            s.anti_counts[i] = {}
+        snapshot = self._snapshot() if self._snapshot else None
+        if snapshot is not None:
+            for ni in snapshot.node_info_list:
+                if ni.node is None:
+                    continue
+                labels = node_labels(ni.node)
+                for p in ni.pods:
+                    for i, term in enumerate(req_aff):
+                        if pod_matches_term(p, pod, term):
+                            val = labels.get(term.topology_key)
+                            s.aff_total[i] += 1
+                            if val is not None:
+                                s.aff_counts[i][val] = s.aff_counts[i].get(val, 0) + 1
+                    for i, term in enumerate(req_anti):
+                        if pod_matches_term(p, pod, term):
+                            val = labels.get(term.topology_key)
+                            if val is not None:
+                                s.anti_counts[i][val] = s.anti_counts[i].get(val, 0) + 1
+                # existing pods' anti-affinity terms that match the incoming pod
+                for p in ni.pods_with_affinity:
+                    for term in _existing_anti_terms(p):
+                        if pod_matches_term(pod, p, term):
+                            val = labels.get(term.topology_key)
+                            if val is not None:
+                                d = s.existing_anti.setdefault(term.topology_key, {})
+                                d[val] = d.get(val, 0) + 1
+        state.write(_STATE_KEY, s)
+        return None
+
+    def add_pod(self, state, pod_to_schedule, pod_to_add, node_info):
+        self._update(state, pod_to_schedule, pod_to_add, node_info, +1)
+        return None
+
+    def remove_pod(self, state, pod_to_schedule, pod_to_remove, node_info):
+        self._update(state, pod_to_schedule, pod_to_remove, node_info, -1)
+        return None
+
+    def _update(self, state, pod, other, node_info, delta):
+        try:
+            s: _AffinityState = state.read(_STATE_KEY)
+        except KeyError:
+            return
+        if node_info.node is None:
+            return
+        labels = node_labels(node_info.node)
+        req_aff, req_anti = _incoming_terms(pod)
+        for i, term in enumerate(req_aff):
+            if pod_matches_term(other, pod, term):
+                val = labels.get(term.topology_key)
+                s.aff_total[i] = s.aff_total.get(i, 0) + delta
+                if val is not None:
+                    s.aff_counts[i][val] = s.aff_counts[i].get(val, 0) + delta
+        for i, term in enumerate(req_anti):
+            if pod_matches_term(other, pod, term):
+                val = labels.get(term.topology_key)
+                if val is not None:
+                    s.anti_counts[i][val] = s.anti_counts[i].get(val, 0) + delta
+        for term in _existing_anti_terms(other):
+            if pod_matches_term(pod, other, term):
+                val = labels.get(term.topology_key)
+                if val is not None:
+                    d = s.existing_anti.setdefault(term.topology_key, {})
+                    d[val] = d.get(val, 0) + delta
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        try:
+            s: _AffinityState = state.read(_STATE_KEY)
+        except KeyError:
+            return None
+        labels = node_labels(node_info.node)
+        req_aff, req_anti = _incoming_terms(pod)
+        for i, term in enumerate(req_aff):
+            val = labels.get(term.topology_key)
+            cnt = s.aff_counts.get(i, {}).get(val, 0) if val is not None else 0
+            if cnt > 0:
+                continue
+            if s.aff_total.get(i, 0) == 0 and s.aff_self.get(i) and val is not None:
+                continue  # first-pod carve-out
+            return Status.unschedulable("pod affinity not satisfied")
+        for i, term in enumerate(req_anti):
+            val = labels.get(term.topology_key)
+            if val is not None and s.anti_counts.get(i, {}).get(val, 0) > 0:
+                return Status.unschedulable("pod anti-affinity violated")
+        for topo_key, domains in s.existing_anti.items():
+            val = labels.get(topo_key)
+            if val is not None and domains.get(val, 0) > 0:
+                return Status.unschedulable(
+                    "existing pods' anti-affinity rules violated"
+                )
+        return None
+
+    # -- scoring -----------------------------------------------------------
+
+    def score(self, state, pod, node_name, snapshot=None):
+        """O(pods-on-relevant-nodes) walk mirroring the kernel's eterm +
+        preferred-term accumulation (scoring.go:81-178)."""
+        ni = snapshot.get(node_name)
+        labels = node_labels(ni.node)
+        total = 0.0
+        aff = pod.spec.affinity
+        pref_aff = list(aff.pod_affinity.preferred) if aff and aff.pod_affinity else []
+        pref_anti = (
+            list(aff.pod_anti_affinity.preferred)
+            if aff and aff.pod_anti_affinity
+            else []
+        )
+        # incoming pod's preferred terms vs all existing pods in same domain
+        for other_ni in snapshot.node_info_list:
+            if other_ni.node is None:
+                continue
+            olabels = node_labels(other_ni.node)
+            for wt in pref_aff:
+                val = labels.get(wt.term.topology_key)
+                if val is not None and olabels.get(wt.term.topology_key) == val:
+                    total += wt.weight * sum(
+                        1 for p in other_ni.pods if pod_matches_term(p, pod, wt.term)
+                    )
+            for wt in pref_anti:
+                val = labels.get(wt.term.topology_key)
+                if val is not None and olabels.get(wt.term.topology_key) == val:
+                    total -= wt.weight * sum(
+                        1 for p in other_ni.pods if pod_matches_term(p, pod, wt.term)
+                    )
+            # existing pods' terms vs incoming pod
+            for p in other_ni.pods_with_affinity:
+                a = p.spec.affinity
+                if a and a.pod_affinity:
+                    for term in a.pod_affinity.required:
+                        if self.hard_weight > 0 and pod_matches_term(pod, p, term):
+                            val = labels.get(term.topology_key)
+                            if val is not None and olabels.get(term.topology_key) == val:
+                                total += self.hard_weight
+                    for wt in a.pod_affinity.preferred:
+                        if pod_matches_term(pod, p, wt.term):
+                            val = labels.get(wt.term.topology_key)
+                            if val is not None and olabels.get(wt.term.topology_key) == val:
+                                total += wt.weight
+                if a and a.pod_anti_affinity:
+                    for wt in a.pod_anti_affinity.preferred:
+                        if pod_matches_term(pod, p, wt.term):
+                            val = labels.get(wt.term.topology_key)
+                            if val is not None and olabels.get(wt.term.topology_key) == val:
+                                total -= wt.weight
+        return total, None
+
+    def normalize_scores(self, state, pod, scores):
+        mx = max((abs(s) for _, s in scores), default=0.0)
+        for i, (n, s) in enumerate(scores):
+            scores[i] = (n, s / mx * 100.0 if mx > 0 else 0.0)
+        return None

@@ -1,0 +1,211 @@
+"""Small plugins: NodeName, NodePorts, NodeUnschedulable, ImageLocality,
+NodePreferAvoidPods, PrioritySort, DefaultBinder, SelectorSpread.
+
+References: nodename/node_name.go:59, nodeports/node_ports.go:36,
+nodeunschedulable/node_unschedulable.go:37, imagelocality/image_locality.go:47,
+nodepreferavoidpods/node_prefer_avoid_pods.go:39, queuesort/priority_sort.go:42,
+defaultbinder/default_binder.go:50, defaultpodtopologyspread/ (SelectorSpread).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ....api import objects as v1
+from ....api.objects import (
+    Binding,
+    Taint,
+    TAINT_NODE_UNSCHEDULABLE,
+    TAINT_NO_SCHEDULE,
+    pod_host_ports,
+    tolerations_tolerate_taint,
+)
+from ....api.selectors import selector_from_match_labels
+from ..interface import (
+    BindPlugin,
+    CycleState,
+    FilterPlugin,
+    PreFilterPlugin,
+    QueueSortPlugin,
+    ScorePlugin,
+    Status,
+)
+
+IMG_MIN_THRESHOLD = 23 * 1024 * 1024
+IMG_MAX_THRESHOLD = 1000 * 1024 * 1024
+
+
+class NodeName(FilterPlugin):
+    name = "NodeName"
+
+    def filter(self, state, pod, node_info) -> Optional[Status]:
+        if pod.spec.node_name and pod.spec.node_name != node_info.name:
+            return Status.unresolvable("node didn't match the requested hostname")
+        return None
+
+
+class NodePorts(PreFilterPlugin, FilterPlugin):
+    name = "NodePorts"
+    _STATE_KEY = "PreFilterNodePorts"
+
+    def pre_filter(self, state, pod) -> Optional[Status]:
+        state.write(self._STATE_KEY, pod_host_ports(pod))
+        return None
+
+    def filter(self, state, pod, node_info) -> Optional[Status]:
+        try:
+            want = state.read(self._STATE_KEY)
+        except KeyError:
+            want = pod_host_ports(pod)
+        for hp in want:
+            if node_info.used_ports.get(hp, 0) > 0:
+                return Status.unschedulable("node didn't have free ports")
+            # wildcard-IP overlap: 0.0.0.0 conflicts with any IP on same
+            # (proto, port) and vice versa
+            ip, proto, port = hp
+            for (uip, uproto, uport), c in node_info.used_ports.items():
+                if c > 0 and uproto == proto and uport == port and (
+                    ip == "0.0.0.0" or uip == "0.0.0.0" or uip == ip
+                ):
+                    return Status.unschedulable("node didn't have free ports")
+        return None
+
+
+class NodeUnschedulable(FilterPlugin):
+    name = "NodeUnschedulable"
+
+    def filter(self, state, pod, node_info) -> Optional[Status]:
+        if node_info.node.spec.unschedulable and not tolerations_tolerate_taint(
+            pod.spec.tolerations,
+            Taint(TAINT_NODE_UNSCHEDULABLE, "", TAINT_NO_SCHEDULE),
+        ):
+            return Status.unresolvable("node(s) were unschedulable")
+        return None
+
+
+class ImageLocality(ScorePlugin):
+    name = "ImageLocality"
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        total_nodes = max(len(snapshot.node_info_list), 1)
+        node_images = {}
+        for img in ni.node.status.images:
+            for nm in img.names:
+                node_images[nm] = img.size_bytes
+        total = 0.0
+        for c in pod.spec.containers:
+            if c.image and c.image in node_images:
+                spread = (
+                    sum(
+                        1
+                        for other in snapshot.node_info_list
+                        if any(
+                            c.image in im.names for im in other.node.status.images
+                        )
+                    )
+                    / total_nodes
+                )
+                total += node_images[c.image] * spread
+        score = (total - IMG_MIN_THRESHOLD) / (IMG_MAX_THRESHOLD - IMG_MIN_THRESHOLD) * 100.0
+        return max(0.0, min(100.0, score)), None
+
+
+class NodePreferAvoidPods(ScorePlugin):
+    name = "NodePreferAvoidPods"
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        ann = ni.node.metadata.annotations.get(
+            "scheduler.alpha.kubernetes.io/preferAvoidPods", ""
+        )
+        refs = {r.strip() for r in ann.split(",") if r.strip()}
+        ctrl = next(
+            (f"{r.kind}/{r.name}" for r in pod.metadata.owner_references if r.controller),
+            None,
+        )
+        return (0.0 if ctrl and ctrl in refs else 100.0), None
+
+
+class PrioritySort(QueueSortPlugin):
+    """priority desc, then FIFO timestamp (priority_sort.go:42-46)."""
+
+    name = "PrioritySort"
+
+    def less(self, pi1, pi2) -> bool:
+        p1, p2 = pi1.pod.priority, pi2.pod.priority
+        if p1 != p2:
+            return p1 > p2
+        return pi1.timestamp < pi2.timestamp
+
+
+class DefaultBinder(BindPlugin):
+    name = "DefaultBinder"
+
+    def __init__(self, server=None):
+        self._server = server
+
+    def bind(self, state, pod, node_name) -> Optional[Status]:
+        if self._server is None:
+            return Status.error("no API server")
+        try:
+            self._server.bind_pod(
+                Binding(
+                    pod_name=pod.metadata.name,
+                    pod_namespace=pod.metadata.namespace,
+                    pod_uid=pod.metadata.uid,
+                    target_node=node_name,
+                )
+            )
+        except Exception as e:  # Conflict / NotFound
+            return Status.error(str(e))
+        return None
+
+
+class SelectorSpread(ScorePlugin):
+    """DefaultPodTopologySpread: fewer same-controller pods → higher score,
+    zone-weighted 2/3 (default_pod_topology_spread.go:43,118).
+
+    Selectors come from Services/RCs/RSs/StatefulSets matching the pod; here
+    they are derived from a lister callable injected at construction."""
+
+    name = "DefaultPodTopologySpread"
+    ZONE_WEIGHT = 2.0 / 3.0
+    ZONE_KEY = "topology.kubernetes.io/zone"
+
+    def __init__(self, selectors_for_pod=None):
+        # callable(pod) -> list[LabelSelector]; defaults to owner-based
+        self._selectors = selectors_for_pod
+
+    def _pod_selectors(self, pod):
+        if self._selectors is not None:
+            return self._selectors(pod)
+        if pod.metadata.labels:
+            return [selector_from_match_labels(pod.metadata.labels)]
+        return []
+
+    def _count(self, pod, selectors, ni) -> int:
+        cnt = 0
+        for p in ni.pods:
+            if p.metadata.namespace != pod.metadata.namespace:
+                continue
+            if any(sel.matches(p.metadata.labels) for sel in selectors):
+                cnt += 1
+        return cnt
+
+    def score(self, state, pod, node_name, snapshot=None):
+        selectors = self._pod_selectors(pod)
+        if not selectors:
+            return 0.0, None
+        ni = snapshot.get(node_name)
+        return float(self._count(pod, selectors, ni)), None
+
+    def normalize_scores(self, state, pod, scores):
+        # raw = node match counts; invert & zone-weight like
+        # CalculateSpreadPriority's finalization
+        mx = max((s for _, s in scores), default=0.0)
+        node_score = {
+            n: ((mx - s) / mx * 100.0 if mx > 0 else 100.0) for n, s in scores
+        }
+        scores[:] = [(n, node_score[n]) for n, _ in scores]
+        return None

@@ -1,0 +1,40 @@
+"""NodeAffinity: required-term filter + preferred-term score.
+
+Reference: framework/plugins/nodeaffinity/node_affinity.go:54 (Filter via
+PodMatchesNodeSelectorAndAffinityTerms), :66-105 (Score = Σ weights of
+matched preferred terms, max-normalized by the framework)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..interface import CycleState, FilterPlugin, ScorePlugin, Status
+from .helpers import node_matches_term, pod_matches_node_selector
+
+
+class NodeAffinityPlugin(FilterPlugin, ScorePlugin):
+    name = "NodeAffinity"
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        if node_info.node is None:
+            return Status.error("node not found")
+        if not pod_matches_node_selector(pod, node_info.node):
+            return Status.unresolvable("node(s) didn't match node selector")
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        total = 0.0
+        if aff:
+            for pt in aff.preferred:
+                if pt.weight != 0 and node_matches_term(ni.node, pt.preference):
+                    total += pt.weight
+        return total, None
+
+    def normalize_scores(self, state, pod, scores):
+        mx = max((s for _, s in scores), default=0.0)
+        if mx > 0:
+            for i, (n, s) in enumerate(scores):
+                scores[i] = (n, s / mx * 100.0)
+        return None

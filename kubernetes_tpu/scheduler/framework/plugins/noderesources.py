@@ -1,0 +1,135 @@
+"""NodeResources plugins: Fit filter + the four scoring strategies.
+
+Reference: framework/plugins/noderesources/{fit,least_allocated,
+most_allocated,balanced_allocation,requested_to_capacity_ratio}.go.
+Score formulas normalized to 0..100 (MAX_NODE_SCORE) like the originals.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ....api.objects import compute_pod_resource_request
+from ....api.resources import CPU, MEMORY, PODS, ResourceList
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    PreFilterPlugin,
+    ScorePlugin,
+    Status,
+)
+
+_FIT_STATE_KEY = "PreFilterNodeResourcesFit"
+
+
+class NodeResourcesFit(PreFilterPlugin, FilterPlugin):
+    """fit.go:119 (PreFilter computes pod request once), fit.go:177-250
+    (Filter: insufficient if podRequest > allocatable - requested)."""
+
+    name = "NodeResourcesFit"
+
+    def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
+        state.write(_FIT_STATE_KEY, compute_pod_resource_request(pod))
+        return None
+
+    def has_extensions(self) -> bool:
+        return True
+
+    def add_pod(self, state, pod_to_schedule, pod_to_add, node_info):
+        return None  # request of pod being scheduled is unaffected
+
+    def remove_pod(self, state, pod_to_schedule, pod_to_remove, node_info):
+        return None
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        try:
+            req: ResourceList = state.read(_FIT_STATE_KEY)
+        except KeyError:
+            req = compute_pod_resource_request(pod)
+        alloc = node_info.allocatable
+        used = node_info.requested
+        # pods-count check (fit.go:205)
+        if len(node_info.pods) + 1 > alloc.get(PODS, 110):
+            return Status.unschedulable("Too many pods")
+        for name, want in req.items():
+            if want == 0:
+                continue
+            if want > alloc.get(name, 0) - used.get(name, 0):
+                return Status.unschedulable(f"Insufficient {name}")
+        return None
+
+
+def _fractions(pod, node_info) -> Tuple[float, float]:
+    """cpu/mem utilization fractions including the incoming pod's non-zero
+    request (least_allocated.go:77-99 semantics)."""
+    req = compute_pod_resource_request(pod, non_zero=True)
+    alloc = node_info.allocatable
+    used = node_info.non_zero_requested
+    out = []
+    for name in (CPU, MEMORY):
+        cap = max(alloc.get(name, 0), 1)
+        u = used.get(name, 0) + req.get(name, 0)
+        out.append(min(u / cap, 1.0))
+    return out[0], out[1]
+
+
+class NodeResourcesLeastAllocated(ScorePlugin):
+    """(cap-req)*100/cap averaged over cpu+memory (least_allocated.go:45)."""
+
+    name = "NodeResourcesLeastAllocated"
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        cpu_f, mem_f = _fractions(pod, ni)
+        return ((1.0 - cpu_f) * 100.0 + (1.0 - mem_f) * 100.0) / 2.0, None
+
+
+class NodeResourcesMostAllocated(ScorePlugin):
+    """req*100/cap averaged (most_allocated.go:75-102)."""
+
+    name = "NodeResourcesMostAllocated"
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        cpu_f, mem_f = _fractions(pod, ni)
+        return (cpu_f * 100.0 + mem_f * 100.0) / 2.0, None
+
+
+class NodeResourcesBalancedAllocation(ScorePlugin):
+    """(1 - |cpuFrac - memFrac|) * 100 (balanced_allocation.go:41)."""
+
+    name = "NodeResourcesBalancedAllocation"
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        cpu_f, mem_f = _fractions(pod, ni)
+        return (1.0 - abs(cpu_f - mem_f)) * 100.0, None
+
+
+class RequestedToCapacityRatio(ScorePlugin):
+    """Piecewise-linear function of utilization
+    (requested_to_capacity_ratio.go:33). Default shape {0%:0, 100%:10}
+    scaled to 0..100; custom shape points configurable."""
+
+    name = "RequestedToCapacityRatio"
+
+    def __init__(self, shape: Optional[List[Tuple[float, float]]] = None):
+        # (utilization %, score 0..10) points, sorted by utilization
+        self.shape = sorted(shape or [(0.0, 0.0), (100.0, 10.0)])
+
+    def _interp(self, util: float) -> float:
+        pts = self.shape
+        if util <= pts[0][0]:
+            return pts[0][1]
+        for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+            if util <= x1:
+                if x1 == x0:
+                    return y1
+                return y0 + (y1 - y0) * (util - x0) / (x1 - x0)
+        return pts[-1][1]
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        cpu_f, mem_f = _fractions(pod, ni)
+        util = (cpu_f + mem_f) / 2.0 * 100.0
+        return self._interp(util) * 10.0, None

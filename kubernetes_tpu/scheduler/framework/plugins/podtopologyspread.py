@@ -1,0 +1,158 @@
+"""PodTopologySpread: skew-bounded spreading over topology domains.
+
+Reference: framework/plugins/podtopologyspread/ (filtering.go:43-121 PreFilter
+match counts + min-match tracking; :285-333 Filter skew check;
+scoring.go:165-250 soft-constraint scoring).
+
+Semantics (shared exactly with the device kernel, ops/lattice.py spread_one):
+  * domain counts include only nodes matching the incoming pod's
+    nodeSelector/affinity (PreFilter eligibility);
+  * a node must carry every constraint's topology key or it is unschedulable;
+  * skew = matchNum(node's domain) + selfMatch(1 if pod matches its own
+    selector) − min(matchNum over eligible domains); hard constraints fail
+    when skew > maxSkew; soft constraints contribute the domain count as an
+    inverted-normalized score.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ....api import objects as v1
+from ..interface import CycleState, FilterPlugin, PreFilterPlugin, ScorePlugin, Status
+from .helpers import node_labels, pod_matches_node_selector
+
+_STATE_KEY = "PreFilterPodTopologySpread"
+
+
+class _SpreadState:
+    def __init__(self):
+        # (constraint idx) -> {topology value: match count}
+        self.counts: Dict[int, Dict[str, int]] = {}
+        self.self_match: Dict[int, bool] = {}
+
+    def clone(self):
+        c = _SpreadState()
+        c.counts = {k: dict(v) for k, v in self.counts.items()}
+        c.self_match = dict(self.self_match)
+        return c
+
+
+def _matches(pod: v1.Pod, constraint: v1.TopologySpreadConstraint, target: v1.Pod) -> bool:
+    if target.metadata.namespace != pod.metadata.namespace:
+        return False
+    if constraint.label_selector is None:
+        return False
+    return constraint.label_selector.matches(target.metadata.labels)
+
+
+class PodTopologySpreadPlugin(PreFilterPlugin, FilterPlugin, ScorePlugin):
+    name = "PodTopologySpread"
+
+    def __init__(self, snapshot_getter=None):
+        self._snapshot = snapshot_getter  # callable -> Snapshot
+
+    def _constraints(self, pod):
+        return list(pod.spec.topology_spread_constraints)
+
+    def has_extensions(self) -> bool:
+        return True
+
+    def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
+        s = _SpreadState()
+        cons = self._constraints(pod)
+        snapshot = self._snapshot() if self._snapshot else None
+        if snapshot is not None:
+            for ci, con in enumerate(cons):
+                s.counts[ci] = {}
+                s.self_match[ci] = (
+                    con.label_selector is not None
+                    and con.label_selector.matches(pod.metadata.labels)
+                )
+            for ni in snapshot.node_info_list:
+                if ni.node is None or not pod_matches_node_selector(pod, ni.node):
+                    continue
+                labels = node_labels(ni.node)
+                for ci, con in enumerate(cons):
+                    val = labels.get(con.topology_key)
+                    if val is None:
+                        continue
+                    cnt = sum(1 for p in ni.pods if _matches(pod, con, p))
+                    s.counts[ci][val] = s.counts[ci].get(val, 0) + cnt
+        state.write(_STATE_KEY, s)
+        return None
+
+    def add_pod(self, state, pod_to_schedule, pod_to_add, node_info):
+        self._update(state, pod_to_schedule, pod_to_add, node_info, +1)
+        return None
+
+    def remove_pod(self, state, pod_to_schedule, pod_to_remove, node_info):
+        self._update(state, pod_to_schedule, pod_to_remove, node_info, -1)
+        return None
+
+    def _update(self, state, pod, other, node_info, delta):
+        try:
+            s: _SpreadState = state.read(_STATE_KEY)
+        except KeyError:
+            return
+        if node_info.node is None or not pod_matches_node_selector(pod, node_info.node):
+            return
+        labels = node_labels(node_info.node)
+        for ci, con in enumerate(self._constraints(pod)):
+            val = labels.get(con.topology_key)
+            if val is None or not _matches(pod, con, other):
+                continue
+            s.counts[ci][val] = s.counts[ci].get(val, 0) + delta
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        cons = self._constraints(pod)
+        if not cons:
+            return None
+        try:
+            s: _SpreadState = state.read(_STATE_KEY)
+        except KeyError:
+            return None
+        labels = node_labels(node_info.node)
+        for ci, con in enumerate(cons):
+            if con.when_unsatisfiable != v1.DO_NOT_SCHEDULE:
+                continue
+            val = labels.get(con.topology_key)
+            if val is None:
+                return Status.unschedulable(
+                    f"node missing topology key {con.topology_key}"
+                )
+            counts = s.counts.get(ci, {})
+            match_num = counts.get(val, 0)
+            min_match = min(counts.values()) if counts else 0
+            self_num = 1 if s.self_match.get(ci) else 0
+            if match_num + self_num - min_match > con.max_skew:
+                return Status.unschedulable("max topology spread skew violated")
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        cons = self._constraints(pod)
+        soft = [
+            (ci, con)
+            for ci, con in enumerate(cons)
+            if con.when_unsatisfiable == v1.SCHEDULE_ANYWAY
+        ]
+        if not soft:
+            return 0.0, None
+        try:
+            s: _SpreadState = state.read(_STATE_KEY)
+        except KeyError:
+            return 0.0, None
+        ni = snapshot.get(node_name)
+        labels = node_labels(ni.node)
+        total = 0.0
+        for ci, con in soft:
+            val = labels.get(con.topology_key)
+            if val is not None:
+                total += s.counts.get(ci, {}).get(val, 0)
+        return total, None
+
+    def normalize_scores(self, state, pod, scores):
+        mx = max((s for _, s in scores), default=0.0)
+        for i, (n, s) in enumerate(scores):
+            scores[i] = (n, (mx - s) / mx * 100.0 if mx > 0 else 100.0)
+        return None

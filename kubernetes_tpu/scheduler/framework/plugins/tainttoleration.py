@@ -1,0 +1,50 @@
+"""TaintToleration: filter untolerated NoSchedule/NoExecute; score counts
+intolerable PreferNoSchedule taints, inverted-normalized.
+
+Reference: framework/plugins/tainttoleration/taint_toleration.go:55-77
+(Filter ⇒ UnschedulableAndUnresolvable), :129-167 (Score)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ....api.objects import (
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE,
+    find_untolerated_taint,
+    tolerations_tolerate_taint,
+)
+from ..interface import CycleState, FilterPlugin, ScorePlugin, Status
+
+
+class TaintTolerationPlugin(FilterPlugin, ScorePlugin):
+    name = "TaintToleration"
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        taint = find_untolerated_taint(
+            node_info.node.spec.taints,
+            pod.spec.tolerations,
+            effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE),
+        )
+        if taint is not None:
+            return Status.unresolvable(
+                f"node(s) had taint {{{taint.key}: {taint.value}}}"
+            )
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        ni = snapshot.get(node_name)
+        cnt = sum(
+            1
+            for t in ni.node.spec.taints
+            if t.effect == TAINT_PREFER_NO_SCHEDULE
+            and not tolerations_tolerate_taint(pod.spec.tolerations, t)
+        )
+        return float(cnt), None
+
+    def normalize_scores(self, state, pod, scores):
+        mx = max((s for _, s in scores), default=0.0)
+        for i, (n, s) in enumerate(scores):
+            scores[i] = (n, (mx - s) / mx * 100.0 if mx > 0 else 100.0)
+        return None

@@ -1,0 +1,108 @@
+"""Plugin registry + default profile wiring.
+
+Mirrors framework/plugins/registry.go:46-77 (in-tree registry) and
+algorithmprovider/registry.go:61-131 (default plugin set & weights: all
+score weights 1 except NodePreferAvoidPods=10000). Out-of-tree plugins merge
+by name, exactly like the reference's OutOfTreeRegistry option.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import plugins as p
+
+
+@dataclass
+class PluginSet:
+    """Per-extension-point plugin names (+ weight for score)."""
+
+    queue_sort: List[str] = field(default_factory=lambda: ["PrioritySort"])
+    pre_filter: List[str] = field(default_factory=list)
+    filter: List[str] = field(default_factory=list)
+    pre_score: List[str] = field(default_factory=list)
+    score: List[Tuple[str, float]] = field(default_factory=list)
+    reserve: List[str] = field(default_factory=list)
+    permit: List[str] = field(default_factory=list)
+    pre_bind: List[str] = field(default_factory=list)
+    bind: List[str] = field(default_factory=lambda: ["DefaultBinder"])
+    post_bind: List[str] = field(default_factory=list)
+    unreserve: List[str] = field(default_factory=list)
+
+
+def default_plugin_set() -> PluginSet:
+    """Default algorithm provider (algorithmprovider/registry.go:61-131).
+
+    Filter order matches the reference: NodeUnschedulable → Fit → NodeName →
+    NodePorts → NodeAffinity → TaintToleration → InterPodAffinity (+ spread).
+    """
+    return PluginSet(
+        pre_filter=[
+            "NodeResourcesFit",
+            "NodePorts",
+            "PodTopologySpread",
+            "InterPodAffinity",
+        ],
+        filter=[
+            "NodeUnschedulable",
+            "NodeResourcesFit",
+            "NodeName",
+            "NodePorts",
+            "NodeAffinity",
+            "TaintToleration",
+            "PodTopologySpread",
+            "InterPodAffinity",
+        ],
+        pre_score=["PodTopologySpread", "InterPodAffinity"],
+        score=[
+            ("NodeResourcesBalancedAllocation", 1.0),
+            ("ImageLocality", 1.0),
+            ("InterPodAffinity", 1.0),
+            ("NodeResourcesLeastAllocated", 1.0),
+            ("NodeAffinity", 1.0),
+            ("NodePreferAvoidPods", 10000.0),
+            ("DefaultPodTopologySpread", 1.0),
+            ("TaintToleration", 1.0),
+            ("PodTopologySpread", 1.0),
+        ],
+    )
+
+
+class Registry(dict):
+    """name -> factory(context) -> plugin instance. Context carries the
+    snapshot getter / API server the way FrameworkHandle does."""
+
+    def merge(self, other: "Registry") -> "Registry":
+        for k, v in other.items():
+            self[k] = v
+        return self
+
+
+def default_registry() -> Registry:
+    r = Registry()
+    r["NodeResourcesFit"] = lambda ctx: p.NodeResourcesFit()
+    r["NodeResourcesLeastAllocated"] = lambda ctx: p.NodeResourcesLeastAllocated()
+    r["NodeResourcesMostAllocated"] = lambda ctx: p.NodeResourcesMostAllocated()
+    r["NodeResourcesBalancedAllocation"] = lambda ctx: p.NodeResourcesBalancedAllocation()
+    r["RequestedToCapacityRatio"] = lambda ctx: p.RequestedToCapacityRatio()
+    r["NodeAffinity"] = lambda ctx: p.NodeAffinityPlugin()
+    r["TaintToleration"] = lambda ctx: p.TaintTolerationPlugin()
+    r["PodTopologySpread"] = lambda ctx: p.PodTopologySpreadPlugin(
+        ctx.get("snapshot_getter")
+    )
+    r["InterPodAffinity"] = lambda ctx: p.InterPodAffinityPlugin(
+        ctx.get("snapshot_getter"),
+        hard_pod_affinity_weight=ctx.get("hard_pod_affinity_weight", 1.0),
+    )
+    r["NodeName"] = lambda ctx: p.NodeName()
+    r["NodePorts"] = lambda ctx: p.NodePorts()
+    r["NodeUnschedulable"] = lambda ctx: p.NodeUnschedulable()
+    r["ImageLocality"] = lambda ctx: p.ImageLocality()
+    r["NodePreferAvoidPods"] = lambda ctx: p.NodePreferAvoidPods()
+    r["PrioritySort"] = lambda ctx: p.PrioritySort()
+    r["DefaultBinder"] = lambda ctx: p.DefaultBinder(ctx.get("server"))
+    r["DefaultPodTopologySpread"] = lambda ctx: p.SelectorSpread(
+        ctx.get("selectors_for_pod")
+    )
+    return r

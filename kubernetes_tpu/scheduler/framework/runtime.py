@@ -1,0 +1,238 @@
+"""Host framework runtime: runs plugin chains for one pod.
+
+The host twin of framework/v1alpha1/framework.go (RunFilterPlugins:424,
+RunScorePlugins:503-580: score → normalize → weight). Where the reference
+fans out over goroutines, the host path here is a plain loop — the bulk path
+is the device lattice; this runtime exists for fallback pods, preemption
+what-ifs, and as the differential-test oracle. Permit plugins park pods in a
+waiting map exactly like waitingPodsMap (waiting_pods_map.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .interface import (
+    Code,
+    CycleState,
+    MAX_NODE_SCORE,
+    Status,
+    is_success,
+)
+from .registry import PluginSet, Registry, default_plugin_set, default_registry
+
+
+class WaitingPod:
+    def __init__(self, pod, plugins_with_timeouts: Dict[str, float]):
+        self.pod = pod
+        self._pending = dict(plugins_with_timeouts)
+        self._event = threading.Event()
+        self._status: Optional[Status] = None
+        self._lock = threading.Lock()
+        self.deadline = time.monotonic() + (
+            max(plugins_with_timeouts.values()) if plugins_with_timeouts else 0
+        )
+
+    def allow(self, plugin_name: str) -> None:
+        with self._lock:
+            self._pending.pop(plugin_name, None)
+            if not self._pending and not self._event.is_set():
+                self._status = None
+                self._event.set()
+
+    def reject(self, msg: str = "") -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._status = Status.unschedulable(msg)
+                self._event.set()
+
+    def wait(self, timeout: float) -> Optional[Status]:
+        if self._event.wait(timeout):
+            return self._status
+        return Status.unschedulable("permit wait timeout")
+
+
+class Framework:
+    """One instance per profile (profile.Map, profile/profile.go:39)."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        plugin_set: Optional[PluginSet] = None,
+        context: Optional[dict] = None,
+    ):
+        self.registry = registry or default_registry()
+        self.plugin_set = plugin_set or default_plugin_set()
+        self.context = context or {}
+        self._instances: Dict[str, object] = {}
+        self.waiting_pods: Dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
+
+    def plugin(self, name: str):
+        inst = self._instances.get(name)
+        if inst is None:
+            factory = self.registry.get(name)
+            if factory is None:
+                raise KeyError(f"plugin {name} not registered")
+            inst = factory(self.context)
+            self._instances[name] = inst
+        return inst
+
+    # -- queue sort ---------------------------------------------------------
+
+    def queue_sort_less(self, pi1, pi2) -> bool:
+        qs = self.plugin(self.plugin_set.queue_sort[0])
+        return qs.less(pi1, pi2)
+
+    # -- filter chain --------------------------------------------------------
+
+    def run_pre_filter_plugins(self, state: CycleState, pod) -> Optional[Status]:
+        for name in self.plugin_set.pre_filter:
+            st = self.plugin(name).pre_filter(state, pod)
+            if not is_success(st):
+                st.message = f"{name}: {st.message}"
+                return st
+        return None
+
+    def run_filter_plugins(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        """First failure wins, but UnschedulableAndUnresolvable upgrades and
+        stops the chain (framework.go:424 RunFilterPlugins)."""
+        result: Optional[Status] = None
+        for name in self.plugin_set.filter:
+            st = self.plugin(name).filter(state, pod, node_info)
+            if not is_success(st):
+                st.message = f"{name}: {st.message}"
+                if st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                    return st
+                if st.code == Code.ERROR:
+                    return st
+                if result is None:
+                    result = st
+                # keep evaluating? reference stops at first failure unless
+                # runAllFilters; default stops.
+                return result
+        return result
+
+    def run_pre_filter_extension_add_pod(
+        self, state: CycleState, pod_to_schedule, pod_to_add, node_info
+    ) -> Optional[Status]:
+        for name in self.plugin_set.pre_filter:
+            plug = self.plugin(name)
+            if plug.has_extensions():
+                st = plug.add_pod(state, pod_to_schedule, pod_to_add, node_info)
+                if not is_success(st):
+                    return st
+        return None
+
+    def run_pre_filter_extension_remove_pod(
+        self, state: CycleState, pod_to_schedule, pod_to_remove, node_info
+    ) -> Optional[Status]:
+        for name in self.plugin_set.pre_filter:
+            plug = self.plugin(name)
+            if plug.has_extensions():
+                st = plug.remove_pod(state, pod_to_schedule, pod_to_remove, node_info)
+                if not is_success(st):
+                    return st
+        return None
+
+    # -- score chain ---------------------------------------------------------
+
+    def run_pre_score_plugins(self, state: CycleState, pod, nodes) -> Optional[Status]:
+        for name in self.plugin_set.pre_score:
+            plug = self.plugin(name)
+            if hasattr(plug, "pre_score"):
+                st = plug.pre_score(state, pod, nodes)
+                if not is_success(st):
+                    return st
+        return None
+
+    def run_score_plugins(
+        self, state: CycleState, pod, node_names: List[str], snapshot
+    ) -> Dict[str, float]:
+        """score → normalize → weight → sum (framework.go:503-580)."""
+        totals = {n: 0.0 for n in node_names}
+        for name, weight in self.plugin_set.score:
+            plug = self.plugin(name)
+            scores: List[Tuple[str, float]] = []
+            for n in node_names:
+                s, st = plug.score(state, pod, n, snapshot=snapshot)
+                if not is_success(st):
+                    raise RuntimeError(f"score plugin {name} failed: {st.message}")
+                scores.append((n, s))
+            plug.normalize_scores(state, pod, scores)
+            for n, s in scores:
+                if s < 0 or s > MAX_NODE_SCORE:
+                    s = max(0.0, min(float(MAX_NODE_SCORE), s))
+                totals[n] += weight * s
+        return totals
+
+    # -- reserve / permit / bind ---------------------------------------------
+
+    def run_reserve_plugins(self, state, pod, node_name) -> Optional[Status]:
+        for name in self.plugin_set.reserve:
+            st = self.plugin(name).reserve(state, pod, node_name)
+            if not is_success(st):
+                return st
+        return None
+
+    def run_unreserve_plugins(self, state, pod, node_name) -> None:
+        for name in self.plugin_set.unreserve:
+            self.plugin(name).unreserve(state, pod, node_name)
+
+    def run_permit_plugins(self, state, pod, node_name) -> Optional[Status]:
+        waits: Dict[str, float] = {}
+        for name in self.plugin_set.permit:
+            st, timeout = self.plugin(name).permit(state, pod, node_name)
+            if st is not None and st.code == Code.WAIT:
+                waits[name] = timeout
+            elif not is_success(st):
+                return st
+        if waits:
+            wp = WaitingPod(pod, waits)
+            with self._waiting_lock:
+                self.waiting_pods[pod.metadata.uid] = wp
+            return Status(Code.WAIT)
+        return None
+
+    def wait_on_permit(self, pod) -> Optional[Status]:
+        with self._waiting_lock:
+            wp = self.waiting_pods.get(pod.metadata.uid)
+        if wp is None:
+            return None
+        try:
+            return wp.wait(max(0.0, wp.deadline - time.monotonic()))
+        finally:
+            with self._waiting_lock:
+                self.waiting_pods.pop(pod.metadata.uid, None)
+
+    def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
+        with self._waiting_lock:
+            return self.waiting_pods.get(uid)
+
+    def iterate_waiting_pods(self):
+        with self._waiting_lock:
+            return list(self.waiting_pods.values())
+
+    def run_pre_bind_plugins(self, state, pod, node_name) -> Optional[Status]:
+        for name in self.plugin_set.pre_bind:
+            st = self.plugin(name).pre_bind(state, pod, node_name)
+            if not is_success(st):
+                return st
+        return None
+
+    def run_bind_plugins(self, state, pod, node_name) -> Optional[Status]:
+        for name in self.plugin_set.bind:
+            st = self.plugin(name).bind(state, pod, node_name)
+            if st is not None and st.code == Code.SKIP:
+                continue
+            return st
+        return None
+
+    def run_post_bind_plugins(self, state, pod, node_name) -> None:
+        for name in self.plugin_set.post_bind:
+            self.plugin(name).post_bind(state, pod, node_name)
+
+    def has_filter_plugin(self, name: str) -> bool:
+        return name in self.plugin_set.filter

@@ -1,0 +1,137 @@
+"""Preemption: victim selection + node choice when a pod cannot schedule.
+
+Mirrors reference generic_scheduler.go Preempt(:270):
+nodesWherePreemptionMightHelp(:1033) — candidates are nodes whose failure was
+NOT UnschedulableAndUnresolvable (the device lattice returns this directly as
+the `resolvable` mask) → selectVictimsOnNode(:940) — remove lower-priority
+pods, re-filter, then reprieve victims highest-priority-first →
+pickOneNodeForPreemption(:721) — lexicographic tie-break.
+
+PDB (PodDisruptionBudget) violation counting is wired but budget-less until
+the disruption controller lands; the criteria order is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import objects as v1
+from .cache.nodeinfo import NodeInfo, Snapshot
+from .core import FitError
+from .framework.interface import Code, CycleState, Status, is_success
+from .framework.runtime import Framework
+
+
+class Preemptor:
+    def __init__(self, framework: Framework, pdb_lister: Optional[Callable] = None):
+        self.framework = framework
+        self._pdbs = pdb_lister
+
+    def preempt(
+        self,
+        pod: v1.Pod,
+        snapshot: Snapshot,
+        fit_error: Optional[FitError] = None,
+        candidate_nodes: Optional[List[str]] = None,
+    ) -> Tuple[str, List[v1.Pod]]:
+        """Returns (node_name, victims) or ("", []) when preemption won't help."""
+        if not pod_eligible_to_preempt_others(pod, snapshot):
+            return "", []
+        if candidate_nodes is None:
+            candidate_nodes = self._nodes_where_preemption_might_help(fit_error, snapshot)
+        victims_by_node: Dict[str, List[v1.Pod]] = {}
+        for name in candidate_nodes:
+            ni = snapshot.get(name)
+            if ni is None or ni.node is None:
+                continue
+            victims = self._select_victims_on_node(pod, ni)
+            if victims is not None:
+                victims_by_node[name] = victims
+        if not victims_by_node:
+            return "", []
+        node = pick_one_node_for_preemption(victims_by_node, snapshot)
+        return node, victims_by_node.get(node, [])
+
+    def _nodes_where_preemption_might_help(
+        self, fit_error: Optional[FitError], snapshot: Snapshot
+    ) -> List[str]:
+        if fit_error is None:
+            return [ni.name for ni in snapshot.node_info_list]
+        out = []
+        for ni in snapshot.node_info_list:
+            st = fit_error.filtered_nodes_statuses.get(ni.name)
+            if st is None or st.code != Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                out.append(ni.name)
+        return out
+
+    def _select_victims_on_node(
+        self, pod: v1.Pod, ni: NodeInfo
+    ) -> Optional[List[v1.Pod]]:
+        """selectVictimsOnNode(:940): remove all lower-priority pods; if the
+        pod then fits, reprieve victims in highest-priority-first order."""
+        node_copy = ni.clone()
+        state = CycleState()
+        st = self.framework.run_pre_filter_plugins(state, pod)
+        if not is_success(st):
+            return None
+        potential = [p for p in node_copy.pods if p.priority < pod.priority]
+        if not potential:
+            return None
+        for victim in potential:
+            node_copy.remove_pod(victim.metadata.key)
+            self.framework.run_pre_filter_extension_remove_pod(
+                state, pod, victim, node_copy
+            )
+        if not is_success(self.framework.run_filter_plugins(state, pod, node_copy)):
+            return None
+        victims: List[v1.Pod] = []
+        # reprieve highest-priority (then earliest-start) victims first
+        potential.sort(key=lambda p: (-p.priority, p.status.start_time or 0))
+        for victim in potential:
+            node_copy.add_pod(victim)
+            self.framework.run_pre_filter_extension_add_pod(state, pod, victim, node_copy)
+            if not is_success(
+                self.framework.run_filter_plugins(state, pod, node_copy)
+            ):
+                node_copy.remove_pod(victim.metadata.key)
+                self.framework.run_pre_filter_extension_remove_pod(
+                    state, pod, victim, node_copy
+                )
+                victims.append(victim)
+        return victims if victims else None
+
+
+def pod_eligible_to_preempt_others(pod: v1.Pod, snapshot: Snapshot) -> bool:
+    """podEligibleToPreemptOthers (:840): a pod that already nominated a node
+    where a lower-priority victim is terminating waits instead of preempting
+    again."""
+    nominated = pod.status.nominated_node_name
+    if nominated:
+        ni = snapshot.get(nominated)
+        if ni is not None:
+            for p in ni.pods:
+                if p.metadata.deletion_timestamp is not None and p.priority < pod.priority:
+                    return False
+    return True
+
+
+def pick_one_node_for_preemption(
+    victims_by_node: Dict[str, List[v1.Pod]], snapshot: Snapshot
+) -> str:
+    """pickOneNodeForPreemption(:721) — lexicographic criteria:
+    1. fewest PDB violations (0 until PDBs land)
+    2. lowest maximum victim priority
+    3. lowest sum of victim priorities
+    4. fewest victims
+    5. latest maximum start time among victims
+    6. first in iteration order (reference: random among remainder)
+    """
+    def key(name: str):
+        victims = victims_by_node[name]
+        max_prio = max((p.priority for p in victims), default=-(2**31))
+        sum_prio = sum(p.priority for p in victims)
+        starts = [p.status.start_time or 0.0 for p in victims]
+        latest_start = max(starts, default=0.0)
+        return (0, max_prio, sum_prio, len(victims), -latest_start)
+
+    return min(sorted(victims_by_node.keys()), key=key)

@@ -1,0 +1,47 @@
+"""Profiles: schedulerName → framework instance (+ recorder).
+
+profile.Map equivalent (reference pkg/scheduler/profile/profile.go:39,58,61):
+one Framework per profile so several virtual schedulers share one process;
+pods select a profile via spec.scheduler_name (profileForPod,
+scheduler.go:741)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..client.events import EventRecorder
+from .config import KubeSchedulerConfiguration, ProfileConfig
+from .framework.registry import PluginSet, Registry, default_plugin_set, default_registry
+from .framework.runtime import Framework
+
+
+class Profile:
+    def __init__(self, name: str, framework: Framework, recorder: EventRecorder):
+        self.name = name
+        self.framework = framework
+        self.recorder = recorder
+
+
+class ProfileMap(dict):
+    def for_pod(self, pod) -> Optional[Profile]:
+        return self.get(pod.spec.scheduler_name)
+
+
+def new_profile_map(
+    cfg: KubeSchedulerConfiguration,
+    context: dict,
+    registry: Optional[Registry] = None,
+    server=None,
+) -> ProfileMap:
+    m = ProfileMap()
+    reg = registry or default_registry()
+    for pc in cfg.profiles:
+        ps = pc.plugin_set or default_plugin_set()
+        if pc.score_weights:
+            ps.score = [
+                (name, pc.score_weights.get(name, w)) for name, w in ps.score
+            ]
+        fw = Framework(registry=reg, plugin_set=ps, context=context)
+        rec = EventRecorder(server, component=pc.scheduler_name)
+        m[pc.scheduler_name] = Profile(pc.scheduler_name, fw, rec)
+    return m

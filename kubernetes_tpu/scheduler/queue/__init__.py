@@ -1,0 +1,5 @@
+"""Scheduling queue: activeQ/backoffQ/unschedulableQ with event-driven flush."""
+
+from .heap import Heap  # noqa: F401
+from .scheduling_queue import PriorityQueue, QueuedPodInfo  # noqa: F401
+from . import events  # noqa: F401

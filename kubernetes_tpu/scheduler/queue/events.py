@@ -1,0 +1,27 @@
+"""Queue-flush event names (reference internal/queue/events.go:20-72).
+
+Each cluster change that could make an unschedulable pod schedulable moves
+pods out of unschedulableQ (MoveAllToActiveOrBackoffQueue). In the TPU build
+the same events also mark the device snapshot dirty (the encoder delta)."""
+
+ASSIGNED_POD_ADD = "AssignedPodAdd"
+ASSIGNED_POD_UPDATE = "AssignedPodUpdate"
+ASSIGNED_POD_DELETE = "AssignedPodDelete"
+NODE_ADD = "NodeAdd"
+NODE_SPEC_UNSCHEDULABLE_CHANGE = "NodeSpecUnschedulableChange"
+NODE_ALLOCATABLE_CHANGE = "NodeAllocatableChange"
+NODE_LABEL_CHANGE = "NodeLabelChange"
+NODE_TAINT_CHANGE = "NodeTaintChange"
+NODE_CONDITION_CHANGE = "NodeConditionChange"
+PV_ADD = "PvAdd"
+PV_UPDATE = "PvUpdate"
+PVC_ADD = "PvcAdd"
+PVC_UPDATE = "PvcUpdate"
+SERVICE_ADD = "ServiceAdd"
+SERVICE_UPDATE = "ServiceUpdate"
+SERVICE_DELETE = "ServiceDelete"
+STORAGE_CLASS_ADD = "StorageClassAdd"
+CSI_NODE_ADD = "CSINodeAdd"
+CSI_NODE_UPDATE = "CSINodeUpdate"
+NODE_DELETE = "NodeDelete"
+UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"

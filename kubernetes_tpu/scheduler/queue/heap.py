@@ -1,0 +1,82 @@
+"""Heap with map index: O(log n) push/pop + O(1) lookup/delete by key.
+
+Equivalent of reference pkg/scheduler/internal/heap/heap.go (used by both
+activeQ and podBackoffQ). Lazy-deletion strategy: removed/updated entries are
+tombstoned and skipped at pop."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Heap:
+    def __init__(self, key_func: Callable[[Any], str], less: Callable[[Any, Any], bool]):
+        self._key = key_func
+        self._less = less
+        self._heap: List[_Entry] = []
+        self._items: Dict[str, "_Entry"] = {}
+        self._counter = itertools.count()
+
+    def add(self, item: Any) -> None:
+        key = self._key(item)
+        old = self._items.get(key)
+        if old is not None:
+            old.valid = False
+        e = _Entry(item, self._less, next(self._counter))
+        self._items[key] = e
+        heapq.heappush(self._heap, e)
+
+    update = add
+
+    def delete(self, item: Any) -> None:
+        self.delete_by_key(self._key(item))
+
+    def delete_by_key(self, key: str) -> None:
+        e = self._items.pop(key, None)
+        if e is not None:
+            e.valid = False
+
+    def get(self, key: str) -> Optional[Any]:
+        e = self._items.get(key)
+        return e.item if e else None
+
+    def peek(self) -> Optional[Any]:
+        while self._heap and not self._heap[0].valid:
+            heapq.heappop(self._heap)
+        return self._heap[0].item if self._heap else None
+
+    def pop(self) -> Optional[Any]:
+        while self._heap:
+            e = heapq.heappop(self._heap)
+            if e.valid:
+                del self._items[self._key(e.item)]
+                return e.item
+        return None
+
+    def list(self) -> List[Any]:
+        return [e.item for e in self._items.values()]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._items
+
+
+class _Entry:
+    __slots__ = ("item", "_less", "seq", "valid")
+
+    def __init__(self, item, less, seq):
+        self.item = item
+        self._less = less
+        self.seq = seq
+        self.valid = True
+
+    def __lt__(self, other: "_Entry") -> bool:
+        if self._less(self.item, other.item):
+            return True
+        if self._less(other.item, self.item):
+            return False
+        return self.seq < other.seq

@@ -1,0 +1,276 @@
+"""PriorityQueue: the three-part scheduling queue.
+
+Reference pkg/scheduler/internal/queue/scheduling_queue.go:117-152:
+  * activeQ     — heap ordered by the QueueSort plugin (priority desc, FIFO)
+  * podBackoffQ — heap by backoff expiry; backoff 1s→10s doubling (:643)
+  * unschedulableQ — map, flushed by events (MoveAllToActiveOrBackoffQueue
+    :494) or after 60s (flushUnschedulableQLeftover)
+plus the nominated-pods map for preemption.
+
+TPU addition: `pop_batch(max_n, window)` pops up to a device batch of pods in
+one call (the batch former of SURVEY.md §7 stage 4) — the reference pops one
+pod per cycle; the device path amortizes one kernel launch over the batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ...api import objects as v1
+from .heap import Heap
+
+
+@dataclass
+class QueuedPodInfo:
+    pod: v1.Pod
+    timestamp: float = field(default_factory=time.monotonic)
+    attempts: int = 0
+    initial_attempt_timestamp: float = field(default_factory=time.monotonic)
+    backoff_expiry: float = 0.0
+
+    @property
+    def key(self) -> str:
+        return self.pod.metadata.key
+
+
+class PriorityQueue:
+    def __init__(
+        self,
+        less: Optional[Callable[[QueuedPodInfo, QueuedPodInfo], bool]] = None,
+        pod_initial_backoff: float = 1.0,
+        pod_max_backoff: float = 10.0,
+        unschedulable_timeout: float = 60.0,
+    ):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        if less is None:
+            less = lambda a, b: (
+                (a.pod.priority, -a.timestamp) > (b.pod.priority, -b.timestamp)
+            )
+        self._active = Heap(lambda pi: pi.key, less)
+        self._backoff = Heap(
+            lambda pi: pi.key, lambda a, b: a.backoff_expiry < b.backoff_expiry
+        )
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+        self._unsched_timeout = unschedulable_timeout
+        self._nominated: Dict[str, str] = {}  # pod key -> node name
+        self._nominated_by_node: Dict[str, set] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.moves = 0  # MoveAllToActiveOrBackoffQueue invocations (metrics)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> None:
+        """Start flush loops (scheduling_queue.go:234: backoff every 1s,
+        unschedulable leftover every 30s)."""
+        for period, fn in ((1.0, self.flush_backoff_completed), (30.0, self._flush_unschedulable_leftover)):
+            t = threading.Thread(
+                target=self._loop, args=(period, fn), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self, period: float, fn) -> None:
+        while not self._stop.wait(period):
+            fn()
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- adds ---------------------------------------------------------------
+
+    def add(self, pod: v1.Pod) -> None:
+        with self._cond:
+            pi = QueuedPodInfo(pod)
+            self._active.add(pi)
+            self._backoff.delete_by_key(pi.key)
+            self._unschedulable.pop(pi.key, None)
+            self._cond.notify()
+
+    def add_unschedulable_if_not_present(
+        self, pi: QueuedPodInfo, moves_at_failure: int
+    ) -> None:
+        """Failed pod re-entry (AddUnschedulableIfNotPresent:300): if a move
+        event fired while the pod was being scheduled, it goes to backoffQ
+        (something changed — retry soon); else unschedulableQ."""
+        with self._cond:
+            key = pi.key
+            if key in self._active or key in self._backoff or key in self._unschedulable:
+                return
+            pi.timestamp = time.monotonic()
+            if self.moves != moves_at_failure:
+                pi.backoff_expiry = self._backoff_time(pi)
+                self._backoff.add(pi)
+            else:
+                self._unschedulable[key] = pi
+
+    def _backoff_time(self, pi: QueuedPodInfo) -> float:
+        d = self._initial_backoff * (2 ** max(pi.attempts - 1, 0))
+        return time.monotonic() + min(d, self._max_backoff)
+
+    # -- pops ---------------------------------------------------------------
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while len(self._active) == 0 and not self._stop.is_set():
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return None
+                self._cond.wait(rem if rem is None or rem < 0.1 else 0.1)
+            if self._stop.is_set():
+                return None
+            pi = self._active.pop()
+            if pi is not None:
+                pi.attempts += 1
+            return pi
+
+    def pop_batch(
+        self, max_n: int, timeout: Optional[float] = None, window: float = 0.0
+    ) -> List[QueuedPodInfo]:
+        """Pop up to max_n pods: block for the first, then drain without
+        blocking (optionally lingering up to `window` seconds to let a burst
+        accumulate — the gang/batch former)."""
+        first = self.pop(timeout)
+        if first is None:
+            return []
+        out = [first]
+        deadline = time.monotonic() + window
+        while len(out) < max_n:
+            with self._cond:
+                pi = self._active.pop()
+                if pi is not None:
+                    pi.attempts += 1
+                    out.append(pi)
+                    continue
+            if window > 0 and time.monotonic() < deadline:
+                time.sleep(min(0.0005, window / 4))
+                continue
+            break
+        return out
+
+    # -- event-driven movement ----------------------------------------------
+
+    def move_all_to_active_or_backoff(self, event: str) -> None:
+        """(scheduling_queue.go:494) — every unschedulable pod re-enters
+        either backoffQ (still backing off) or activeQ."""
+        with self._cond:
+            self.moves += 1
+            now = time.monotonic()
+            for key, pi in list(self._unschedulable.items()):
+                expiry = self._backoff_time(pi)
+                if expiry > now:
+                    pi.backoff_expiry = expiry
+                    self._backoff.add(pi)
+                else:
+                    self._active.add(pi)
+                del self._unschedulable[key]
+            self._cond.notify_all()
+
+    def flush_backoff_completed(self) -> None:
+        with self._cond:
+            now = time.monotonic()
+            while True:
+                pi = self._backoff.peek()
+                if pi is None or pi.backoff_expiry > now:
+                    break
+                self._backoff.pop()
+                self._active.add(pi)
+                self._cond.notify()
+
+    def _flush_unschedulable_leftover(self) -> None:
+        with self._cond:
+            now = time.monotonic()
+            moved = False
+            for key, pi in list(self._unschedulable.items()):
+                if now - pi.timestamp > self._unsched_timeout:
+                    del self._unschedulable[key]
+                    pi.backoff_expiry = self._backoff_time(pi)
+                    if pi.backoff_expiry > now:
+                        self._backoff.add(pi)
+                    else:
+                        self._active.add(pi)
+                        moved = True
+            if moved:
+                self._cond.notify_all()
+
+    # -- update/delete (informer-driven) ------------------------------------
+
+    def update(self, old: Optional[v1.Pod], new: v1.Pod) -> None:
+        with self._cond:
+            key = new.metadata.key
+            for store in (self._active, self._backoff):
+                pi = store.get(key)
+                if pi is not None:
+                    pi.pod = new
+                    store.update(pi)
+                    return
+            pi = self._unschedulable.get(key)
+            if pi is not None:
+                pi.pod = new
+                # spec update may make it schedulable again
+                if _significant_update(old, new):
+                    del self._unschedulable[key]
+                    self._active.add(pi)
+                    self._cond.notify()
+
+    def delete(self, pod: v1.Pod) -> None:
+        with self._cond:
+            key = pod.metadata.key
+            self._active.delete_by_key(key)
+            self._backoff.delete_by_key(key)
+            self._unschedulable.pop(key, None)
+            self.delete_nominated_if_exists(pod)
+
+    # -- nominated pods ------------------------------------------------------
+
+    def add_nominated_pod(self, pod: v1.Pod, node_name: str) -> None:
+        with self._lock:
+            key = pod.metadata.key
+            self.delete_nominated_if_exists(pod)
+            self._nominated[key] = node_name
+            self._nominated_by_node.setdefault(node_name, set()).add(key)
+
+    def delete_nominated_if_exists(self, pod: v1.Pod) -> None:
+        with self._lock:
+            key = pod.metadata.key
+            node = self._nominated.pop(key, None)
+            if node is not None:
+                self._nominated_by_node.get(node, set()).discard(key)
+
+    def nominated_pods_for_node(self, node_name: str) -> List[str]:
+        with self._lock:
+            return sorted(self._nominated_by_node.get(node_name, set()))
+
+    # -- introspection -------------------------------------------------------
+
+    def pending_pods(self) -> dict:
+        with self._lock:
+            return {
+                "active": [pi.key for pi in self._active.list()],
+                "backoff": [pi.key for pi in self._backoff.list()],
+                "unschedulable": sorted(self._unschedulable.keys()),
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._active) + len(self._backoff) + len(self._unschedulable)
+
+
+def _significant_update(old: Optional[v1.Pod], new: v1.Pod) -> bool:
+    """UpdatePodInSchedulingQueue / isPodUpdated: ignore pure status churn."""
+    if old is None:
+        return True
+    return (
+        old.spec != new.spec
+        or old.metadata.labels != new.metadata.labels
+        or old.metadata.annotations != new.metadata.annotations
+    )

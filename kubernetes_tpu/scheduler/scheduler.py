@@ -1,0 +1,445 @@
+"""The Scheduler: event pipeline → batched device cycles → assume → bind.
+
+Top-loop equivalent of reference pkg/scheduler/scheduler.go:79 (Scheduler),
+:363 (Run), :548 (scheduleOne), re-shaped around the TPU data plane:
+
+  reference                           this build
+  ---------                           ----------
+  queue.Pop one pod                   queue.pop_batch(P) — batch former
+  UpdateSnapshot (generation diff)    encoder.flush() — device row scatter
+  findNodesThatFitPod / prioritize    one fused lattice kernel for the batch
+  (16 goroutines over nodes)          (vmap/scan over pods×nodes on device)
+  selectHost                          on-device argmax + random tie-break
+  assume + async bind goroutine       assume + bind worker pool (unchanged)
+  preempt on FitError                 host preemption seeded by the kernel's
+                                      resolvable mask (see preemption.py)
+
+Pods whose spec overflows the static device encoding run the host fallback
+path (core.GenericScheduler) — same plugins, same outcome, lower throughput;
+mirrors how the reference lets extenders post-process a narrowed node set
+(generic_scheduler.go:421).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..api import objects as v1
+from ..client.apiserver import APIServer, NotFound
+from ..client.informers import SharedInformerFactory
+from ..ops.batch import encode_pod_batch
+from ..ops.lattice import (
+    NUM_SCORE_COMPONENTS,
+    SC_BALANCED,
+    SC_IMAGE,
+    SC_INTERPOD,
+    SC_LEAST_ALLOC,
+    SC_MOST_ALLOC,
+    SC_NODE_AFFINITY,
+    SC_PREFER_AVOID,
+    SC_REQ_TO_CAP,
+    SC_TAINT,
+    SC_TOPO_SPREAD,
+    make_schedule_batch,
+)
+from ..utils.metrics import metrics
+from ..utils.trace import Trace
+from .cache.cache import SchedulerCache
+from .config import KubeSchedulerConfiguration
+from .core import FitError, GenericScheduler
+from .framework.interface import Code, CycleState, is_success
+from .preemption import Preemptor
+from .profile import ProfileMap, new_profile_map
+from .queue import PriorityQueue, QueuedPodInfo
+from . import eventhandlers
+
+logger = logging.getLogger("kubernetes_tpu.scheduler")
+
+_SCORE_NAME_TO_COMPONENT = {
+    "NodeResourcesLeastAllocated": SC_LEAST_ALLOC,
+    "NodeResourcesMostAllocated": SC_MOST_ALLOC,
+    "NodeResourcesBalancedAllocation": SC_BALANCED,
+    "RequestedToCapacityRatio": SC_REQ_TO_CAP,
+    "NodeAffinity": SC_NODE_AFFINITY,
+    "TaintToleration": SC_TAINT,
+    "ImageLocality": SC_IMAGE,
+    "NodePreferAvoidPods": SC_PREFER_AVOID,
+    "PodTopologySpread": SC_TOPO_SPREAD,
+    "InterPodAffinity": SC_INTERPOD,
+    # DefaultPodTopologySpread has no device component; host path only.
+}
+
+
+class Scheduler:
+    def __init__(
+        self,
+        server: APIServer,
+        config: Optional[KubeSchedulerConfiguration] = None,
+    ):
+        self.cfg = config or KubeSchedulerConfiguration()
+        self.cfg.validate()
+        self.server = server
+        self.cache = SchedulerCache(
+            ttl_seconds=self.cfg.assume_ttl_seconds,
+            encoding_config=self.cfg.encoding,
+        )
+        self.queue = PriorityQueue(
+            pod_initial_backoff=self.cfg.pod_initial_backoff_seconds,
+            pod_max_backoff=self.cfg.pod_max_backoff_seconds,
+        )
+        self._snapshot = None  # latest host snapshot (fallback/preemption)
+        context = {
+            "server": server,
+            "snapshot_getter": lambda: self._snapshot,
+            "hard_pod_affinity_weight": self.cfg.hard_pod_affinity_weight,
+        }
+        self.profiles: ProfileMap = new_profile_map(self.cfg, context, server=server)
+        self.informer_factory = SharedInformerFactory(server)
+        self._algo: Dict[str, GenericScheduler] = {
+            name: GenericScheduler(
+                p.framework, self.cfg.percentage_of_nodes_to_score
+            )
+            for name, p in self.profiles.items()
+        }
+        self._preemptors = {
+            name: Preemptor(p.framework) for name, p in self.profiles.items()
+        }
+        self._bind_pool = ThreadPoolExecutor(
+            max_workers=self.cfg.bind_workers, thread_name_prefix="binder"
+        )
+        self._stop = threading.Event()
+        self._sched_thread: Optional[threading.Thread] = None
+        self._rng_counter = itertools.count()
+        self._rng_key = jax.random.PRNGKey(0)
+        self._weights = self._build_weights()
+        eventhandlers.add_all_event_handlers(self)
+
+    # -- wiring --------------------------------------------------------------
+
+    def _build_weights(self) -> np.ndarray:
+        w = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
+        default = next(iter(self.profiles.values()))
+        for name, weight in default.framework.plugin_set.score:
+            idx = _SCORE_NAME_TO_COMPONENT.get(name)
+            if idx is not None:
+                w[idx] = weight
+        return w
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """informers → WaitForCacheSync → queue/janitor/scheduling loops
+        (app.Run, cmd/kube-scheduler/app/server.go:142)."""
+        self.informer_factory.start()
+        self.informer_factory.wait_for_cache_sync()
+        self.queue.run()
+        self.cache.start_janitor()
+        self._sched_thread = threading.Thread(
+            target=self._scheduling_loop, daemon=True, name="scheduler"
+        )
+        self._sched_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        self.cache.stop()
+        self.informer_factory.stop()
+        self._bind_pool.shutdown(wait=False)
+
+    def wait_for_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: wait until no pending pods remain."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.queue) == 0 and not self.cache.encoder._dirty_rows:
+                return True
+            time.sleep(0.01)
+        return len(self.queue) == 0
+
+    # -- the loop ------------------------------------------------------------
+
+    def _scheduling_loop(self) -> None:
+        while not self._stop.is_set():
+            pis = self.queue.pop_batch(
+                self.cfg.device_batch_size,
+                timeout=0.2,
+                window=self.cfg.device_batch_window,
+            )
+            if not pis:
+                continue
+            try:
+                self.schedule_pod_batch(pis)
+            except Exception:
+                logger.exception("scheduling batch failed")
+                moves = self.queue.moves
+                for pi in pis:
+                    self.queue.add_unschedulable_if_not_present(pi, moves)
+
+    def schedule_pod_batch(self, pis: List[QueuedPodInfo]) -> None:
+        trace = Trace("schedule_batch", pods=len(pis))
+        t_start = time.monotonic()
+        moves0 = self.queue.moves
+        known: List[QueuedPodInfo] = []
+        for pi in pis:
+            if self.profiles.for_pod(pi.pod) is None:
+                logger.error(
+                    "no profile for scheduler name %s", pi.pod.spec.scheduler_name
+                )
+                continue
+            known.append(pi)
+        if not known:
+            return
+        if self.cfg.use_device:
+            self._schedule_batch_device(known, moves0, trace, t_start)
+        else:
+            self._snapshot = self.cache.update_snapshot()
+            for pi in known:
+                self._schedule_one_host(pi, moves0)
+        trace.log_if_long(0.1)
+
+    # -- device path ---------------------------------------------------------
+
+    @staticmethod
+    def _pad(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def _schedule_batch_device(
+        self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
+    ) -> None:
+        with self.cache.lock:
+            eb = encode_pod_batch(
+                self.cache.encoder,
+                [pi.pod for pi in pis],
+                pad_to=self._pad(len(pis)),
+            )
+            snap = self.cache.encoder.flush()
+            enc_cfg = self.cache.encoder.cfg
+            row_names = list(self.cache.encoder.row_names)
+        trace.step("encoded+flushed")
+        kern = make_schedule_batch(enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        res = kern(snap, eb.batch, np.asarray(self._weights), sub)
+        chosen = np.asarray(res.chosen)
+        feas = np.asarray(res.feasible_count)
+        trace.step("kernel")
+        algo_dur = time.monotonic() - t_start
+
+        fallback_pis: List[QueuedPodInfo] = []
+        failed: List = []  # (pi, resolvable_rows)
+        resolvable = None
+        for i, pi in enumerate(pis):
+            if eb.fallback[i]:
+                fallback_pis.append(pi)
+                continue
+            row = int(chosen[i])
+            if row < 0:
+                if resolvable is None:
+                    resolvable = np.asarray(res.resolvable)
+                rows = np.nonzero(resolvable[i])[0]
+                failed.append((pi, [row_names[r] for r in rows if row_names[r]]))
+                continue
+            node_name = row_names[row]
+            if node_name is None:
+                failed.append((pi, []))
+                continue
+            metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
+            self._assume_and_bind(pi, node_name, t_start)
+        if fallback_pis or failed:
+            self._snapshot = self.cache.update_snapshot()
+        for pi in fallback_pis:
+            self._schedule_one_host(pi, moves0)
+        for pi, candidates in failed:
+            self._handle_failure(
+                pi,
+                moves0,
+                message=f"0/{self.cache.node_count} nodes are available",
+                candidate_nodes=candidates,
+            )
+
+    # -- host fallback path ---------------------------------------------------
+
+    def _schedule_one_host(self, pi: QueuedPodInfo, moves0: int) -> None:
+        t0 = time.monotonic()
+        pod = pi.pod
+        prof = self.profiles.for_pod(pod)
+        algo = self._algo[prof.name]
+        # fresh snapshot per cycle so earlier assumes in this batch are seen
+        # (scheduleOne snapshots per pod, generic_scheduler.go:142)
+        self._snapshot = self.cache.update_snapshot()
+        state = CycleState()
+        try:
+            result = algo.schedule(
+                pod, self._snapshot, state, self._nominated_pods_for_node
+            )
+        except FitError as fe:
+            metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
+            self._handle_failure(pi, moves0, message=str(fe), fit_error=fe)
+            return
+        metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
+        self._assume_and_bind(pi, result.suggested_host, t0)
+
+    def _nominated_pods_for_node(self, node_name: str) -> List[v1.Pod]:
+        keys = self.queue.nominated_pods_for_node(node_name)
+        out = []
+        pods_informer = self.informer_factory.informer("pods")
+        for k in keys:
+            p = pods_informer.get(k)
+            if p is not None:
+                out.append(p)
+        return out
+
+    # -- assume + bind --------------------------------------------------------
+
+    def _assume_and_bind(self, pi: QueuedPodInfo, node_name: str, t_start: float) -> None:
+        pod = pi.pod
+        prof = self.profiles.for_pod(pod)
+        fw = prof.framework
+        state = CycleState()
+        st = fw.run_reserve_plugins(state, pod, node_name)
+        if not is_success(st):
+            self._handle_failure(pi, self.queue.moves, message=st.message, error=True)
+            return
+        try:
+            self.cache.assume_pod(pod, node_name)
+        except ValueError as e:
+            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            return
+        self.queue.delete_nominated_if_exists(pod)
+        st = fw.run_permit_plugins(state, pod, node_name)
+        if st is not None and st.code not in (Code.SUCCESS, Code.WAIT):
+            self.cache.forget_pod(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(pi, self.queue.moves, message=st.message)
+            return
+        self._bind_pool.submit(self._bind_async, pi, node_name, state, t_start)
+
+    def _bind_async(self, pi: QueuedPodInfo, node_name: str, state, t_start) -> None:
+        """binding cycle (async goroutine at scheduler.go:666)."""
+        pod = pi.pod
+        prof = self.profiles.for_pod(pod)
+        fw = prof.framework
+        b0 = time.monotonic()
+        try:
+            st = fw.wait_on_permit(pod)
+            if not is_success(st):
+                raise RuntimeError(f"permit: {st.message}")
+            st = fw.run_pre_bind_plugins(state, pod, node_name)
+            if not is_success(st):
+                raise RuntimeError(f"prebind: {st.message}")
+            st = fw.run_bind_plugins(state, pod, node_name)
+            if not is_success(st):
+                raise RuntimeError(f"bind: {st.message}")
+            self.cache.finish_binding(pod)
+            fw.run_post_bind_plugins(state, pod, node_name)
+            metrics.observe("binding_duration_seconds", time.monotonic() - b0)
+            metrics.observe(
+                "e2e_scheduling_duration_seconds", time.monotonic() - t_start
+            )
+            metrics.inc("schedule_attempts_total", {"result": "scheduled"})
+            prof.recorder.eventf(
+                pod, "Normal", "Scheduled", "Binding",
+                f"Successfully assigned {pod.metadata.key} to {node_name}",
+            )
+        except Exception as e:
+            self.cache.forget_pod(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+
+    # -- failure path ---------------------------------------------------------
+
+    def _handle_failure(
+        self,
+        pi: QueuedPodInfo,
+        moves0: int,
+        message: str = "",
+        fit_error: Optional[FitError] = None,
+        candidate_nodes: Optional[List[str]] = None,
+        error: bool = False,
+    ) -> None:
+        pod = pi.pod
+        prof = self.profiles.for_pod(pod)
+        metrics.inc(
+            "schedule_attempts_total",
+            {"result": "error" if error else "unschedulable"},
+        )
+        prof.recorder.eventf(
+            pod, "Warning", "FailedScheduling", "Scheduling", message
+        )
+        self._set_pod_unschedulable_condition(pod, message)
+        if not error and not self.cfg.disable_preemption:
+            self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
+        self.queue.add_unschedulable_if_not_present(pi, moves0)
+
+    def _set_pod_unschedulable_condition(self, pod: v1.Pod, message: str) -> None:
+        def mutate(p):
+            for c in p.status.conditions:
+                if c.type == v1.COND_POD_SCHEDULED:
+                    c.status = "False"
+                    c.reason = "Unschedulable"
+                    c.message = message
+                    return p
+            p.status.conditions.append(
+                v1.PodCondition(
+                    type=v1.COND_POD_SCHEDULED,
+                    status="False",
+                    reason="Unschedulable",
+                    message=message,
+                )
+            )
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            pass
+
+    def _attempt_preemption(
+        self, pod, prof, fit_error, candidate_nodes: Optional[List[str]]
+    ) -> None:
+        """sched.preempt (scheduler.go:392): find victims, delete them, set
+        NominatedNodeName."""
+        if self._snapshot is None:
+            self._snapshot = self.cache.update_snapshot()
+        preemptor = self._preemptors[prof.name]
+        node, victims = preemptor.preempt(
+            pod, self._snapshot, fit_error, candidate_nodes or None
+        )
+        if not node:
+            return
+        for victim in victims:
+            try:
+                self.server.delete(
+                    "pods", victim.metadata.namespace, victim.metadata.name
+                )
+                prof.recorder.eventf(
+                    victim, "Normal", "Preempted", "Preempting",
+                    f"by {pod.metadata.key} on node {node}",
+                )
+                metrics.inc("preemption_victims")
+            except NotFound:
+                pass
+        metrics.inc("preemption_attempts")
+
+        def mutate(p):
+            p.status.nominated_node_name = node
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            return
+        self.queue.add_nominated_pod(pod, node)

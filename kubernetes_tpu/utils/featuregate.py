@@ -1,0 +1,76 @@
+"""Feature gates: named alpha/beta/GA switches.
+
+component-base/featuregate/feature_gate.go:87,294 equivalent, parsing the
+same --feature-gates=Name=true map form. Gates relevant to the TPU build are
+pre-registered; unknown gates error like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Mapping
+
+ALPHA, BETA, GA = "ALPHA", "BETA", "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    default: bool
+    pre_release: str = BETA
+    locked: bool = False
+
+
+DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
+    # TPU-native data plane per extension point (SURVEY §2.3: profile gate)
+    "TPUBatchScore": FeatureSpec(default=True, pre_release=BETA),
+    "TPUShardedNodes": FeatureSpec(default=True, pre_release=ALPHA),
+    "DeviceOracleVerify": FeatureSpec(default=False, pre_release=ALPHA),
+    # reference-parity gates the scheduler consults
+    "EvenPodsSpread": FeatureSpec(default=True, pre_release=BETA),
+    "PodPriority": FeatureSpec(default=True, pre_release=GA, locked=True),
+    "TaintNodesByCondition": FeatureSpec(default=True, pre_release=GA),
+    "PodOverhead": FeatureSpec(default=True, pre_release=BETA),
+    "NonPreemptingPriority": FeatureSpec(default=False, pre_release=ALPHA),
+}
+
+
+class FeatureGate:
+    def __init__(self, features: Mapping[str, FeatureSpec] = None):
+        self._lock = threading.Lock()
+        self._known = dict(features or DEFAULT_FEATURES)
+        self._enabled: Dict[str, bool] = {}
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            if name in self._enabled:
+                return self._enabled[name]
+            spec = self._known.get(name)
+            if spec is None:
+                raise KeyError(f"unknown feature gate {name}")
+            return spec.default
+
+    def set_from_map(self, m: Mapping[str, bool]) -> None:
+        with self._lock:
+            for name, val in m.items():
+                spec = self._known.get(name)
+                if spec is None:
+                    raise KeyError(f"unknown feature gate {name}")
+                if spec.locked and val != spec.default:
+                    raise ValueError(f"cannot set locked feature gate {name}")
+                self._enabled[name] = bool(val)
+
+    def set_from_string(self, s: str) -> None:
+        m = {}
+        for part in filter(None, (p.strip() for p in s.split(","))):
+            k, _, v = part.partition("=")
+            m[k] = v.lower() in ("true", "1", "t")
+        self.set_from_map(m)
+
+    def add(self, name: str, spec: FeatureSpec) -> None:
+        with self._lock:
+            self._known[name] = spec
+
+
+def default_feature_gate() -> FeatureGate:
+    return FeatureGate()

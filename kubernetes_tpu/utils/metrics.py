@@ -1,0 +1,112 @@
+"""Metrics registry: counters, gauges, histograms with label support.
+
+component-base/metrics-lite (reference wraps prometheus; scheduler series at
+pkg/scheduler/metrics/metrics.go:51-231). Same series names are used by the
+scheduler so dashboards translate: schedule_attempts_total,
+e2e_scheduling_duration_seconds, scheduling_algorithm_duration_seconds,
+binding_duration_seconds, pending_pods, queue_incoming_pods_total, etc.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_DEF_BUCKETS = [
+    0.0001, 0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05,
+    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0,
+]
+
+
+class Histogram:
+    def __init__(self, buckets: Optional[List[float]] = None):
+        self.buckets = buckets or _DEF_BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._samples: List[float] = []  # bounded reservoir for quantiles
+        self._max_samples = 100000
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        self.counts[i] += 1
+        self.total += v
+        self.n += 1
+        if len(self._samples) < self._max_samples:
+            self._samples.append(v)
+
+    def quantile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._hists: Dict[Tuple[str, Tuple], Histogram] = {}
+
+    @staticmethod
+    def _k(name: str, labels: Optional[dict]) -> Tuple[str, Tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[self._k(name, labels)] += by
+
+    def set_gauge(self, name: str, value: float, labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._gauges[self._k(name, labels)] = value
+
+    def observe(self, name: str, value: float, labels: Optional[dict] = None) -> None:
+        with self._lock:
+            k = self._k(name, labels)
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+            h.observe(value)
+
+    def counter(self, name: str, labels: Optional[dict] = None) -> float:
+        with self._lock:
+            return self._counters.get(self._k(name, labels), 0.0)
+
+    def histogram(self, name: str, labels: Optional[dict] = None) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(self._k(name, labels))
+
+    def reset(self) -> None:
+        """DELETE /metrics debug endpoint behavior (server.go:237-247)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {}
+            for (name, labels), v in self._counters.items():
+                out[f"{name}{dict(labels)}"] = v
+            for (name, labels), v in self._gauges.items():
+                out[f"{name}{dict(labels)}"] = v
+            for (name, labels), h in self._hists.items():
+                out[f"{name}{dict(labels)}"] = {
+                    "count": h.n,
+                    "avg": h.avg,
+                    "p50": h.quantile(0.50),
+                    "p90": h.quantile(0.90),
+                    "p99": h.quantile(0.99),
+                }
+            return out
+
+
+metrics = Metrics()  # process-global registry (legacyregistry equivalent)

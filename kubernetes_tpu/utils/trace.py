@@ -1,0 +1,40 @@
+"""Step tracing with log-if-long semantics.
+
+utiltrace equivalent (vendor/k8s.io/utils/trace/trace.go:55,64, used at
+generic_scheduler.go:151-152): record named steps; emit only when total
+duration exceeds the threshold — the slow-batch reporter for device cycles.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu")
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.monotonic()
+        self.steps: List[Tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.monotonic(), msg))
+
+    def total(self) -> float:
+        return time.monotonic() - self.start
+
+    def log_if_long(self, threshold: float) -> bool:
+        total = self.total()
+        if total < threshold:
+            return False
+        parts = [f'"{self.name}" {self.fields} ({total*1000:.1f}ms):']
+        last = self.start
+        for t, msg in self.steps:
+            parts.append(f"  +{(t - last)*1000:.1f}ms {msg}")
+            last = t
+        logger.warning("\n".join(parts))
+        return True
