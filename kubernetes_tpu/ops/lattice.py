@@ -266,11 +266,11 @@ def _gather_counts(counts, extra, sid):
 
 
 @functools.lru_cache(maxsize=32)
-def make_schedule_batch(v_cap: int, hard_pod_affinity_weight: float = 1.0):
-    """Build the jitted batch kernel for a given domain-segment capacity.
+def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
+    """Build the (unjitted) batch kernel for a given domain-segment capacity.
 
-    Cached per (v_cap, weight): XLA recompiles only when the domain-segment
-    capacity grows (vocabulary doubling), not per scheduling cycle."""
+    Cached per (v_cap, weight); jitted by make_schedule_batch (single device)
+    or parallel.sharded.make_sharded_schedule_batch (mesh)."""
 
     def pod_static(snap: DeviceSnapshot, bp) -> Tuple:
         """Stage A for one pod: static mask/score pieces. Returns
@@ -467,7 +467,6 @@ def make_schedule_batch(v_cap: int, hard_pod_affinity_weight: float = 1.0):
         out = (chosen, jnp.where(ok, best, -jnp.inf), feas_count, resolvable)
         return new_carry, out
 
-    @jax.jit
     def schedule_batch(
         snap: DeviceSnapshot, batch: PodBatch, weights: jnp.ndarray, rng: jnp.ndarray
     ) -> BatchResult:
@@ -491,3 +490,9 @@ def make_schedule_batch(v_cap: int, hard_pod_affinity_weight: float = 1.0):
         )
 
     return schedule_batch
+
+
+@functools.lru_cache(maxsize=32)
+def make_schedule_batch(v_cap: int, hard_pod_affinity_weight: float = 1.0):
+    """Single-device jitted batch kernel (cached per capacity)."""
+    return jax.jit(make_schedule_batch_raw(v_cap, hard_pod_affinity_weight))

@@ -1,0 +1,4 @@
+"""Mesh construction + node-axis sharded scheduling step."""
+
+from .mesh import make_mesh, snapshot_shardings, replicated  # noqa: F401
+from .sharded import make_sharded_schedule_batch, shard_snapshot  # noqa: F401

@@ -1,0 +1,63 @@
+"""Device mesh + sharding specs for the scheduling data plane.
+
+The scaling axis of a cluster scheduler is node count (SURVEY.md §5
+"long-context" analogue): every [N, ·] snapshot tensor shards over the mesh's
+"nodes" axis — the way sequence parallelism shards a context — and the
+per-pod reductions (feasible-mask AND, score max/argmax, topology-domain
+segment sums) become XLA collectives over ICI inserted by the SPMD
+partitioner under jit. Pod batches and vocabulary-indexed metadata are
+replicated (small).
+
+Replaces the reference's process-parallel sharding story (informer fan-out +
+16-goroutine ParallelizeUntil, SURVEY.md §2.3) with mesh parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.encoding import DeviceSnapshot
+
+NODES_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (NODES_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
+    """Sharding pytree for DeviceSnapshot: row-major arrays shard on the node
+    axis; [T]-shaped eterm metadata replicates."""
+    row = NamedSharding(mesh, P(NODES_AXIS))
+    row2 = NamedSharding(mesh, P(NODES_AXIS, None))
+    row3 = NamedSharding(mesh, P(NODES_AXIS, None, None))
+    rep = replicated(mesh)
+    return DeviceSnapshot(
+        valid=row,
+        unschedulable=row,
+        allocatable=row2,
+        requested=row2,
+        nonzero_req=row2,
+        label_vals=row2,
+        label_numvals=row2,
+        taint_key=row2,
+        taint_val=row2,
+        taint_effect=row2,
+        sel_counts=row2,
+        eterm_w=row2,
+        eterm_topo_key=rep,
+        eterm_kind=rep,
+        port_counts=row2,
+        image_bytes=row2,
+        avoid=row2,
+    )

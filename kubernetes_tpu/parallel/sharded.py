@@ -1,0 +1,64 @@
+"""Node-axis sharded scheduling step.
+
+jit-compiles the same lattice kernel (ops/lattice.py) with the snapshot
+sharded over the mesh's "nodes" axis. The SPMD partitioner turns:
+  * the feasible-mask AND / per-node filter math → purely local work,
+  * topology-domain segment-sums → local scatter-adds + psum over ICI
+    (domain ids are global, so partial sums reduce across shards),
+  * score max / argmax select → local max + pmax/all-gather of candidates,
+  * the scan carry scatter (.at[idx].add) → a one-shard update.
+This is the TPU equivalent of the reference's "shard informer fan-out +
+goroutines per node chunk" (SURVEY.md §2.3 table) with ICI instead of
+channels, and of its multi-host story (DCN) when the mesh spans hosts via
+jax.distributed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.encoding import DeviceSnapshot, PodBatch
+from ..ops.lattice import BatchResult, make_schedule_batch_raw
+from .mesh import NODES_AXIS, replicated, snapshot_shardings
+
+
+def shard_snapshot(snap: DeviceSnapshot, mesh: Mesh) -> DeviceSnapshot:
+    """Place a snapshot onto the mesh with node-axis sharding. Row counts are
+    capacity-padded powers of two, so they divide evenly over the mesh."""
+    shardings = snapshot_shardings(mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), snap, shardings
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def make_sharded_schedule_batch(
+    v_cap: int, mesh: Mesh, hard_pod_affinity_weight: float = 1.0
+):
+    """The lattice kernel jitted with explicit in/out shardings over `mesh`.
+
+    Everything except the snapshot is replicated; results (chosen rows,
+    scores, counts) are replicated so the host reads them without gathers.
+    The resolvable [P, N] mask stays sharded on N (it is only consulted for
+    failed pods, host-side, via per-row gathers).
+    """
+    base = make_schedule_batch_raw(v_cap, hard_pod_affinity_weight)
+    rep = replicated(mesh)
+    in_shardings = (
+        snapshot_shardings(mesh),
+        PodBatch(*([rep] * len(PodBatch._fields))),
+        rep,
+        rep,
+    )
+    out_shardings = BatchResult(
+        chosen=rep,
+        score=rep,
+        feasible_count=rep,
+        resolvable=NamedSharding(mesh, P(None, NODES_AXIS)),
+    )
+    return jax.jit(base, in_shardings=in_shardings, out_shardings=out_shardings)

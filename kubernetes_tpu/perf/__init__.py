@@ -1,0 +1,4 @@
+"""scheduler_perf-equivalent benchmark harness."""
+
+from .workloads import WORKLOADS, WorkloadConfig, build_workload  # noqa: F401
+from .harness import run_benchmark, BenchResult  # noqa: F401

@@ -1,0 +1,128 @@
+"""Benchmark harness: in-process topology, throughput + latency collection.
+
+Mirrors test/integration/scheduler_perf (util.go:55 mustSetupScheduler,
+:210-251 throughputCollector): in-memory API store + real scheduler + real
+informers, no kubelets (binding is acknowledged by the store, the moral
+equivalent of the fake PV controller / hollow-node trick). Reports
+sustained throughput (scheduled pods per second over the measurement
+window) and the latency histograms the reference collects.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.objects import Pod
+from ..client.apiserver import APIServer
+from ..scheduler import KubeSchedulerConfiguration, Scheduler
+from ..utils.metrics import metrics
+from .workloads import WorkloadConfig, build_workload
+
+
+@dataclass
+class BenchResult:
+    workload: str
+    num_nodes: int
+    num_measured_pods: int
+    duration_s: float
+    throughput_pods_per_s: float
+    scheduled: int
+    unscheduled: int
+    e2e_p50_ms: float = 0.0
+    e2e_p90_ms: float = 0.0
+    e2e_p99_ms: float = 0.0
+    algo_p99_ms: float = 0.0
+    samples: List[int] = field(default_factory=list)  # scheduled count / 100ms
+
+    def to_dict(self) -> dict:
+        d = self.__dict__.copy()
+        d.pop("samples", None)
+        return d
+
+
+def run_benchmark(
+    cfg: WorkloadConfig,
+    sched_config: Optional[KubeSchedulerConfiguration] = None,
+    timeout_s: float = 300.0,
+    quiet: bool = True,
+) -> BenchResult:
+    metrics.reset()
+    server = APIServer()
+    scfg = sched_config or KubeSchedulerConfiguration()
+    sched = Scheduler(server, scfg)
+
+    nodes, init_pods, factory = build_workload(cfg)
+    for n in nodes:
+        server.create("nodes", n)
+
+    sched.start()
+
+    # init pods: scheduled before measurement starts (mustSetupScheduler's
+    # "init pods" stage)
+    for p in init_pods:
+        server.create("pods", p)
+    _wait_all_scheduled(server, len(init_pods), timeout_s)
+
+    measured = [factory(i) for i in range(cfg.num_measured_pods)]
+    # warm the kernel before the clock starts (XLA compile is one-off)
+    t0 = time.monotonic()
+    for p in measured:
+        server.create("pods", p)
+    create_done = time.monotonic()
+
+    total_target = len(init_pods) + cfg.num_measured_pods
+    samples = []
+    deadline = time.monotonic() + timeout_s
+    scheduled = 0
+    while time.monotonic() < deadline:
+        scheduled = _count_scheduled(server)
+        samples.append(scheduled)
+        if scheduled >= total_target:
+            break
+        time.sleep(0.05)
+    t1 = time.monotonic()
+    sched.stop()
+
+    measured_scheduled = scheduled - len(init_pods)
+    duration = t1 - t0
+    thr = measured_scheduled / duration if duration > 0 else 0.0
+    e2e = metrics.histogram("e2e_scheduling_duration_seconds")
+    algo = metrics.histogram("scheduling_algorithm_duration_seconds")
+    res = BenchResult(
+        workload=cfg.name,
+        num_nodes=cfg.num_nodes,
+        num_measured_pods=cfg.num_measured_pods,
+        duration_s=duration,
+        throughput_pods_per_s=thr,
+        scheduled=measured_scheduled,
+        unscheduled=cfg.num_measured_pods - measured_scheduled,
+        e2e_p50_ms=(e2e.quantile(0.5) * 1000 if e2e else 0.0),
+        e2e_p90_ms=(e2e.quantile(0.9) * 1000 if e2e else 0.0),
+        e2e_p99_ms=(e2e.quantile(0.99) * 1000 if e2e else 0.0),
+        algo_p99_ms=(algo.quantile(0.99) * 1000 if algo else 0.0),
+        samples=samples,
+    )
+    if not quiet:
+        print(
+            f"{cfg.name}/{cfg.num_nodes}: {thr:.0f} pods/s "
+            f"({measured_scheduled}/{cfg.num_measured_pods} in {duration:.2f}s; "
+            f"create took {create_done - t0:.2f}s), "
+            f"e2e p99 {res.e2e_p99_ms:.1f}ms"
+        )
+    return res
+
+
+def _count_scheduled(server: APIServer) -> int:
+    pods, _ = server.list("pods")
+    return sum(1 for p in pods if p.spec.node_name)
+
+
+def _wait_all_scheduled(server: APIServer, count: int, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _count_scheduled(server) >= count:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("init pods did not all schedule")

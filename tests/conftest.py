@@ -8,10 +8,16 @@ hollow nodes, SURVEY.md §4). Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: env presets axon (TPU)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# the image's sitecustomize pre-imports jax._src, which snapshots
+# JAX_PLATFORMS=axon before this file runs — override via config too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
