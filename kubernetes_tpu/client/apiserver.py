@@ -188,6 +188,40 @@ class APIServer:
 
     # -- typed convenience used by the scheduler ----------------------------
 
+    def bind_pods(self, bindings) -> list:
+        """Batch bind: one lock acquisition for a whole device batch (the
+        uplink analogue of the reference's per-pod POST /binding — our
+        scheduler commits hundreds of placements per cycle, so the API layer
+        accepts them in bulk). Returns per-binding error strings (None = ok).
+        """
+        errors = []
+        with self._lock:
+            for b in bindings:
+                try:
+                    store = self._objects.get("pods", {})
+                    key = f"{b.pod_namespace}/{b.pod_name}"
+                    pod = store.get(key)
+                    if pod is None:
+                        raise NotFound(f"pods {key} not found")
+                    if pod.spec.node_name:
+                        raise Conflict(f"pod {key} already bound")
+                    if b.pod_uid and pod.metadata.uid != b.pod_uid:
+                        raise Conflict("uid mismatch on binding")
+                    pod.spec.node_name = b.target_node
+                    self._bump(pod)
+                    self._notify(
+                        "pods",
+                        Event(
+                            MODIFIED,
+                            copy.deepcopy(pod),
+                            pod.metadata.resource_version,
+                        ),
+                    )
+                    errors.append(None)
+                except (NotFound, Conflict) as e:
+                    errors.append(str(e))
+        return errors
+
     def bind_pod(self, binding) -> None:
         """POST pods/{name}/binding: set spec.nodeName if not already bound.
 

@@ -723,6 +723,17 @@ class SnapshotEncoder:
     def invalidate_device(self) -> None:
         self._full_upload = True
 
+    def set_device_snapshot(self, snap: DeviceSnapshot) -> None:
+        """Install a kernel-returned snapshot (occupancy committed on device).
+
+        The wave kernel donates the input snapshot and returns it with batch
+        commits applied; the scheduler replays the same commits into the host
+        masters (via cache assume → add_pod), so a subsequent row-set flush
+        writes identical values — device and host stay convergent without a
+        delta-add protocol, as long as replay happens before the next flush
+        (the synchronous cycle guarantees it)."""
+        self._device = snap
+
 
 # Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata
 # columns, replaced wholesale on flush instead of row-scattered).

@@ -1,0 +1,541 @@
+"""Wave-commit lattice: vectorized bulk pass + bounded conflict-resolution.
+
+The first-cut kernel (ops/lattice.py) reproduced scheduleOne's serial
+semantics as a P-step lax.scan — measured at ~3.5 ms/pod on hardware because
+every step re-ran topology segment-sums and rewrote a multi-MB carry. This
+kernel restructures the batch cycle so nothing scales with P serially:
+
+  Stage A (fully vectorized, template granularity):
+    * filter masks, score matrix, normalization per TEMPLATE [TPL, N] — a
+      burst of Deployment pods is one template, not P pods;
+    * topology-domain sums ONCE per (predicate, topology-key) pair [J, V]
+      (the PairTable), not once per pod;
+    * per-template top-M candidate nodes; per-pod candidate order =
+      score-descending with per-pod random tie-noise (selectHost's uniform
+      tie-break, generic_scheduler.go:235).
+
+  Stage B (W waves, all-vectorized):
+    every wave, each unplaced pod takes its best still-feasible candidate;
+    conflicts are resolved batch-wide: per-node capacity by prefix-fit in
+    pod order, per-(pair, domain) exclusivity by lowest pod index (one
+    contributor per topology domain per wave keeps anti-affinity/spread
+    sound). Losers retry next wave against updated deltas. The lowest
+    active pod always wins all its groups, so every wave commits ≥1 pod —
+    no livelock; leftovers defer to the next batch.
+
+Serial-equivalence note (SURVEY §7 hard part (c)): within a batch, scores
+are not recomputed after each commit (reference recomputes per pod), and
+near-tie candidates may swap under the tie-noise epsilon. Placements remain
+feasible-at-commit-time under full filter semantics; the divergence is
+bounded to score staleness inside one batch window — the same staleness the
+reference tolerates between its snapshot and async binds.
+
+The snapshot's occupancy tensors are DONATED and returned updated with all
+committed pods, so consecutive batches chain on-device with no host round
+trip (SURVEY §7 hard part (d): persistent device state, delta-only uplink).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import (
+    DeviceSnapshot,
+    ETERM_AFF_PREF,
+    ETERM_AFF_REQ,
+    ETERM_ANTI_PREF,
+    ETERM_ANTI_REQ,
+    PodBatch,
+    RES_CPU,
+    RES_MEM,
+)
+from .lattice import (
+    DEFAULT_WEIGHTS,
+    NUM_SCORE_COMPONENTS,
+    SC_BALANCED,
+    SC_IMAGE,
+    SC_INTERPOD,
+    SC_LEAST_ALLOC,
+    SC_MOST_ALLOC,
+    SC_NODE_AFFINITY,
+    SC_PREFER_AVOID,
+    SC_REQ_TO_CAP,
+    SC_TAINT,
+    SC_TOPO_SPREAD,
+    _image_locality,
+    _label_cols,
+    _node_affinity_required,
+    _node_affinity_score,
+    _prefer_avoid,
+    _taints,
+)
+from .templates import PairTable, TemplateBatch
+
+TIE_EPS = 1e-3
+
+
+class WaveResult(NamedTuple):
+    chosen: Any  # [P] int32 node row, -1 = not placed
+    placed: Any  # [P] bool
+    deferred: Any  # [P] bool — feasible nodes existed but waves ran out
+    feasible_count: Any  # [P] int32 base-feasible node count
+    score: Any  # [P] float32
+    resolvable_tpl: Any  # [TPL, N] bool — preemption candidates per template
+
+
+def _group_prefix_sums(groups, sort_key, values):
+    """Exclusive prefix sums of `values` within equal-`groups` runs after
+    sorting by sort_key (sort_key must sort group-contiguously, e.g.
+    group*(P+1)+idx). Returns (order, exclusive_prefix[sorted order])."""
+    order = jnp.argsort(sort_key)
+    g = groups[order]
+    v = values[order]
+    cum = jnp.cumsum(v, axis=0)
+    excl_global = cum - v
+    # group start position via running max over indices where a new group starts
+    pos = jnp.arange(g.shape[0])
+    is_start = jnp.concatenate([jnp.array([True]), g[1:] != g[:-1]])
+    start_pos = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, pos, -1)
+    )
+    base = excl_global[start_pos]
+    return order, excl_global - base
+
+
+@functools.lru_cache(maxsize=32)
+def make_wave_kernel(
+    v_cap: int,
+    m_cand: int = 128,
+    n_waves: int = 8,
+    hard_pod_affinity_weight: float = 1.0,
+):
+    """Build the wave kernel (unjitted) for the given static capacities."""
+
+    def kernel(snap: DeviceSnapshot, tb: TemplateBatch, pt: PairTable, weights, rng):
+        tpl: PodBatch = tb.tpl
+        n = snap.valid.shape[0]
+        TPL = tpl.valid.shape[0]
+        P = tb.pod_tpl.shape[0]
+        J = pt.col.shape[0]
+        m_c = min(m_cand, n)  # candidate list cannot exceed node capacity
+
+        # ================= Stage A: per-template statics =================
+        def statics_one(bp):
+            ns_aff = _node_affinity_required(snap, bp)
+            taint_ok, prefer_cnt = _taints(snap, bp)
+            unsched_ok = ~snap.unschedulable | bp.tolerates_unschedulable
+            static_ok = snap.valid & ns_aff & taint_ok & unsched_ok
+            return (
+                static_ok,
+                ns_aff,
+                _node_affinity_score(snap, bp),
+                prefer_cnt,
+                _image_locality(snap, bp),
+                _prefer_avoid(snap, bp),
+            )
+
+        static_ok, ns_aff, aff_score, prefer_cnt, img, avoid = jax.vmap(
+            statics_one
+        )(tpl)  # each [TPL, N]
+
+        free0 = snap.allocatable - snap.requested  # [N, R]
+        fits0 = jnp.all(
+            (tpl.req[:, None, :] == 0) | (tpl.req[:, None, :] <= free0[None]),
+            axis=-1,
+        )  # [TPL, N]
+        ports0 = jnp.any(
+            tpl.port_mask[:, None, :] & (snap.port_counts[None] > 0), axis=-1
+        )  # [TPL, N]
+
+        # ---- pair domain structure ----
+        def pair_cols(j):
+            col = jnp.clip(pt.col[j], 0, None)
+            sidv = snap.sel_counts[:, jnp.clip(col, 0, snap.sel_counts.shape[1] - 1)]
+            etv = snap.eterm_w[:, jnp.clip(col, 0, snap.eterm_w.shape[1] - 1)]
+            w = jnp.where(pt.is_eterm[j], etv, sidv.astype(jnp.float32))
+            dom, _ = _label_cols(snap, pt.key[j])
+            e = pt.elig_tpl[j]
+            elig = jnp.where(
+                e >= 0, ns_aff[jnp.clip(e, 0, TPL - 1)], jnp.ones_like(snap.valid)
+            )
+            elig = elig & snap.valid & (dom >= 0)
+            return w, dom, elig
+
+        w_j, dom_j, elig_j = jax.vmap(pair_cols)(jnp.arange(J))  # [J, N]
+
+        def dom_sums(w, dom, elig, delta):
+            seg = jnp.where(elig, dom, v_cap)
+            sums = jax.ops.segment_sum(
+                jnp.where(elig, w, 0.0), seg, num_segments=v_cap
+            ) + delta  # [V]
+            present = (
+                jax.ops.segment_max(elig.astype(jnp.int32), seg, num_segments=v_cap)
+                > 0
+            )
+            node_cnt = jnp.where(dom >= 0, sums[jnp.clip(dom, 0, v_cap - 1)], 0.0)
+            min_dom = jnp.min(jnp.where(present, sums, jnp.inf))
+            return node_cnt, min_dom, jnp.sum(sums), sums
+
+        cnt0, min0, tot0, base_dom = jax.vmap(dom_sums)(
+            w_j, dom_j, elig_j, jnp.zeros((J, v_cap))
+        )  # cnt0 [J, N]; base_dom [J, V]
+        present_dom = jax.vmap(
+            lambda j: jax.ops.segment_max(
+                elig_j[j].astype(jnp.int32),
+                jnp.where(elig_j[j], dom_j[j], v_cap),
+                num_segments=v_cap,
+            )
+            > 0
+        )(jnp.arange(J))  # [J, V] — wave-invariant
+
+        def tpl_pair_verdicts(t, cnt, min_d, tot):
+            """Carry-dependent filter verdicts for template t given pair
+            counts (cnt [J, N], min_d [J], tot [J])."""
+            def spread_c(pair, skew, hard, selfm):
+                ok_pair = pair >= 0
+                p = jnp.clip(pair, 0, J - 1)
+                haskey = dom_j[p] >= 0
+                m = jnp.where(jnp.isfinite(min_d[p]), min_d[p], 0.0)
+                skewed = cnt[p] + jnp.where(selfm, 1.0, 0.0) - m > skew
+                bad = hard & (skewed | ~haskey)
+                soft = jnp.where(~hard, cnt[p], 0.0)
+                return jnp.where(ok_pair, bad, False), jnp.where(ok_pair, soft, 0.0)
+
+            sbad, ssoft = jax.vmap(spread_c)(
+                pt.spr_pair[t], pt.spr_skew[t], pt.spr_hard[t], pt.spr_self[t]
+            )
+            spread_bad = jnp.any(sbad, axis=0)
+            spread_pen = jnp.sum(ssoft, axis=0)
+
+            def aff_a(pair, selfm):
+                ok_pair = pair >= 0
+                p = jnp.clip(pair, 0, J - 1)
+                haskey = dom_j[p] >= 0
+                ok = (cnt[p] > 0) | ((tot[p] == 0) & selfm & haskey)
+                return jnp.where(ok_pair, ok, True)
+
+            aff_ok = jnp.all(jax.vmap(aff_a)(pt.aff_pair[t], pt.aff_self[t]), axis=0)
+
+            def anti_b(pair):
+                ok_pair = pair >= 0
+                p = jnp.clip(pair, 0, J - 1)
+                bad = (dom_j[p] >= 0) & (cnt[p] > 0)
+                return jnp.where(ok_pair, bad, False)
+
+            anti_bad = jnp.any(jax.vmap(anti_b)(pt.anti_pair[t]), axis=0)
+
+            et_rel = pt.etm_match[t] & (pt.kind == ETERM_ANTI_REQ)  # [J]
+            eterm_bad = jnp.any(
+                et_rel[:, None] & (dom_j >= 0) & (cnt > 0), axis=0
+            )
+            return spread_bad, spread_pen, aff_ok, anti_bad, eterm_bad
+
+        spread_bad0, spread_pen0, aff_ok0, anti_bad0, eterm_bad0 = jax.vmap(
+            lambda t: tpl_pair_verdicts(t, cnt0, min0, tot0)
+        )(jnp.arange(TPL))
+
+        feasible0 = (
+            static_ok & fits0 & ~ports0 & ~spread_bad0 & aff_ok0 & ~anti_bad0
+            & ~eterm_bad0
+        )  # [TPL, N]
+        resolvable_tpl = static_ok & ~feasible0
+        feas_cnt_tpl = jnp.sum(feasible0.astype(jnp.int32), axis=1)  # [TPL]
+
+        # ---- scores [TPL, N] ----
+        nz_used = (
+            snap.nonzero_req[None] + tpl.nonzero_req[:, None, :]
+        ).astype(jnp.float32)  # [TPL, N, R]
+        alloc = jnp.maximum(snap.allocatable.astype(jnp.float32), 1.0)[None]
+        frac = jnp.clip(nz_used / alloc, 0.0, 1.0)
+        cpu_f, mem_f = frac[..., RES_CPU], frac[..., RES_MEM]
+        least = ((1.0 - cpu_f) + (1.0 - mem_f)) * 50.0
+        most = (cpu_f + mem_f) * 50.0
+        balanced = (1.0 - jnp.abs(cpu_f - mem_f)) * 100.0
+        rtc = (cpu_f + mem_f) * 50.0
+
+        # interpod score: existing pods' terms + incoming preferred terms
+        sgn = jnp.select(
+            [
+                pt.kind == ETERM_ANTI_PREF,
+                pt.kind == ETERM_AFF_PREF,
+                pt.kind == ETERM_AFF_REQ,
+            ],
+            [-1.0, 1.0, hard_pod_affinity_weight],
+            default=0.0,
+        )  # [J]
+        ip_et = jnp.einsum(
+            "tj,jn->tn", pt.etm_match.astype(jnp.float32) * sgn[None, :], cnt0
+        )
+
+        def ppref_t(t):
+            def one(pair, w):
+                p = jnp.clip(pair, 0, J - 1)
+                return jnp.where(pair >= 0, w * cnt0[p], 0.0)
+
+            return jnp.sum(jax.vmap(one)(pt.pref_pair[t], pt.pref_w[t]), axis=0)
+
+        ip = ip_et + jax.vmap(ppref_t)(jnp.arange(TPL))  # [TPL, N]
+
+        def norm_max(x, feas):
+            mx = jnp.max(jnp.where(feas, x, -jnp.inf), axis=1, keepdims=True)
+            safe = jnp.where(jnp.isfinite(mx) & (mx > 0), mx, 1.0)
+            return jnp.clip(x / safe * 100.0, 0.0, 100.0)
+
+        def norm_invert(x, feas):
+            mx = jnp.max(jnp.where(feas, x, -jnp.inf), axis=1, keepdims=True)
+            ok = jnp.isfinite(mx) & (mx > 0)
+            safe = jnp.where(ok, mx, 1.0)
+            return jnp.where(ok, (safe - x) / safe * 100.0, 100.0)
+
+        ip_mx = jnp.max(
+            jnp.where(feasible0, jnp.abs(ip), 0.0), axis=1, keepdims=True
+        )
+        ip_norm = jnp.where(ip_mx > 0, ip / ip_mx * 100.0, 0.0)
+
+        comps = jnp.stack(
+            [
+                least,
+                most,
+                balanced,
+                rtc,
+                norm_max(aff_score, feasible0),
+                norm_invert(prefer_cnt, feasible0),
+                img,
+                avoid,
+                norm_invert(spread_pen0, feasible0),
+                ip_norm,
+            ]
+        )  # [K, TPL, N]
+        total_score = jnp.einsum("k,ktn->tn", weights, comps)
+
+        # ---- top-M candidates per template ----
+        masked = jnp.where(feasible0, total_score, -jnp.inf)
+        top_v, top_i = jax.lax.top_k(masked, m_c)  # [TPL, M]
+
+        # ---- per-pod candidate ordering ----
+        t_of = jnp.clip(tb.pod_tpl, 0, TPL - 1)  # [P]
+        noise = jax.random.uniform(rng, (P, m_c), maxval=0.999)
+        # top_v is sorted descending; equal-score runs form groups. Order
+        # candidates by score-group, uniformly random within a group (the
+        # float-safe form of selectHost's uniform tie-break — adding tiny
+        # noise to raw scores underflows when weights reach 1e4×100).
+        grp_id = jnp.cumsum(
+            jnp.concatenate(
+                [jnp.zeros((TPL, 1), jnp.float32),
+                 (top_v[:, 1:] != top_v[:, :-1]).astype(jnp.float32)],
+                axis=1,
+            ),
+            axis=1,
+        )  # [TPL, M]
+        pod_v = top_v[t_of]  # [P, M]
+        order = jnp.argsort(grp_id[t_of] + noise, axis=1)  # [P, M]
+        cand_nodes = jnp.take_along_axis(top_i[t_of], order, axis=1)  # [P, M]
+        cand_valid = jnp.isfinite(jnp.take_along_axis(pod_v, order, axis=1))
+        # pinned pods: single candidate = the pinned row (still filter-checked)
+        pinned = tb.pod_name_row >= 0
+        cand_nodes = jnp.where(
+            pinned[:, None],
+            jnp.where(
+                jnp.arange(m_c)[None, :] == 0,
+                jnp.clip(tb.pod_name_row, 0, n - 1)[:, None],
+                0,
+            ),
+            cand_nodes,
+        )
+        pin_feas = jnp.take_along_axis(
+            feasible0[t_of], jnp.clip(tb.pod_name_row, 0, n - 1)[:, None], axis=1
+        )[:, 0]
+        cand_valid = jnp.where(
+            pinned[:, None],
+            (jnp.arange(m_c)[None, :] == 0) & pin_feas[:, None],
+            cand_valid,
+        )
+        cand_nodes = jnp.clip(cand_nodes, 0, n - 1)
+
+        # which pods participate in pair exclusivity (contributor or
+        # hard-checker), per pair
+        checks = jnp.zeros((TPL, J), bool)
+        def scatter_pairs(checks, pairs, extra_mask=None):
+            m = pairs >= 0 if extra_mask is None else (pairs >= 0) & extra_mask
+            idx = jnp.clip(pairs, 0, J - 1)
+            return checks.at[jnp.arange(TPL)[:, None], idx].max(m)
+
+        checks = scatter_pairs(checks, pt.spr_pair, pt.spr_hard)
+        checks = scatter_pairs(checks, pt.anti_pair)
+        checks = checks | (pt.etm_match & (pt.kind == ETERM_ANTI_REQ)[None, :])
+        participates = checks | (pt.contrib > 0)  # [TPL, J]
+        uses_carveout = jnp.zeros((TPL, J), bool)
+        uses_carveout = scatter_pairs(uses_carveout, pt.aff_pair, pt.aff_self)
+
+        # resource matrix for prefix-fit: requests ⧺ port usage (capacity 1)
+        PV = snap.port_counts.shape[1]
+        req_ext_tpl = jnp.concatenate(
+            [tpl.req.astype(jnp.int32), tpl.port_mask.astype(jnp.int32)], axis=1
+        )  # [TPL, R+PV]
+
+        # ================= Stage B: waves =================
+        def wave(_, state):
+            placed, chosen, req_d, port_d, dom_d = state
+            free_d = free0 - req_d  # [N, R]
+            fits_w = jnp.all(
+                (tpl.req[:, None, :] == 0)
+                | (tpl.req[:, None, :] <= free_d[None]),
+                axis=-1,
+            )
+            ports_w = jnp.any(
+                tpl.port_mask[:, None, :]
+                & ((snap.port_counts + port_d)[None] > 0),
+                axis=-1,
+            )
+            cnt_w = cnt0 + jax.vmap(
+                lambda j: jnp.where(
+                    dom_j[j] >= 0, dom_d[j][jnp.clip(dom_j[j], 0, v_cap - 1)], 0.0
+                )
+            )(jnp.arange(J))
+            sums_w = base_dom + dom_d  # [J, V]
+            min_w = jnp.min(jnp.where(present_dom, sums_w, jnp.inf), axis=1)
+            tot_w = tot0 + jnp.sum(dom_d, axis=1)
+
+            sb, _, ao, ab, eb = jax.vmap(
+                lambda t: tpl_pair_verdicts(t, cnt_w, min_w, tot_w)
+            )(jnp.arange(TPL))
+            wave_feas = static_ok & fits_w & ~ports_w & ~sb & ao & ~ab & ~eb
+
+            cand_feas = (
+                jnp.take_along_axis(wave_feas[t_of], cand_nodes, axis=1)
+                & cand_valid
+            )  # [P, M]
+            first = jnp.argmax(cand_feas, axis=1)
+            has = jnp.any(cand_feas, axis=1)
+            cand_n = cand_nodes[jnp.arange(P), first]
+            active = tb.pod_valid & ~placed & has
+
+            # -- capacity prefix-fit in pod order --
+            grp = jnp.where(active, cand_n, n)
+            sort_key = grp * (P + 1) + jnp.arange(P)
+            vals = req_ext_tpl[t_of] * active[:, None].astype(jnp.int32)
+            order_c, excl = _group_prefix_sums(grp, sort_key, vals)
+            free_ext = jnp.concatenate(
+                [
+                    free_d,
+                    1 - jnp.minimum(snap.port_counts + port_d, 1),
+                ],
+                axis=1,
+            )  # [N, R+PV]
+            node_sorted = cand_n[order_c]
+            req_sorted = req_ext_tpl[t_of][order_c]
+            fit_sorted = jnp.all(
+                excl + req_sorted <= free_ext[node_sorted], axis=1
+            )
+            fit_ok = jnp.zeros(P, bool).at[order_c].set(fit_sorted)
+
+            # -- (pair, domain) exclusivity --
+            part = participates[t_of] & active[:, None]  # [P, J]
+            pod_dom = dom_j[:, cand_n].T  # [P, J] domain of candidate per pair
+            carve = (
+                uses_carveout[t_of] & (tot_w == 0)[None, :] & active[:, None]
+            )
+            key_pd = jnp.where(
+                carve,
+                jnp.arange(J)[None, :] * (v_cap + 2) + v_cap + 1,
+                jnp.arange(J)[None, :] * (v_cap + 2)
+                + jnp.clip(pod_dom, 0, v_cap - 1),
+            )
+            part = part & ((pod_dom >= 0) | carve)
+            flat_key = jnp.where(part, key_pd, J * (v_cap + 2)).reshape(-1)
+            pod_idx_mat = jnp.broadcast_to(
+                jnp.arange(P)[:, None], (P, J)
+            ).reshape(-1)
+            seg_min = jax.ops.segment_min(
+                pod_idx_mat, flat_key, num_segments=J * (v_cap + 2) + 1
+            )
+            is_winner = (seg_min[flat_key] == pod_idx_mat).reshape(P, J)
+            dom_ok = jnp.all(is_winner | ~part, axis=1)
+
+            commit = active & fit_ok & dom_ok
+            ci = jnp.where(commit, cand_n, n)  # OOB -> dropped
+            req_d = req_d.at[ci].add(tpl.req[t_of], mode="drop")
+            port_d = port_d.at[ci].add(
+                tpl.port_mask[t_of].astype(jnp.int32), mode="drop"
+            )
+            contrib_p = pt.contrib[t_of] * commit[:, None]  # [P, J]
+            dd_key = jnp.where(
+                (pod_dom >= 0) & (contrib_p != 0),
+                jnp.arange(J)[None, :] * v_cap + jnp.clip(pod_dom, 0, v_cap - 1),
+                J * v_cap,
+            ).reshape(-1)
+            dom_d = (
+                dom_d.reshape(-1)
+                .at[dd_key]
+                .add(contrib_p.reshape(-1), mode="drop")
+                .reshape(J, v_cap)
+            )
+            placed = placed | commit
+            chosen = jnp.where(commit, cand_n, chosen)
+            return placed, chosen, req_d, port_d, dom_d
+
+        state0 = (
+            jnp.zeros(P, bool),
+            jnp.full(P, -1, jnp.int32),
+            jnp.zeros_like(snap.requested),
+            jnp.zeros_like(snap.port_counts),
+            jnp.zeros((J, v_cap), jnp.float32),
+        )
+        placed, chosen, req_d, port_d, dom_d = jax.lax.fori_loop(
+            0, n_waves, wave, state0
+        )
+
+        # ================= finalize: commit occupancy to snapshot ==========
+        ci = jnp.where(placed, chosen, n)
+        new_snap = snap._replace(
+            requested=snap.requested.at[ci].add(tpl.req[t_of], mode="drop"),
+            nonzero_req=snap.nonzero_req.at[ci].add(
+                tpl.nonzero_req[t_of], mode="drop"
+            ),
+            sel_counts=snap.sel_counts.at[ci].add(
+                tpl.match_sel[t_of].astype(jnp.int32), mode="drop"
+            ),
+            eterm_w=snap.eterm_w.at[ci].add(tpl.eterm_add[t_of], mode="drop"),
+            port_counts=snap.port_counts.at[ci].add(
+                tpl.port_mask[t_of].astype(jnp.int32), mode="drop"
+            ),
+        )
+
+        feas_cnt = jnp.where(tb.pod_valid, feas_cnt_tpl[t_of], 0)
+        feas_cnt = jnp.where(
+            pinned, jnp.where(pin_feas & tb.pod_valid, 1, 0), feas_cnt
+        )
+        score_out = jnp.where(
+            placed,
+            total_score[t_of, jnp.clip(chosen, 0, n - 1)],
+            -jnp.inf,
+        )
+        deferred = tb.pod_valid & ~placed & (feas_cnt > 0)
+        return new_snap, WaveResult(
+            chosen=jnp.where(placed, chosen, -1),
+            placed=placed,
+            deferred=deferred,
+            feasible_count=feas_cnt,
+            score=score_out,
+            resolvable_tpl=resolvable_tpl,
+        )
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def make_wave_kernel_jit(
+    v_cap: int,
+    m_cand: int = 128,
+    n_waves: int = 8,
+    hard_pod_affinity_weight: float = 1.0,
+):
+    return jax.jit(
+        make_wave_kernel(v_cap, m_cand, n_waves, hard_pod_affinity_weight),
+        donate_argnums=(0,),
+    )

@@ -41,6 +41,11 @@ class KubeSchedulerConfiguration:
     encoding: EncodingConfig = field(default_factory=EncodingConfig)
     bind_workers: int = 16
     assume_ttl_seconds: float = 30.0
+    # wave kernel (ops/wavelattice.py): vectorized bulk pass + W commit waves
+    use_wave: bool = True  # False => serial scan lattice (oracle-exact)
+    wave_m_cand: int = 128  # top-M candidate nodes per template
+    wave_n_waves: int = 8  # conflict-resolution waves per batch
+    sync_batch_bind: bool = True  # bulk bind in-cycle when no permit/prebind
 
     def validate(self) -> None:
         if self.percentage_of_nodes_to_score < 0 or self.percentage_of_nodes_to_score > 100:

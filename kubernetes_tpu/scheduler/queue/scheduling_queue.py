@@ -95,6 +95,16 @@ class PriorityQueue:
             self._unschedulable.pop(pi.key, None)
             self._cond.notify()
 
+    def readd(self, pi: QueuedPodInfo) -> None:
+        """Return a popped-but-unprocessed pod to activeQ preserving its
+        QueuedPodInfo (used for wave-deferred pods: feasible nodes existed
+        but in-batch contention ran out of waves — not a scheduling failure,
+        so no backoff and no attempt decay)."""
+        with self._cond:
+            pi.attempts = max(pi.attempts - 1, 0)
+            self._active.add(pi)
+            self._cond.notify()
+
     def add_unschedulable_if_not_present(
         self, pi: QueuedPodInfo, moves_at_failure: int
     ) -> None:

@@ -35,7 +35,10 @@ import numpy as np
 from ..api import objects as v1
 from ..client.apiserver import APIServer, NotFound
 from ..client.informers import SharedInformerFactory
+from ..api.objects import Binding
 from ..ops.batch import encode_pod_batch
+from ..ops.templates import TemplateCache, build_pair_table
+from ..ops.wavelattice import make_wave_kernel_jit
 from ..ops.lattice import (
     NUM_SCORE_COMPONENTS,
     SC_BALANCED,
@@ -120,6 +123,8 @@ class Scheduler:
         self._rng_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(0)
         self._weights = self._build_weights()
+        self._tpl_cache = TemplateCache(self.cache.encoder)
+        self._pair_cache: Optional[tuple] = None  # (sig, table)
         eventhandlers.add_all_event_handlers(self)
 
     # -- wiring --------------------------------------------------------------
@@ -196,7 +201,9 @@ class Scheduler:
             known.append(pi)
         if not known:
             return
-        if self.cfg.use_device:
+        if self.cfg.use_device and self.cfg.use_wave:
+            self._schedule_batch_wave(known, moves0, trace, t_start)
+        elif self.cfg.use_device:
             self._schedule_batch_device(known, moves0, trace, t_start)
         else:
             self._snapshot = self.cache.update_snapshot()
@@ -265,6 +272,187 @@ class Scheduler:
                 message=f"0/{self.cache.node_count} nodes are available",
                 candidate_nodes=candidates,
             )
+
+    # -- wave device path -----------------------------------------------------
+
+    def _pair_table(self, eb):
+        """Pair table cached by (template set, vocab) signature."""
+        enc = self.cache.encoder
+        sig = (
+            eb.num_templates,
+            self._tpl_cache._vocab_sig,
+            len(enc.sel_vocab),
+            len(enc.eterm_vocab),
+        )
+        if self._pair_cache is not None and self._pair_cache[0] == sig:
+            return self._pair_cache[1]
+        table, overflow = build_pair_table(enc, eb.batch.tpl, eb.num_templates)
+        if overflow:
+            logger.warning("pair table overflow; kernel capacity grew")
+        self._pair_cache = (sig, table)
+        return table
+
+    def _schedule_batch_wave(
+        self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
+    ) -> None:
+        with self.cache.lock:
+            eb = self._tpl_cache.encode(
+                [pi.pod for pi in pis], pad_to=self.cfg.device_batch_size
+            )
+            ptab = self._pair_table(eb)
+            snap = self.cache.encoder.flush()
+            enc_cfg = self.cache.encoder.cfg
+            row_names = list(self.cache.encoder.row_names)
+        trace.step("encoded+flushed")
+        kern = make_wave_kernel_jit(
+            enc_cfg.v_cap,
+            self.cfg.wave_m_cand,
+            self.cfg.wave_n_waves,
+            self.cfg.hard_pod_affinity_weight,
+        )
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        try:
+            new_snap, res = kern(
+                snap, eb.batch, ptab, np.asarray(self._weights), sub
+            )
+        except Exception:
+            self.cache.encoder.invalidate_device()
+            raise
+        with self.cache.lock:
+            self.cache.encoder.set_device_snapshot(new_snap)
+        jax.block_until_ready(
+            (res.chosen, res.placed, res.deferred, res.feasible_count)
+        )
+        chosen = np.asarray(res.chosen)
+        placed = np.asarray(res.placed)
+        deferred = np.asarray(res.deferred)
+        trace.step("kernel")
+        algo_dur = time.monotonic() - t_start
+        metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
+
+        to_bind: List = []  # (pi, node_name)
+        fallback_pis: List[QueuedPodInfo] = []
+        failed: List = []  # (pi, tpl_index)
+        for i, pi in enumerate(pis):
+            if eb.fallback[i]:
+                fallback_pis.append(pi)
+                continue
+            if placed[i]:
+                node_name = row_names[int(chosen[i])]
+                if node_name is None:
+                    failed.append((pi, i))
+                    continue
+                to_bind.append((pi, node_name))
+            elif deferred[i]:
+                self.queue.readd(pi)
+            else:
+                failed.append((pi, i))
+
+        self._assume_and_bind_bulk(to_bind, t_start)
+        if fallback_pis or failed:
+            self._snapshot = self.cache.update_snapshot()
+        for pi in fallback_pis:
+            self._schedule_one_host(pi, moves0)
+        if failed:
+            resolvable_tpl = np.asarray(res.resolvable_tpl)
+            pod_tpl = np.asarray(eb.batch.pod_tpl)
+            for pi, i in failed:
+                rows = np.nonzero(resolvable_tpl[pod_tpl[i]])[0]
+                self._handle_failure(
+                    pi,
+                    moves0,
+                    message=f"0/{self.cache.node_count} nodes are available",
+                    candidate_nodes=[
+                        row_names[r] for r in rows if row_names[r]
+                    ],
+                )
+
+    def _assume_and_bind_bulk(self, to_bind: List, t_start: float) -> None:
+        """Assume + bind a whole wave of placements. When the profile has no
+        permit/prebind/postbind plugins and the binder is the default, the
+        binds collapse into one batch API call (the in-cycle fast path —
+        async per-pod binding remains for plugin-bearing profiles, matching
+        the reference's goroutine-per-bind at scheduler.go:666)."""
+        if not to_bind:
+            return
+        simple: List = []
+        for pi, node_name in to_bind:
+            pod = pi.pod
+            prof = self.profiles.for_pod(pod)
+            fw = prof.framework
+            ps = fw.plugin_set
+            plain = (
+                self.cfg.sync_batch_bind
+                and not ps.reserve
+                and not ps.permit
+                and not ps.pre_bind
+                and not ps.post_bind
+                and ps.bind == ["DefaultBinder"]
+            )
+            try:
+                self.cache.assume_pod(pod, node_name)
+            except ValueError as e:
+                self._handle_failure(
+                    pi, self.queue.moves, message=str(e), error=True
+                )
+                continue
+            self.queue.delete_nominated_if_exists(pod)
+            if plain:
+                simple.append((pi, node_name, prof))
+            else:
+                self._assume_and_bind_after_assume(pi, node_name, t_start)
+        if not simple:
+            return
+        bindings = [
+            Binding(
+                pod_name=pi.pod.metadata.name,
+                pod_namespace=pi.pod.metadata.namespace,
+                pod_uid=pi.pod.metadata.uid,
+                target_node=node_name,
+            )
+            for pi, node_name, _ in simple
+        ]
+        b0 = time.monotonic()
+        errors = self.server.bind_pods(bindings)
+        bind_dur = time.monotonic() - b0
+        e2e = time.monotonic() - t_start
+        for (pi, node_name, prof), err in zip(simple, errors):
+            if err is None:
+                self.cache.finish_binding(pi.pod)
+                metrics.observe("binding_duration_seconds", bind_dur)
+                metrics.observe("e2e_scheduling_duration_seconds", e2e)
+                metrics.inc("schedule_attempts_total", {"result": "scheduled"})
+                prof.recorder.eventf(
+                    pi.pod, "Normal", "Scheduled", "Binding",
+                    f"Successfully assigned {pi.pod.metadata.key} to {node_name}",
+                )
+            else:
+                self.cache.forget_pod(pi.pod)
+                self._handle_failure(
+                    pi, self.queue.moves, message=err, error=True
+                )
+
+    def _assume_and_bind_after_assume(
+        self, pi: QueuedPodInfo, node_name: str, t_start: float
+    ) -> None:
+        """Plugin-bearing profile: run reserve/permit then async bind (the
+        pod is already assumed)."""
+        pod = pi.pod
+        prof = self.profiles.for_pod(pod)
+        fw = prof.framework
+        state = CycleState()
+        st = fw.run_reserve_plugins(state, pod, node_name)
+        if not is_success(st):
+            self.cache.forget_pod(pod)
+            self._handle_failure(pi, self.queue.moves, message=st.message, error=True)
+            return
+        st = fw.run_permit_plugins(state, pod, node_name)
+        if st is not None and st.code not in (Code.SUCCESS, Code.WAIT):
+            self.cache.forget_pod(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(pi, self.queue.moves, message=st.message)
+            return
+        self._bind_pool.submit(self._bind_async, pi, node_name, state, t_start)
 
     # -- host fallback path ---------------------------------------------------
 

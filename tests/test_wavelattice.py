@@ -1,0 +1,170 @@
+"""Wave-commit kernel tests: semantics on small clusters + agreement with the
+serial scan lattice on randomized workloads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.ops.encoding import SnapshotEncoder
+from kubernetes_tpu.ops.lattice import DEFAULT_WEIGHTS
+from kubernetes_tpu.ops.templates import TemplateCache, build_pair_table
+from kubernetes_tpu.ops.wavelattice import make_wave_kernel_jit
+
+from test_lattice_smoke import make_node, make_pod
+
+
+def run_wave(enc, pods, pad=None, cache=None):
+    cache = cache or TemplateCache(enc)
+    eb = cache.encode(pods, pad_to=pad or max(1, len(pods)))
+    pt, overflow = build_pair_table(enc, eb.batch.tpl, eb.num_templates)
+    assert not overflow
+    snap = enc.flush()
+    kern = make_wave_kernel_jit(enc.cfg.v_cap)
+    new_snap, res = kern(
+        snap, eb.batch, pt, jnp.asarray(DEFAULT_WEIGHTS), jax.random.PRNGKey(0)
+    )
+    enc.invalidate_device()  # snapshot was donated; encoder must re-upload
+    return res, new_snap
+
+
+def test_wave_basic_fit():
+    enc = SnapshotEncoder()
+    for i in range(4):
+        enc.add_node(make_node(f"n{i}", cpu="4"))
+    enc.add_pod("n0", make_pod("existing", cpu="3"))
+    res, _ = run_wave(enc, [make_pod("p", cpu="2")])
+    assert int(res.chosen[0]) not in (-1, 0)
+    assert int(res.feasible_count[0]) == 3
+
+
+def test_wave_in_batch_conflict():
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0", cpu="3"))
+    enc.add_node(make_node("n1", cpu="3"))
+    res, _ = run_wave(enc, [make_pod("a", cpu="2"), make_pod("b", cpu="2")])
+    assert {int(res.chosen[0]), int(res.chosen[1])} == {0, 1}
+
+
+def test_wave_anti_affinity_in_batch():
+    """One-per-zone anti-affinity enforced across a batch of identical pods."""
+    enc = SnapshotEncoder()
+    for i in range(6):
+        enc.add_node(make_node(f"n{i}", labels={"zone": f"z{i % 3}"}))
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector.make(match_labels={"app": "w"}),
+                    topology_key="zone",
+                ),
+            )
+        )
+    )
+    pods = [
+        make_pod(f"p{i}", labels={"app": "w"}, affinity=anti) for i in range(4)
+    ]
+    res, _ = run_wave(enc, pods, pad=4)
+    chosen = [int(c) for c in res.chosen]
+    placed = [c for c in chosen if c >= 0]
+    assert len(placed) == 3  # only 3 zones
+    zones = {placed_row % 3 for placed_row in placed}
+    assert len(zones) == 3  # one per zone
+    assert chosen.count(-1) == 1
+    # the unplaced pod saw feasible nodes initially -> requeue not unschedulable
+    unplaced_i = chosen.index(-1)
+    assert int(res.feasible_count[unplaced_i]) > 0
+
+
+def test_wave_affinity_chain_carveout():
+    """First pod uses the self-carve-out; followers must join its zone."""
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("a", labels={"zone": "z1"}))
+    enc.add_node(make_node("b", labels={"zone": "z2"}))
+    aff = Affinity(
+        pod_affinity=PodAffinity(
+            required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector.make(match_labels={"app": "g"}),
+                    topology_key="zone",
+                ),
+            )
+        )
+    )
+    pods = [make_pod(f"p{i}", labels={"app": "g"}, affinity=aff) for i in range(3)]
+    res, _ = run_wave(enc, pods, pad=4)
+    chosen = [int(res.chosen[i]) for i in range(3)]
+    assert all(c >= 0 for c in chosen)
+    assert len({c for c in chosen}) == 1 or len({c % 2 for c in chosen}) == 1
+    # all in one zone (rows map 1:1 to zones here)
+    assert len(set(chosen)) == 1
+
+
+def test_wave_topology_spread_batch():
+    enc = SnapshotEncoder()
+    for i in range(6):
+        enc.add_node(make_node(f"n{i}", labels={"zone": f"z{i % 3}"}))
+    sel = LabelSelector.make(match_labels={"app": "s"})
+    tsc = TopologySpreadConstraint(
+        max_skew=1, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+        label_selector=sel,
+    )
+    pods = [
+        make_pod(f"p{i}", labels={"app": "s"}, topology_spread_constraints=[tsc])
+        for i in range(6)
+    ]
+    res, snap = run_wave(enc, pods, pad=8)
+    chosen = [int(res.chosen[i]) for i in range(6)]
+    assert all(c >= 0 for c in chosen)
+    by_zone = {}
+    for c in chosen:
+        by_zone[c % 3] = by_zone.get(c % 3, 0) + 1
+    assert max(by_zone.values()) - min(by_zone.values()) <= 1
+
+
+def test_wave_pinned_pod():
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0"))
+    enc.add_node(make_node("n1"))
+    res, _ = run_wave(enc, [make_pod("p", node_name="n1")])
+    assert int(res.chosen[0]) == 1
+    assert int(res.feasible_count[0]) == 1
+
+
+def test_wave_unschedulable_resolvable():
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("small", cpu="1"))
+    res, _ = run_wave(enc, [make_pod("big", cpu="2")])
+    assert int(res.chosen[0]) == -1
+    assert not bool(res.deferred[0])
+    assert int(res.feasible_count[0]) == 0
+    assert bool(np.asarray(res.resolvable_tpl)[0, 0])
+
+
+def test_wave_occupancy_chains_to_next_batch():
+    """Committed pods persist in the returned snapshot: the next batch sees
+    them without any host flush."""
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0", cpu="3"))
+    enc.add_node(make_node("n1", cpu="3"))
+    cache = TemplateCache(enc)
+    eb = cache.encode([make_pod("a", cpu="2")], pad_to=1)
+    pt, _ = build_pair_table(enc, eb.batch.tpl, eb.num_templates)
+    snap = enc.flush()
+    kern = make_wave_kernel_jit(enc.cfg.v_cap)
+    w = jnp.asarray(DEFAULT_WEIGHTS)
+    snap, r1 = kern(snap, eb.batch, pt, w, jax.random.PRNGKey(0))
+    first = int(r1.chosen[0])
+    eb2 = cache.encode([make_pod("b", cpu="2")], pad_to=1)
+    pt2, _ = build_pair_table(enc, eb2.batch.tpl, eb2.num_templates)
+    snap, r2 = kern(snap, eb2.batch, pt2, w, jax.random.PRNGKey(1))
+    second = int(r2.chosen[0])
+    assert {first, second} == {0, 1}
+    enc.invalidate_device()
