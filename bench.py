@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Headline benchmark: scheduling throughput on the scheduler_perf-equivalent
+5k-node InterPodAffinity suite (reference harness:
+test/integration/scheduler_perf/config/performance-config.yaml;
+throughput metric definition: test/integration/scheduler_perf/util.go:210-251).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
+
+vs_baseline is measured throughput divided by the north-star target from
+BASELINE.json (50,000 pods/s on the 5k-node InterPodAffinity suite), so
+vs_baseline >= 1.0 means the target is met or beaten.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+TARGET_PODS_PER_S = 50_000.0  # BASELINE.json north-star, v5e-8
+
+
+def main() -> None:
+    from kubernetes_tpu.perf.harness import run_benchmark
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+
+    cfg = WORKLOADS["SchedulingPodAffinity/5000"]
+
+    # Warm-up on a small instance of the same workload so XLA compile time
+    # (one-off, cached) doesn't pollute the measured window.
+    warm = WORKLOADS["SchedulingPodAffinity/500"]
+    run_benchmark(warm, quiet=True)
+
+    res = run_benchmark(cfg, quiet=True)
+    out = {
+        "metric": "scheduling_throughput_5k_node_interpodaffinity",
+        "value": round(res.throughput_pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
+        "detail": {
+            "workload": res.workload,
+            "num_nodes": res.num_nodes,
+            "scheduled": res.scheduled,
+            "unscheduled": res.unscheduled,
+            "duration_s": round(res.duration_s, 3),
+            "e2e_p50_ms": round(res.e2e_p50_ms, 3),
+            "e2e_p99_ms": round(res.e2e_p99_ms, 3),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,9 @@ class EncodedBatch:
     batch: PodBatch
     pods: List[v1.Pod]  # row-aligned with the batch (padded rows absent)
     fallback: np.ndarray  # [P] bool — pod overflowed static buckets
+    batch_np: Optional[PodBatch] = None  # host (numpy) mirror of `batch`;
+    # device→host readbacks through the PJRT tunnel cost a full RTT, so
+    # host-side consumers (pair-table build) must never np.asarray(batch)
 
 
 class _PodEnc:
@@ -378,4 +381,7 @@ def encode_pod_batch(
         fallback[i] = d["fallback"]
 
     batch = PodBatch(**{k: jnp.asarray(v) for k, v in b.items()})
-    return EncodedBatch(batch=batch, pods=list(pods), fallback=fallback)
+    batch_np = PodBatch(**b)
+    return EncodedBatch(
+        batch=batch, pods=list(pods), fallback=fallback, batch_np=batch_np
+    )

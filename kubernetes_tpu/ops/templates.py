@@ -80,6 +80,7 @@ class EncodedTemplateBatch:
     pods: List[v1.Pod]
     fallback: np.ndarray  # [P] bool (template overflowed device buckets)
     num_templates: int
+    tpl_np: Optional[PodBatch] = None  # host mirror of batch.tpl (no D2H)
 
 
 class TemplateCache:
@@ -96,6 +97,7 @@ class TemplateCache:
         self._rows: Dict[Tuple, int] = {}
         self._exemplars: List[v1.Pod] = []
         self._fallback: List[bool] = []
+        self._tpl_batch_np: Optional[PodBatch] = None
         self._vocab_sig = self._sig()
 
     def _sig(self) -> Tuple:
@@ -150,6 +152,7 @@ class TemplateCache:
                 )
                 self._vocab_sig = self._sig()
             self._tpl_batch = eb.batch
+            self._tpl_batch_np = eb.batch_np
             self._fallback = list(eb.fallback[: len(self._exemplars)])
 
         pod_tpl = np.full(P, -1, np.int32)
@@ -178,6 +181,7 @@ class TemplateCache:
             pods=list(pods),
             fallback=fallback,
             num_templates=len(self._exemplars),
+            tpl_np=self._tpl_batch_np,
         )
 
     @staticmethod
@@ -189,7 +193,7 @@ class TemplateCache:
 
     def match_sel_row(self, pod_index_in_batch_tpl: int) -> np.ndarray:
         """Host mirror of a template's predicate match vector (for assume)."""
-        return np.asarray(self._tpl_batch.match_sel[pod_index_in_batch_tpl])
+        return np.asarray(self._tpl_batch_np.match_sel[pod_index_in_batch_tpl])
 
 
 class PairTable(NamedTuple):
@@ -227,7 +231,10 @@ class PairTable(NamedTuple):
 def build_pair_table(
     enc: SnapshotEncoder, tpl_batch: PodBatch, num_templates: int, j_cap: int = 32
 ) -> Tuple[PairTable, bool]:
-    """Host-side pair dedup over a template batch. Returns (table, overflow)."""
+    """Host-side pair dedup over a template batch. Returns (table, overflow).
+
+    `tpl_batch` must be the host (numpy) mirror — passing device arrays here
+    would pay a tunnel round trip per field."""
     b = jax.tree.map(np.asarray, tpl_batch)
     TPL = b.spread_sid.shape[0]
     pairs: Dict[Tuple, int] = {}

@@ -368,7 +368,15 @@ def make_wave_kernel(
         checks = scatter_pairs(checks, pt.spr_pair, pt.spr_hard)
         checks = scatter_pairs(checks, pt.anti_pair)
         checks = checks | (pt.etm_match & (pt.kind == ETERM_ANTI_REQ)[None, :])
-        participates = checks | (pt.contrib > 0)  # [TPL, J]
+        # Exclusivity is only needed for pairs some template HARD-checks:
+        # those verdicts can be invalidated by a same-wave contributor in the
+        # same domain. Pure-affinity pairs (cnt>0 checks) are monotone under
+        # additions, so their contributors commit freely — without this gate
+        # a burst of one Deployment's affinity pods serializes to one commit
+        # per wave.
+        needs_excl = jnp.any(checks, axis=0)  # [J]
+        participates = (checks | (pt.contrib != 0)) & needs_excl[None, :]
+        is_contrib_tpl = pt.contrib != 0  # [TPL, J]
         uses_carveout = jnp.zeros((TPL, J), bool)
         uses_carveout = scatter_pairs(uses_carveout, pt.aff_pair, pt.aff_self)
 
@@ -435,11 +443,14 @@ def make_wave_kernel(
             fit_ok = jnp.zeros(P, bool).at[order_c].set(fit_sorted)
 
             # -- (pair, domain) exclusivity --
-            part = participates[t_of] & active[:, None]  # [P, J]
             pod_dom = dom_j[:, cand_n].T  # [P, J] domain of candidate per pair
             carve = (
                 uses_carveout[t_of] & (tot_w == 0)[None, :] & active[:, None]
             )
+            # carveout claims are exclusive regardless of the needs_excl gate
+            # (two pods claiming "no matches anywhere" in different domains
+            # would diverge from serial semantics)
+            part = (participates[t_of] | carve) & active[:, None]  # [P, J]
             key_pd = jnp.where(
                 carve,
                 jnp.arange(J)[None, :] * (v_cap + 2) + v_cap + 1,
@@ -447,15 +458,43 @@ def make_wave_kernel(
                 + jnp.clip(pod_dom, 0, v_cap - 1),
             )
             part = part & ((pod_dom >= 0) | carve)
-            flat_key = jnp.where(part, key_pd, J * (v_cap + 2)).reshape(-1)
+            is_contrib = (is_contrib_tpl[t_of] | carve) & part  # [P, J]
+            dump = J * (v_cap + 2)
+            flat_key = jnp.where(part, key_pd, dump).reshape(-1)
+            flat_key_c = jnp.where(is_contrib, key_pd, dump).reshape(-1)
             pod_idx_mat = jnp.broadcast_to(
                 jnp.arange(P)[:, None], (P, J)
             ).reshape(-1)
-            seg_min = jax.ops.segment_min(
-                pod_idx_mat, flat_key, num_segments=J * (v_cap + 2) + 1
+            nseg = dump + 1
+            min_all = jax.ops.segment_min(pod_idx_mat, flat_key, num_segments=nseg)
+            min_con = jax.ops.segment_min(
+                pod_idx_mat, flat_key_c, num_segments=nseg
             )
-            is_winner = (seg_min[flat_key] == pod_idx_mat).reshape(P, J)
-            dom_ok = jnp.all(is_winner | ~part, axis=1)
+            # contributor commits iff it is the group's lowest participant;
+            # checker-only pods commit iff no contributor is committing in
+            # their group this wave (group min is a checker)
+            g_all = min_all[flat_key].reshape(P, J)
+            g_con = min_con[flat_key].reshape(P, J)
+            # serial-order guard for the carveout: in index order a lower
+            # contributor to pair j would commit before the claimant, making
+            # its tot==0 premise false — so block the claim this wave when
+            # any lower-indexed active contributor exists pair-wide
+            contrib_any = is_contrib_tpl[t_of] & active[:, None] & ~carve
+            pair_key = jnp.where(
+                contrib_any, jnp.arange(J)[None, :], J
+            ).reshape(-1)
+            min_contrib_pair = jax.ops.segment_min(
+                pod_idx_mat, pair_key, num_segments=J + 1
+            )[:J]
+            carve_allowed = (
+                jnp.arange(P)[:, None] < min_contrib_pair[None, :]
+            )  # [P, J]
+            ok_pair = jnp.where(
+                is_contrib,
+                g_all == pod_idx_mat.reshape(P, J),
+                g_con > g_all,
+            ) & (~carve | carve_allowed)
+            dom_ok = jnp.all(ok_pair | ~part, axis=1)
 
             commit = active & fit_ok & dom_ok
             ci = jnp.where(commit, cand_n, n)  # OOB -> dropped
@@ -486,6 +525,9 @@ def make_wave_kernel(
             jnp.zeros_like(snap.port_counts),
             jnp.zeros((J, v_cap), jnp.float32),
         )
+        # Static trip count on purpose: a data-dependent while_loop hangs the
+        # axon PJRT tunnel (empirically — even a trivial one never returns).
+        # The host picks n_waves per batch shape instead (scheduler.py).
         placed, chosen, req_d, port_d, dom_d = jax.lax.fori_loop(
             0, n_waves, wave, state0
         )

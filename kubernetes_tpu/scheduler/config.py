@@ -36,15 +36,19 @@ class KubeSchedulerConfiguration:
     hard_pod_affinity_weight: float = 1.0
     # --- TPU-native section -------------------------------------------------
     use_device: bool = True  # TPUBatchScore profile gate
-    device_batch_size: int = 128
+    device_batch_size: int = 1024
     device_batch_window: float = 0.0  # linger seconds to let bursts accumulate
     encoding: EncodingConfig = field(default_factory=EncodingConfig)
     bind_workers: int = 16
     assume_ttl_seconds: float = 30.0
     # wave kernel (ops/wavelattice.py): vectorized bulk pass + W commit waves
     use_wave: bool = True  # False => serial scan lattice (oracle-exact)
-    wave_m_cand: int = 128  # top-M candidate nodes per template
-    wave_n_waves: int = 8  # conflict-resolution waves per batch
+    wave_m_cand: int = 512  # top-M candidate nodes per template (>= batch/2 so a
+    # zone-concentrated burst has enough distinct targets)
+    wave_n_waves: int = 32  # conflict-resolution waves for batches with hard
+    # (anti-affinity/spread) pairs; static trip count — every such batch pays
+    # all waves (the axon tunnel hangs on data-dependent while_loops).
+    # Batches without hard pairs use min(4, wave_n_waves).
     sync_batch_bind: bool = True  # bulk bind in-cycle when no permit/prebind
 
     def validate(self) -> None:

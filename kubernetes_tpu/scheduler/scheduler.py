@@ -37,6 +37,7 @@ from ..client.apiserver import APIServer, NotFound
 from ..client.informers import SharedInformerFactory
 from ..api.objects import Binding
 from ..ops.batch import encode_pod_batch
+from ..ops.encoding import ETERM_ANTI_REQ as _ETERM_ANTI_REQ
 from ..ops.templates import TemplateCache, build_pair_table
 from ..ops.wavelattice import make_wave_kernel_jit
 from ..ops.lattice import (
@@ -124,7 +125,7 @@ class Scheduler:
         self._rng_key = jax.random.PRNGKey(0)
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
-        self._pair_cache: Optional[tuple] = None  # (sig, table)
+        self._pair_cache: Optional[tuple] = None  # (sig, table, n_waves)
         eventhandlers.add_all_event_handlers(self)
 
     # -- wiring --------------------------------------------------------------
@@ -276,7 +277,14 @@ class Scheduler:
     # -- wave device path -----------------------------------------------------
 
     def _pair_table(self, eb):
-        """Pair table cached by (template set, vocab) signature."""
+        """Pair table cached by (template set, vocab) signature.
+
+        Also derives the wave count for the batch: batches with no
+        hard-checked pairs (no required anti-affinity / hard spread) commit
+        in a few waves; hard-checked pairs serialize commits per topology
+        domain and need more. The trip count must be static — the axon
+        tunnel hangs on data-dependent while_loops — so the host picks it.
+        """
         enc = self.cache.encoder
         sig = (
             eb.num_templates,
@@ -285,12 +293,28 @@ class Scheduler:
             len(enc.eterm_vocab),
         )
         if self._pair_cache is not None and self._pair_cache[0] == sig:
-            return self._pair_cache[1]
-        table, overflow = build_pair_table(enc, eb.batch.tpl, eb.num_templates)
+            return self._pair_cache[1], self._pair_cache[2]
+        table, overflow = build_pair_table(enc, eb.tpl_np, eb.num_templates)
         if overflow:
             logger.warning("pair table overflow; kernel capacity grew")
-        self._pair_cache = (sig, table)
-        return table
+        b = eb.tpl_np
+        anti_kinds = {
+            tid
+            for tid in range(len(enc.eterm_vocab))
+            if enc.eterm_vocab.items[tid].kind == _ETERM_ANTI_REQ
+        }
+        has_hard = (
+            bool(np.any((b.spread_key >= 0) & b.spread_hard))
+            or bool(np.any(b.panti_sid >= 0))
+            or any(
+                bool(np.any(b.match_eterm[:, tid])) for tid in anti_kinds
+            )
+        )
+        waves = self.cfg.wave_n_waves if has_hard else min(
+            4, self.cfg.wave_n_waves
+        )
+        self._pair_cache = (sig, table, waves)
+        return table, waves
 
     def _schedule_batch_wave(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
@@ -299,7 +323,7 @@ class Scheduler:
             eb = self._tpl_cache.encode(
                 [pi.pod for pi in pis], pad_to=self.cfg.device_batch_size
             )
-            ptab = self._pair_table(eb)
+            ptab, n_waves = self._pair_table(eb)
             snap = self.cache.encoder.flush()
             enc_cfg = self.cache.encoder.cfg
             row_names = list(self.cache.encoder.row_names)
@@ -307,7 +331,7 @@ class Scheduler:
         kern = make_wave_kernel_jit(
             enc_cfg.v_cap,
             self.cfg.wave_m_cand,
-            self.cfg.wave_n_waves,
+            n_waves,
             self.cfg.hard_pod_affinity_weight,
         )
         self._rng_key, sub = jax.random.split(self._rng_key)
