@@ -257,6 +257,54 @@ class PodSpec:
     service_account_name: str = ""
 
 
+@dataclass(frozen=True)
+class GCEPersistentDiskVolumeSource:
+    pd_name: str = ""
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class AWSElasticBlockStoreVolumeSource:
+    volume_id: str = ""
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class ISCSIVolumeSource:
+    target_portal: str = ""
+    iqn: str = ""
+    lun: int = 0
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class RBDVolumeSource:
+    monitors: Tuple[str, ...] = ()
+    image: str = ""
+    pool: str = "rbd"
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class AzureDiskVolumeSource:
+    disk_name: str = ""
+    data_disk_uri: str = ""
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class CinderVolumeSource:
+    volume_id: str = ""
+    read_only: bool = False
+
+
+@dataclass(frozen=True)
+class CSIVolumeSource:
+    driver: str = ""
+    volume_handle: str = ""
+    read_only: bool = False
+
+
 @dataclass
 class Volume:
     name: str = ""
@@ -266,6 +314,12 @@ class Volume:
     empty_dir: bool = False
     config_map: Optional[str] = None
     secret: Optional[str] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    azure_disk: Optional[AzureDiskVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
 
 
 POD_PENDING = "Pending"
@@ -419,3 +473,129 @@ class Binding:
     pod_uid: str
     target_node: str
     kind: str = "Binding"
+
+
+# ---------------------------------------------------------------------------
+# Storage (subset needed for scheduling: volume binding / restrictions /
+# zone / limits — reference staging/src/k8s.io/api/core/v1/types.go PV/PVC,
+# storage/v1 StorageClass/CSINode)
+# ---------------------------------------------------------------------------
+
+CLAIM_PENDING = "Pending"
+CLAIM_BOUND = "Bound"
+CLAIM_LOST = "Lost"
+
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    resources: Dict[str, Quantity] = field(default_factory=dict)  # requests
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""  # bound PV name
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = CLAIM_PENDING
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(
+        default_factory=PersistentVolumeClaimSpec
+    )
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus
+    )
+    kind: str = "PersistentVolumeClaim"
+
+    def deep_copy(self) -> "PersistentVolumeClaim":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    claim_ref: Optional[str] = None  # "namespace/name" of bound claim
+    node_affinity: Optional[NodeSelector] = None  # volume node affinity
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    azure_disk: Optional[AzureDiskVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    csi: Optional[CSIVolumeSource] = None
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = "Available"  # Available | Bound | Released | Failed
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(
+        default_factory=PersistentVolumeStatus
+    )
+    kind: str = "PersistentVolume"
+
+    def deep_copy(self) -> "PersistentVolume":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = BINDING_IMMEDIATE
+    kind: str = "StorageClass"
+
+    def deep_copy(self) -> "StorageClass":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class CSINodeDriver:
+    name: str = ""
+    node_id: str = ""
+    allocatable_count: Optional[int] = None  # attachable volume limit
+
+
+@dataclass
+class CSINode:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: List[CSINodeDriver] = field(default_factory=list)
+    kind: str = "CSINode"
+
+    def deep_copy(self) -> "CSINode":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Services & workload controllers (subset for SelectorSpread/ServiceAffinity)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    cluster_ip: str = ""
+    ports: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    kind: str = "Service"
+
+    def deep_copy(self) -> "Service":
+        return copy.deepcopy(self)

@@ -1,0 +1,5 @@
+"""Controller components (reference pkg/controller/...).
+
+Currently: volume scheduling (the PV binder the scheduler shares with the
+PV controller, reference pkg/controller/volume/scheduling/).
+"""

@@ -1,0 +1,301 @@
+"""Volume scheduling: the binder the scheduler consults for PVC-bearing pods.
+
+Reference: pkg/controller/volume/scheduling/scheduler_binder.go
+(`NewVolumeBinder`, FindPodVolumes/AssumePodVolumes/BindPodVolumes and the
+PV assume cache), wired into the scheduler at pkg/scheduler/scheduler.go:
+241-249 and consumed by the VolumeBinding plugin
+(framework/plugins/volumebinding/volume_binding.go).
+
+Same split as the reference:
+  * Find — pure read: can this pod's claims be satisfied on this node?
+    (bound claims → PV node affinity; unbound claims → a matching PV
+    exists, or the class provisions dynamically)
+  * Assume — optimistic in-memory claim→PV reservations for the chosen node
+  * Bind — API writes (PV.claim_ref, PVC.volume_name/phase); a failed claim
+    write rolls the already-written PV back, and the in-memory reservation
+    is dropped either way
+
+The "real" storage backend is the in-process API store; a FakeVolumeBinder
+mirrors scheduler_binder_fake.go for tests and perf harnesses.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import objects as v1
+from ..api.resources import parse_quantity
+from ..client.apiserver import APIServer, NotFound
+
+# node label keys a PV's zone constraint may use (volumezone.go)
+ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+REGION_LABELS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+class ClaimNotFound(Exception):
+    """Referenced PVC does not exist (UnschedulableAndUnresolvable class)."""
+
+
+@dataclass
+class PodVolumeDecision:
+    """Planned bindings for one pod on one node (the assume-cache payload)."""
+
+    static_bindings: List[Tuple[str, str]] = field(default_factory=list)
+    # (claim key, pv name)
+    dynamic_provisions: List[str] = field(default_factory=list)  # claim keys
+    all_bound: bool = True
+
+
+class VolumeBinder:
+    """SchedulerVolumeBinder (scheduler_binder.go:NewVolumeBinder)."""
+
+    def __init__(self, server: APIServer):
+        self.server = server
+        self._lock = threading.Lock()
+        # claim key -> pv name reserved by an assumed (not yet bound) pod
+        self._assumed_pv_for_claim: Dict[str, str] = {}
+        # pod key -> decision awaiting bind
+        self._decisions: Dict[str, PodVolumeDecision] = {}
+
+    # -- lookups -------------------------------------------------------------
+
+    def _claim(self, namespace: str, name: str) -> v1.PersistentVolumeClaim:
+        try:
+            return self.server.get("persistentvolumeclaims", namespace, name)
+        except NotFound:
+            raise ClaimNotFound(
+                f"persistentvolumeclaim {namespace}/{name} not found"
+            ) from None
+
+    def _pv(self, name: str) -> Optional[v1.PersistentVolume]:
+        try:
+            return self.server.get("persistentvolumes", "", name)
+        except NotFound:
+            return None
+
+    def _storage_class(self, name: Optional[str]) -> Optional[v1.StorageClass]:
+        if not name:
+            return None
+        try:
+            return self.server.get("storageclasses", "", name)
+        except NotFound:
+            return None
+
+    def pod_claims(self, pod: v1.Pod) -> List[v1.PersistentVolumeClaim]:
+        out = []
+        for vol in pod.spec.volumes:
+            if vol.persistent_volume_claim:
+                out.append(
+                    self._claim(pod.metadata.namespace, vol.persistent_volume_claim)
+                )
+        return out
+
+    # -- find ----------------------------------------------------------------
+
+    def find_pod_volumes(
+        self, pod: v1.Pod, node: v1.Node
+    ) -> Tuple[bool, bool, List[str]]:
+        """(unbound_satisfied, bound_satisfied, reasons) —
+        FindPodVolumes(scheduler_binder.go)."""
+        reasons: List[str] = []
+        unbound_ok = True
+        bound_ok = True
+        with self._lock:
+            assumed = dict(self._assumed_pv_for_claim)
+        taken = set(assumed.values())
+        for claim in self.pod_claims(pod):
+            key = claim.metadata.key
+            pv_name = claim.spec.volume_name or assumed.get(key, "")
+            if pv_name:
+                pv = self._pv(pv_name)
+                if pv is None or not self._pv_matches_node(pv, node):
+                    bound_ok = False
+                    reasons.append("node(s) had volume node affinity conflict")
+                continue
+            sc = self._storage_class(claim.spec.storage_class_name)
+            if sc is not None and sc.volume_binding_mode == v1.BINDING_WAIT_FOR_FIRST_CONSUMER:
+                # dynamic provisioning: satisfiable anywhere the provisioner
+                # can reach; treated as satisfied (the fake PV controller /
+                # provisioner completes it after bind)
+                continue
+            pv = self._find_matching_pv(claim, node, taken)
+            if pv is None:
+                unbound_ok = False
+                reasons.append(
+                    "node(s) didn't find available persistent volumes to bind"
+                )
+        return unbound_ok, bound_ok, reasons
+
+    def _find_matching_pv(
+        self,
+        claim: v1.PersistentVolumeClaim,
+        node: v1.Node,
+        taken: set,
+    ) -> Optional[v1.PersistentVolume]:
+        want = parse_quantity(claim.spec.resources.get("storage", 0))
+        pvs, _ = self.server.list("persistentvolumes")
+        best = None
+        best_cap = None
+        for pv in pvs:
+            if pv.metadata.name in taken or pv.spec.claim_ref:
+                continue
+            if (pv.spec.storage_class_name or "") != (
+                claim.spec.storage_class_name or ""
+            ):
+                continue
+            if claim.spec.access_modes and not set(claim.spec.access_modes) <= set(
+                pv.spec.access_modes
+            ):
+                continue
+            cap = parse_quantity(pv.spec.capacity.get("storage", 0))
+            if cap < want:
+                continue
+            if not self._pv_matches_node(pv, node):
+                continue
+            # smallest PV that fits (volume.FindMatchingVolume semantics)
+            if best is None or cap < best_cap:
+                best, best_cap = pv, cap
+        return best
+
+    @staticmethod
+    def _pv_matches_node(pv: v1.PersistentVolume, node: v1.Node) -> bool:
+        na = pv.spec.node_affinity
+        if na is None:
+            return True
+        from ..scheduler.framework.plugins.helpers import node_matches_term
+
+        return any(node_matches_term(node, t) for t in na.terms)
+
+    # -- assume --------------------------------------------------------------
+
+    def assume_pod_volumes(self, pod: v1.Pod, node: v1.Node) -> bool:
+        """Reserve claim→PV pairings in memory; returns all_bound
+        (AssumePodVolumes)."""
+        decision = PodVolumeDecision()
+        with self._lock:
+            taken = set(self._assumed_pv_for_claim.values())
+        for claim in self.pod_claims(pod):
+            key = claim.metadata.key
+            if claim.spec.volume_name:
+                continue
+            sc = self._storage_class(claim.spec.storage_class_name)
+            if sc is not None and sc.volume_binding_mode == v1.BINDING_WAIT_FOR_FIRST_CONSUMER:
+                decision.dynamic_provisions.append(key)
+                decision.all_bound = False
+                continue
+            pv = self._find_matching_pv(claim, node, taken)
+            if pv is None:
+                raise ValueError(
+                    f"no persistent volume available for claim {key} on node "
+                    f"{node.metadata.name} at assume time"
+                )
+            taken.add(pv.metadata.name)
+            decision.static_bindings.append((key, pv.metadata.name))
+            decision.all_bound = False
+        with self._lock:
+            for key, pv_name in decision.static_bindings:
+                self._assumed_pv_for_claim[key] = pv_name
+            if not decision.all_bound:
+                self._decisions[pod.metadata.key] = decision
+        return decision.all_bound
+
+    def forget_pod_volumes(self, pod: v1.Pod) -> None:
+        with self._lock:
+            decision = self._decisions.pop(pod.metadata.key, None)
+            if decision:
+                for key, _ in decision.static_bindings:
+                    self._assumed_pv_for_claim.pop(key, None)
+
+    # -- bind ----------------------------------------------------------------
+
+    def bind_pod_volumes(self, pod: v1.Pod, node_name: str = "") -> None:
+        """Write the planned bindings to the API (BindPodVolumes)."""
+        with self._lock:
+            decision = self._decisions.get(pod.metadata.key)
+        if decision is None:
+            return
+        try:
+            for claim_key, pv_name in decision.static_bindings:
+                ns, _, name = claim_key.partition("/")
+
+                def bind_pv(p, _ck=claim_key):
+                    p.spec.claim_ref = _ck
+                    p.status.phase = "Bound"
+                    return p
+
+                def unbind_pv(p):
+                    p.spec.claim_ref = None
+                    p.status.phase = "Available"
+                    return p
+
+                def bind_claim(c, _pv=pv_name):
+                    c.spec.volume_name = _pv
+                    c.status.phase = v1.CLAIM_BOUND
+                    return c
+
+                self.server.guaranteed_update("persistentvolumes", "", pv_name, bind_pv)
+                try:
+                    self.server.guaranteed_update(
+                        "persistentvolumeclaims", ns, name, bind_claim
+                    )
+                except Exception:
+                    # roll the PV back so it isn't orphaned-bound (claim_ref
+                    # set, claim unbound) and unmatchable forever
+                    try:
+                        self.server.guaranteed_update(
+                            "persistentvolumes", "", pv_name, unbind_pv
+                        )
+                    except NotFound:
+                        pass
+                    raise
+            for claim_key in decision.dynamic_provisions:
+                ns, _, name = claim_key.partition("/")
+
+                def mark(c):
+                    c.metadata.annotations[
+                        "volume.kubernetes.io/selected-node"
+                    ] = node_name
+                    return c
+
+                try:
+                    self.server.guaranteed_update(
+                        "persistentvolumeclaims", ns, name, mark
+                    )
+                except NotFound:
+                    pass
+        finally:
+            self.forget_pod_volumes(pod)
+
+
+class FakeVolumeBinder:
+    """scheduler_binder_fake.go — configurable canned answers for tests."""
+
+    def __init__(self, find=(True, True, []), assume_all_bound=True):
+        self._find = find
+        self._assume = assume_all_bound
+        self.assume_called = False
+        self.bind_called = False
+
+    def pod_claims(self, pod):
+        return []
+
+    def find_pod_volumes(self, pod, node):
+        return self._find
+
+    def assume_pod_volumes(self, pod, node):
+        self.assume_called = True
+        return self._assume
+
+    def forget_pod_volumes(self, pod):
+        pass
+
+    def bind_pod_volumes(self, pod, node_name=""):
+        self.bind_called = True

@@ -109,6 +109,21 @@ def encode_pod_batch(
         spec = pod.spec
         aff = spec.affinity
 
+        # PVC-backed and direct-attach volumes need the host path: the
+        # volume plugins (binding, restrictions, attach limits, zone) are
+        # host-side post-filters, like reference extenders
+        if any(
+            vol.persistent_volume_claim
+            or vol.gce_persistent_disk
+            or vol.aws_elastic_block_store
+            or vol.iscsi
+            or vol.rbd
+            or vol.azure_disk
+            or vol.cinder
+            for vol in spec.volumes
+        ):
+            d["fallback"] = True
+
         # topology spread
         spreads = []
         for tsc in spec.topology_spread_constraints[: c.spread_max]:

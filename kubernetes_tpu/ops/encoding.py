@@ -146,6 +146,27 @@ class EncodingConfig:
     pod_pref_max: int = 4  # incoming preferred (anti-)affinity terms (signed w)
     images_max: int = 8  # images per pod
 
+    @classmethod
+    def for_cluster(cls, num_nodes: int, **overrides) -> "EncodingConfig":
+        """Capacities pre-sized for a cluster of ~num_nodes so steady-state
+        runs never grow (growth = device re-upload + kernel recompile; an
+        observed 14.5s recompile mid-benchmark wrecks p99). v_cap dominates:
+        hostname-like labels contribute one value per node."""
+
+        def pow2(n: int, floor: int) -> int:
+            p = floor
+            while p < n:
+                p *= 2
+            return p
+
+        # 25% slack for churn (nodes come and go; rows are not reused until
+        # compaction), plus a flat allowance for non-hostname label values.
+        n_cap = pow2(int(num_nodes * 1.25) + 1, 128)
+        v_cap = pow2(int(num_nodes * 1.25) + 512, 256)
+        base = dict(n_cap=n_cap, v_cap=v_cap)
+        base.update(overrides)
+        return cls(**base)
+
 
 class Vocab:
     """Growable string->id intern table."""
@@ -371,6 +392,14 @@ class SnapshotEncoder:
             sl = tuple(slice(0, s) for s in arr.shape)
             dst[sl] = arr
         self._full_upload = True
+
+    def presize_for_cluster(self, num_nodes: int) -> None:
+        """Grow n_cap/v_cap ahead of a known cluster scale (see
+        EncodingConfig.for_cluster). Cheap before the first flush; later it
+        costs the same single re-upload a demand-grow would."""
+        want = EncodingConfig.for_cluster(num_nodes)
+        self._ensure_cap("n_cap", want.n_cap)
+        self._ensure_cap("v_cap", want.v_cap)
 
     def _ensure_cap(self, attr: str, needed: int) -> None:
         cur = getattr(self.cfg, attr)

@@ -49,6 +49,25 @@ def pod_fingerprint(pod: v1.Pod) -> Tuple:
         ),
         None,
     )
+    volumes = tuple(
+        (
+            v.persistent_volume_claim,
+            v.gce_persistent_disk,
+            v.aws_elastic_block_store,
+            v.iscsi,
+            v.rbd,
+            v.azure_disk,
+            v.cinder,
+        )
+        for v in spec.volumes
+        if v.persistent_volume_claim
+        or v.gce_persistent_disk
+        or v.aws_elastic_block_store
+        or v.iscsi
+        or v.rbd
+        or v.azure_disk
+        or v.cinder
+    )
     return (
         pod.metadata.namespace,
         frozenset(pod.metadata.labels.items()),
@@ -61,6 +80,7 @@ def pod_fingerprint(pod: v1.Pod) -> Tuple:
         tuple(spec.topology_spread_constraints),
         ctrl,
         spec.scheduler_name,
+        volumes,
     )
 
 

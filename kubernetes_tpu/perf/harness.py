@@ -47,11 +47,15 @@ def run_benchmark(
     sched_config: Optional[KubeSchedulerConfiguration] = None,
     timeout_s: float = 300.0,
     quiet: bool = True,
+    presize_nodes: Optional[int] = None,
 ) -> BenchResult:
     metrics.reset()
     server = APIServer()
     scfg = sched_config or KubeSchedulerConfiguration()
     sched = Scheduler(server, scfg)
+    # presize for a larger target cluster so a warm-up run compiles the same
+    # kernel variant (same v_cap/n_cap) the measured run will use
+    sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
 
     nodes, init_pods, factory = build_workload(cfg)
     for n in nodes:

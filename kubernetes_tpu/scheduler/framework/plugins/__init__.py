@@ -29,3 +29,18 @@ from .misc import (  # noqa: F401
     DefaultBinder,
     SelectorSpread,
 )
+from .storage import (  # noqa: F401
+    AzureDiskLimits,
+    CinderLimits,
+    EBSLimits,
+    GCEPDLimits,
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+)
+from .extended import (  # noqa: F401
+    NodeLabel,
+    NodeResourceLimits,
+    ServiceAffinity,
+)

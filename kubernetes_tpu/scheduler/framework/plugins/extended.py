@@ -1,0 +1,204 @@
+"""Policy-configured / legacy plugins: NodeLabel, ServiceAffinity,
+NodeResourceLimits.
+
+Reference: framework/plugins/nodelabel/node_label.go (policy-args label
+presence filter + score), serviceaffinity/service_affinity.go (same-service
+pods pinned to nodes agreeing on configured label keys), and
+noderesources/resource_limits.go (prefer nodes satisfying the pod's
+resource limits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ....api import objects as v1
+from ....api.resources import CPU, MEMORY, parse_quantity
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    PreFilterPlugin,
+    PreScorePlugin,
+    ScorePlugin,
+    Status,
+)
+from .helpers import node_labels, services_matching_pod
+
+
+class NodeLabel(FilterPlugin, ScorePlugin):
+    """node_label.go:31 — filter on configured present/absent label keys,
+    score on preferred presence/absence."""
+
+    name = "NodeLabel"
+
+    def __init__(
+        self,
+        present_labels: Optional[List[str]] = None,
+        absent_labels: Optional[List[str]] = None,
+        present_labels_preference: Optional[List[str]] = None,
+        absent_labels_preference: Optional[List[str]] = None,
+    ):
+        self.present = present_labels or []
+        self.absent = absent_labels or []
+        self.present_pref = present_labels_preference or []
+        self.absent_pref = absent_labels_preference or []
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        labels = node_labels(node_info.node)
+        for k in self.present:
+            if k not in labels:
+                return Status.unschedulable(
+                    "node(s) didn't have the requested labels"
+                )
+        for k in self.absent:
+            if k in labels:
+                return Status.unschedulable(
+                    "node(s) had the excluded labels"
+                )
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        labels = node_labels(snapshot.get(node_name).node)
+        total = len(self.present_pref) + len(self.absent_pref)
+        if total == 0:
+            return 0.0, None
+        hits = sum(1 for k in self.present_pref if k in labels) + sum(
+            1 for k in self.absent_pref if k not in labels
+        )
+        return hits * 100.0 / total, None
+
+
+_SA_STATE_KEY = "PreFilterServiceAffinity"
+
+
+class ServiceAffinity(PreFilterPlugin, FilterPlugin, ScorePlugin):
+    """service_affinity.go — pods of one Service agree on the node values of
+    the configured affinity label keys; score spreads by anti-affinity keys."""
+
+    name = "ServiceAffinity"
+
+    def __init__(
+        self,
+        services_lister=None,  # () -> List[v1.Service]
+        snapshot_getter=None,  # () -> Snapshot
+        affinity_labels: Optional[List[str]] = None,
+        anti_affinity_labels_preference: Optional[List[str]] = None,
+    ):
+        self._services = services_lister
+        self._snapshot = snapshot_getter
+        self.affinity_labels = affinity_labels or []
+        self.anti_pref = anti_affinity_labels_preference or []
+
+    def _service_selectors(self, pod: v1.Pod) -> List[Dict[str, str]]:
+        if self._services is None:
+            return []
+        return services_matching_pod(self._services(), pod)
+
+    def _matching_pods_nodes(self, pod: v1.Pod) -> List[str]:
+        """Node names hosting other pods matched by the same services."""
+        snap = self._snapshot() if self._snapshot else None
+        if snap is None:
+            return []
+        sels = self._service_selectors(pod)
+        if not sels:
+            return []
+        nodes = []
+        for ni in snap.node_info_list:
+            for other in ni.pods:
+                if other.metadata.namespace != pod.metadata.namespace:
+                    continue
+                if any(
+                    all(
+                        other.metadata.labels.get(k) == vv
+                        for k, vv in sel.items()
+                    )
+                    for sel in sels
+                ):
+                    nodes.append(ni.name)
+                    break
+        return nodes
+
+    def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
+        if not self.affinity_labels:
+            return None
+        snap = self._snapshot() if self._snapshot else None
+        constraints: Dict[str, str] = {}
+        if snap is not None:
+            for node_name in self._matching_pods_nodes(pod):
+                ni = snap.get(node_name)
+                if ni is None:
+                    continue
+                labels = node_labels(ni.node)
+                for k in self.affinity_labels:
+                    if k in labels:
+                        constraints.setdefault(k, labels[k])
+        state.write(_SA_STATE_KEY, constraints)
+        return None
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        if not self.affinity_labels:
+            return None
+        try:
+            constraints: Dict[str, str] = state.read(_SA_STATE_KEY)
+        except KeyError:
+            constraints = {}
+        labels = node_labels(node_info.node)
+        for k, want in constraints.items():
+            if labels.get(k) != want:
+                return Status.unschedulable(
+                    "node(s) didn't match service affinity"
+                )
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        if not self.anti_pref:
+            return 0.0, None
+        ni = snapshot.get(node_name)
+        labels = node_labels(ni.node)
+        busy = self._matching_pods_nodes(pod)
+        if not busy:
+            return 100.0, None
+        # fewer same-service pods sharing this node's label values → higher
+        count = 0
+        for other_name in busy:
+            other = snapshot.get(other_name)
+            if other is None:
+                continue
+            olabels = node_labels(other.node)
+            if all(
+                labels.get(k) == olabels.get(k) for k in self.anti_pref
+            ):
+                count += 1
+        return max(0.0, 100.0 - count * 10.0), None
+
+
+_RL_STATE_KEY = "PreScoreNodeResourceLimits"
+
+
+class NodeResourceLimits(PreScorePlugin, ScorePlugin):
+    """resource_limits.go:40 — one point per resource (cpu, memory) whose
+    pod-level limit the node can satisfy."""
+
+    name = "NodeResourceLimits"
+
+    def pre_score(self, state: CycleState, pod, nodes) -> Optional[Status]:
+        cpu = 0.0
+        mem = 0.0
+        for c in pod.spec.containers:
+            cpu += parse_quantity(c.limits.get(CPU, 0)) if c.limits else 0.0
+            mem += parse_quantity(c.limits.get(MEMORY, 0)) if c.limits else 0.0
+        state.write(_RL_STATE_KEY, (cpu, mem))
+        return None
+
+    def score(self, state, pod, node_name, snapshot=None):
+        try:
+            cpu, mem = state.read(_RL_STATE_KEY)
+        except KeyError:
+            cpu, mem = 0.0, 0.0
+        alloc = snapshot.get(node_name).allocatable
+        score = 0
+        if cpu > 0 and alloc.get(CPU, 0) >= cpu:
+            score += 1
+        if mem > 0 and alloc.get(MEMORY, 0) >= mem:
+            score += 1
+        return float(score), None

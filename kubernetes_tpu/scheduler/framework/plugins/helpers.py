@@ -22,6 +22,22 @@ from ....api.selectors import (
 )
 
 
+def services_matching_pod(services, pod: v1.Pod):
+    """Selectors (raw dicts) of Services selecting the pod — the shared core
+    of SelectorSpread's getSelectors and ServiceAffinity
+    (default_pod_topology_spread.go:43, service_affinity.go)."""
+    out = []
+    for svc in services:
+        if svc.metadata.namespace != pod.metadata.namespace:
+            continue
+        sel = svc.spec.selector
+        if sel and all(
+            pod.metadata.labels.get(k) == vv for k, vv in sel.items()
+        ):
+            out.append(sel)
+    return out
+
+
 def node_labels(node: v1.Node) -> Dict[str, str]:
     labels = dict(node.metadata.labels)
     labels.setdefault("kubernetes.io/hostname", node.metadata.name)

@@ -1,0 +1,281 @@
+"""Storage-related filter plugins.
+
+Reference: framework/plugins/volumebinding/volume_binding.go,
+volumerestrictions/volume_restrictions.go, volumezone/volume_zone.go,
+nodevolumelimits/{csi.go,non_csi.go}. These all run host-side after the
+device mask narrows candidates (the reference's extender-style post-filter,
+generic_scheduler.go:421) — volume state is API-shaped and churny, a poor
+fit for the HBM-resident snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ....api import objects as v1
+from ....controller.volume_scheduling import (
+    REGION_LABELS,
+    ZONE_LABELS,
+    ClaimNotFound,
+    VolumeBinder,
+)
+from ..interface import Code, CycleState, FilterPlugin, Status
+
+ERR_REASON_BIND_CONFLICT = "node(s) didn't find available persistent volumes to bind"
+ERR_REASON_NODE_CONFLICT = "node(s) had volume node affinity conflict"
+
+
+class VolumeBinding(FilterPlugin):
+    """volume_binding.go: delegate to the shared VolumeBinder's Find."""
+
+    name = "VolumeBinding"
+
+    def __init__(self, binder: Optional[VolumeBinder]):
+        self.binder = binder
+
+    @staticmethod
+    def _pod_has_pvcs(pod: v1.Pod) -> bool:
+        return any(vol.persistent_volume_claim for vol in pod.spec.volumes)
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        if self.binder is None or not self._pod_has_pvcs(pod):
+            return None
+        try:
+            unbound_ok, bound_ok, reasons = self.binder.find_pod_volumes(
+                pod, node_info.node
+            )
+        except ClaimNotFound as e:
+            # missing PVC can't be fixed by preemption
+            return Status(Code.UNSCHEDULABLE_AND_UNRESOLVABLE, str(e))
+        if unbound_ok and bound_ok:
+            return None
+        return Status.unschedulable(
+            "; ".join(reasons) or ERR_REASON_BIND_CONFLICT
+        )
+
+
+def _attachable_volumes(
+    pod: v1.Pod, binder: Optional[VolumeBinder], source: str
+) -> Set[str]:
+    """Unique volume ids of `source` kind used by a pod (direct + via PVC)."""
+    out: Set[str] = set()
+    has_pvc = False
+    for vol in pod.spec.volumes:
+        src = getattr(vol, source, None)
+        if src is not None:
+            out.add(_vol_id(src))
+        elif vol.persistent_volume_claim:
+            has_pvc = True
+    if has_pvc and binder is not None:
+        try:
+            for claim in binder.pod_claims(pod):
+                pv_name = claim.spec.volume_name
+                if not pv_name:
+                    continue
+                pv = binder._pv(pv_name)
+                if pv is None:
+                    continue
+                psrc = getattr(pv.spec, source, None)
+                if psrc is not None:
+                    out.add(_vol_id(psrc))
+        except ClaimNotFound:
+            pass
+    return out
+
+
+def _vol_id(src) -> str:
+    for attr in ("pd_name", "volume_id", "disk_name", "iqn", "image"):
+        val = getattr(src, attr, None)
+        if val:
+            return f"{type(src).__name__}:{val}"
+    return f"{type(src).__name__}:?"
+
+
+class VolumeRestrictions(FilterPlugin):
+    """volume_restrictions.go isVolumeConflict: a GCE-PD/ISCSI/RBD volume
+    already on the node conflicts unless both mounts are read-only; the same
+    EBS volume on one node always conflicts (EBS has no read-only
+    exemption)."""
+
+    name = "VolumeRestrictions"
+
+    _SOURCES = ("gce_persistent_disk", "aws_elastic_block_store", "iscsi", "rbd")
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        new_vols = []
+        for vol in pod.spec.volumes:
+            for sname in self._SOURCES:
+                src = getattr(vol, sname, None)
+                if src is not None:
+                    new_vols.append((sname, src))
+        if not new_vols:
+            return None
+        for existing in node_info.pods:
+            for evol in existing.spec.volumes:
+                for sname, src in new_vols:
+                    esrc = getattr(evol, sname, None)
+                    if esrc is None:
+                        continue
+                    if _vol_id(esrc) != _vol_id(src):
+                        continue
+                    if sname != "aws_elastic_block_store" and (
+                        src.read_only and esrc.read_only
+                    ):
+                        continue
+                    return Status.unschedulable("node(s) had a volume conflict")
+        return None
+
+
+class VolumeZone(FilterPlugin):
+    """volume_zone.go: a bound PV carrying zone/region labels restricts the
+    pod to nodes whose labels match."""
+
+    name = "VolumeZone"
+
+    def __init__(self, binder: Optional[VolumeBinder]):
+        self.binder = binder
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        if self.binder is None:
+            return None
+        node_lbls = node_info.node.metadata.labels
+        try:
+            claims = self.binder.pod_claims(pod)
+        except ClaimNotFound as e:
+            return Status(Code.UNSCHEDULABLE_AND_UNRESOLVABLE, str(e))
+        for claim in claims:
+            if not claim.spec.volume_name:
+                continue
+            pv = self.binder._pv(claim.spec.volume_name)
+            if pv is None:
+                continue
+            for keyset in (ZONE_LABELS, REGION_LABELS):
+                pv_val = next(
+                    (
+                        pv.metadata.labels[k]
+                        for k in keyset
+                        if k in pv.metadata.labels
+                    ),
+                    None,
+                )
+                if pv_val is None:
+                    continue
+                node_val = next(
+                    (node_lbls[k] for k in keyset if k in node_lbls), None
+                )
+                # PV zone labels may hold a __ separated set (volume helpers)
+                if node_val is None or node_val not in pv_val.split("__"):
+                    return Status.unschedulable(
+                        "node(s) had no available volume zone"
+                    )
+        return None
+
+
+# -- attachable-volume count limits (nodevolumelimits) ----------------------
+
+DEFAULT_LIMITS = {
+    "aws_elastic_block_store": 39,  # non_csi.go DefaultMaxEBSVolumes
+    "gce_persistent_disk": 16,
+    "azure_disk": 16,
+    "cinder": 256,
+}
+
+
+class _NonCSILimits(FilterPlugin):
+    source = ""  # volume source attr this instance counts
+    limit_key = ""  # node allocatable resource name override
+
+    def __init__(self, binder: Optional[VolumeBinder] = None, limit: Optional[int] = None):
+        self.binder = binder
+        self.limit = limit if limit is not None else DEFAULT_LIMITS[self.source]
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        new = _attachable_volumes(pod, self.binder, self.source)
+        if not new:
+            return None
+        used: Set[str] = set()
+        for existing in node_info.pods:
+            used |= _attachable_volumes(existing, self.binder, self.source)
+        if len(used | new) > self.limit:
+            return Status.unschedulable(
+                "node(s) exceed max volume count"
+            )
+        return None
+
+
+class EBSLimits(_NonCSILimits):
+    name = "EBSLimits"
+    source = "aws_elastic_block_store"
+
+
+class GCEPDLimits(_NonCSILimits):
+    name = "GCEPDLimits"
+    source = "gce_persistent_disk"
+
+
+class AzureDiskLimits(_NonCSILimits):
+    name = "AzureDiskLimits"
+    source = "azure_disk"
+
+
+class CinderLimits(_NonCSILimits):
+    name = "CinderLimits"
+    source = "cinder"
+
+
+class NodeVolumeLimits(FilterPlugin):
+    """csi.go: per-CSI-driver attachable limits from the node's CSINode."""
+
+    name = "NodeVolumeLimits"
+
+    def __init__(self, binder: Optional[VolumeBinder], csinode_getter=None):
+        self.binder = binder
+        self._csinode = csinode_getter  # name -> CSINode | None
+
+    def _pod_csi_volumes(self, pod) -> Dict[str, Set[str]]:
+        """driver -> volume handles used by pod (via bound PVs)."""
+        out: Dict[str, Set[str]] = {}
+        if self.binder is None:
+            return out
+        try:
+            claims = self.binder.pod_claims(pod)
+        except ClaimNotFound:
+            return out
+        for claim in claims:
+            if not claim.spec.volume_name:
+                continue
+            pv = self.binder._pv(claim.spec.volume_name)
+            if pv is None or pv.spec.csi is None:
+                continue
+            out.setdefault(pv.spec.csi.driver, set()).add(
+                pv.spec.csi.volume_handle
+            )
+        return out
+
+    def filter(self, state: CycleState, pod, node_info) -> Optional[Status]:
+        new = self._pod_csi_volumes(pod)
+        if not new or self._csinode is None:
+            return None
+        csinode = self._csinode(node_info.name)
+        if csinode is None:
+            return None
+        limits = {
+            d.name: d.allocatable_count
+            for d in csinode.drivers
+            if d.allocatable_count is not None
+        }
+        if not limits:
+            return None
+        used: Dict[str, Set[str]] = {}
+        for existing in node_info.pods:
+            for driver, handles in self._pod_csi_volumes(existing).items():
+                used.setdefault(driver, set()).update(handles)
+        for driver, handles in new.items():
+            limit = limits.get(driver)
+            if limit is None:
+                continue
+            if len(used.get(driver, set()) | handles) > limit:
+                return Status.unschedulable(
+                    "node(s) exceed max volume count"
+                )
+        return None

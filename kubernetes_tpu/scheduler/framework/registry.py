@@ -35,7 +35,8 @@ def default_plugin_set() -> PluginSet:
     """Default algorithm provider (algorithmprovider/registry.go:61-131).
 
     Filter order matches the reference: NodeUnschedulable → Fit → NodeName →
-    NodePorts → NodeAffinity → TaintToleration → InterPodAffinity (+ spread).
+    NodePorts → NodeAffinity → VolumeRestrictions → TaintToleration →
+    volume limits → VolumeBinding → VolumeZone → spread → InterPodAffinity.
     """
     return PluginSet(
         pre_filter=[
@@ -50,7 +51,14 @@ def default_plugin_set() -> PluginSet:
             "NodeName",
             "NodePorts",
             "NodeAffinity",
+            "VolumeRestrictions",
             "TaintToleration",
+            "NodeVolumeLimits",
+            "EBSLimits",
+            "GCEPDLimits",
+            "AzureDiskLimits",
+            "VolumeBinding",
+            "VolumeZone",
             "PodTopologySpread",
             "InterPodAffinity",
         ],
@@ -105,4 +113,21 @@ def default_registry() -> Registry:
     r["DefaultPodTopologySpread"] = lambda ctx: p.SelectorSpread(
         ctx.get("selectors_for_pod")
     )
+    r["VolumeBinding"] = lambda ctx: p.VolumeBinding(ctx.get("volume_binder"))
+    r["VolumeRestrictions"] = lambda ctx: p.VolumeRestrictions()
+    r["VolumeZone"] = lambda ctx: p.VolumeZone(ctx.get("volume_binder"))
+    r["NodeVolumeLimits"] = lambda ctx: p.NodeVolumeLimits(
+        ctx.get("volume_binder"), ctx.get("csinode_getter")
+    )
+    r["EBSLimits"] = lambda ctx: p.EBSLimits(ctx.get("volume_binder"))
+    r["GCEPDLimits"] = lambda ctx: p.GCEPDLimits(ctx.get("volume_binder"))
+    r["AzureDiskLimits"] = lambda ctx: p.AzureDiskLimits(ctx.get("volume_binder"))
+    r["CinderLimits"] = lambda ctx: p.CinderLimits(ctx.get("volume_binder"))
+    r["NodeLabel"] = lambda ctx: p.NodeLabel(**ctx.get("node_label_args", {}))
+    r["ServiceAffinity"] = lambda ctx: p.ServiceAffinity(
+        ctx.get("services_lister"),
+        ctx.get("snapshot_getter"),
+        **ctx.get("service_affinity_args", {}),
+    )
+    r["NodeResourceLimits"] = lambda ctx: p.NodeResourceLimits()
     return r

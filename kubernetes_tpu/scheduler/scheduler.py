@@ -35,6 +35,7 @@ import numpy as np
 from ..api import objects as v1
 from ..client.apiserver import APIServer, NotFound
 from ..client.informers import SharedInformerFactory
+from ..controller.volume_scheduling import VolumeBinder
 from ..api.objects import Binding
 from ..ops.batch import encode_pod_batch
 from ..ops.encoding import ETERM_ANTI_REQ as _ETERM_ANTI_REQ
@@ -100,10 +101,15 @@ class Scheduler:
             pod_max_backoff=self.cfg.pod_max_backoff_seconds,
         )
         self._snapshot = None  # latest host snapshot (fallback/preemption)
+        self.volume_binder = VolumeBinder(server)
         context = {
             "server": server,
             "snapshot_getter": lambda: self._snapshot,
             "hard_pod_affinity_weight": self.cfg.hard_pod_affinity_weight,
+            "volume_binder": self.volume_binder,
+            "csinode_getter": self._csinode,
+            "services_lister": lambda: server.list("services")[0],
+            "selectors_for_pod": self._selectors_for_pod,
         }
         self.profiles: ProfileMap = new_profile_map(self.cfg, context, server=server)
         self.informer_factory = SharedInformerFactory(server)
@@ -130,6 +136,24 @@ class Scheduler:
 
     # -- wiring --------------------------------------------------------------
 
+    def _csinode(self, name: str):
+        try:
+            return self.server.get("csinodes", "", name)
+        except NotFound:
+            return None
+
+    def _selectors_for_pod(self, pod: v1.Pod):
+        """Selectors of Services matching the pod (SelectorSpread's lister —
+        getSelectors in default_pod_topology_spread.go:43)."""
+        from ..api.selectors import selector_from_match_labels
+        from .framework.plugins.helpers import services_matching_pod
+
+        services, _ = self.server.list("services")
+        return [
+            selector_from_match_labels(sel)
+            for sel in services_matching_pod(services, pod)
+        ]
+
     def _build_weights(self) -> np.ndarray:
         w = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
         default = next(iter(self.profiles.values()))
@@ -146,6 +170,14 @@ class Scheduler:
         (app.Run, cmd/kube-scheduler/app/server.go:142)."""
         self.informer_factory.start()
         self.informer_factory.wait_for_cache_sync()
+        # presize device capacities from the synced node count so the wave
+        # kernel compiles once instead of re-compiling on capacity growth
+        n_nodes = max(
+            self.cache.node_count,
+            len(self.informer_factory.informer("nodes").indexer),
+        )
+        with self.cache.lock:
+            self.cache.encoder.presize_for_cluster(max(n_nodes, 1))
         self.queue.run()
         self.cache.start_janitor()
         self._sched_thread = threading.Thread(
@@ -319,10 +351,13 @@ class Scheduler:
     def _schedule_batch_wave(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
+        # two padded-batch buckets: ragged tails use a small lattice, bursts
+        # the full one. Exactly two jit variants per wave count — each extra
+        # bucket is another multi-second XLA compile on first use
+        small = min(256, self.cfg.device_batch_size)
+        pad = small if len(pis) <= small else self.cfg.device_batch_size
         with self.cache.lock:
-            eb = self._tpl_cache.encode(
-                [pi.pod for pi in pis], pad_to=self.cfg.device_batch_size
-            )
+            eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
             ptab, n_waves = self._pair_table(eb)
             snap = self.cache.encoder.flush()
             enc_cfg = self.cache.encoder.cfg
@@ -512,24 +547,51 @@ class Scheduler:
 
     # -- assume + bind --------------------------------------------------------
 
+    def _pod_has_pvcs(self, pod: v1.Pod) -> bool:
+        return any(vol.persistent_volume_claim for vol in pod.spec.volumes)
+
+    def _assume_volumes(self, pi: QueuedPodInfo, node_name: str) -> bool:
+        """VolumeBinder.AssumePodVolumes before Reserve (scheduler.go:615).
+        Returns False (after recording the failure) when no volume plan
+        exists for the chosen node."""
+        pod = pi.pod
+        if not self._pod_has_pvcs(pod):
+            return True
+        if self._snapshot is None:
+            self._snapshot = self.cache.update_snapshot()
+        ni = self._snapshot.get(node_name)
+        if ni is None:
+            return True
+        try:
+            self.volume_binder.assume_pod_volumes(pod, ni.node)
+        except Exception as e:
+            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            return False
+        return True
+
     def _assume_and_bind(self, pi: QueuedPodInfo, node_name: str, t_start: float) -> None:
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
         fw = prof.framework
         state = CycleState()
+        if not self._assume_volumes(pi, node_name):
+            return
         st = fw.run_reserve_plugins(state, pod, node_name)
         if not is_success(st):
+            self.volume_binder.forget_pod_volumes(pod)
             self._handle_failure(pi, self.queue.moves, message=st.message, error=True)
             return
         try:
             self.cache.assume_pod(pod, node_name)
         except ValueError as e:
+            self.volume_binder.forget_pod_volumes(pod)
             self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
             return
         self.queue.delete_nominated_if_exists(pod)
         st = fw.run_permit_plugins(state, pod, node_name)
         if st is not None and st.code not in (Code.SUCCESS, Code.WAIT):
             self.cache.forget_pod(pod)
+            self.volume_binder.forget_pod_volumes(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves, message=st.message)
             return
@@ -545,6 +607,9 @@ class Scheduler:
             st = fw.wait_on_permit(pod)
             if not is_success(st):
                 raise RuntimeError(f"permit: {st.message}")
+            # bindVolumes before PreBind (scheduler.go:454,704)
+            if self._pod_has_pvcs(pod):
+                self.volume_binder.bind_pod_volumes(pod, node_name)
             st = fw.run_pre_bind_plugins(state, pod, node_name)
             if not is_success(st):
                 raise RuntimeError(f"prebind: {st.message}")
@@ -564,6 +629,7 @@ class Scheduler:
             )
         except Exception as e:
             self.cache.forget_pod(pod)
+            self.volume_binder.forget_pod_volumes(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
 
