@@ -27,9 +27,10 @@ def main() -> None:
     cfg = WORKLOADS["SchedulingPodAffinity/5000"]
 
     # Warm-up on a small instance of the same workload so XLA compile time
-    # (one-off, cached) doesn't pollute the measured window.
+    # (one-off, cached) doesn't pollute the measured window; presized to the
+    # measured cluster's capacities so the same kernel variant compiles.
     warm = WORKLOADS["SchedulingPodAffinity/500"]
-    run_benchmark(warm, quiet=True)
+    run_benchmark(warm, quiet=True, presize_nodes=cfg.num_nodes)
 
     res = run_benchmark(cfg, quiet=True)
     out = {

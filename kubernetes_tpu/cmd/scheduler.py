@@ -1,0 +1,157 @@
+"""kube-scheduler process entry.
+
+Reference: cmd/kube-scheduler/app/server.go — runCommand/Setup (:302),
+Run (:142): healthz server (:10251, server.go:160-171), metrics mux
+(:237-268 with the debug DELETE reset), leader election gating sched.Run
+(:196-210 — losing leadership is fatal), SIGUSR2 cache debugger.
+
+The API backend is the in-process store; a REST-backed client lands with
+the apiserver façade.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..client.apiserver import APIServer
+from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from ..scheduler import KubeSchedulerConfiguration, Scheduler
+from ..scheduler.apis_config import load_config_file
+from ..scheduler.cache.debugger import CacheDebugger
+from ..utils.metrics import metrics
+
+logger = logging.getLogger("kubernetes_tpu.cmd.scheduler")
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    server_version = "kube-scheduler-tpu"
+
+    def log_message(self, *args):
+        pass
+
+    def _respond(self, code: int, body: bytes, ctype="text/plain"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/healthz", "/livez", "/readyz"):
+            ok = self.server.health_check()
+            self._respond(200 if ok else 500, b"ok" if ok else b"unhealthy")
+        elif self.path == "/metrics":
+            body = json.dumps(metrics.dump(), indent=1).encode()
+            self._respond(200, body, "application/json")
+        else:
+            self._respond(404, b"not found")
+
+    def do_DELETE(self):
+        # debug handler: DELETE /metrics resets (server.go:237-247)
+        if self.path == "/metrics":
+            metrics.reset()
+            self._respond(200, b"metrics reset\n")
+        else:
+            self._respond(404, b"not found")
+
+
+def serve_health(port: int, health_check) -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+    srv.health_check = health_check
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def run(
+    server: Optional[APIServer] = None,
+    config: Optional[KubeSchedulerConfiguration] = None,
+    healthz_port: int = 10251,
+    block: bool = True,
+) -> Scheduler:
+    """app.Run (server.go:142): health endpoints → informers → leader
+    election (optional) → scheduling loops."""
+    server = server or APIServer()
+    cfg = config or KubeSchedulerConfiguration()
+    sched = Scheduler(server, cfg)
+    healthy = threading.Event()
+    if healthz_port:
+        serve_health(healthz_port, lambda: healthy.is_set())
+    CacheDebugger(sched).listen_for_signal()
+
+    stop = threading.Event()
+
+    def start_scheduling():
+        sched.start()
+        healthy.set()
+
+    if cfg.leader_election is not None:
+        def on_stopped():
+            # leaderelection.go: losing the lease is fatal for the process
+            logger.error("leader election lost; shutting down scheduling")
+            healthy.clear()
+            sched.stop()
+            stop.set()
+
+        elector = LeaderElector(
+            server,
+            cfg.leader_election,
+            on_started_leading=start_scheduling,
+            on_stopped_leading=on_stopped,
+        )
+        threading.Thread(target=elector.run, daemon=True).start()
+        sched._elector = elector
+    else:
+        start_scheduling()
+
+    if block:
+        try:
+            while not stop.is_set():
+                stop.wait(1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            sched.stop()
+    return sched
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-scheduler-tpu")
+    parser.add_argument("--config", help="ComponentConfig or Policy file")
+    parser.add_argument("--healthz-port", type=int, default=10251)
+    parser.add_argument(
+        "--leader-elect", action="store_true", default=False
+    )
+    parser.add_argument(
+        "--platform",
+        default="",
+        help="force a JAX platform (e.g. 'cpu' to run without the TPU — "
+        "the device-failure fallback path)",
+    )
+    parser.add_argument("-v", "--verbosity", type=int, default=1)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
+    )
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    cfg = (
+        load_config_file(args.config)
+        if args.config
+        else KubeSchedulerConfiguration()
+    )
+    if args.leader_elect and cfg.leader_election is None:
+        cfg.leader_election = LeaderElectionConfig()
+    run(config=cfg, healthz_port=args.healthz_port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

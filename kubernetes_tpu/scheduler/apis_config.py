@@ -1,0 +1,264 @@
+"""Versioned ComponentConfig loading + legacy Policy translation.
+
+Reference: pkg/scheduler/apis/config/{types.go,v1alpha1,v1alpha2} (the
+--config file path), scheme-based conversion, and the legacy Policy JSON
+(legacy_types.go) whose predicate/priority names map onto framework plugins
+via pkg/scheduler/framework/plugins/legacy_registry.go:148,183.
+
+Input is a dict (parsed JSON — or YAML if available) with an `apiVersion`
+of kubescheduler.config.k8s.io/v1alpha1 or /v1alpha2; both convert into the
+internal KubeSchedulerConfiguration. Policy files (`kind: Policy`) convert
+their predicate/priority lists into a PluginSet.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..client.leaderelection import LeaderElectionConfig
+from .config import KubeSchedulerConfiguration, ProfileConfig
+from .extender import ExtenderConfig, ExtenderManagedResource
+from .framework.registry import PluginSet, default_plugin_set
+
+SUPPORTED_VERSIONS = (
+    "kubescheduler.config.k8s.io/v1alpha1",
+    "kubescheduler.config.k8s.io/v1alpha2",
+)
+
+# legacy_registry.go:148 — predicate name -> plugin name
+PREDICATE_TO_PLUGIN: Dict[str, str] = {
+    "PodFitsResources": "NodeResourcesFit",
+    "PodFitsHostPorts": "NodePorts",
+    "HostName": "NodeName",
+    "MatchNodeSelector": "NodeAffinity",
+    "NoDiskConflict": "VolumeRestrictions",
+    "NoVolumeZoneConflict": "VolumeZone",
+    "MaxEBSVolumeCount": "EBSLimits",
+    "MaxGCEPDVolumeCount": "GCEPDLimits",
+    "MaxAzureDiskVolumeCount": "AzureDiskLimits",
+    "MaxCinderVolumeCount": "CinderLimits",
+    "MaxCSIVolumeCountPred": "NodeVolumeLimits",
+    "CheckVolumeBinding": "VolumeBinding",
+    "PodToleratesNodeTaints": "TaintToleration",
+    "CheckNodeUnschedulable": "NodeUnschedulable",
+    "EvenPodsSpreadPred": "PodTopologySpread",
+    "MatchInterPodAffinity": "InterPodAffinity",
+    "CheckNodeLabelPresence": "NodeLabel",
+    "CheckServiceAffinity": "ServiceAffinity",
+}
+
+# "GeneralPredicates" expands to the basic node checks (legacy_registry.go)
+GENERAL_PREDICATES = [
+    "NodeResourcesFit",
+    "NodeName",
+    "NodePorts",
+    "NodeAffinity",
+]
+
+# legacy_registry.go:183 — priority name -> plugin name
+PRIORITY_TO_PLUGIN: Dict[str, str] = {
+    "LeastRequestedPriority": "NodeResourcesLeastAllocated",
+    "MostRequestedPriority": "NodeResourcesMostAllocated",
+    "BalancedResourceAllocation": "NodeResourcesBalancedAllocation",
+    "RequestedToCapacityRatioPriority": "RequestedToCapacityRatio",
+    "SelectorSpreadPriority": "DefaultPodTopologySpread",
+    "ServiceSpreadingPriority": "DefaultPodTopologySpread",
+    "InterPodAffinityPriority": "InterPodAffinity",
+    "NodeAffinityPriority": "NodeAffinity",
+    "TaintTolerationPriority": "TaintToleration",
+    "ImageLocalityPriority": "ImageLocality",
+    "NodePreferAvoidPodsPriority": "NodePreferAvoidPods",
+    "EvenPodsSpreadPriority": "PodTopologySpread",
+    "ResourceLimitsPriority": "NodeResourceLimits",
+    "NodeLabelPriority": "NodeLabel",
+}
+
+# plugins that also need a pre-filter / pre-score stage when enabled
+_NEEDS_PRE_FILTER = {
+    "NodeResourcesFit",
+    "NodePorts",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "ServiceAffinity",
+}
+_NEEDS_PRE_SCORE = {
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "TaintToleration",
+    "NodeResourceLimits",
+    "DefaultPodTopologySpread",
+}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def load_config_file(path: str) -> KubeSchedulerConfiguration:
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+
+            data = yaml.safe_load(text)
+        except ImportError as e:
+            raise ConfigError(
+                "config file is not JSON and PyYAML is unavailable"
+            ) from e
+    return config_from_dict(data)
+
+
+def config_from_dict(data: dict) -> KubeSchedulerConfiguration:
+    if data.get("kind") == "Policy":
+        return policy_to_config(data)
+    api_version = data.get("apiVersion", SUPPORTED_VERSIONS[-1])
+    if api_version not in SUPPORTED_VERSIONS:
+        raise ConfigError(f"unsupported apiVersion {api_version!r}")
+    cfg = KubeSchedulerConfiguration()
+    if "disablePreemption" in data:
+        cfg.disable_preemption = bool(data["disablePreemption"])
+    if "percentageOfNodesToScore" in data:
+        cfg.percentage_of_nodes_to_score = int(data["percentageOfNodesToScore"])
+    if "podInitialBackoffSeconds" in data:
+        cfg.pod_initial_backoff_seconds = float(data["podInitialBackoffSeconds"])
+    if "podMaxBackoffSeconds" in data:
+        cfg.pod_max_backoff_seconds = float(data["podMaxBackoffSeconds"])
+    le = data.get("leaderElection") or {}
+    if le.get("leaderElect"):
+        cfg.leader_election = LeaderElectionConfig(
+            lease_duration=float(le.get("leaseDuration", 15.0)),
+            renew_deadline=float(le.get("renewDeadline", 10.0)),
+            retry_period=float(le.get("retryPeriod", 2.0)),
+        )
+    profiles = []
+    if api_version.endswith("v1alpha2") and data.get("profiles"):
+        for p in data["profiles"]:
+            profiles.append(
+                ProfileConfig(
+                    scheduler_name=p.get("schedulerName", "default-scheduler"),
+                    plugin_set=_plugins_overlay(p.get("plugins")),
+                )
+            )
+    elif data.get("schedulerName"):  # v1alpha1 single-profile field
+        profiles.append(ProfileConfig(scheduler_name=data["schedulerName"]))
+    if profiles:
+        cfg.profiles = profiles
+    for e in data.get("extenders", []) or []:
+        cfg.extenders.append(_extender_from_dict(e))
+    cfg.validate()
+    return cfg
+
+
+def _plugins_overlay(plugins: Optional[dict]) -> Optional[PluginSet]:
+    """v1alpha2 per-extension-point enabled/disabled overlay on defaults."""
+    if not plugins:
+        return None
+    ps = default_plugin_set()
+    point_attr = {
+        "queueSort": "queue_sort",
+        "preFilter": "pre_filter",
+        "filter": "filter",
+        "preScore": "pre_score",
+        "score": "score",
+        "reserve": "reserve",
+        "permit": "permit",
+        "preBind": "pre_bind",
+        "bind": "bind",
+        "postBind": "post_bind",
+        "unreserve": "unreserve",
+    }
+    for point, attr in point_attr.items():
+        overlay = plugins.get(point)
+        if not overlay:
+            continue
+        current = getattr(ps, attr)
+        disabled = {d.get("name") for d in overlay.get("disabled", [])}
+        if "*" in disabled:
+            current = []
+        elif attr == "score":
+            current = [(n, w) for n, w in current if n not in disabled]
+        else:
+            current = [n for n in current if n not in disabled]
+        for en in overlay.get("enabled", []):
+            name = en["name"]
+            if attr == "score":
+                current.append((name, float(en.get("weight", 1))))
+            elif name not in current:
+                current.append(name)
+        setattr(ps, attr, current)
+    return ps
+
+
+def _extender_from_dict(e: dict) -> ExtenderConfig:
+    return ExtenderConfig(
+        url_prefix=e.get("urlPrefix", ""),
+        filter_verb=e.get("filterVerb", ""),
+        prioritize_verb=e.get("prioritizeVerb", ""),
+        bind_verb=e.get("bindVerb", ""),
+        preempt_verb=e.get("preemptVerb", ""),
+        weight=float(e.get("weight", 1)),
+        http_timeout=float(e.get("httpTimeout", 30)),
+        node_cache_capable=bool(e.get("nodeCacheCapable", False)),
+        managed_resources=[
+            ExtenderManagedResource(
+                name=m.get("name", ""),
+                ignored_by_scheduler=bool(m.get("ignoredByScheduler", False)),
+            )
+            for m in e.get("managedResources", []) or []
+        ],
+        ignorable=bool(e.get("ignorable", False)),
+    )
+
+
+def policy_to_config(policy: dict) -> KubeSchedulerConfiguration:
+    """Legacy Policy JSON → internal config (createFromConfig,
+    factory.go:239 + legacy_registry.go name mapping)."""
+    cfg = KubeSchedulerConfiguration()
+    cfg.profiles = [
+        ProfileConfig(plugin_set=policy_to_plugin_set(policy))
+    ]
+    for e in policy.get("extenders", []) or []:
+        cfg.extenders.append(_extender_from_dict(e))
+    if "hardPodAffinitySymmetricWeight" in policy:
+        cfg.hard_pod_affinity_weight = float(
+            policy["hardPodAffinitySymmetricWeight"]
+        )
+    cfg.validate()
+    return cfg
+
+
+def policy_to_plugin_set(policy: dict) -> PluginSet:
+    predicates = policy.get("predicates")
+    priorities = policy.get("priorities")
+    ps = default_plugin_set()
+    if predicates is not None:
+        filters: List[str] = []
+        for pred in predicates:
+            name = pred.get("name", "")
+            if name == "GeneralPredicates":
+                for plug in GENERAL_PREDICATES:
+                    if plug not in filters:
+                        filters.append(plug)
+                continue
+            plug = PREDICATE_TO_PLUGIN.get(name)
+            if plug is None:
+                raise ConfigError(f"unknown Policy predicate {name!r}")
+            if plug not in filters:
+                filters.append(plug)
+        ps.filter = filters
+        ps.pre_filter = [p for p in filters if p in _NEEDS_PRE_FILTER]
+    if priorities is not None:
+        scores: List[Tuple[str, float]] = []
+        for pri in priorities:
+            name = pri.get("name", "")
+            plug = PRIORITY_TO_PLUGIN.get(name)
+            if plug is None:
+                raise ConfigError(f"unknown Policy priority {name!r}")
+            scores.append((plug, float(pri.get("weight", 1))))
+        ps.score = scores
+        ps.pre_score = [p for p, _ in scores if p in _NEEDS_PRE_SCORE]
+    return ps

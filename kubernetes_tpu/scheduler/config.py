@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..client.leaderelection import LeaderElectionConfig
 from ..ops.encoding import EncodingConfig
+from .extender import ExtenderConfig
 
 
 @dataclass
@@ -33,6 +34,7 @@ class KubeSchedulerConfiguration:
     profiles: List[ProfileConfig] = field(
         default_factory=lambda: [ProfileConfig()]
     )
+    extenders: List["ExtenderConfig"] = field(default_factory=list)
     hard_pod_affinity_weight: float = 1.0
     # --- TPU-native section -------------------------------------------------
     use_device: bool = True  # TPUBatchScore profile gate

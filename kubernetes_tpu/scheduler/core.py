@@ -71,11 +71,13 @@ class GenericScheduler:
         framework: Framework,
         percentage_of_nodes_to_score: int = 0,
         rng: Optional[random.Random] = None,
+        extenders: Optional[list] = None,
     ):
         self.framework = framework
         self.percentage = percentage_of_nodes_to_score
         self._next_start_index = 0  # round-robin start (generic_scheduler.go:429)
         self._rng = rng or random.Random(0)
+        self.extenders = extenders or []
 
     # -- public -------------------------------------------------------------
 
@@ -94,6 +96,7 @@ class GenericScheduler:
         feasible, statuses, evaluated = self.find_nodes_that_fit(
             pod, snapshot, state, nominated_pods_for_node
         )
+        feasible = self._find_nodes_that_pass_extenders(pod, feasible, statuses)
         if not feasible:
             raise FitError(
                 pod=pod,
@@ -105,8 +108,47 @@ class GenericScheduler:
         self.framework.run_pre_score_plugins(state, pod, feasible)
         names = [ni.name for ni in feasible]
         totals = self.framework.run_score_plugins(state, pod, names, snapshot)
+        for ext in self.extenders:
+            if not ext.cfg.prioritize_verb or not ext.is_interested(pod):
+                continue
+            try:
+                for node, score in ext.prioritize(pod, names).items():
+                    if node in totals:
+                        # extender scores are 0..10 (MaxExtenderPriority);
+                        # rescale to the 0..100 in-tree plugin range
+                        # (prioritizeNodes, generic_scheduler.go:694)
+                        totals[node] += score * (100.0 / 10.0)
+            except Exception:
+                # prioritize failures never fail the pod (the reference only
+                # logs them, generic_scheduler.go:676)
+                continue
         host = self.select_host(totals)
         return ScheduleResult(host, evaluated, len(feasible))
+
+    def _find_nodes_that_pass_extenders(
+        self, pod: v1.Pod, feasible: List[NodeInfo], statuses: Dict[str, Status]
+    ) -> List[NodeInfo]:
+        """findNodesThatPassExtenders (generic_scheduler.go:502)."""
+        for ext in self.extenders:
+            if not feasible:
+                break
+            if not ext.cfg.filter_verb or not ext.is_interested(pod):
+                continue
+            names = [ni.name for ni in feasible]
+            try:
+                passed, failed = ext.filter(pod, names)
+            except Exception:
+                if ext.is_ignorable():
+                    continue
+                # transport failure of a required extender is a cycle ERROR
+                # (retry with backoff), NOT unschedulable — a FitError here
+                # would wrongly trigger preemption against healthy nodes
+                raise
+            for node, reason in failed.items():
+                statuses[node] = Status.unschedulable(f"extender: {reason}")
+            keep = set(passed)
+            feasible = [ni for ni in feasible if ni.name in keep]
+        return feasible
 
     def find_nodes_that_fit(
         self,

@@ -1,0 +1,209 @@
+"""HTTP scheduler extenders: out-of-process filter/prioritize/bind/preempt.
+
+Reference: pkg/scheduler/core/extender.go (HTTPExtender:91, Filter:334,
+Prioritize, Bind:404, ProcessPreemption:214) and the v1 extender API types
+(pkg/scheduler/apis/extender/v1). JSON over HTTP POST, one verb per
+capability; an extender advertises interest via managed resources and can be
+`ignorable` (failures don't fail the pod).
+
+Extender-interested pods run the host scheduling path: the device lattice
+narrows nothing for an out-of-process veto, mirroring how the reference
+serializes extender calls after its in-process filters
+(generic_scheduler.go:421,502).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import objects as v1
+
+
+@dataclass
+class ExtenderManagedResource:
+    name: str = ""
+    ignored_by_scheduler: bool = False
+
+
+@dataclass
+class ExtenderConfig:
+    """KubeSchedulerConfiguration.extenders entry (apis/config/types.go
+    Extender / legacy Policy ExtenderConfig)."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    preempt_verb: str = ""
+    weight: float = 1.0
+    http_timeout: float = 30.0
+    node_cache_capable: bool = False
+    managed_resources: List[ExtenderManagedResource] = field(default_factory=list)
+    ignorable: bool = False
+
+
+class ExtenderError(Exception):
+    pass
+
+
+def _pod_dict(pod: v1.Pod) -> dict:
+    return {
+        "metadata": {
+            "name": pod.metadata.name,
+            "namespace": pod.metadata.namespace,
+            "uid": pod.metadata.uid,
+            "labels": dict(pod.metadata.labels),
+        },
+        "spec": {
+            "nodeName": pod.spec.node_name,
+            "schedulerName": pod.spec.scheduler_name,
+            "containers": [
+                {"name": c.name, "resources": {"requests": dict(c.requests)}}
+                for c in pod.spec.containers
+            ],
+        },
+    }
+
+
+class HTTPExtender:
+    """One configured extender endpoint (extender.go:91 NewHTTPExtender)."""
+
+    def __init__(self, cfg: ExtenderConfig):
+        self.cfg = cfg
+
+    # -- capability probes ---------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.cfg.url_prefix
+
+    def is_ignorable(self) -> bool:
+        return self.cfg.ignorable
+
+    def is_binder(self) -> bool:
+        return bool(self.cfg.bind_verb)
+
+    def supports_preemption(self) -> bool:
+        return bool(self.cfg.preempt_verb)
+
+    def is_interested(self, pod: v1.Pod) -> bool:
+        """IsInterested (extender.go:441): no managed resources => all pods;
+        otherwise pods requesting one of them."""
+        if not self.cfg.managed_resources:
+            return True
+        managed = {m.name for m in self.cfg.managed_resources}
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            if any(r in managed for r in c.requests):
+                return True
+        return False
+
+    # -- transport -----------------------------------------------------------
+
+    def _post(self, verb: str, payload: dict) -> dict:
+        url = f"{self.cfg.url_prefix.rstrip('/')}/{verb}"
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=self.cfg.http_timeout) as resp:
+            return json.loads(resp.read().decode() or "{}")
+
+    # -- verbs ---------------------------------------------------------------
+
+    def _node_args(self, node_names: Sequence[str]) -> dict:
+        """nodeCacheCapable extenders receive names only; others get node
+        objects (ExtenderArgs.Nodes vs NodeNames, extender.go:334)."""
+        if self.cfg.node_cache_capable:
+            return {"nodenames": list(node_names)}
+        return {
+            "nodes": {
+                "items": [{"metadata": {"name": n}} for n in node_names]
+            }
+        }
+
+    def filter(
+        self, pod: v1.Pod, node_names: Sequence[str]
+    ) -> Tuple[List[str], Dict[str, str]]:
+        """(feasible node names, failed node -> reason). Raises on transport
+        error (caller applies `ignorable`)."""
+        if not self.cfg.filter_verb:
+            return list(node_names), {}
+        payload = {"pod": _pod_dict(pod)}
+        payload.update(self._node_args(node_names))
+        result = self._post(self.cfg.filter_verb, payload)
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        feasible = result.get("nodenames")
+        if feasible is None and result.get("nodes") is not None:
+            feasible = [
+                item["metadata"]["name"]
+                for item in result["nodes"].get("items", [])
+            ]
+        if feasible is None:
+            feasible = list(node_names)
+        failed = result.get("failedNodes") or {}
+        return list(feasible), dict(failed)
+
+    def prioritize(
+        self, pod: v1.Pod, node_names: Sequence[str]
+    ) -> Dict[str, float]:
+        """node -> weighted score (Prioritize + weight, extender.go:372)."""
+        if not self.cfg.prioritize_verb:
+            return {}
+        payload = {"pod": _pod_dict(pod)}
+        payload.update(self._node_args(node_names))
+        result = self._post(self.cfg.prioritize_verb, payload)
+        out: Dict[str, float] = {}
+        for entry in result or []:
+            out[entry["host"]] = entry["score"] * self.cfg.weight
+        return out
+
+    def bind(self, pod: v1.Pod, node_name: str) -> None:
+        result = self._post(
+            self.cfg.bind_verb,
+            {
+                "podName": pod.metadata.name,
+                "podNamespace": pod.metadata.namespace,
+                "podUID": pod.metadata.uid,
+                "node": node_name,
+            },
+        )
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+
+    def process_preemption(
+        self,
+        pod: v1.Pod,
+        victims_by_node: Dict[str, List[v1.Pod]],
+    ) -> Dict[str, List[str]]:
+        """node -> victim pod names the extender accepts
+        (ProcessPreemption, extender.go:214)."""
+        if not self.cfg.preempt_verb:
+            return {
+                node: [p.metadata.name for p in victims]
+                for node, victims in victims_by_node.items()
+            }
+        result = self._post(
+            self.cfg.preempt_verb,
+            {
+                "pod": _pod_dict(pod),
+                "nodeNameToVictims": {
+                    node: {"pods": [_pod_dict(p) for p in victims]}
+                    for node, victims in victims_by_node.items()
+                },
+            },
+        )
+        out: Dict[str, List[str]] = {}
+        for node, victims in (result.get("nodeNameToVictims") or {}).items():
+            out[node] = [
+                p["metadata"]["name"] for p in victims.get("pods", [])
+            ]
+        return out
+
+
+def build_extenders(configs: Sequence[ExtenderConfig]) -> List[HTTPExtender]:
+    return [HTTPExtender(c) for c in configs]

@@ -24,12 +24,22 @@ _FIT_STATE_KEY = "PreFilterNodeResourcesFit"
 
 class NodeResourcesFit(PreFilterPlugin, FilterPlugin):
     """fit.go:119 (PreFilter computes pod request once), fit.go:177-250
-    (Filter: insufficient if podRequest > allocatable - requested)."""
+    (Filter: insufficient if podRequest > allocatable - requested).
+
+    `ignored_resources` carries extender managedResources flagged
+    ignoredByScheduler (fit.go IgnoredResources): the extender owns
+    accounting for those, so the in-tree fit check must skip them."""
 
     name = "NodeResourcesFit"
 
+    def __init__(self, ignored_resources=None):
+        self.ignored = frozenset(ignored_resources or ())
+
     def pre_filter(self, state: CycleState, pod) -> Optional[Status]:
-        state.write(_FIT_STATE_KEY, compute_pod_resource_request(pod))
+        req = compute_pod_resource_request(pod)
+        for name in self.ignored:
+            req.pop(name, None)
+        state.write(_FIT_STATE_KEY, req)
         return None
 
     def has_extensions(self) -> bool:
@@ -46,6 +56,8 @@ class NodeResourcesFit(PreFilterPlugin, FilterPlugin):
             req: ResourceList = state.read(_FIT_STATE_KEY)
         except KeyError:
             req = compute_pod_resource_request(pod)
+            for name in self.ignored:
+                req.pop(name, None)
         alloc = node_info.allocatable
         used = node_info.requested
         # pods-count check (fit.go:205)

@@ -89,7 +89,9 @@ class Registry(dict):
 
 def default_registry() -> Registry:
     r = Registry()
-    r["NodeResourcesFit"] = lambda ctx: p.NodeResourcesFit()
+    r["NodeResourcesFit"] = lambda ctx: p.NodeResourcesFit(
+        ctx.get("ignored_extended_resources")
+    )
     r["NodeResourcesLeastAllocated"] = lambda ctx: p.NodeResourcesLeastAllocated()
     r["NodeResourcesMostAllocated"] = lambda ctx: p.NodeResourcesMostAllocated()
     r["NodeResourcesBalancedAllocation"] = lambda ctx: p.NodeResourcesBalancedAllocation()

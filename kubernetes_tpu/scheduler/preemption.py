@@ -23,9 +23,15 @@ from .framework.runtime import Framework
 
 
 class Preemptor:
-    def __init__(self, framework: Framework, pdb_lister: Optional[Callable] = None):
+    def __init__(
+        self,
+        framework: Framework,
+        pdb_lister: Optional[Callable] = None,
+        extenders: Optional[list] = None,
+    ):
         self.framework = framework
         self._pdbs = pdb_lister
+        self.extenders = extenders or []
 
     def preempt(
         self,
@@ -49,8 +55,39 @@ class Preemptor:
                 victims_by_node[name] = victims
         if not victims_by_node:
             return "", []
+        victims_by_node = self._process_preemption_with_extenders(
+            pod, victims_by_node
+        )
+        if not victims_by_node:
+            return "", []
         node = pick_one_node_for_preemption(victims_by_node, snapshot)
         return node, victims_by_node.get(node, [])
+
+    def _process_preemption_with_extenders(
+        self, pod: v1.Pod, victims_by_node: Dict[str, List[v1.Pod]]
+    ) -> Dict[str, List[v1.Pod]]:
+        """processPreemptionWithExtenders (generic_scheduler.go:316): each
+        preemption-capable interested extender narrows the candidate map."""
+        for ext in self.extenders:
+            if not victims_by_node:
+                break
+            if not ext.supports_preemption() or not ext.is_interested(pod):
+                continue
+            try:
+                accepted = ext.process_preemption(pod, victims_by_node)
+            except Exception:
+                if ext.is_ignorable():
+                    continue
+                return {}
+            new_map: Dict[str, List[v1.Pod]] = {}
+            for node, names in accepted.items():
+                old = victims_by_node.get(node)
+                if old is None:
+                    continue
+                keep = set(names)
+                new_map[node] = [p for p in old if p.metadata.name in keep]
+            victims_by_node = new_map
+        return victims_by_node
 
     def _nodes_where_preemption_might_help(
         self, fit_error: Optional[FitError], snapshot: Snapshot

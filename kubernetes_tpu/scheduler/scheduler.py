@@ -60,6 +60,7 @@ from ..utils.trace import Trace
 from .cache.cache import SchedulerCache
 from .config import KubeSchedulerConfiguration
 from .core import FitError, GenericScheduler
+from .extender import build_extenders
 from .framework.interface import Code, CycleState, is_success
 from .preemption import Preemptor
 from .profile import ProfileMap, new_profile_map
@@ -110,17 +111,29 @@ class Scheduler:
             "csinode_getter": self._csinode,
             "services_lister": lambda: server.list("services")[0],
             "selectors_for_pod": self._selectors_for_pod,
+            # extender managedResources flagged ignoredByScheduler: the
+            # extender owns their accounting (fit.go IgnoredResources)
+            "ignored_extended_resources": frozenset(
+                m.name
+                for e in self.cfg.extenders
+                for m in e.managed_resources
+                if m.ignored_by_scheduler
+            ),
         }
         self.profiles: ProfileMap = new_profile_map(self.cfg, context, server=server)
         self.informer_factory = SharedInformerFactory(server)
+        self.extenders = build_extenders(self.cfg.extenders)
         self._algo: Dict[str, GenericScheduler] = {
             name: GenericScheduler(
-                p.framework, self.cfg.percentage_of_nodes_to_score
+                p.framework,
+                self.cfg.percentage_of_nodes_to_score,
+                extenders=self.extenders,
             )
             for name, p in self.profiles.items()
         }
         self._preemptors = {
-            name: Preemptor(p.framework) for name, p in self.profiles.items()
+            name: Preemptor(p.framework, extenders=self.extenders)
+            for name, p in self.profiles.items()
         }
         self._bind_pool = ThreadPoolExecutor(
             max_workers=self.cfg.bind_workers, thread_name_prefix="binder"
@@ -225,13 +238,22 @@ class Scheduler:
         t_start = time.monotonic()
         moves0 = self.queue.moves
         known: List[QueuedPodInfo] = []
+        extender_pis: List[QueuedPodInfo] = []
         for pi in pis:
             if self.profiles.for_pod(pi.pod) is None:
                 logger.error(
                     "no profile for scheduler name %s", pi.pod.spec.scheduler_name
                 )
                 continue
+            # extender-interested pods need the host path: an out-of-process
+            # veto can't be folded into the device mask
+            if any(e.is_interested(pi.pod) for e in self.extenders):
+                extender_pis.append(pi)
+                continue
             known.append(pi)
+        for pi in extender_pis:
+            # _schedule_one_host re-snapshots per pod
+            self._schedule_one_host(pi, moves0)
         if not known:
             return
         if self.cfg.use_device and self.cfg.use_wave:
@@ -532,6 +554,12 @@ class Scheduler:
             metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
             self._handle_failure(pi, moves0, message=str(fe), fit_error=fe)
             return
+        except Exception as e:
+            # cycle error (e.g. required extender unreachable): backoff and
+            # retry without attempting preemption
+            metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
+            self._handle_failure(pi, moves0, message=str(e), error=True)
+            return
         metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
         self._assume_and_bind(pi, result.suggested_host, t0)
 
@@ -613,9 +641,22 @@ class Scheduler:
             st = fw.run_pre_bind_plugins(state, pod, node_name)
             if not is_success(st):
                 raise RuntimeError(f"prebind: {st.message}")
-            st = fw.run_bind_plugins(state, pod, node_name)
-            if not is_success(st):
-                raise RuntimeError(f"bind: {st.message}")
+            # extendersBinding (scheduler.go:496,517): first interested
+            # binder extender wins; else in-tree bind plugins
+            ext_binder = next(
+                (
+                    e
+                    for e in self.extenders
+                    if e.is_binder() and e.is_interested(pod)
+                ),
+                None,
+            )
+            if ext_binder is not None:
+                ext_binder.bind(pod, node_name)
+            else:
+                st = fw.run_bind_plugins(state, pod, node_name)
+                if not is_success(st):
+                    raise RuntimeError(f"bind: {st.message}")
             self.cache.finish_binding(pod)
             fw.run_post_bind_plugins(state, pod, node_name)
             metrics.observe("binding_duration_seconds", time.monotonic() - b0)

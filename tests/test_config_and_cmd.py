@@ -1,0 +1,225 @@
+"""ComponentConfig versions, legacy Policy translation, cache debugger,
+process entry with healthz/metrics endpoints."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.cmd.scheduler import run as run_scheduler
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.scheduler.apis_config import (
+    ConfigError,
+    config_from_dict,
+    policy_to_plugin_set,
+)
+from kubernetes_tpu.scheduler.cache.debugger import CacheDebugger
+
+
+def make_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        status=NodeStatus(allocatable={"cpu": "4", "memory": "32Gi", "pods": 110}),
+    )
+
+
+def make_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+    )
+
+
+# -- config versions ---------------------------------------------------------
+
+
+def test_v1alpha2_profiles_and_plugin_overlay():
+    cfg = config_from_dict(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha2",
+            "kind": "KubeSchedulerConfiguration",
+            "percentageOfNodesToScore": 30,
+            "profiles": [
+                {
+                    "schedulerName": "tpu-scheduler",
+                    "plugins": {
+                        "filter": {"disabled": [{"name": "NodeAffinity"}]},
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [
+                                {"name": "NodeResourcesMostAllocated", "weight": 5}
+                            ],
+                        },
+                    },
+                }
+            ],
+        }
+    )
+    assert cfg.percentage_of_nodes_to_score == 30
+    ps = cfg.profiles[0].plugin_set
+    assert "NodeAffinity" not in ps.filter
+    assert ps.score == [("NodeResourcesMostAllocated", 5.0)]
+    assert cfg.profiles[0].scheduler_name == "tpu-scheduler"
+
+
+def test_v1alpha1_and_unsupported_version():
+    cfg = config_from_dict(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+            "schedulerName": "legacy",
+        }
+    )
+    assert cfg.profiles[0].scheduler_name == "legacy"
+    with pytest.raises(ConfigError):
+        config_from_dict({"apiVersion": "kubescheduler.config.k8s.io/v9"})
+
+
+def test_leader_election_from_config():
+    cfg = config_from_dict(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha2",
+            "leaderElection": {"leaderElect": True, "leaseDuration": 30},
+        }
+    )
+    assert cfg.leader_election is not None
+    assert cfg.leader_election.lease_duration == 30
+
+
+# -- legacy Policy -----------------------------------------------------------
+
+
+def test_policy_predicates_and_priorities_translate():
+    ps = policy_to_plugin_set(
+        {
+            "kind": "Policy",
+            "predicates": [
+                {"name": "PodFitsResources"},
+                {"name": "PodToleratesNodeTaints"},
+                {"name": "MatchInterPodAffinity"},
+            ],
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 2},
+                {"name": "EvenPodsSpreadPriority", "weight": 1},
+            ],
+        }
+    )
+    assert ps.filter == [
+        "NodeResourcesFit",
+        "TaintToleration",
+        "InterPodAffinity",
+    ]
+    assert ("NodeResourcesLeastAllocated", 2.0) in ps.score
+    assert ("PodTopologySpread", 1.0) in ps.score
+    assert "InterPodAffinity" in ps.pre_filter
+
+
+def test_policy_general_predicates_and_unknown():
+    ps = policy_to_plugin_set(
+        {"predicates": [{"name": "GeneralPredicates"}], "priorities": []}
+    )
+    assert ps.filter == ["NodeResourcesFit", "NodeName", "NodePorts", "NodeAffinity"]
+    with pytest.raises(ConfigError):
+        policy_to_plugin_set({"predicates": [{"name": "Bogus"}]})
+
+
+def test_policy_config_schedules_end_to_end():
+    cfg = config_from_dict(
+        {
+            "kind": "Policy",
+            "predicates": [{"name": "GeneralPredicates"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }
+    )
+    server = APIServer()
+    sched = Scheduler(server, cfg)
+    server.create("nodes", make_node("n0"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if server.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n0"
+    finally:
+        sched.stop()
+
+
+# -- cache debugger ----------------------------------------------------------
+
+
+def test_cache_debugger_compare_and_dump():
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    server.create("nodes", make_node("n0"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if server.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.02)
+        time.sleep(0.3)  # let informer deliver the bound pod back to cache
+        dbg = CacheDebugger(sched)
+        nodes, pods = dbg.compare()
+        assert nodes == [] and pods == []
+        out = dbg.dump()
+        assert "node n0: 1 pods" in out
+    finally:
+        sched.stop()
+
+
+# -- process entry -----------------------------------------------------------
+
+
+def test_cmd_run_serves_healthz_and_metrics():
+    server = APIServer()
+    server.create("nodes", make_node("n0"))
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    sched = run_scheduler(server=server, healthz_port=port, block=False)
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if server.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.02)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read()
+        assert body == b"ok"
+        m = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read()
+        )
+        assert any("schedule_attempts_total" in k for k in m)
+        # debug reset handler (DELETE /metrics)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics", method="DELETE"
+        )
+        urllib.request.urlopen(req, timeout=5)
+        m2 = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read()
+        )
+        assert not any("schedule_attempts_total" in k for k in m2)
+    finally:
+        sched.stop()

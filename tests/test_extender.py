@@ -1,0 +1,288 @@
+"""HTTP extender integration: real scheduler + an in-process extender server
+(reference test/integration/scheduler/extender_test.go topology)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.scheduler.extender import (
+    ExtenderConfig,
+    ExtenderManagedResource,
+    HTTPExtender,
+)
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    server_version = "TestExtender/1.0"
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        verb = self.path.strip("/").split("/")[-1]
+        handler = getattr(self.server, f"handle_{verb}", None)
+        result = handler(body) if handler else {}
+        payload = json.dumps(result).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+@pytest.fixture
+def extender_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def make_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        status=NodeStatus(allocatable={"cpu": "4", "memory": "32Gi", "pods": 110}),
+    )
+
+
+def make_pod(name, requests=None):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            containers=[Container(requests=requests or {"cpu": "100m"})]
+        ),
+    )
+
+
+def test_extender_filter_excludes_nodes(extender_server):
+    extender_server.handle_filter = lambda body: {
+        "nodenames": [n for n in body["nodenames"] if n == "n1"],
+        "failedNodes": {n: "not n1" for n in body["nodenames"] if n != "n1"},
+    }
+    url = f"http://127.0.0.1:{extender_server.server_address[1]}"
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        extenders=[ExtenderConfig(url_prefix=url, filter_verb="filter", node_cache_capable=True)]
+    )
+    sched = Scheduler(server, cfg)
+    for i in range(4):
+        server.create("nodes", make_node(f"n{i}"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            pod = server.get("pods", "default", "p")
+            if pod.spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n1"
+    finally:
+        sched.stop()
+
+
+def test_extender_prioritize_steers_choice(extender_server):
+    extender_server.handle_prioritize = lambda body: [
+        {"host": n, "score": 100 if n == "n2" else 0}
+        for n in body["nodenames"]
+    ]
+    url = f"http://127.0.0.1:{extender_server.server_address[1]}"
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        extenders=[
+            ExtenderConfig(
+                url_prefix=url,
+                prioritize_verb="prioritize",
+                weight=10.0,
+                node_cache_capable=True,
+            )
+        ]
+    )
+    sched = Scheduler(server, cfg)
+    for i in range(4):
+        server.create("nodes", make_node(f"n{i}"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            pod = server.get("pods", "default", "p")
+            if pod.spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n2"
+    finally:
+        sched.stop()
+
+
+def test_extender_bind_delegates(extender_server):
+    bound = {}
+
+    def handle_bind(body):
+        bound[body["podName"]] = body["node"]
+        # the external binder writes the binding itself
+        srv = extender_server.api_server
+        from kubernetes_tpu.api.objects import Binding
+
+        srv.bind_pods(
+            [
+                Binding(
+                    pod_name=body["podName"],
+                    pod_namespace=body["podNamespace"],
+                    pod_uid=body["podUID"],
+                    target_node=body["node"],
+                )
+            ]
+        )
+        return {}
+
+    extender_server.handle_bind = handle_bind
+    url = f"http://127.0.0.1:{extender_server.server_address[1]}"
+    server = APIServer()
+    extender_server.api_server = server
+    cfg = KubeSchedulerConfiguration(
+        extenders=[ExtenderConfig(url_prefix=url, bind_verb="bind")]
+    )
+    sched = Scheduler(server, cfg)
+    server.create("nodes", make_node("n0"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if bound.get("p"):
+                break
+            time.sleep(0.02)
+        assert bound.get("p") == "n0"
+    finally:
+        sched.stop()
+
+
+def test_extender_ignorable_failure_does_not_block():
+    # extender at a dead endpoint, ignorable=True: pods still schedule
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        extenders=[
+            ExtenderConfig(
+                url_prefix="http://127.0.0.1:1",  # nothing listens
+                filter_verb="filter",
+                http_timeout=0.2,
+                ignorable=True,
+            )
+        ]
+    )
+    sched = Scheduler(server, cfg)
+    server.create("nodes", make_node("n0"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            pod = server.get("pods", "default", "p")
+            if pod.spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n0"
+    finally:
+        sched.stop()
+
+
+def test_non_cache_capable_gets_node_objects(extender_server):
+    seen = {}
+
+    def handle_filter(body):
+        seen["payload"] = body
+        names = [n["metadata"]["name"] for n in body["nodes"]["items"]]
+        return {
+            "nodes": {
+                "items": [{"metadata": {"name": n}} for n in names if n == "n0"]
+            }
+        }
+
+    extender_server.handle_filter = handle_filter
+    url = f"http://127.0.0.1:{extender_server.server_address[1]}"
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        extenders=[ExtenderConfig(url_prefix=url, filter_verb="filter")]
+    )
+    sched = Scheduler(server, cfg)
+    for i in range(2):
+        server.create("nodes", make_node(f"n{i}"))
+    sched.start()
+    try:
+        server.create("pods", make_pod("p"))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if server.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n0"
+        assert "nodes" in seen["payload"] and "nodenames" not in seen["payload"]
+    finally:
+        sched.stop()
+
+
+def test_ignored_extended_resource_skips_fit_check(extender_server):
+    # pod requests an extender-managed ignoredByScheduler resource no node
+    # advertises; fit must skip it and the extender filter decides
+    extender_server.handle_filter = lambda body: {"nodenames": ["n0"]}
+    url = f"http://127.0.0.1:{extender_server.server_address[1]}"
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        extenders=[
+            ExtenderConfig(
+                url_prefix=url,
+                filter_verb="filter",
+                node_cache_capable=True,
+                managed_resources=[
+                    ExtenderManagedResource(
+                        name="example.com/gpu", ignored_by_scheduler=True
+                    )
+                ],
+            )
+        ]
+    )
+    sched = Scheduler(server, cfg)
+    server.create("nodes", make_node("n0"))
+    sched.start()
+    try:
+        server.create(
+            "pods", make_pod("p", requests={"cpu": "100m", "example.com/gpu": "1"})
+        )
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if server.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert server.get("pods", "default", "p").spec.node_name == "n0"
+    finally:
+        sched.stop()
+
+
+def test_is_interested_managed_resources():
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://x",
+            managed_resources=[ExtenderManagedResource(name="example.com/gpu")],
+        )
+    )
+    assert not ext.is_interested(make_pod("p"))
+    assert ext.is_interested(
+        make_pod("q", requests={"cpu": "1", "example.com/gpu": "1"})
+    )
