@@ -466,12 +466,15 @@ class Node:
 
 @dataclass
 class Binding:
-    """pods/{name}/binding subresource payload (DefaultBinder.Bind)."""
+    """pods/{name}/binding subresource payload (DefaultBinder.Bind).
 
-    pod_name: str
-    pod_namespace: str
-    pod_uid: str
-    target_node: str
+    Fields default empty so partial wire payloads decode; an empty pod_uid
+    skips the uid check on bind."""
+
+    pod_name: str = ""
+    pod_namespace: str = ""
+    pod_uid: str = ""
+    target_node: str = ""
     kind: str = "Binding"
 
 
@@ -582,6 +585,47 @@ class CSINode:
 # ---------------------------------------------------------------------------
 # Services & workload controllers (subset for SelectorSpread/ServiceAffinity)
 # ---------------------------------------------------------------------------
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    phase: str = "Active"  # Active | Terminating
+    kind: str = "Namespace"
+
+    def deep_copy(self) -> "Namespace":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)  # matchLabels
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+    kind: str = "ReplicaSet"
+
+    def deep_copy(self) -> "ReplicaSet":
+        return copy.deepcopy(self)
 
 
 @dataclass

@@ -1,0 +1,179 @@
+"""Reflective JSON codec for the API object model.
+
+The apimachinery serializer role (reference
+staging/src/k8s.io/apimachinery/pkg/runtime/serializer/json): dataclasses
+⇄ Kubernetes-style camelCase JSON. Field names convert snake_case →
+lowerCamelCase; nested dataclasses, tuples, lists, dicts and Optionals
+recurse; zero/empty values are omitted on output (omitempty).
+
+The kind registry maps REST resource names ("pods") and JSON `kind`
+strings ("Pod") to classes, standing in for runtime.Scheme's GVK mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type, get_args, get_origin, get_type_hints
+
+from . import objects as v1
+
+# resource name -> (kind string, class)
+RESOURCE_KINDS: Dict[str, Type] = {
+    "pods": v1.Pod,
+    "nodes": v1.Node,
+    "services": v1.Service,
+    "persistentvolumes": v1.PersistentVolume,
+    "persistentvolumeclaims": v1.PersistentVolumeClaim,
+    "storageclasses": v1.StorageClass,
+    "csinodes": v1.CSINode,
+    "bindings": v1.Binding,
+    "namespaces": v1.Namespace,
+    "replicasets": v1.ReplicaSet,
+}
+
+KIND_TO_RESOURCE = {
+    cls.__name__: res for res, cls in RESOURCE_KINDS.items()
+}
+
+
+def register_kind(resource: str, cls: Type) -> None:
+    RESOURCE_KINDS[resource] = cls
+    KIND_TO_RESOURCE[cls.__name__] = resource
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def to_dict(obj: Any) -> Any:
+    """Dataclass → JSON-ready dict (camelCase keys, omitempty)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            val = getattr(obj, f.name)
+            # omitempty: skip values equal to the field default (and empty
+            # containers from default factories)
+            if f.default is not dataclasses.MISSING and val == f.default:
+                continue
+            enc = to_dict(val)
+            if enc is None or enc == {} or enc == []:
+                continue
+            if enc == "" and (
+                f.default is dataclasses.MISSING or f.default == ""
+            ):
+                # an explicit empty string that differs from a non-empty
+                # default is meaningful (e.g. cluster-scoped namespace="")
+                continue
+            out[_camel(f.name)] = enc
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(x) for x in obj]
+    if isinstance(obj, frozenset):
+        return sorted(obj)
+    if isinstance(obj, dict):
+        return {k: to_dict(val) for k, val in obj.items()}
+    return obj
+
+
+def _resolve_optional(tp):
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Type, data: Any) -> Any:
+    """JSON dict → dataclass instance (inverse of to_dict)."""
+    if data is None:
+        return None
+    cls = _resolve_optional(cls)
+    if isinstance(cls, str):  # unresolved forward ref — shouldn't happen
+        raise TypeError(f"unresolved type {cls}")
+    origin = get_origin(cls)
+    if origin in (list, tuple):
+        (item_tp, *_rest) = get_args(cls) or (Any,)
+        seq = [from_dict(item_tp, x) for x in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        _k, val_tp = get_args(cls) or (str, Any)
+        return {k: from_dict(val_tp, val) for k, val in data.items()}
+    if origin is typing.Union:
+        resolved = _resolve_optional(cls)
+        if get_origin(resolved) is typing.Union:
+            # scalar union (e.g. Quantity = str|int|float): pass through
+            return data
+        return from_dict(resolved, data)
+    if dataclasses.is_dataclass(cls):
+        hints = get_type_hints(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            camel = _camel(f.name)
+            if camel in data:
+                raw = data[camel]
+            elif f.name in data:
+                raw = data[f.name]
+            else:
+                continue
+            kwargs[f.name] = from_dict(hints[f.name], raw)
+        return cls(**kwargs)
+    if cls in (Any, object):
+        return data
+    if cls is float and isinstance(data, int):
+        return float(data)
+    return data
+
+
+def decode(resource: str, data: dict) -> Any:
+    """JSON body → typed object for a REST resource."""
+    cls = RESOURCE_KINDS.get(resource)
+    if cls is None:
+        raise KeyError(f"unknown resource {resource!r}")
+    return from_dict(cls, data)
+
+
+def decode_any(data: dict) -> Any:
+    """JSON body with a `kind` field → (resource, typed object)."""
+    kind = data.get("kind", "")
+    resource = KIND_TO_RESOURCE.get(kind)
+    if resource is None:
+        raise KeyError(f"unknown kind {kind!r}")
+    return resource, from_dict(RESOURCE_KINDS[resource], data)
+
+
+def encode(obj: Any) -> dict:
+    d = to_dict(obj)
+    if isinstance(d, dict):
+        d.setdefault("kind", type(obj).__name__)
+        d.setdefault("apiVersion", "v1")
+    return d
+
+
+def _register_late() -> None:
+    # late imports: these kinds live in client/* which depends on the store
+    try:
+        from ..client.events import ClusterEvent
+        from ..client.leaderelection import Lease
+    except ImportError:
+        return
+    RESOURCE_KINDS["events"] = ClusterEvent
+    KIND_TO_RESOURCE["ClusterEvent"] = "events"
+    KIND_TO_RESOURCE["Event"] = "events"
+    RESOURCE_KINDS["leases"] = Lease
+    KIND_TO_RESOURCE["Lease"] = "leases"
+
+
+_register_late()

@@ -1,0 +1,161 @@
+"""REST client: the APIServer interface over HTTP.
+
+The typed-clientset role of client-go (staging/src/k8s.io/client-go
+kubernetes.Interface): every component that takes an `APIServer` (scheduler,
+informers, controllers, kubectl) can take a RESTClient instead and run
+against a remote API process. Watch uses the newline-delimited JSON stream
+and feeds a local Watcher, exactly how Reflector consumes watch responses
+(client-go/tools/cache/reflector.go:210).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..api import serialization as codec
+from ..client.apiserver import AlreadyExists, Conflict, NotFound
+from ..runtime.watch import Event, Watcher
+
+
+class RESTClient:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _url(self, resource: str, namespace: str, name: str = "") -> str:
+        # empty namespace = cluster-scoped path (the store keys by the
+        # object's own namespace either way)
+        if namespace:
+            path = f"/api/v1/namespaces/{namespace}/{resource}"
+        else:
+            path = f"/api/v1/{resource}"
+        if name:
+            path += f"/{name}"
+        return self.base + path
+
+    def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except Exception:
+                pass
+            msg = payload.get("message", str(e))
+            if e.code == 404:
+                raise NotFound(msg) from None
+            if e.code == 409:
+                reason = payload.get("reason", "")
+                if reason == "AlreadyExists":
+                    raise AlreadyExists(msg) from None
+                raise Conflict(msg) from None
+            raise
+
+    # -- the APIServer interface ---------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        out = self._request(
+            "POST",
+            self._url(kind, obj.metadata.namespace),
+            codec.encode(obj),
+        )
+        return codec.decode(kind, out)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        out = self._request("GET", self._url(kind, namespace, name))
+        return codec.decode(kind, out)
+
+    def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
+        out = self._request(
+            "PUT",
+            self._url(kind, obj.metadata.namespace, obj.metadata.name),
+            codec.encode(obj),
+        )
+        return codec.decode(kind, out)
+
+    def guaranteed_update(
+        self, kind: str, namespace: str, name: str, mutate: Callable[[Any], Any]
+    ) -> Any:
+        while True:
+            cur = self.get(kind, namespace, name)
+            new = mutate(cur)
+            if new is None:
+                return cur
+            try:
+                return self.update(kind, new)
+            except Conflict:
+                continue
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        return self._request("DELETE", self._url(kind, namespace, name))
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> Tuple[List[Any], int]:
+        url = self._url(kind, namespace or "")
+        out = self._request("GET", url)
+        rv = int(out.get("metadata", {}).get("resourceVersion", 0))
+        items = [codec.decode(kind, item) for item in out.get("items", [])]
+        if namespace is not None:
+            items = [o for o in items if o.metadata.namespace == namespace]
+        return items, rv
+
+    def watch(self, kind: str, from_version: int = 0) -> Watcher:
+        w = Watcher()
+        url = self._url(kind, "") + f"?watch=1&resourceVersion={from_version}"
+
+        def pump():
+            try:
+                req = urllib.request.Request(url)
+                with urllib.request.urlopen(req, timeout=None) as resp:
+                    for line in resp:
+                        if w.stopped:
+                            break
+                        line = line.strip()
+                        if not line:
+                            continue
+                        msg = json.loads(line)
+                        obj = codec.decode(kind, msg["object"])
+                        w.push(
+                            Event(
+                                msg["type"],
+                                obj,
+                                obj.metadata.resource_version,
+                            )
+                        )
+            except Exception:
+                pass
+            finally:
+                w.stop()
+
+        threading.Thread(target=pump, daemon=True).start()
+        return w
+
+    def bind_pods(self, bindings) -> list:
+        errors = []
+        for b in bindings:
+            try:
+                self._request(
+                    "POST",
+                    self.base
+                    + f"/api/v1/namespaces/{b.pod_namespace}/pods/"
+                    + f"{b.pod_name}/binding",
+                    codec.encode(b),
+                )
+                errors.append(None)
+            except Exception as e:
+                errors.append(str(e))
+        return errors

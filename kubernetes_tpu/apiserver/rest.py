@@ -1,0 +1,240 @@
+"""HTTP REST façade over the in-process versioned store.
+
+Paths follow the core-group conventions the reference serves
+(staging/src/k8s.io/apiserver; handler chain config.go:660 — here reduced
+to panic recovery + optional admit hooks):
+
+  GET    /healthz | /readyz | /livez
+  GET    /api/v1/{resource}                     (cluster list)
+  GET    /api/v1/{resource}?watch=1&resourceVersion=N   (watch stream)
+  GET    /api/v1/namespaces/{ns}/{resource}
+  GET    /api/v1/namespaces/{ns}/{resource}/{name}
+  POST   /api/v1/namespaces/{ns}/{resource} | /api/v1/{resource}
+  PUT    /api/v1/namespaces/{ns}/{resource}/{name}
+  DELETE /api/v1/namespaces/{ns}/{resource}/{name}
+  POST   /api/v1/namespaces/{ns}/pods/{name}/binding     (bind subresource)
+
+Watch responses stream newline-delimited JSON events
+({"type": "ADDED"|"MODIFIED"|"DELETED", "object": {...}}), the same wire
+shape client-go's Reflector consumes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api import serialization as codec
+from ..api.objects import Binding
+from ..client.apiserver import (
+    AlreadyExists,
+    APIServer,
+    Conflict,
+    NotFound,
+)
+
+_WATCH_POLL_S = 0.5
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kube-apiserver-tpu"
+
+    def log_message(self, *args):
+        pass
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def store(self) -> APIServer:
+        return self.server.store
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _status_error(self, code: int, reason: str, message: str) -> None:
+        self._json(
+            code,
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "reason": reason,
+                "message": message,
+                "code": code,
+            },
+        )
+
+    def _parse(self) -> Tuple[Optional[str], Optional[str], Optional[str], dict]:
+        """(resource, namespace, name, query) or (None, ...) on bad path."""
+        u = urlparse(self.path)
+        parts = [p for p in u.path.split("/") if p]
+        query = {k: v[-1] for k, v in parse_qs(u.query).items()}
+        if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+            return None, None, None, query
+        rest = parts[2:]
+        if not rest:
+            return None, None, None, query
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns = rest[1]
+            resource = rest[2]
+            name = rest[3] if len(rest) > 3 else None
+            sub = rest[4] if len(rest) > 4 else None
+            return resource, ns, name if not sub else f"{name}/{sub}", query
+        resource = rest[0]
+        name = rest[1] if len(rest) > 1 else None
+        return resource, None, name, query
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        if u.path in ("/healthz", "/readyz", "/livez"):
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        resource, ns, name, query = self._parse()
+        if resource is None:
+            return self._status_error(404, "NotFound", "unknown path")
+        try:
+            if name:
+                obj = self.store.get(resource, ns or "", name)
+                return self._json(200, codec.encode(obj))
+            if query.get("watch") in ("1", "true"):
+                return self._serve_watch(resource, ns, query)
+            objs, rv = self.store.list(resource, namespace=ns)
+            return self._json(
+                200,
+                {
+                    "kind": "List",
+                    "apiVersion": "v1",
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": [codec.encode(o) for o in objs],
+                },
+            )
+        except NotFound as e:
+            return self._status_error(404, "NotFound", str(e))
+        except KeyError as e:
+            return self._status_error(404, "NotFound", str(e))
+
+    def _serve_watch(self, resource: str, ns: Optional[str], query: dict):
+        from_rv = int(query.get("resourceVersion", 0) or 0)
+        watcher = self.store.watch(resource, from_version=from_rv)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.server.stopping.is_set():
+                ev = watcher.get(timeout=_WATCH_POLL_S)
+                if ev is None:
+                    if watcher.stopped:
+                        break
+                    continue
+                obj = ev.object
+                if ns is not None and obj.metadata.namespace != ns:
+                    continue
+                line = (
+                    json.dumps(
+                        {"type": ev.type, "object": codec.encode(obj)}
+                    ).encode()
+                    + b"\n"
+                )
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            watcher.stop()
+
+    def do_POST(self):
+        resource, ns, name, _q = self._parse()
+        if resource is None:
+            return self._status_error(404, "NotFound", "unknown path")
+        try:
+            body = self._read_body()
+            if resource == "pods" and name and name.endswith("/binding"):
+                b = codec.from_dict(Binding, body)
+                pod_name = name.rsplit("/", 1)[0]
+                b.pod_name = b.pod_name or pod_name
+                b.pod_namespace = b.pod_namespace or (ns or "default")
+                errs = self.store.bind_pods([b])
+                if errs and errs[0]:
+                    return self._status_error(409, "Conflict", errs[0])
+                return self._json(201, {"kind": "Status", "status": "Success"})
+            obj = codec.decode(resource, body)
+            if ns is not None:
+                obj.metadata.namespace = ns
+            created = self.store.create(resource, obj)
+            return self._json(201, codec.encode(created))
+        except AlreadyExists as e:
+            return self._status_error(409, "AlreadyExists", str(e))
+        except (KeyError, json.JSONDecodeError) as e:
+            return self._status_error(400, "BadRequest", str(e))
+
+    def do_PUT(self):
+        resource, ns, name, _q = self._parse()
+        if resource is None or not name:
+            return self._status_error(404, "NotFound", "unknown path")
+        try:
+            obj = codec.decode(resource, self._read_body())
+            if ns is not None:
+                obj.metadata.namespace = ns
+            updated = self.store.update(resource, obj)
+            return self._json(200, codec.encode(updated))
+        except NotFound as e:
+            return self._status_error(404, "NotFound", str(e))
+        except Conflict as e:
+            return self._status_error(409, "Conflict", str(e))
+        except (KeyError, json.JSONDecodeError) as e:
+            return self._status_error(400, "BadRequest", str(e))
+
+    def do_DELETE(self):
+        resource, ns, name, _q = self._parse()
+        if resource is None or not name:
+            return self._status_error(404, "NotFound", "unknown path")
+        try:
+            self.store.delete(resource, ns or "", name)
+            return self._json(200, {"kind": "Status", "status": "Success"})
+        except NotFound as e:
+            return self._status_error(404, "NotFound", str(e))
+
+
+class APIServerHTTP(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, store: APIServer):
+        super().__init__(addr, _Handler)
+        self.store = store
+        self.stopping = threading.Event()
+
+    def shutdown(self):
+        self.stopping.set()
+        super().shutdown()
+
+
+def serve(
+    store: Optional[APIServer] = None, port: int = 0
+) -> Tuple[APIServerHTTP, int, APIServer]:
+    """Start the façade on a background thread; returns (server, port, store)."""
+    store = store or APIServer()
+    srv = APIServerHTTP(("0.0.0.0", port), store)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1], store

@@ -1,0 +1,36 @@
+"""kube-apiserver process entry: the REST façade as a standalone process.
+
+Reference: cmd/kube-apiserver/app/server.go (reduced: one server, no
+aggregation layers — CRDs/aggregation are tracked as follow-ups).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-apiserver-tpu")
+    parser.add_argument("--port", type=int, default=18080)
+    parser.add_argument("-v", "--verbosity", type=int, default=1)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
+    )
+    from ..apiserver.rest import serve
+
+    srv, port, _store = serve(port=args.port)
+    logging.getLogger("kubernetes_tpu.cmd.apiserver").info(
+        "serving /api/v1 on :%d", port
+    )
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

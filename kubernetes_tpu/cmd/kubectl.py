@@ -1,0 +1,247 @@
+"""kubectl-equivalent CLI over the REST API.
+
+Reference: staging/src/k8s.io/kubectl/pkg/cmd — the core verbs a scheduler
+user needs: get, describe, create/apply -f, delete, bind (debug helper),
+cordon/uncordon, taint. Server address via --server or KUBECTL_TPU_SERVER.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..api import serialization as codec
+from ..apiserver.client import RESTClient
+from ..client.apiserver import NotFound
+
+ALIASES = {
+    "pod": "pods",
+    "po": "pods",
+    "node": "nodes",
+    "no": "nodes",
+    "svc": "services",
+    "service": "services",
+    "pv": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "sc": "storageclasses",
+    "ev": "events",
+    "event": "events",
+}
+
+
+def _resource(arg: str) -> str:
+    return ALIASES.get(arg, arg)
+
+
+def _load_objects(path: str) -> List[tuple]:
+    """File (JSON, JSON list, or YAML if available) → [(resource, obj)]."""
+    if path == "-":
+        text = sys.stdin.read()
+    else:
+        with open(path) as f:
+            text = f.read()
+    try:
+        data = json.loads(text)
+        docs = data if isinstance(data, list) else [data]
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+
+            docs = [d for d in yaml.safe_load_all(text) if d]
+        except ImportError:
+            raise SystemExit("file is not JSON and PyYAML is unavailable")
+    return [codec.decode_any(doc) for doc in docs]
+
+
+def cmd_get(client: RESTClient, args) -> int:
+    resource = _resource(args.resource)
+    if args.name:
+        try:
+            obj = client.get(resource, args.namespace, args.name)
+        except NotFound:
+            obj = client.get(resource, "", args.name)
+        if args.output == "json":
+            print(json.dumps(codec.encode(obj), indent=2))
+        else:
+            _print_table(resource, [obj])
+        return 0
+    objs, _rv = client.list(resource)
+    if args.namespace and resource == "pods":
+        objs = [o for o in objs if o.metadata.namespace == args.namespace]
+    if args.output == "json":
+        print(json.dumps([codec.encode(o) for o in objs], indent=2))
+    else:
+        _print_table(resource, objs)
+    return 0
+
+
+def _print_table(resource: str, objs) -> None:
+    if resource == "pods":
+        print(f"{'NAMESPACE':<12} {'NAME':<40} {'NODE':<24} {'PHASE':<10}")
+        for p in objs:
+            print(
+                f"{p.metadata.namespace:<12} {p.metadata.name:<40} "
+                f"{p.spec.node_name or '<none>':<24} {p.status.phase:<10}"
+            )
+    elif resource == "nodes":
+        print(f"{'NAME':<28} {'UNSCHEDULABLE':<14} {'TAINTS':<5} {'CPU':<8}")
+        for n in objs:
+            print(
+                f"{n.metadata.name:<28} {str(n.spec.unschedulable):<14} "
+                f"{len(n.spec.taints):<5} "
+                f"{n.status.allocatable.get('cpu', '?'):<8}"
+            )
+    elif resource == "events":
+        print(f"{'TYPE':<8} {'REASON':<20} {'OBJECT':<40} {'NOTE'}")
+        for e in objs:
+            print(f"{e.type:<8} {e.reason:<20} {e.involved_key:<40} {e.note[:60]}")
+    else:
+        print("NAME")
+        for o in objs:
+            print(o.metadata.name)
+
+
+def cmd_describe(client: RESTClient, args) -> int:
+    resource = _resource(args.resource)
+    try:
+        obj = client.get(resource, args.namespace, args.name)
+    except NotFound:
+        obj = client.get(resource, "", args.name)
+    print(json.dumps(codec.encode(obj), indent=2))
+    if resource == "pods":
+        events, _ = client.list("events")
+        key = obj.metadata.key
+        related = [e for e in events if e.involved_key == key]
+        if related:
+            print("\nEvents:")
+            for e in related:
+                print(f"  {e.type} {e.reason}: {e.note} (x{e.count})")
+    return 0
+
+
+def cmd_apply(client: RESTClient, args) -> int:
+    for resource, obj in _load_objects(args.filename):
+        try:
+            client.create(resource, obj)
+            print(f"{resource}/{obj.metadata.name} created")
+        except Exception:
+            cur = client.get(
+                resource, obj.metadata.namespace, obj.metadata.name
+            )
+            obj.metadata.resource_version = cur.metadata.resource_version
+            obj.metadata.uid = cur.metadata.uid
+            client.update(resource, obj)
+            print(f"{resource}/{obj.metadata.name} configured")
+    return 0
+
+
+def cmd_create(client: RESTClient, args) -> int:
+    for resource, obj in _load_objects(args.filename):
+        client.create(resource, obj)
+        print(f"{resource}/{obj.metadata.name} created")
+    return 0
+
+
+def cmd_delete(client: RESTClient, args) -> int:
+    resource = _resource(args.resource)
+    try:
+        client.delete(resource, args.namespace, args.name)
+    except NotFound:
+        client.delete(resource, "", args.name)
+    print(f"{resource}/{args.name} deleted")
+    return 0
+
+
+def cmd_cordon(client: RESTClient, args, unschedulable=True) -> int:
+    def mutate(n):
+        n.spec.unschedulable = unschedulable
+        return n
+
+    client.guaranteed_update("nodes", "", args.name, mutate)
+    print(f"node/{args.name} {'cordoned' if unschedulable else 'uncordoned'}")
+    return 0
+
+
+def cmd_taint(client: RESTClient, args) -> int:
+    # kubectl taint nodes NAME key=value:Effect (suffix '-' removes)
+    from ..api.objects import Taint
+
+    spec = args.taint
+    remove = spec.endswith("-")
+    spec = spec.rstrip("-")
+    kv, _, effect = spec.partition(":")
+    key, _, value = kv.partition("=")
+
+    def mutate(n):
+        n.spec.taints = [t for t in n.spec.taints if t.key != key]
+        if not remove:
+            n.spec.taints.append(Taint(key, value, effect))
+        return n
+
+    client.guaranteed_update("nodes", "", args.name, mutate)
+    print(f"node/{args.name} {'untainted' if remove else 'tainted'}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubectl-tpu")
+    parser.add_argument(
+        "--server",
+        default=os.environ.get("KUBECTL_TPU_SERVER", "http://127.0.0.1:18080"),
+    )
+    parser.add_argument("-n", "--namespace", default="default")
+    parser.add_argument("-o", "--output", default="table", choices=["table", "json"])
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    p_get = sub.add_parser("get")
+    p_get.add_argument("resource")
+    p_get.add_argument("name", nargs="?")
+    p_desc = sub.add_parser("describe")
+    p_desc.add_argument("resource")
+    p_desc.add_argument("name")
+    p_apply = sub.add_parser("apply")
+    p_apply.add_argument("-f", "--filename", required=True)
+    p_create = sub.add_parser("create")
+    p_create.add_argument("-f", "--filename", required=True)
+    p_del = sub.add_parser("delete")
+    p_del.add_argument("resource")
+    p_del.add_argument("name")
+    p_cord = sub.add_parser("cordon")
+    p_cord.add_argument("name")
+    p_uncord = sub.add_parser("uncordon")
+    p_uncord.add_argument("name")
+    p_taint = sub.add_parser("taint")
+    p_taint.add_argument("nodes")  # literal "nodes"
+    p_taint.add_argument("name")
+    p_taint.add_argument("taint")
+
+    args = parser.parse_args(argv)
+    client = RESTClient(args.server)
+    try:
+        if args.verb == "get":
+            return cmd_get(client, args)
+        if args.verb == "describe":
+            return cmd_describe(client, args)
+        if args.verb == "apply":
+            return cmd_apply(client, args)
+        if args.verb == "create":
+            return cmd_create(client, args)
+        if args.verb == "delete":
+            return cmd_delete(client, args)
+        if args.verb == "cordon":
+            return cmd_cordon(client, args, True)
+        if args.verb == "uncordon":
+            return cmd_cordon(client, args, False)
+        if args.verb == "taint":
+            return cmd_taint(client, args)
+    except NotFound as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,141 @@
+"""Node lifecycle controller: heartbeat monitoring, taints, eviction.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go
+(:314-368): watch node Leases + NodeStatus; a node whose lease outages
+exceed nodeMonitorGracePeriod goes NotReady and gets the
+node.kubernetes.io/unreachable:NoExecute taint; pods on it are evicted
+(deleted) after podEvictionTimeout. Recovery removes the taint.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict
+
+from ..api import objects as v1
+from ..client.apiserver import NotFound
+from ..kubemark.hollow_node import NODE_LEASE_NS
+
+logger = logging.getLogger("kubernetes_tpu.controller.nodelifecycle")
+
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+
+class NodeLifecycleController:
+    def __init__(
+        self,
+        server,
+        node_monitor_period: float = 1.0,
+        node_monitor_grace_period: float = 40.0,
+        pod_eviction_timeout: float = 60.0,
+    ):
+        self.server = server
+        self.monitor_period = node_monitor_period
+        self.grace_period = node_monitor_grace_period
+        self.eviction_timeout = pod_eviction_timeout
+        self._not_ready_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="nodelifecycle", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._monitor_once()
+            except Exception:
+                logger.exception("node monitor pass failed")
+            self._stop.wait(self.monitor_period)
+
+    def _monitor_once(self) -> None:
+        now = time.time()
+        nodes, _ = self.server.list("nodes")
+        for node in nodes:
+            name = node.metadata.name
+            healthy = self._node_healthy(name, now)
+            if healthy:
+                if name in self._not_ready_since:
+                    del self._not_ready_since[name]
+                    self._set_ready(name, True)
+            else:
+                since = self._not_ready_since.setdefault(name, now)
+                if now - since >= 0:
+                    self._set_ready(name, False)
+                if now - since > self.eviction_timeout:
+                    self._evict_pods(name)
+
+    def _node_healthy(self, name: str, now: float) -> bool:
+        try:
+            lease = self.server.get("leases", NODE_LEASE_NS, name)
+        except NotFound:
+            return True  # no lease: node isn't lease-managed (static node)
+        return now - lease.renew_time < self.grace_period
+
+    def _set_ready(self, name: str, ready: bool) -> None:
+        def mutate(node):
+            changed = False
+            cond = next(
+                (c for c in node.status.conditions if c.type == v1.NODE_READY),
+                None,
+            )
+            want = "True" if ready else "Unknown"
+            if cond is None:
+                node.status.conditions.append(
+                    v1.NodeCondition(type=v1.NODE_READY, status=want)
+                )
+                changed = True
+            elif cond.status != want:
+                cond.status = want
+                cond.last_transition_time = time.time()
+                changed = True
+            has_taint = any(
+                t.key == TAINT_UNREACHABLE for t in node.spec.taints
+            )
+            if ready and has_taint:
+                node.spec.taints = [
+                    t for t in node.spec.taints if t.key != TAINT_UNREACHABLE
+                ]
+                changed = True
+            elif not ready and not has_taint:
+                node.spec.taints.append(
+                    v1.Taint(TAINT_UNREACHABLE, "", v1.TAINT_NO_EXECUTE)
+                )
+                changed = True
+            return node if changed else None
+
+        try:
+            self.server.guaranteed_update("nodes", "", name, mutate)
+        except NotFound:
+            pass
+
+    def _evict_pods(self, node_name: str) -> None:
+        pods, _ = self.server.list("pods")
+        for pod in pods:
+            if pod.spec.node_name != node_name:
+                continue
+            if any(
+                tol.key == TAINT_UNREACHABLE
+                and tol.effect in ("", v1.TAINT_NO_EXECUTE)
+                for tol in pod.spec.tolerations
+            ):
+                continue
+            try:
+                self.server.delete(
+                    "pods", pod.metadata.namespace, pod.metadata.name
+                )
+                logger.info(
+                    "evicted pod %s from dead node %s",
+                    pod.metadata.key,
+                    node_name,
+                )
+            except NotFound:
+                pass

@@ -1,0 +1,215 @@
+"""Controllers + hollow nodes: ReplicaSet reconcile, GC cascade, node
+failure detection/eviction, namespace drain — driven end-to-end with the
+real scheduler and hollow kubelets (the reference's kubemark topology)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Namespace,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSet,
+    ReplicaSetSpec,
+)
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.garbagecollector import GarbageCollector
+from kubernetes_tpu.controller.manager import ControllerManager
+from kubernetes_tpu.controller.namespace import NamespaceController
+from kubernetes_tpu.controller.nodelifecycle import (
+    TAINT_UNREACHABLE,
+    NodeLifecycleController,
+)
+from kubernetes_tpu.controller.replicaset import ReplicaSetController
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+
+def make_rs(name, replicas=3):
+    return ReplicaSet(
+        metadata=ObjectMeta(name=name),
+        spec=ReplicaSetSpec(
+            replicas=replicas,
+            selector={"app": name},
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(labels={"app": name}),
+                spec=PodSpec(
+                    containers=[Container(requests={"cpu": "100m"})]
+                ),
+            ),
+        ),
+    )
+
+
+def wait_until(fn, timeout=20.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_replicaset_scales_and_hollow_nodes_run_pods():
+    server = APIServer()
+    hollow = HollowCluster(server, num_nodes=4)
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    rs_ctrl = ReplicaSetController(server)
+    hollow.start()
+    sched.start()
+    rs_ctrl.start()
+    try:
+        server.create("replicasets", make_rs("web", replicas=3))
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in server.list("pods")[0]
+                if p.spec.node_name and p.status.phase == "Running"
+            )
+            == 3
+        ), [
+            (p.metadata.name, p.spec.node_name, p.status.phase)
+            for p in server.list("pods")[0]
+        ]
+
+        # scale down to 1
+        def scale(rs):
+            rs.spec.replicas = 1
+            return rs
+
+        server.guaranteed_update("replicasets", "default", "web", scale)
+        assert wait_until(lambda: len(server.list("pods")[0]) == 1)
+    finally:
+        rs_ctrl.stop()
+        sched.stop()
+        hollow.stop()
+
+
+def test_gc_cascades_replicaset_pods():
+    server = APIServer()
+    rs_ctrl = ReplicaSetController(server)
+    gc = GarbageCollector(server, period=0.2)
+    rs_ctrl.start()
+    gc.start()
+    try:
+        server.create("replicasets", make_rs("api", replicas=2))
+        assert wait_until(lambda: len(server.list("pods")[0]) == 2)
+        rs_ctrl.stop()  # so it doesn't recreate while we delete
+        server.delete("replicasets", "default", "api")
+        assert wait_until(lambda: len(server.list("pods")[0]) == 0)
+    finally:
+        rs_ctrl.stop()
+        gc.stop()
+
+
+def test_nodelifecycle_detects_death_and_evicts():
+    server = APIServer()
+    hollow = HollowCluster(server, num_nodes=2, heartbeat_interval=0.2)
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    nlc = NodeLifecycleController(
+        server,
+        node_monitor_period=0.2,
+        node_monitor_grace_period=1.0,
+        pod_eviction_timeout=1.5,
+    )
+    hollow.start()
+    sched.start()
+    nlc.start()
+    try:
+        from tests_util_pods import simple_pod
+    except ImportError:
+        from kubernetes_tpu.api.objects import Pod
+
+        def simple_pod(name):
+            return Pod(
+                metadata=ObjectMeta(name=name),
+                spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+            )
+
+    try:
+        server.create("pods", simple_pod("victim"))
+        assert wait_until(
+            lambda: server.get("pods", "default", "victim").spec.node_name
+        )
+        node_name = server.get("pods", "default", "victim").spec.node_name
+        hollow.kill_node(node_name)  # stops its lease renewals
+        assert wait_until(
+            lambda: any(
+                t.key == TAINT_UNREACHABLE
+                for t in server.get("nodes", "", node_name).spec.taints
+            ),
+            timeout=15,
+        )
+        # pod evicted after timeout
+        assert wait_until(
+            lambda: not any(
+                p.metadata.name == "victim" for p in server.list("pods")[0]
+            ),
+            timeout=15,
+        )
+        # the OTHER node recovers never-died state: still untainted
+        other = next(
+            n.metadata.name
+            for n in server.list("nodes")[0]
+            if n.metadata.name != node_name
+        )
+        assert not server.get("nodes", "", other).spec.taints
+    finally:
+        nlc.stop()
+        sched.stop()
+        hollow.stop()
+
+
+def test_namespace_controller_drains():
+    server = APIServer()
+    nsc = NamespaceController(server, period=0.2)
+    nsc.start()
+    try:
+        server.create(
+            "namespaces", Namespace(metadata=ObjectMeta(name="scratch", namespace=""))
+        )
+        from kubernetes_tpu.api.objects import Pod
+
+        server.create(
+            "pods",
+            Pod(
+                metadata=ObjectMeta(name="p", namespace="scratch"),
+                spec=PodSpec(containers=[Container(requests={"cpu": "1"})]),
+            ),
+        )
+
+        def term(ns):
+            ns.phase = "Terminating"
+            return ns
+
+        server.guaranteed_update("namespaces", "", "scratch", term)
+        assert wait_until(
+            lambda: not server.list("pods", namespace="scratch")[0]
+        )
+        assert wait_until(
+            lambda: not any(
+                n.metadata.name == "scratch"
+                for n in server.list("namespaces")[0]
+            )
+        )
+    finally:
+        nsc.stop()
+
+
+def test_controller_manager_runs_all():
+    server = APIServer()
+    mgr = ControllerManager(server)
+    mgr.start()
+    try:
+        assert mgr._started.wait(5)
+        assert set(mgr.controllers) == {
+            "replicaset",
+            "nodelifecycle",
+            "garbagecollector",
+            "namespace",
+        }
+    finally:
+        mgr.stop()

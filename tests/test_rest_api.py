@@ -1,0 +1,194 @@
+"""REST façade + client + kubectl: the full HTTP path.
+
+Mirrors the reference's integration topology (test/integration/: real
+in-process apiserver over HTTP, real components as clients) — here the
+scheduler itself runs against the REST client to prove every component
+works across the wire, not just in-process.
+"""
+
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+from kubernetes_tpu.api import serialization as codec
+from kubernetes_tpu.api.objects import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver import RESTClient, serve
+from kubernetes_tpu.client.apiserver import AlreadyExists, NotFound
+from kubernetes_tpu.cmd.kubectl import main as kubectl_main
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+
+@pytest.fixture
+def rest():
+    srv, port, store = serve(port=0)
+    yield RESTClient(f"http://127.0.0.1:{port}"), store, port
+    srv.shutdown()
+
+
+def make_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={"cpu": "4", "memory": "32Gi", "pods": 110}),
+    )
+
+
+def make_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+    )
+
+
+def test_rest_crud_roundtrip(rest):
+    client, _store, _port = rest
+    client.create("nodes", make_node("n0"))
+    got = client.get("nodes", "", "n0")
+    assert got.metadata.name == "n0"
+    assert got.status.allocatable["cpu"] == "4"
+    with pytest.raises(AlreadyExists):
+        client.create("nodes", make_node("n0"))
+
+    def mutate(n):
+        n.spec.unschedulable = True
+        return n
+
+    client.guaranteed_update("nodes", "", "n0", mutate)
+    assert client.get("nodes", "", "n0").spec.unschedulable is True
+    objs, rv = client.list("nodes")
+    assert len(objs) == 1 and rv > 0
+    client.delete("nodes", "", "n0")
+    with pytest.raises(NotFound):
+        client.get("nodes", "", "n0")
+
+
+def test_rest_watch_streams_events(rest):
+    client, _store, _port = rest
+    w = client.watch("pods")
+    time.sleep(0.2)
+    client.create("pods", make_pod("a"))
+    ev = w.get(timeout=5)
+    assert ev is not None and ev.type == "ADDED"
+    assert ev.object.metadata.name == "a"
+    client.delete("pods", "default", "a")
+    types = set()
+    for _ in range(2):
+        ev = w.get(timeout=5)
+        if ev:
+            types.add(ev.type)
+    assert "DELETED" in types
+    w.stop()
+
+
+def test_scheduler_runs_over_rest(rest):
+    client, _store, _port = rest
+    for i in range(3):
+        client.create("nodes", make_node(f"n{i}"))
+    sched = Scheduler(client, KubeSchedulerConfiguration())
+    sched.start()
+    try:
+        client.create("pods", make_pod("p"))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if client.get("pods", "default", "p").spec.node_name:
+                break
+            time.sleep(0.05)
+        assert client.get("pods", "default", "p").spec.node_name
+    finally:
+        sched.stop()
+
+
+def test_binding_subresource(rest):
+    client, _store, port = rest
+    client.create("nodes", make_node("n0"))
+    client.create("pods", make_pod("p"))
+    body = json.dumps(
+        {"podName": "p", "podNamespace": "default", "targetNode": "n0"}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/namespaces/default/pods/p/binding",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req, timeout=5)
+    assert resp.status == 201
+    assert client.get("pods", "default", "p").spec.node_name == "n0"
+
+
+def test_kubectl_get_apply_taint(rest, tmp_path):
+    client, _store, port = rest
+    server_flag = f"--server=http://127.0.0.1:{port}"
+    manifest = tmp_path / "node.json"
+    manifest.write_text(
+        json.dumps(codec.encode(make_node("kn")))
+    )
+    assert kubectl_main([server_flag, "apply", "-f", str(manifest)]) == 0
+    out = io.StringIO()
+    with redirect_stdout(out):
+        assert kubectl_main([server_flag, "get", "nodes"]) == 0
+    assert "kn" in out.getvalue()
+    assert (
+        kubectl_main(
+            [server_flag, "taint", "nodes", "kn", "dedicated=infra:NoSchedule"]
+        )
+        == 0
+    )
+    assert client.get("nodes", "", "kn").spec.taints[0].key == "dedicated"
+    assert kubectl_main([server_flag, "cordon", "kn"]) == 0
+    assert client.get("nodes", "", "kn").spec.unschedulable is True
+    out = io.StringIO()
+    with redirect_stdout(out):
+        assert kubectl_main([server_flag, "-o", "json", "get", "nodes", "kn"]) == 0
+    assert json.loads(out.getvalue())["metadata"]["name"] == "kn"
+    assert kubectl_main([server_flag, "delete", "nodes", "kn"]) == 0
+
+
+def test_serializer_roundtrip_pod_affinity():
+    from kubernetes_tpu.api.objects import (
+        Affinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        Toleration,
+    )
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    pod = Pod(
+        metadata=ObjectMeta(name="p", labels={"app": "x"}),
+        spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": "100m"})],
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required=(
+                        PodAffinityTerm(
+                            label_selector=LabelSelector.make(
+                                match_labels={"app": "x"}
+                            ),
+                            topology_key="zone",
+                        ),
+                    )
+                )
+            ),
+            tolerations=[Toleration(key="k", operator="Exists")],
+        ),
+    )
+    wire = json.dumps(codec.encode(pod))
+    back = codec.decode("pods", json.loads(wire))
+    term = back.spec.affinity.pod_anti_affinity.required[0]
+    assert term.topology_key == "zone"
+    assert term.label_selector.matches({"app": "x"})
+    assert back.spec.tolerations[0].operator == "Exists"
+    # cluster-scoped namespace survives
+    node_wire = json.dumps(codec.encode(make_node("n")))
+    assert codec.decode("nodes", json.loads(node_wire)).metadata.namespace == ""
