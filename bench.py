@@ -10,45 +10,119 @@ Prints ONE JSON line:
 vs_baseline is measured throughput divided by the north-star target from
 BASELINE.json (50,000 pods/s on the 5k-node InterPodAffinity suite), so
 vs_baseline >= 1.0 means the target is met or beaten.
+
+Resilience contract (VERDICT r1 item 1b): the TPU backend behind the tunnel
+can be flaky or entirely unavailable. This script (a) probes backend init in
+a SUBPROCESS with a hard timeout so a hanging init can't wedge the bench,
+(b) retries the probe, (c) falls back to the CPU backend (clearly labeled in
+the output) when the TPU never comes up, and (d) ALWAYS emits the JSON line
+— on an unexpected error the line carries an "error" field and value 0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
+import traceback
 
 TARGET_PODS_PER_S = 50_000.0  # BASELINE.json north-star, v5e-8
 
+_PROBE = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "(x @ x).block_until_ready();"
+    "print(jax.devices()[0].platform)"
+)
 
-def main() -> None:
-    from kubernetes_tpu.perf.harness import run_benchmark
-    from kubernetes_tpu.perf.workloads import WORKLOADS
 
-    cfg = WORKLOADS["SchedulingPodAffinity/5000"]
+def _probe_backend(attempts: int = 2, timeout_s: float = 150.0) -> str:
+    """Return the usable default platform name, or '' if init never succeeds.
 
-    # Warm-up on a small instance of the same workload so XLA compile time
-    # (one-off, cached) doesn't pollute the measured window; presized to the
-    # measured cluster's capacities so the same kernel variant compiles.
-    warm = WORKLOADS["SchedulingPodAffinity/500"]
-    run_benchmark(warm, quiet=True, presize_nodes=cfg.num_nodes)
+    Run in a child process because a broken TPU tunnel makes backend init
+    HANG (observed: >120s) rather than fail fast — an in-process attempt
+    would take the whole bench down with it.
+    """
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1]
+        except subprocess.TimeoutExpired:
+            pass
+        if i + 1 < attempts:
+            time.sleep(5.0)
+    return ""
 
-    res = run_benchmark(cfg, quiet=True)
+
+def main() -> int:
     out = {
         "metric": "scheduling_throughput_5k_node_interpodaffinity",
-        "value": round(res.throughput_pods_per_s, 1),
+        "value": 0.0,
         "unit": "pods/s",
-        "vs_baseline": round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
-        "detail": {
-            "workload": res.workload,
-            "num_nodes": res.num_nodes,
-            "scheduled": res.scheduled,
-            "unscheduled": res.unscheduled,
-            "duration_s": round(res.duration_s, 3),
-            "e2e_p50_ms": round(res.e2e_p50_ms, 3),
-            "e2e_p99_ms": round(res.e2e_p99_ms, 3),
-        },
+        "vs_baseline": 0.0,
     }
+    try:
+        forced = bool(os.environ.get("BENCH_FORCE_CPU"))
+        platform = "" if forced else _probe_backend()
+        if not platform or platform == "cpu":
+            # TPU tunnel down (or explicitly skipped): measure the CPU
+            # fallback so the round still gets a real number, and say so.
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            if not platform:
+                platform = (
+                    "cpu (BENCH_FORCE_CPU)" if forced
+                    else "cpu (tpu backend init failed)"
+                )
+
+        from kubernetes_tpu.perf.harness import run_benchmark
+        from kubernetes_tpu.perf.workloads import WORKLOADS
+
+        cfg = WORKLOADS["SchedulingPodAffinity/5000"]
+
+        # Warm-up on a small instance of the same workload so XLA compile
+        # time (one-off, cached) doesn't pollute the measured window;
+        # presized to the measured cluster's capacities so the same kernel
+        # variant compiles.
+        warm = WORKLOADS["SchedulingPodAffinity/500"]
+        run_benchmark(warm, quiet=True, presize_nodes=cfg.num_nodes)
+
+        res = run_benchmark(cfg, quiet=True)
+        out.update(
+            value=round(res.throughput_pods_per_s, 1),
+            vs_baseline=round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
+            detail={
+                "platform": platform,
+                "workload": res.workload,
+                "num_nodes": res.num_nodes,
+                "scheduled": res.scheduled,
+                "unscheduled": res.unscheduled,
+                "duration_s": round(res.duration_s, 3),
+                "e2e_p50_ms": round(res.e2e_p50_ms, 3),
+                "e2e_p99_ms": round(res.e2e_p99_ms, 3),
+                "algo_p99_ms": round(res.algo_p99_ms, 3),
+                "stage_breakdown_s": {
+                    "encode_total": round(res.encode_total_s, 3),
+                    "kernel_total": round(res.kernel_total_s, 3),
+                    "n_batches": res.n_batches,
+                },
+            },
+        )
+    except Exception as e:  # noqa: BLE001 — the contract is "always one JSON line"
+        traceback.print_exc()
+        out["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
+    sys.stdout.flush()
+    return 0
 
 
 if __name__ == "__main__":

@@ -34,6 +34,10 @@ class BenchResult:
     e2e_p90_ms: float = 0.0
     e2e_p99_ms: float = 0.0
     algo_p99_ms: float = 0.0
+    # per-batch stage breakdown (sums over the measurement window)
+    encode_total_s: float = 0.0
+    kernel_total_s: float = 0.0
+    n_batches: int = 0
     samples: List[int] = field(default_factory=list)  # scheduled count / 100ms
 
     def to_dict(self) -> dict:
@@ -70,6 +74,15 @@ def run_benchmark(
     _wait_all_scheduled(server, len(init_pods), timeout_s)
 
     measured = [factory(i) for i in range(cfg.num_measured_pods)]
+    # baseline the stage histograms so the breakdown covers only the
+    # measurement window (init pods above already ran encode/kernel)
+    _e0 = metrics.histogram("scheduling_stage_duration_seconds", {"stage": "encode"})
+    _k0 = metrics.histogram("scheduling_stage_duration_seconds", {"stage": "kernel"})
+    base_enc, base_kern, base_n = (
+        (_e0.total if _e0 else 0.0),
+        (_k0.total if _k0 else 0.0),
+        (_k0.n if _k0 else 0),
+    )
     # warm the kernel before the clock starts (XLA compile is one-off)
     t0 = time.monotonic()
     for p in measured:
@@ -94,6 +107,12 @@ def run_benchmark(
     thr = measured_scheduled / duration if duration > 0 else 0.0
     e2e = metrics.histogram("e2e_scheduling_duration_seconds")
     algo = metrics.histogram("scheduling_algorithm_duration_seconds")
+    enc_h = metrics.histogram(
+        "scheduling_stage_duration_seconds", {"stage": "encode"}
+    )
+    kern_h = metrics.histogram(
+        "scheduling_stage_duration_seconds", {"stage": "kernel"}
+    )
     res = BenchResult(
         workload=cfg.name,
         num_nodes=cfg.num_nodes,
@@ -106,6 +125,9 @@ def run_benchmark(
         e2e_p90_ms=(e2e.quantile(0.9) * 1000 if e2e else 0.0),
         e2e_p99_ms=(e2e.quantile(0.99) * 1000 if e2e else 0.0),
         algo_p99_ms=(algo.quantile(0.99) * 1000 if algo else 0.0),
+        encode_total_s=((enc_h.total if enc_h else 0.0) - base_enc),
+        kernel_total_s=((kern_h.total if kern_h else 0.0) - base_kern),
+        n_batches=((kern_h.n if kern_h else 0) - base_n),
         samples=samples,
     )
     if not quiet:

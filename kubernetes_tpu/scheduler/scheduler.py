@@ -26,6 +26,7 @@ import itertools
 import logging
 import threading
 import time
+from contextlib import contextmanager
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -68,6 +69,20 @@ from .queue import PriorityQueue, QueuedPodInfo
 from . import eventhandlers
 
 logger = logging.getLogger("kubernetes_tpu.scheduler")
+
+
+@contextmanager
+def _stage_timer(stage: str):
+    """Feed the bench's stage_breakdown_s (encode vs kernel time per batch)."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        metrics.observe(
+            "scheduling_stage_duration_seconds",
+            time.monotonic() - t0,
+            {"stage": stage},
+        )
 
 _SCORE_NAME_TO_COMPONENT = {
     "NodeResourcesLeastAllocated": SC_LEAST_ALLOC,
@@ -278,7 +293,7 @@ class Scheduler:
     def _schedule_batch_device(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
-        with self.cache.lock:
+        with _stage_timer("encode"), self.cache.lock:
             eb = encode_pod_batch(
                 self.cache.encoder,
                 [pi.pod for pi in pis],
@@ -290,9 +305,10 @@ class Scheduler:
         trace.step("encoded+flushed")
         kern = make_schedule_batch(enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight)
         self._rng_key, sub = jax.random.split(self._rng_key)
-        res = kern(snap, eb.batch, np.asarray(self._weights), sub)
-        chosen = np.asarray(res.chosen)
-        feas = np.asarray(res.feasible_count)
+        with _stage_timer("kernel"):
+            res = kern(snap, eb.batch, np.asarray(self._weights), sub)
+            chosen = np.asarray(res.chosen)
+            feas = np.asarray(res.feasible_count)
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
 
@@ -378,7 +394,7 @@ class Scheduler:
         # bucket is another multi-second XLA compile on first use
         small = min(256, self.cfg.device_batch_size)
         pad = small if len(pis) <= small else self.cfg.device_batch_size
-        with self.cache.lock:
+        with _stage_timer("encode"), self.cache.lock:
             eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
             ptab, n_waves = self._pair_table(eb)
             snap = self.cache.encoder.flush()
@@ -392,21 +408,22 @@ class Scheduler:
             self.cfg.hard_pod_affinity_weight,
         )
         self._rng_key, sub = jax.random.split(self._rng_key)
-        try:
-            new_snap, res = kern(
-                snap, eb.batch, ptab, np.asarray(self._weights), sub
+        with _stage_timer("kernel"):
+            try:
+                new_snap, res = kern(
+                    snap, eb.batch, ptab, np.asarray(self._weights), sub
+                )
+            except Exception:
+                self.cache.encoder.invalidate_device()
+                raise
+            with self.cache.lock:
+                self.cache.encoder.set_device_snapshot(new_snap)
+            jax.block_until_ready(
+                (res.chosen, res.placed, res.deferred, res.feasible_count)
             )
-        except Exception:
-            self.cache.encoder.invalidate_device()
-            raise
-        with self.cache.lock:
-            self.cache.encoder.set_device_snapshot(new_snap)
-        jax.block_until_ready(
-            (res.chosen, res.placed, res.deferred, res.feasible_count)
-        )
-        chosen = np.asarray(res.chosen)
-        placed = np.asarray(res.placed)
-        deferred = np.asarray(res.deferred)
+            chosen = np.asarray(res.chosen)
+            placed = np.asarray(res.placed)
+            deferred = np.asarray(res.deferred)
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
         metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)

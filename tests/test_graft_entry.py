@@ -1,0 +1,38 @@
+"""Driver-artifact regression tests (VERDICT r1 item 1).
+
+Round 1 shipped with dryrun_multichip hanging on TPU backend init — these
+tests pin the contract: entry() must lower under jit single-device, and
+dryrun_multichip(8) must complete on the virtual CPU mesh.
+"""
+
+import subprocess
+import sys
+
+import jax
+
+
+def test_entry_lowers():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    jax.jit(fn).lower(*args)  # compile-check without executing
+
+
+def test_dryrun_multichip_8():
+    # run in a subprocess with a hard timeout: the round-1 failure mode was
+    # a hang, which an in-process call would propagate to the whole suite
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; sys.path.insert(0, '/root/repo'); "
+            "import __graft_entry__ as g; g.dryrun_multichip(8)",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "scheduled" in r.stdout
