@@ -70,7 +70,9 @@ def main() -> int:
         "vs_baseline": 0.0,
     }
     try:
-        forced = bool(os.environ.get("BENCH_FORCE_CPU"))
+        forced = os.environ.get("BENCH_FORCE_CPU", "").lower() not in (
+            "", "0", "false",
+        )
         platform = "" if forced else _probe_backend()
         if not platform or platform == "cpu":
             # TPU tunnel down (or explicitly skipped): measure the CPU
@@ -84,7 +86,10 @@ def main() -> int:
                     else "cpu (tpu backend init failed)"
                 )
 
-        from kubernetes_tpu.perf.harness import run_benchmark
+        from kubernetes_tpu.perf.harness import (
+            run_benchmark,
+            run_latency_benchmark,
+        )
         from kubernetes_tpu.perf.workloads import WORKLOADS
 
         cfg = WORKLOADS["SchedulingPodAffinity/5000"]
@@ -97,6 +102,17 @@ def main() -> int:
         run_benchmark(warm, quiet=True, presize_nodes=cfg.num_nodes)
 
         res = run_benchmark(cfg, quiet=True)
+
+        # steady-state latency: inject at ~30% of measured burst throughput
+        # (capped) so queue depth stays ~0 and the percentiles measure the
+        # scheduling machinery, not the backlog
+        lat = None
+        try:
+            rate = max(10.0, min(res.throughput_pods_per_s * 0.3, 2000.0))
+            lat = run_latency_benchmark(cfg, rate, n_pods=500)
+        except Exception:
+            traceback.print_exc()
+
         out.update(
             value=round(res.throughput_pods_per_s, 1),
             vs_baseline=round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
@@ -115,6 +131,18 @@ def main() -> int:
                     "kernel_total": round(res.kernel_total_s, 3),
                     "n_batches": res.n_batches,
                 },
+                "steady_state_latency": (
+                    {
+                        "rate_pods_per_s": round(lat.rate_pods_per_s, 1),
+                        "pod_p50_ms": round(lat.pod_p50_ms, 3),
+                        "pod_p90_ms": round(lat.pod_p90_ms, 3),
+                        "pod_p99_ms": round(lat.pod_p99_ms, 3),
+                        "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
+                        "scheduled": lat.scheduled,
+                    }
+                    if lat is not None
+                    else None
+                ),
             },
         )
     except Exception as e:  # noqa: BLE001 — the contract is "always one JSON line"
@@ -122,7 +150,7 @@ def main() -> int:
         out["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
     sys.stdout.flush()
-    return 0
+    return 1 if "error" in out else 0
 
 
 if __name__ == "__main__":

@@ -66,7 +66,23 @@ def run_benchmark(
         server.create("nodes", n)
 
     sched.start()
+    try:
+        return _run_benchmark_body(
+            cfg, server, sched, init_pods, factory, timeout_s, quiet
+        )
+    finally:
+        sched.stop()
 
+
+def _run_benchmark_body(
+    cfg: WorkloadConfig,
+    server: APIServer,
+    sched: Scheduler,
+    init_pods: List[Pod],
+    factory,
+    timeout_s: float,
+    quiet: bool,
+) -> BenchResult:
     # init pods: scheduled before measurement starts (mustSetupScheduler's
     # "init pods" stage)
     for p in init_pods:
@@ -100,7 +116,6 @@ def run_benchmark(
             break
         time.sleep(0.05)
     t1 = time.monotonic()
-    sched.stop()
 
     measured_scheduled = scheduled - len(init_pods)
     duration = t1 - t0
@@ -138,6 +153,93 @@ def run_benchmark(
             f"e2e p99 {res.e2e_p99_ms:.1f}ms"
         )
     return res
+
+
+@dataclass
+class LatencyResult:
+    """Steady-state per-pod latency: pods injected at a fixed rate below
+    saturation, latency = queue entry → bound (incl. queue wait). This is
+    the honest p99 the burst-throughput run can't show (its per-pod latency
+    is dominated by the batch former's deliberate batching window).
+    Metric semantics: reference pod_scheduling_duration_seconds /
+    e2e_scheduling_duration_seconds (scheduler_perf util.go:127-195)."""
+
+    workload: str
+    num_nodes: int
+    rate_pods_per_s: float
+    scheduled: int
+    pod_p50_ms: float
+    pod_p90_ms: float
+    pod_p99_ms: float
+    cycle_p50_ms: float
+    cycle_p99_ms: float
+
+
+def run_latency_benchmark(
+    cfg: WorkloadConfig,
+    rate_pods_per_s: float,
+    n_pods: int = 1000,
+    sched_config: Optional[KubeSchedulerConfiguration] = None,
+    timeout_s: float = 120.0,
+    presize_nodes: Optional[int] = None,
+) -> LatencyResult:
+    """Inject pods one at a time at a fixed rate and report per-pod latency
+    percentiles. The rate should be well below the burst throughput so the
+    queue never backs up (latency is then scheduling cost, not queue depth)."""
+    metrics.reset()
+    server = APIServer()
+    scfg = sched_config or KubeSchedulerConfiguration()
+    sched = Scheduler(server, scfg)
+    sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
+
+    nodes, init_pods, factory = build_workload(cfg)
+    for n in nodes:
+        server.create("nodes", n)
+    sched.start()
+    try:
+        for p in init_pods:
+            server.create("pods", p)
+        _wait_all_scheduled(server, len(init_pods), timeout_s)
+
+        # warm both padded-batch kernel variants (single pod → small bucket)
+        # so the measured window sees no XLA compiles
+        warm = factory(10**6)
+        server.create("pods", warm)
+        _wait_all_scheduled(server, len(init_pods) + 1, timeout_s)
+        metrics.reset()
+
+        interval = 1.0 / rate_pods_per_s
+        t_next = time.monotonic()
+        for i in range(n_pods):
+            server.create("pods", factory(i))
+            t_next += interval
+            pause = t_next - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+        deadline = time.monotonic() + timeout_s
+        target = len(init_pods) + 1 + n_pods
+        while time.monotonic() < deadline:
+            if _count_scheduled(server) >= target:
+                break
+            time.sleep(0.02)
+        scheduled = _count_scheduled(server) - len(init_pods) - 1
+    finally:
+        sched.stop()
+
+    pod_h = metrics.histogram("pod_scheduling_duration_seconds")
+    e2e_h = metrics.histogram("e2e_scheduling_duration_seconds")
+    q = lambda h, p: (h.quantile(p) * 1000 if h else 0.0)  # noqa: E731
+    return LatencyResult(
+        workload=cfg.name,
+        num_nodes=cfg.num_nodes,
+        rate_pods_per_s=rate_pods_per_s,
+        scheduled=scheduled,
+        pod_p50_ms=q(pod_h, 0.5),
+        pod_p90_ms=q(pod_h, 0.9),
+        pod_p99_ms=q(pod_h, 0.99),
+        cycle_p50_ms=q(e2e_h, 0.5),
+        cycle_p99_ms=q(e2e_h, 0.99),
+    )
 
 
 def _count_scheduled(server: APIServer) -> int:

@@ -5,14 +5,17 @@ tests pin the contract: entry() must lower under jit single-device, and
 dryrun_multichip(8) must complete on the virtual CPU mesh.
 """
 
+import pathlib
 import subprocess
 import sys
 
 import jax
 
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
 
 def test_entry_lowers():
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, REPO)
     import __graft_entry__ as g
 
     fn, args = g.entry()
@@ -26,13 +29,13 @@ def test_dryrun_multichip_8():
         [
             sys.executable,
             "-c",
-            "import sys; sys.path.insert(0, '/root/repo'); "
+            f"import sys; sys.path.insert(0, {REPO!r}); "
             "import __graft_entry__ as g; g.dryrun_multichip(8)",
         ],
         capture_output=True,
         text=True,
         timeout=240,
-        cwd="/root/repo",
+        cwd=REPO,
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "scheduled" in r.stdout
