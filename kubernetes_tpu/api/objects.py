@@ -3,7 +3,10 @@
 Covers the slice of staging/src/k8s.io/api/core/v1/types.go the control plane
 consumes: metadata, resources, taints/tolerations, node & pod affinity,
 topology spread constraints, host ports, images, conditions. Plain mutable
-dataclasses; deep-copy is `copy.deepcopy`; defaulting happens in
+dataclasses; Pod/Node deep-copy is a hand-rolled structural copy (every
+mutable sub-object cloned, frozen ones shared — ~100x faster than
+copy.deepcopy on the store's hot path; tests/test_api.py pins field
+completeness); other kinds fall back to copy.deepcopy. Defaulting happens in
 constructors; conversion layers are unnecessary (single internal version).
 """
 
@@ -357,12 +360,112 @@ class Pod:
     kind: str = "Pod"
 
     def deep_copy(self) -> "Pod":
-        return copy.deepcopy(self)
+        """Structural copy: clone every mutable container/dataclass, share
+        frozen ones (selectors, affinity, taints, volume sources — immutable
+        by construction). ~100x faster than copy.deepcopy's memo walk; the
+        API store copies on every create/get/list/watch-event, so this is on
+        the control plane's hottest path."""
+        return Pod(
+            metadata=_copy_meta(self.metadata),
+            spec=_copy_pod_spec(self.spec),
+            status=_copy_pod_status(self.status),
+            kind=self.kind,
+        )
+
+    def __deepcopy__(self, memo) -> "Pod":
+        return self.deep_copy()
 
     @property
     def priority(self) -> int:
         """pod priority with default 0 (podutil.GetPodPriority)."""
         return self.spec.priority if self.spec.priority is not None else 0
+
+
+def _copy_meta(m: ObjectMeta) -> ObjectMeta:
+    return ObjectMeta(
+        name=m.name,
+        namespace=m.namespace,
+        uid=m.uid,
+        labels=dict(m.labels),
+        annotations=dict(m.annotations),
+        resource_version=m.resource_version,
+        generation=m.generation,
+        creation_timestamp=m.creation_timestamp,
+        deletion_timestamp=m.deletion_timestamp,
+        owner_references=[
+            OwnerReference(r.api_version, r.kind, r.name, r.uid, r.controller)
+            for r in m.owner_references
+        ],
+        finalizers=list(m.finalizers),
+    )
+
+
+def _copy_container(c: Container) -> Container:
+    return Container(
+        name=c.name,
+        image=c.image,
+        requests=dict(c.requests),
+        limits=dict(c.limits),
+        ports=[
+            ContainerPort(p.container_port, p.host_port, p.protocol, p.host_ip)
+            for p in c.ports
+        ],
+    )
+
+
+def _copy_volume(v: Volume) -> Volume:
+    # sources are frozen dataclasses / scalars — share them
+    return Volume(
+        name=v.name,
+        persistent_volume_claim=v.persistent_volume_claim,
+        host_path=v.host_path,
+        empty_dir=v.empty_dir,
+        config_map=v.config_map,
+        secret=v.secret,
+        gce_persistent_disk=v.gce_persistent_disk,
+        aws_elastic_block_store=v.aws_elastic_block_store,
+        iscsi=v.iscsi,
+        rbd=v.rbd,
+        azure_disk=v.azure_disk,
+        cinder=v.cinder,
+    )
+
+
+def _copy_pod_spec(s: PodSpec) -> PodSpec:
+    return PodSpec(
+        node_name=s.node_name,
+        scheduler_name=s.scheduler_name,
+        priority=s.priority,
+        priority_class_name=s.priority_class_name,
+        containers=[_copy_container(c) for c in s.containers],
+        init_containers=[_copy_container(c) for c in s.init_containers],
+        overhead=dict(s.overhead),
+        node_selector=dict(s.node_selector),
+        affinity=s.affinity,  # frozen
+        tolerations=list(s.tolerations),  # items frozen
+        topology_spread_constraints=list(s.topology_spread_constraints),
+        host_network=s.host_network,
+        restart_policy=s.restart_policy,
+        termination_grace_period_seconds=s.termination_grace_period_seconds,
+        volumes=[_copy_volume(v) for v in s.volumes],
+        service_account_name=s.service_account_name,
+    )
+
+
+def _copy_pod_status(st: PodStatus) -> PodStatus:
+    return PodStatus(
+        phase=st.phase,
+        conditions=[
+            PodCondition(
+                c.type, c.status, c.reason, c.message, c.last_transition_time
+            )
+            for c in st.conditions
+        ],
+        nominated_node_name=st.nominated_node_name,
+        reason=st.reason,
+        message=st.message,
+        start_time=st.start_time,
+    )
 
 
 def compute_pod_resource_request(
@@ -455,7 +558,40 @@ class Node:
     kind: str = "Node"
 
     def deep_copy(self) -> "Node":
-        return copy.deepcopy(self)
+        return Node(
+            metadata=_copy_meta(self.metadata),
+            spec=NodeSpec(
+                unschedulable=self.spec.unschedulable,
+                taints=list(self.spec.taints),  # items frozen
+                pod_cidr=self.spec.pod_cidr,
+                provider_id=self.spec.provider_id,
+            ),
+            status=NodeStatus(
+                capacity=dict(self.status.capacity),
+                allocatable=dict(self.status.allocatable),
+                conditions=[
+                    NodeCondition(
+                        c.type,
+                        c.status,
+                        c.reason,
+                        c.message,
+                        c.last_heartbeat_time,
+                        c.last_transition_time,
+                    )
+                    for c in self.status.conditions
+                ],
+                images=[
+                    ContainerImage(list(im.names), im.size_bytes)
+                    for im in self.status.images
+                ],
+                addresses=list(self.status.addresses),
+                node_info=dict(self.status.node_info),
+            ),
+            kind=self.kind,
+        )
+
+    def __deepcopy__(self, memo) -> "Node":
+        return self.deep_copy()
 
     def allocatable(self) -> ResourceList:
         src = self.status.allocatable or self.status.capacity

@@ -16,6 +16,7 @@ Everything is normalised at parse time into the units the device kernels use:
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 from typing import Dict, Mapping, Union
 
 # Canonical resource names (reference: v1.ResourceCPU etc.)
@@ -69,6 +70,13 @@ def parse_quantity(q: Quantity) -> float:
     """
     if isinstance(q, (int, float)):
         return float(q)
+    return _parse_quantity_str(q)
+
+
+@lru_cache(maxsize=4096)
+def _parse_quantity_str(q: str) -> float:
+    # quantity strings repeat endlessly ("500m", "1Gi", ...) across pod
+    # events — memoized because this sits under every resource computation
     s = q.strip()
     m = _QTY_RE.match(s)
     if not m:

@@ -169,6 +169,17 @@ class APIServer:
             ]
             return objs, self._rv
 
+    def count(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> int:
+        """Copy-free count over stored objects. The predicate runs under the
+        store lock against live objects and MUST NOT mutate or retain them —
+        it exists because a poll loop doing list() deep-copies the world per
+        tick (observed: harness polling dominated a 5k-node benchmark)."""
+        with self._lock:
+            store = self._objects.get(kind, {})
+            if predicate is None:
+                return len(store)
+            return sum(1 for o in store.values() if predicate(o))
+
     # -- watch --------------------------------------------------------------
 
     def watch(self, kind: str, from_version: int = 0) -> Watcher:

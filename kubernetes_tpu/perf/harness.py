@@ -243,8 +243,7 @@ def run_latency_benchmark(
 
 
 def _count_scheduled(server: APIServer) -> int:
-    pods, _ = server.list("pods")
-    return sum(1 for p in pods if p.spec.node_name)
+    return server.count("pods", lambda p: bool(p.spec.node_name))
 
 
 def _wait_all_scheduled(server: APIServer, count: int, timeout_s: float) -> None:

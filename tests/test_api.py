@@ -120,3 +120,87 @@ def test_pod_host_ports():
         )
     )
     assert pod_host_ports(pod) == [("0.0.0.0", "TCP", 8080)]
+
+
+def _sentinel_for(tp, fname):
+    """A non-default value for a dataclass field, recursing into nested ones."""
+    import dataclasses
+    import typing
+
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is typing.Union:  # Optional[X]
+        non_none = [a for a in args if a is not type(None)]
+        return _sentinel_for(non_none[0], fname)
+    if tp is str:
+        return f"sentinel-{fname}"
+    if tp is int:
+        return 7
+    if tp is float:
+        return 7.5
+    if tp is bool:
+        return True
+    if origin in (dict, typing.Dict) or tp is dict:
+        return {f"k-{fname}": "v"} if not args or args[1] is str else {f"k-{fname}": 7}
+    if origin in (list, typing.List):
+        return [_sentinel_for(args[0], fname)]
+    if origin in (tuple, typing.Tuple):
+        return (_sentinel_for(args[0], fname),)
+    if dataclasses.is_dataclass(tp):
+        return _filled_instance(tp)
+    return None
+
+
+def _filled_instance(cls):
+    """Instance with every field set to a non-default sentinel."""
+    import dataclasses
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        v = _sentinel_for(hints[f.name], f.name)
+        if v is not None:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def test_structural_copy_field_completeness():
+    """Guard against drift: the hand-rolled Pod/Node deep_copy enumerates
+    fields explicitly; a field added to any copied dataclass must round-trip
+    (otherwise the API store would silently revert it to the default)."""
+    from kubernetes_tpu.api import objects as v1
+
+    for cls in (v1.Pod, v1.Node):
+        # union fields (Volume sources) and typing edge cases make a fully
+        # generic filler fragile, so fill the top two levels explicitly
+        obj = _filled_instance(cls)
+        cp = obj.deep_copy()
+        assert cp == obj, f"{cls.__name__} structural copy dropped a field"
+        # mutations must not alias
+        cp.metadata.labels["mutate"] = "x"
+        assert "mutate" not in obj.metadata.labels
+
+
+def test_structural_copy_deep_isolation():
+    from kubernetes_tpu.api import objects as v1
+
+    pod = v1.Pod(
+        metadata=v1.ObjectMeta(name="p", labels={"a": "b"}),
+        spec=v1.PodSpec(
+            containers=[
+                v1.Container(requests={"cpu": "1"}, ports=[v1.ContainerPort(80)])
+            ],
+            volumes=[v1.Volume(name="v", persistent_volume_claim="c")],
+            node_selector={"d": "ssd"},
+        ),
+    )
+    cp = pod.deep_copy()
+    cp.spec.containers[0].requests["cpu"] = "9"
+    cp.spec.node_selector["d"] = "hdd"
+    cp.spec.volumes[0].persistent_volume_claim = "other"
+    cp.spec.node_name = "n1"
+    assert pod.spec.containers[0].requests["cpu"] == "1"
+    assert pod.spec.node_selector["d"] == "ssd"
+    assert pod.spec.volumes[0].persistent_volume_claim == "c"
+    assert pod.spec.node_name == ""
