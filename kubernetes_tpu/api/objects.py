@@ -350,6 +350,8 @@ class PodStatus:
     reason: str = ""
     message: str = ""
     start_time: Optional[float] = None
+    pod_ip: str = ""  # set by the node agent once the sandbox is up
+    host_ip: str = ""
 
 
 @dataclass
@@ -465,6 +467,8 @@ def _copy_pod_status(st: PodStatus) -> PodStatus:
         reason=st.reason,
         message=st.message,
         start_time=st.start_time,
+        pod_ip=st.pod_ip,
+        host_ip=st.host_ip,
     )
 
 
@@ -778,4 +782,226 @@ class Service:
     kind: str = "Service"
 
     def deep_copy(self) -> "Service":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Workload controllers: Deployment / Job / DaemonSet / StatefulSet
+# (apps/v1 + batch/v1 subset; reference staging/src/k8s.io/api/apps/v1 and
+# batch/v1 types.go — the fields the controllers in pkg/controller consume)
+# ---------------------------------------------------------------------------
+
+ROLLING_UPDATE = "RollingUpdate"
+RECREATE = "Recreate"
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = ROLLING_UPDATE
+    max_surge: int = 1  # absolute counts (reference also allows percentages)
+    max_unavailable: int = 0
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)  # matchLabels
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    revision_history_limit: int = 10
+    paused: bool = False
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+    kind: str = "Deployment"
+
+    def deep_copy(self) -> "Deployment":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class JobSpec:
+    parallelism: int = 1
+    completions: Optional[int] = None  # None => any single success completes
+    backoff_limit: int = 6
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    active_deadline_seconds: Optional[int] = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    conditions: List[PodCondition] = field(default_factory=list)  # Complete/Failed
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+    kind: str = "Job"
+
+    def deep_copy(self) -> "Job":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    desired_number_scheduled: int = 0
+    number_ready: int = 0
+    number_misscheduled: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+    kind: str = "DaemonSet"
+
+    def deep_copy(self) -> "DaemonSet":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    service_name: str = ""
+    pod_management_policy: str = "OrderedReady"  # or Parallel
+
+
+@dataclass
+class StatefulSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    current_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class StatefulSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+    kind: str = "StatefulSet"
+
+    def deep_copy(self) -> "StatefulSet":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget (policy/v1beta1) — consumed by the disruption
+# controller and the scheduler's preemption PDB accounting
+# (reference pkg/controller/disruption/disruption.go,
+# pkg/scheduler/core/generic_scheduler.go:940 selectVictimsOnNode)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    # exactly one of min_available / max_unavailable set (absolute counts;
+    # the reference also allows percentages — intentional simplification)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+    selector: Dict[str, str] = field(default_factory=dict)  # matchLabels
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus
+    )
+    kind: str = "PodDisruptionBudget"
+
+    def deep_copy(self) -> "PodDisruptionBudget":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Endpoints (core/v1) — maintained by the endpoints controller, consumed by
+# the proxy dataplane (reference pkg/controller/endpoint, pkg/proxy)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_pod: str = ""  # namespace/name of backing pod
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+    kind: str = "Endpoints"
+
+    def deep_copy(self) -> "Endpoints":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# PriorityClass (scheduling.k8s.io/v1) — admission resolves
+# priority_class_name -> spec.priority (reference
+# plugin/pkg/admission/priority/admission.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"  # or Never
+    description: str = ""
+    kind: str = "PriorityClass"
+
+    def deep_copy(self) -> "PriorityClass":
         return copy.deepcopy(self)

@@ -111,3 +111,11 @@ def labels_match_selector(
     if selector is None:
         return False
     return selector.matches(labels)
+
+
+def match_labels(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool:
+    """matchLabels subset semantics (labels.SelectorFromSet): EMPTY selector
+    matches EVERYTHING — metav1.LabelSelector{} selects all pods, the
+    convention PDBs and controllers rely on. Shared by the controllers and
+    the preemptor so budget accounting and victim filtering can't diverge."""
+    return all(labels.get(k) == val for k, val in selector.items())

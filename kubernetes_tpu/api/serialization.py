@@ -30,6 +30,13 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "bindings": v1.Binding,
     "namespaces": v1.Namespace,
     "replicasets": v1.ReplicaSet,
+    "deployments": v1.Deployment,
+    "jobs": v1.Job,
+    "daemonsets": v1.DaemonSet,
+    "statefulsets": v1.StatefulSet,
+    "poddisruptionbudgets": v1.PodDisruptionBudget,
+    "endpoints": v1.Endpoints,
+    "priorityclasses": v1.PriorityClass,
 }
 
 KIND_TO_RESOURCE = {

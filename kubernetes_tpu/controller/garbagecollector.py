@@ -20,6 +20,10 @@ _KIND_RESOURCES = {
     "ReplicaSet": "replicasets",
     "Pod": "pods",
     "Service": "services",
+    "Deployment": "deployments",
+    "Job": "jobs",
+    "DaemonSet": "daemonsets",
+    "StatefulSet": "statefulsets",
 }
 _DEPENDENT_RESOURCES = ("pods", "replicasets")
 

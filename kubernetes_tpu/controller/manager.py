@@ -12,15 +12,28 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .daemonset import DaemonSetController
+from .deployment import DeploymentController
+from .disruption import DisruptionController
+from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
+from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 
 logger = logging.getLogger("kubernetes_tpu.controller.manager")
 
+# reference list: cmd/kube-controller-manager/app/controllermanager.go:372-414
 CONTROLLER_INITIALIZERS = {
     "replicaset": ReplicaSetController,
+    "deployment": DeploymentController,
+    "job": JobController,
+    "daemonset": DaemonSetController,
+    "statefulset": StatefulSetController,
+    "endpoints": EndpointsController,
+    "disruption": DisruptionController,
     "nodelifecycle": NodeLifecycleController,
     "garbagecollector": GarbageCollector,
     "namespace": NamespaceController,

@@ -23,6 +23,23 @@ from ..client.leaderelection import Lease
 
 NODE_LEASE_NS = "kube-node-lease"
 
+_ip_lock = threading.Lock()
+_ip_by_seed: Dict[str, str] = {}
+
+
+def _fake_pod_ip(seed: str) -> str:
+    """Deterministic, collision-free fake IP per seed (uid/node name): a
+    process-wide counter mapped into 10.0.0.0/8 — collision-free up to ~16M
+    allocations, stable for the process lifetime (unlike hash(), which is
+    PYTHONHASHSEED-randomized and birthday-collides at kubemark scale)."""
+    with _ip_lock:
+        ip = _ip_by_seed.get(seed)
+        if ip is None:
+            n = len(_ip_by_seed)
+            ip = f"10.{(n // (254 * 256)) % 256}.{(n // 254) % 256}.{n % 254 + 1}"
+            _ip_by_seed[seed] = ip
+        return ip
+
 
 def make_hollow_node(
     name: str,
@@ -160,6 +177,10 @@ class HollowCluster:
                 return None
             p.status.phase = v1.POD_RUNNING
             p.status.start_time = time.time()
+            # fake sandbox IP (the real kubelet reports the CNI-assigned IP;
+            # endpoints controller needs one to publish an address)
+            p.status.pod_ip = _fake_pod_ip(p.metadata.uid)
+            p.status.host_ip = _fake_pod_ip(p.spec.node_name)
             return p
 
         try:

@@ -127,6 +127,7 @@ class EncodingConfig:
     k_cap: int = 32  # label keys
     v_cap: int = 256  # label values (also topology-domain segment count)
     r_cap: int = 6  # resource columns (4 base + extended)
+    pb_cap: int = 8  # priority bands (distinct pod priorities; preempt what-if)
     s_cap: int = 8  # interned pod-predicates (sel_counts columns)
     t_cap: int = 8  # interned eterms
     pv_cap: int = 8  # interned (proto, port) host-port slots
@@ -231,6 +232,11 @@ class DeviceSnapshot(NamedTuple):
     port_counts: Any  # [N, PV] int32 host-port usage counts
     image_bytes: Any  # [N, I] float32 image size if present else 0
     avoid: Any  # [N, AV] bool node-avoids-controller flags
+    # priority-banded requested resources: the preemption what-if kernel
+    # reads "how much could be freed by evicting pods below priority p" as
+    # a masked band sum (SURVEY §7.6 batched masked what-if)
+    prio_req: Any  # [N, PB, R] int32 requested by pods in priority band b
+    band_prio: Any  # [PB] int32 priority of band b (I32_MAX = empty band)
 
 
 class PodBatch(NamedTuple):
@@ -308,6 +314,7 @@ class _PodEntry:
     port_ids: List[int]
     match_cache_len: int  # sids evaluated so far (== len(sel vocab) at update)
     match_vec: np.ndarray  # [<=S] bool
+    prio_band: int = 0  # priority band this pod's requests landed in
 
 
 class SnapshotEncoder:
@@ -363,6 +370,8 @@ class SnapshotEncoder:
         self.m_port_counts = np.zeros((n, c.pv_cap), np.int32)
         self.m_image_bytes = np.zeros((n, c.im_cap), np.float32)
         self.m_avoid = np.zeros((n, c.av_cap), np.bool_)
+        self.m_prio_req = np.zeros((n, c.pb_cap, c.r_cap), np.int32)
+        self.m_band_prio = np.full(c.pb_cap, I32_MAX, np.int32)
 
     def _grow(self, **caps: int) -> None:
         """Grow one or more capacities; copies masters, forces full upload."""
@@ -384,6 +393,8 @@ class SnapshotEncoder:
             "m_port_counts": self.m_port_counts,
             "m_image_bytes": self.m_image_bytes,
             "m_avoid": self.m_avoid,
+            "m_prio_req": self.m_prio_req,
+            "m_band_prio": self.m_band_prio,
         }
         self.cfg = replace(self.cfg, **caps)
         self._alloc_masters()
@@ -476,6 +487,35 @@ class SnapshotEncoder:
         i = self.avoid_vocab.intern(ref)
         self._ensure_cap("av_cap", len(self.avoid_vocab))
         return i
+
+    def _band_of(self, priority: int) -> int:
+        """Priority band index. Distinct priorities get their own band; once
+        bands are exhausted, fall back to the band with the largest priority
+        <= the pod's (else the lowest band). The fallback overstates what a
+        higher-priority preemptor could free — the what-if mask must stay
+        OPTIMISTIC (no false negatives vs the host reprieve loop, which does
+        the exact check on surviving candidates)."""
+        bands = self.m_band_prio
+        exact = np.nonzero(bands == priority)[0]
+        if exact.size:
+            return int(exact[0])
+        empty = np.nonzero(bands == I32_MAX)[0]
+        if empty.size:
+            b = int(empty[0])
+            bands[b] = priority
+            self.generation += 1
+            return b
+        lower = np.nonzero(bands <= priority)[0]
+        if lower.size:
+            return int(lower[np.argmax(bands[lower])])
+        # every band sits above this pod: adopt the lowest band and relabel
+        # it DOWN to this priority. Lowering a band's label is optimistic for
+        # the band's existing pods (they appear removable to lower-priority
+        # preemptors), never pessimistic — the invariant holds.
+        b = int(np.argmin(bands))
+        bands[b] = priority
+        self.generation += 1
+        return b
 
     # -- resource encoding ---------------------------------------------------
 
@@ -576,6 +616,7 @@ class SnapshotEncoder:
         self.m_req[row, :] = 0
         self.m_nonzero[row, :] = 0
         self.m_port_counts[row, :] = 0
+        self.m_prio_req[row, :, :] = 0
         self._dirty_rows.add(row)
         self.generation += 1
 
@@ -630,6 +671,7 @@ class SnapshotEncoder:
         nz[RES_PODS] = 1
         eids, ews = self._pod_eterms(pod)
         pids = [self.intern_port(proto, port) for (_, proto, port) in pod_host_ports(pod)]
+        band = self._band_of(pod.priority)
         entry = _PodEntry(
             namespace=pod.metadata.namespace,
             labels=dict(pod.metadata.labels),
@@ -640,10 +682,12 @@ class SnapshotEncoder:
             port_ids=pids,
             match_cache_len=len(self.sel_vocab),
             match_vec=self._match_vec(pod.metadata.namespace, pod.metadata.labels),
+            prio_band=band,
         )
         self._pods[row][pod.metadata.key] = entry
         self.m_req[row, : len(req)] += req
         self.m_nonzero[row, : len(nz)] += nz
+        self.m_prio_req[row, band, : len(req)] += req
         for i, mv in enumerate(entry.match_vec):
             if mv:
                 self.m_sel_counts[row, i] += 1
@@ -665,6 +709,7 @@ class SnapshotEncoder:
         z = zpad(entry.nonzero, self.cfg.r_cap)
         self.m_req[row, :] -= r
         self.m_nonzero[row, :] -= z
+        self.m_prio_req[row, entry.prio_band, :] -= r
         for i, mv in enumerate(entry.match_vec):
             if mv:
                 self.m_sel_counts[row, i] -= 1
@@ -707,6 +752,8 @@ class SnapshotEncoder:
             port_counts=self.m_port_counts,
             image_bytes=self.m_image_bytes,
             avoid=self.m_avoid,
+            prio_req=self.m_prio_req,
+            band_prio=self.m_band_prio,
         )
 
     def flush(self) -> DeviceSnapshot:
@@ -766,7 +813,7 @@ class SnapshotEncoder:
 
 # Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata
 # columns, replaced wholesale on flush instead of row-scattered).
-_GLOBAL_FIELDS = frozenset({"eterm_topo_key", "eterm_kind"})
+_GLOBAL_FIELDS = frozenset({"eterm_topo_key", "eterm_kind", "band_prio"})
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

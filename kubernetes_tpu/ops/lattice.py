@@ -265,6 +265,30 @@ def _gather_counts(counts, extra, sid):
     return jnp.where(sid >= 0, c.astype(jnp.float32), 0.0)
 
 
+def _pod_static(snap: DeviceSnapshot, bp) -> Tuple:
+    """Stage A for one pod: static mask/score pieces. Returns
+    (static_ok, ns_aff_mask, aff_score, prefer_cnt, img, avoid). Shared by
+    the schedule kernel and the preemption what-if kernel (static_ok is
+    exactly the UnschedulableAndUnresolvable boundary: nodes failing it
+    cannot be helped by evictions, generic_scheduler.go:1033)."""
+    n = snap.valid.shape[0]
+    rows = jnp.arange(n)
+    ns_aff = _node_affinity_required(snap, bp)
+    taint_ok, prefer_cnt = _taints(snap, bp)
+    unsched_ok = ~snap.unschedulable | bp.tolerates_unschedulable
+    name_ok = jnp.where(
+        bp.node_name_row == -1,
+        True,
+        jnp.where(bp.node_name_row < 0, False, rows == bp.node_name_row),
+    )
+    static_ok = snap.valid & ns_aff & taint_ok & unsched_ok & name_ok
+    # Scores computed regardless of feasibility; normalization masks later.
+    aff_score = _node_affinity_score(snap, bp)
+    img = _image_locality(snap, bp)
+    avoid = _prefer_avoid(snap, bp)
+    return static_ok, ns_aff, aff_score, prefer_cnt, img, avoid
+
+
 @functools.lru_cache(maxsize=32)
 def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
     """Build the (unjitted) batch kernel for a given domain-segment capacity.
@@ -272,25 +296,7 @@ def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
     Cached per (v_cap, weight); jitted by make_schedule_batch (single device)
     or parallel.sharded.make_sharded_schedule_batch (mesh)."""
 
-    def pod_static(snap: DeviceSnapshot, bp) -> Tuple:
-        """Stage A for one pod: static mask/score pieces. Returns
-        (static_ok, unresolvable_ok, ns_aff_mask, static_scores [4, N])."""
-        n = snap.valid.shape[0]
-        rows = jnp.arange(n)
-        ns_aff = _node_affinity_required(snap, bp)
-        taint_ok, prefer_cnt = _taints(snap, bp)
-        unsched_ok = ~snap.unschedulable | bp.tolerates_unschedulable
-        name_ok = jnp.where(
-            bp.node_name_row == -1,
-            True,
-            jnp.where(bp.node_name_row < 0, False, rows == bp.node_name_row),
-        )
-        static_ok = snap.valid & ns_aff & taint_ok & unsched_ok & name_ok
-        # Scores computed regardless of feasibility; normalization masks later.
-        aff_score = _node_affinity_score(snap, bp)
-        img = _image_locality(snap, bp)
-        avoid = _prefer_avoid(snap, bp)
-        return static_ok, ns_aff, aff_score, prefer_cnt, img, avoid
+    pod_static = _pod_static
 
     def step(snap: DeviceSnapshot, carry, xs, weights, rng):
         (req_x, nz_x, sel_x, et_x, port_x) = carry
@@ -496,3 +502,45 @@ def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
 def make_schedule_batch(v_cap: int, hard_pod_affinity_weight: float = 1.0):
     """Single-device jitted batch kernel (cached per capacity)."""
     return jax.jit(make_schedule_batch_raw(v_cap, hard_pod_affinity_weight))
+
+
+def _preempt_whatif(
+    snap: DeviceSnapshot, batch: PodBatch, priority: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched masked preemption what-if (SURVEY §7.6): for every (pod, node)
+    pair, would the pod fit if all pods of lower priority were evicted?
+
+    Replaces the serial per-node host scan of selectVictimsOnNode
+    (generic_scheduler.go:850-877 parallel what-if) with one device pass.
+    The mask is OPTIMISTIC: it accounts resources (via the priority-banded
+    requested matrix) and the static UnschedulableAndUnresolvable filters,
+    but not affinity/spread constraints contributed by would-be victims —
+    the host reprieve loop does the exact plugin re-check on the (few)
+    surviving candidates, so false positives cost time, never correctness.
+    """
+    statics = jax.vmap(lambda bp: _pod_static(snap, bp))(batch)
+    static_ok = statics[0]  # [P, N]
+
+    # removable[p, n, r] = Σ_b [band_prio[b] < prio_p] · prio_req[n, b, r]
+    # (priority passed separately: template batches carry per-pod priority
+    # outside the template tensors)
+    removable_band = snap.band_prio[None, :] < priority[:, None]  # [P, B]
+    removable = jnp.einsum(
+        "pb,nbr->pnr",
+        removable_band.astype(jnp.int32),
+        snap.prio_req,
+    )
+    free = (
+        snap.allocatable[None, :, :]
+        - snap.requested[None, :, :]
+        + removable
+    )  # [P, N, R]
+    req = batch.req[:, None, :]  # [P, 1, R]
+    fits = jnp.all((req == 0) | (req <= free), axis=-1)  # [P, N]
+    # a node already holding >= 1 lower-priority pod is the only kind where
+    # eviction helps; removable pods count shows as the PODS column
+    has_victims = jnp.any(removable > 0, axis=-1)
+    return static_ok & fits & has_victims & batch.valid[:, None]
+
+
+preempt_whatif = jax.jit(_preempt_whatif)

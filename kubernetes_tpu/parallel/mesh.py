@@ -60,4 +60,6 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         port_counts=row2,
         image_bytes=row2,
         avoid=row2,
+        prio_req=row3,
+        band_prio=rep,
     )

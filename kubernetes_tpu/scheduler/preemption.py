@@ -3,12 +3,15 @@
 Mirrors reference generic_scheduler.go Preempt(:270):
 nodesWherePreemptionMightHelp(:1033) — candidates are nodes whose failure was
 NOT UnschedulableAndUnresolvable (the device lattice returns this directly as
-the `resolvable` mask) → selectVictimsOnNode(:940) — remove lower-priority
-pods, re-filter, then reprieve victims highest-priority-first →
-pickOneNodeForPreemption(:721) — lexicographic tie-break.
+the `resolvable` mask, further narrowed by the batched device what-if,
+ops/lattice.py preempt_whatif) → selectVictimsOnNode(:940) — remove
+lower-priority pods, re-filter, then reprieve victims (PDB-violating ones
+first, then by priority) → pickOneNodeForPreemption(:721) — lexicographic
+tie-break whose first criterion is fewest PDB violations.
 
-PDB (PodDisruptionBudget) violation counting is wired but budget-less until
-the disruption controller lands; the criteria order is preserved.
+PDB budgets come from the disruption controller's published
+status.disruptions_allowed (controller/disruption.py), matching
+filterPodsWithPDBViolation (generic_scheduler.go:1089).
 """
 
 from __future__ import annotations
@@ -20,6 +23,37 @@ from .cache.nodeinfo import NodeInfo, Snapshot
 from .core import FitError
 from .framework.interface import Code, CycleState, Status, is_success
 from .framework.runtime import Framework
+
+
+from ..api.selectors import match_labels as _match_labels
+
+
+def filter_pods_with_pdb_violation(
+    pods: List[v1.Pod], pdbs: List[v1.PodDisruptionBudget]
+) -> Tuple[List[v1.Pod], List[v1.Pod]]:
+    """Split candidate victims into (violating, non_violating): a pod
+    violates if evicting it would push any matching PDB past its
+    disruptionsAllowed (budget consumed in list order, like the reference's
+    per-PDB countdown, generic_scheduler.go:1089)."""
+    budget = {
+        id(pdb): pdb.status.disruptions_allowed for pdb in pdbs
+    }
+    violating: List[v1.Pod] = []
+    non_violating: List[v1.Pod] = []
+    for pod in pods:
+        matched = [
+            pdb
+            for pdb in pdbs
+            if pdb.metadata.namespace == pod.metadata.namespace
+            and _match_labels(pdb.spec.selector, pod.metadata.labels)
+        ]
+        if any(budget[id(pdb)] <= 0 for pdb in matched):
+            violating.append(pod)
+        else:
+            for pdb in matched:
+                budget[id(pdb)] -= 1
+            non_violating.append(pod)
+    return violating, non_violating
 
 
 class Preemptor:
@@ -45,14 +79,16 @@ class Preemptor:
             return "", []
         if candidate_nodes is None:
             candidate_nodes = self._nodes_where_preemption_might_help(fit_error, snapshot)
+        pdbs = list(self._pdbs()) if self._pdbs is not None else []
         victims_by_node: Dict[str, List[v1.Pod]] = {}
+        violations_by_node: Dict[str, int] = {}
         for name in candidate_nodes:
             ni = snapshot.get(name)
             if ni is None or ni.node is None:
                 continue
-            victims = self._select_victims_on_node(pod, ni)
-            if victims is not None:
-                victims_by_node[name] = victims
+            result = self._select_victims_on_node(pod, ni, pdbs)
+            if result is not None:
+                victims_by_node[name], violations_by_node[name] = result
         if not victims_by_node:
             return "", []
         victims_by_node = self._process_preemption_with_extenders(
@@ -60,7 +96,9 @@ class Preemptor:
         )
         if not victims_by_node:
             return "", []
-        node = pick_one_node_for_preemption(victims_by_node, snapshot)
+        node = pick_one_node_for_preemption(
+            victims_by_node, snapshot, violations_by_node
+        )
         return node, victims_by_node.get(node, [])
 
     def _process_preemption_with_extenders(
@@ -102,10 +140,12 @@ class Preemptor:
         return out
 
     def _select_victims_on_node(
-        self, pod: v1.Pod, ni: NodeInfo
-    ) -> Optional[List[v1.Pod]]:
+        self, pod: v1.Pod, ni: NodeInfo, pdbs: List[v1.PodDisruptionBudget]
+    ) -> Optional[Tuple[List[v1.Pod], int]]:
         """selectVictimsOnNode(:940): remove all lower-priority pods; if the
-        pod then fits, reprieve victims in highest-priority-first order."""
+        pod then fits, reprieve victims — PDB-violating candidates first so
+        budgeted pods survive when possible, then highest-priority-first.
+        Returns (victims, numPDBViolations)."""
         node_copy = ni.clone()
         state = CycleState()
         st = self.framework.run_pre_filter_plugins(state, pod)
@@ -121,21 +161,32 @@ class Preemptor:
             )
         if not is_success(self.framework.run_filter_plugins(state, pod, node_copy)):
             return None
-        victims: List[v1.Pod] = []
-        # reprieve highest-priority (then earliest-start) victims first
-        potential.sort(key=lambda p: (-p.priority, p.status.start_time or 0))
-        for victim in potential:
+
+        def reprieve(victim: v1.Pod) -> bool:
             node_copy.add_pod(victim)
-            self.framework.run_pre_filter_extension_add_pod(state, pod, victim, node_copy)
-            if not is_success(
-                self.framework.run_filter_plugins(state, pod, node_copy)
-            ):
-                node_copy.remove_pod(victim.metadata.key)
-                self.framework.run_pre_filter_extension_remove_pod(
-                    state, pod, victim, node_copy
-                )
+            self.framework.run_pre_filter_extension_add_pod(
+                state, pod, victim, node_copy
+            )
+            if is_success(self.framework.run_filter_plugins(state, pod, node_copy)):
+                return True
+            node_copy.remove_pod(victim.metadata.key)
+            self.framework.run_pre_filter_extension_remove_pod(
+                state, pod, victim, node_copy
+            )
+            return False
+
+        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+        by_prio = lambda p: (-p.priority, p.status.start_time or 0)  # noqa: E731
+        victims: List[v1.Pod] = []
+        n_violations = 0
+        for victim in sorted(violating, key=by_prio):
+            if not reprieve(victim):
                 victims.append(victim)
-        return victims if victims else None
+                n_violations += 1
+        for victim in sorted(non_violating, key=by_prio):
+            if not reprieve(victim):
+                victims.append(victim)
+        return (victims, n_violations) if victims else None
 
 
 def pod_eligible_to_preempt_others(pod: v1.Pod, snapshot: Snapshot) -> bool:
@@ -153,22 +204,32 @@ def pod_eligible_to_preempt_others(pod: v1.Pod, snapshot: Snapshot) -> bool:
 
 
 def pick_one_node_for_preemption(
-    victims_by_node: Dict[str, List[v1.Pod]], snapshot: Snapshot
+    victims_by_node: Dict[str, List[v1.Pod]],
+    snapshot: Snapshot,
+    violations_by_node: Optional[Dict[str, int]] = None,
 ) -> str:
     """pickOneNodeForPreemption(:721) — lexicographic criteria:
-    1. fewest PDB violations (0 until PDBs land)
+    1. fewest PDB violations
     2. lowest maximum victim priority
     3. lowest sum of victim priorities
     4. fewest victims
     5. latest maximum start time among victims
     6. first in iteration order (reference: random among remainder)
     """
+    violations_by_node = violations_by_node or {}
+
     def key(name: str):
         victims = victims_by_node[name]
         max_prio = max((p.priority for p in victims), default=-(2**31))
         sum_prio = sum(p.priority for p in victims)
         starts = [p.status.start_time or 0.0 for p in victims]
         latest_start = max(starts, default=0.0)
-        return (0, max_prio, sum_prio, len(victims), -latest_start)
+        return (
+            violations_by_node.get(name, 0),
+            max_prio,
+            sum_prio,
+            len(victims),
+            -latest_start,
+        )
 
     return min(sorted(victims_by_node.keys()), key=key)

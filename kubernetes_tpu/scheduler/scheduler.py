@@ -146,8 +146,17 @@ class Scheduler:
             )
             for name, p in self.profiles.items()
         }
+        def list_pdbs():
+            try:
+                pdbs, _ = self.server.list("poddisruptionbudgets")
+                return pdbs
+            except Exception:
+                return []
+
         self._preemptors = {
-            name: Preemptor(p.framework, extenders=self.extenders)
+            name: Preemptor(
+                p.framework, pdb_lister=list_pdbs, extenders=self.extenders
+            )
             for name, p in self.profiles.items()
         }
         self._bind_pool = ThreadPoolExecutor(
@@ -313,7 +322,7 @@ class Scheduler:
         algo_dur = time.monotonic() - t_start
 
         fallback_pis: List[QueuedPodInfo] = []
-        failed: List = []  # (pi, resolvable_rows)
+        failed: List = []  # (pi, batch_index or -1)
         resolvable = None
         for i, pi in enumerate(pis):
             if eb.fallback[i]:
@@ -323,12 +332,11 @@ class Scheduler:
             if row < 0:
                 if resolvable is None:
                     resolvable = np.asarray(res.resolvable)
-                rows = np.nonzero(resolvable[i])[0]
-                failed.append((pi, [row_names[r] for r in rows if row_names[r]]))
+                failed.append((pi, i))
                 continue
             node_name = row_names[row]
             if node_name is None:
-                failed.append((pi, []))
+                failed.append((pi, -1))
                 continue
             metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
             self._assume_and_bind(pi, node_name, t_start)
@@ -336,13 +344,38 @@ class Scheduler:
             self._snapshot = self.cache.update_snapshot()
         for pi in fallback_pis:
             self._schedule_one_host(pi, moves0)
-        for pi, candidates in failed:
-            self._handle_failure(
-                pi,
-                moves0,
-                message=f"0/{self.cache.node_count} nodes are available",
-                candidate_nodes=candidates,
-            )
+        if failed:
+            # one batched device what-if narrows every failed pod's candidates
+            whatif = None
+            try:
+                from ..ops.lattice import preempt_whatif
+
+                with self.cache.lock:
+                    snap2 = self.cache.encoder.flush()
+                whatif = np.asarray(
+                    preempt_whatif(snap2, eb.batch, eb.batch.priority)
+                )
+            except Exception:
+                logger.exception("preempt what-if kernel failed")
+            for pi, i in failed:
+                # i < 0: decode anomaly (node vanished mid-cycle) — pass
+                # None so the preemptor does its own full scan
+                candidates: Optional[List[str]] = None
+                if i >= 0 and resolvable is not None:
+                    mask = resolvable[i]
+                    if whatif is not None:
+                        mask = mask & whatif[i]
+                    candidates = [
+                        row_names[r]
+                        for r in np.nonzero(mask)[0]
+                        if row_names[r]
+                    ]
+                self._handle_failure(
+                    pi,
+                    moves0,
+                    message=f"0/{self.cache.node_count} nodes are available",
+                    candidate_nodes=candidates,
+                )
 
     # -- wave device path -----------------------------------------------------
 
@@ -454,8 +487,19 @@ class Scheduler:
         if failed:
             resolvable_tpl = np.asarray(res.resolvable_tpl)
             pod_tpl = np.asarray(eb.batch.pod_tpl)
+            # batched masked what-if (one device call for ALL failed pods):
+            # per-template optimistic preemption mask, priority = max over
+            # the batch's pods of that template so the mask stays a superset
+            # for every pod; the host reprieve loop is the exact check
+            whatif_tpl = self._preempt_whatif_tpl(
+                eb, [(pi, i) for pi, i in failed], pod_tpl
+            )
             for pi, i in failed:
-                rows = np.nonzero(resolvable_tpl[pod_tpl[i]])[0]
+                t = int(pod_tpl[i])
+                rows_mask = resolvable_tpl[t]
+                if whatif_tpl is not None:
+                    rows_mask = rows_mask & whatif_tpl[t]
+                rows = np.nonzero(rows_mask)[0]
                 self._handle_failure(
                     pi,
                     moves0,
@@ -464,6 +508,24 @@ class Scheduler:
                         row_names[r] for r in rows if row_names[r]
                     ],
                 )
+
+    def _preempt_whatif_tpl(self, eb, failed: List, pod_tpl: np.ndarray):
+        """[TPL, N] optimistic preemption mask for the batch's templates
+        (ops/lattice.preempt_whatif), or None when unavailable."""
+        try:
+            from ..ops.lattice import preempt_whatif
+
+            prios = np.zeros(eb.batch.tpl.valid.shape[0], np.int32)
+            pod_prio = np.asarray(eb.batch.pod_prio)
+            for pi, i in failed:
+                t = int(pod_tpl[i])
+                prios[t] = max(prios[t], int(pod_prio[i]))
+            with self.cache.lock:
+                snap = self.cache.encoder.flush()
+            return np.asarray(preempt_whatif(snap, eb.batch.tpl, prios))
+        except Exception:
+            logger.exception("preempt what-if kernel failed; using resolvable only")
+            return None
 
     def _assume_and_bind_bulk(self, to_bind: List, t_start: float) -> None:
         """Assume + bind a whole wave of placements. When the profile has no
@@ -760,8 +822,11 @@ class Scheduler:
         if self._snapshot is None:
             self._snapshot = self.cache.update_snapshot()
         preemptor = self._preemptors[prof.name]
+        # candidate_nodes semantics: None = unknown (scan per fit_error /
+        # all nodes); a list — possibly empty — is the device what-if's
+        # narrowed candidate set and is authoritative (empty = hopeless)
         node, victims = preemptor.preempt(
-            pod, self._snapshot, fit_error, candidate_nodes or None
+            pod, self._snapshot, fit_error, candidate_nodes
         )
         if not node:
             return

@@ -207,6 +207,12 @@ def test_controller_manager_runs_all():
         assert mgr._started.wait(5)
         assert set(mgr.controllers) == {
             "replicaset",
+            "deployment",
+            "job",
+            "daemonset",
+            "statefulset",
+            "endpoints",
+            "disruption",
             "nodelifecycle",
             "garbagecollector",
             "namespace",

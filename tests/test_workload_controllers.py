@@ -1,0 +1,374 @@
+"""Workload controllers end-to-end: Deployment rolling update, Job
+completion/backoff, DaemonSet per-node placement, StatefulSet ordered
+rollout, Endpoints publication, PDB disruption accounting — driven with the
+real scheduler + hollow nodes (the reference's integration-test topology:
+real controllers, no real kubelets)."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.daemonset import DaemonSetController
+from kubernetes_tpu.controller.deployment import DeploymentController, template_hash
+from kubernetes_tpu.controller.disruption import DisruptionController
+from kubernetes_tpu.controller.endpoints import EndpointsController
+from kubernetes_tpu.controller.job import JobController
+from kubernetes_tpu.controller.replicaset import ReplicaSetController
+from kubernetes_tpu.controller.statefulset import StatefulSetController
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+
+def wait_until(fn, timeout=25.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def _template(labels, cpu="100m", image="app:v1"):
+    return v1.PodTemplateSpec(
+        metadata=v1.ObjectMeta(labels=dict(labels)),
+        spec=v1.PodSpec(
+            containers=[v1.Container(image=image, requests={"cpu": cpu})]
+        ),
+    )
+
+
+class _Cluster:
+    """Scheduler + hollow nodes + a chosen set of controllers."""
+
+    def __init__(self, num_nodes=4, controllers=()):
+        self.server = APIServer()
+        self.hollow = HollowCluster(self.server, num_nodes=num_nodes)
+        self.sched = Scheduler(self.server, KubeSchedulerConfiguration())
+        self.controllers = list(controllers)
+
+    def __enter__(self):
+        self.hollow.start()
+        self.sched.start()
+        for c in self.controllers:
+            c.start()
+        return self
+
+    def __exit__(self, *exc):
+        for c in self.controllers:
+            c.stop()
+        self.sched.stop()
+        self.hollow.stop()
+
+
+def test_deployment_rollout_and_rolling_update():
+    server = APIServer()
+    cl = _Cluster(num_nodes=4)
+    cl.controllers = [
+        DeploymentController(cl.server),
+        ReplicaSetController(cl.server),
+    ]
+    with cl:
+        server = cl.server
+        dep = v1.Deployment(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.DeploymentSpec(
+                replicas=3,
+                selector={"app": "web"},
+                template=_template({"app": "web"}),
+                strategy=v1.DeploymentStrategy(max_surge=1, max_unavailable=1),
+            ),
+        )
+        server.create("deployments", dep)
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in server.list("pods")[0]
+                if p.status.phase == "Running"
+            )
+            == 3
+        ), [(p.metadata.name, p.status.phase) for p in server.list("pods")[0]]
+        rss, _ = server.list("replicasets")
+        assert len(rss) == 1
+        h1 = template_hash(dep.spec.template)
+        assert rss[0].metadata.labels["pod-template-hash"] == h1
+
+        # rolling update: change the image
+        def bump(cur):
+            cur.spec.template.spec.containers[0].image = "app:v2"
+            return cur
+
+        server.guaranteed_update("deployments", "default", "web", bump)
+
+        def updated():
+            pods, _ = server.list("pods")
+            v2 = [
+                p
+                for p in pods
+                if p.status.phase == "Running"
+                and p.spec.containers[0].image == "app:v2"
+            ]
+            v1_pods = [
+                p for p in pods if p.spec.containers[0].image == "app:v1"
+            ]
+            return len(v2) == 3 and not v1_pods
+
+        assert wait_until(updated, timeout=30), [
+            (p.metadata.name, p.spec.containers[0].image, p.status.phase)
+            for p in server.list("pods")[0]
+        ]
+        dep2 = server.get("deployments", "default", "web")
+        assert wait_until(
+            lambda: server.get("deployments", "default", "web").status.ready_replicas
+            == 3
+        )
+        assert dep2.spec.replicas == 3
+
+
+def test_job_runs_to_completion():
+    server = APIServer()
+    ctrl = JobController(server)
+    ctrl.start()
+    try:
+        job = v1.Job(
+            metadata=v1.ObjectMeta(name="crunch"),
+            spec=v1.JobSpec(
+                parallelism=2,
+                completions=3,
+                template=_template({"job": "crunch"}),
+            ),
+        )
+        server.create("jobs", job)
+        assert wait_until(lambda: len(server.list("pods")[0]) == 2)
+
+        # succeed pods as they appear until the job completes
+        def succeed_all():
+            for p in server.list("pods")[0]:
+                if p.status.phase not in ("Succeeded", "Failed"):
+                    def fin(cur):
+                        cur.status.phase = "Succeeded"
+                        return cur
+
+                    server.guaranteed_update(
+                        "pods", p.metadata.namespace, p.metadata.name, fin
+                    )
+            j = server.get("jobs", "default", "crunch")
+            return any(
+                c.type == "Complete" and c.status == "True"
+                for c in j.status.conditions
+            )
+
+        assert wait_until(succeed_all, timeout=20)
+        j = server.get("jobs", "default", "crunch")
+        assert j.status.succeeded == 3
+        assert j.status.completion_time is not None
+    finally:
+        ctrl.stop()
+
+
+def test_job_backoff_limit_fails_job():
+    server = APIServer()
+    ctrl = JobController(server)
+    ctrl.start()
+    try:
+        job = v1.Job(
+            metadata=v1.ObjectMeta(name="flaky"),
+            spec=v1.JobSpec(
+                parallelism=1,
+                completions=1,
+                backoff_limit=1,
+                template=_template({"job": "flaky"}),
+            ),
+        )
+        server.create("jobs", job)
+
+        def fail_active():
+            for p in server.list("pods")[0]:
+                if p.status.phase not in ("Succeeded", "Failed"):
+                    def fin(cur):
+                        cur.status.phase = "Failed"
+                        return cur
+
+                    server.guaranteed_update(
+                        "pods", p.metadata.namespace, p.metadata.name, fin
+                    )
+            j = server.get("jobs", "default", "flaky")
+            return any(
+                c.type == "Failed" and c.status == "True"
+                for c in j.status.conditions
+            )
+
+        assert wait_until(fail_active, timeout=20)
+    finally:
+        ctrl.stop()
+
+
+def test_daemonset_places_one_pod_per_eligible_node():
+    cl = _Cluster(num_nodes=3)
+    cl.controllers = [DaemonSetController(cl.server)]
+    with cl:
+        server = cl.server
+        # taint one node; the DS template has no toleration for it
+        def taint(cur):
+            cur.spec.taints = [v1.Taint("dedicated", "infra", "NoSchedule")]
+            return cur
+
+        server.guaranteed_update("nodes", "", "hollow-node-2", taint)
+        ds = v1.DaemonSet(
+            metadata=v1.ObjectMeta(name="agent"),
+            spec=v1.DaemonSetSpec(
+                selector={"app": "agent"},
+                template=_template({"app": "agent"}, cpu="10m"),
+            ),
+        )
+        server.create("daemonsets", ds)
+
+        def placed():
+            pods, _ = server.list("pods")
+            nodes = {p.spec.node_name for p in pods if p.spec.node_name}
+            return len(pods) == 2 and nodes == {
+                "hollow-node-0",
+                "hollow-node-1",
+            }
+
+        assert wait_until(placed), [
+            (p.metadata.name, p.spec.node_name)
+            for p in server.list("pods")[0]
+        ]
+        # a new node grows the daemon set
+        cl.hollow.add_node("hollow-node-3")
+        assert wait_until(
+            lambda: any(
+                p.spec.node_name == "hollow-node-3"
+                for p in server.list("pods")[0]
+            )
+        )
+        st = server.get("daemonsets", "default", "agent")
+        assert wait_until(
+            lambda: server.get(
+                "daemonsets", "default", "agent"
+            ).status.desired_number_scheduled
+            == 3
+        )
+
+
+def test_statefulset_ordered_rollout_and_scale_down():
+    cl = _Cluster(num_nodes=3)
+    cl.controllers = [StatefulSetController(cl.server)]
+    with cl:
+        server = cl.server
+        st = v1.StatefulSet(
+            metadata=v1.ObjectMeta(name="db"),
+            spec=v1.StatefulSetSpec(
+                replicas=3,
+                selector={"app": "db"},
+                template=_template({"app": "db"}),
+                service_name="db",
+            ),
+        )
+        server.create("statefulsets", st)
+        assert wait_until(
+            lambda: sorted(
+                p.metadata.name
+                for p in server.list("pods")[0]
+                if p.status.phase == "Running"
+            )
+            == ["db-0", "db-1", "db-2"],
+            timeout=30,
+        ), [
+            (p.metadata.name, p.status.phase)
+            for p in server.list("pods")[0]
+        ]
+
+        def shrink(cur):
+            cur.spec.replicas = 1
+            return cur
+
+        server.guaranteed_update("statefulsets", "default", "db", shrink)
+        assert wait_until(
+            lambda: sorted(
+                p.metadata.name for p in server.list("pods")[0]
+            )
+            == ["db-0"],
+            timeout=30,
+        )
+
+
+def test_endpoints_publishes_ready_pod_addresses():
+    cl = _Cluster(num_nodes=2)
+    cl.controllers = [
+        EndpointsController(cl.server),
+        ReplicaSetController(cl.server),
+    ]
+    with cl:
+        server = cl.server
+        server.create(
+            "services",
+            v1.Service(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.ServiceSpec(
+                    selector={"app": "web"}, ports=[("http", 80)]
+                ),
+            ),
+        )
+        rs = v1.ReplicaSet(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.ReplicaSetSpec(
+                replicas=2,
+                selector={"app": "web"},
+                template=_template({"app": "web"}),
+            ),
+        )
+        server.create("replicasets", rs)
+
+        def published():
+            try:
+                ep = server.get("endpoints", "default", "web")
+            except KeyError:
+                return False
+            return (
+                len(ep.subsets) == 1
+                and len(ep.subsets[0].addresses) == 2
+                and all(a.ip for a in ep.subsets[0].addresses)
+                and ep.subsets[0].ports == [("http", 80)]
+            )
+
+        assert wait_until(published), server.list("endpoints")[0]
+
+
+def test_disruption_controller_budget_accounting():
+    cl = _Cluster(num_nodes=3)
+    cl.controllers = [
+        DisruptionController(cl.server),
+        ReplicaSetController(cl.server),
+    ]
+    with cl:
+        server = cl.server
+        rs = v1.ReplicaSet(
+            metadata=v1.ObjectMeta(name="quorum"),
+            spec=v1.ReplicaSetSpec(
+                replicas=3,
+                selector={"app": "quorum"},
+                template=_template({"app": "quorum"}),
+            ),
+        )
+        server.create("replicasets", rs)
+        pdb = v1.PodDisruptionBudget(
+            metadata=v1.ObjectMeta(name="quorum-pdb"),
+            spec=v1.PodDisruptionBudgetSpec(
+                min_available=2, selector={"app": "quorum"}
+            ),
+        )
+        server.create("poddisruptionbudgets", pdb)
+
+        def budgeted():
+            p = server.get("poddisruptionbudgets", "default", "quorum-pdb")
+            return (
+                p.status.current_healthy == 3
+                and p.status.desired_healthy == 2
+                and p.status.disruptions_allowed == 1
+                and p.status.expected_pods == 3
+            )
+
+        assert wait_until(budgeted), server.get(
+            "poddisruptionbudgets", "default", "quorum-pdb"
+        ).status
