@@ -40,7 +40,7 @@ AdmitHook = Callable[[str, str, Any], None]  # (verb, kind, obj) -> raise to den
 
 
 class APIServer:
-    def __init__(self, watch_history: int = 200000):
+    def __init__(self, watch_history: int = 200000, wal=None):
         self._lock = threading.RLock()
         self._rv = 0
         # kind -> key -> object
@@ -51,6 +51,50 @@ class APIServer:
         self._history: Dict[str, deque] = {}
         self._history_len = watch_history
         self.admit_hooks: List[AdmitHook] = []
+        # optional durability (runtime/wal.py): every mutation is logged
+        # before acknowledgment; recover() rebuilds a server from disk —
+        # the crash-only contract of the reference's etcd layer
+        self._wal = wal
+        self._compacting = threading.Event()
+
+    @classmethod
+    def recover(cls, wal_path: str, watch_history: int = 200000) -> "APIServer":
+        """Rebuild a server from its WAL + snapshot (crash restart).
+        Watch history does not survive (watchers must re-list, exactly like
+        an etcd compaction forcing a reflector relist)."""
+        from ..runtime.wal import WriteAheadLog
+
+        rv, objects = WriteAheadLog.recover(wal_path)
+        srv = cls(watch_history=watch_history, wal=WriteAheadLog(wal_path))
+        srv._rv = rv
+        srv._objects = objects
+        return srv
+
+    def _log(self, verb: str, kind: str, obj: Any) -> None:
+        if self._wal is None:
+            return
+        self._wal.append(self._rv, verb, kind, obj)
+        if self._wal.due() and not self._compacting.is_set():
+            # compaction runs OFF the mutation path: serializing + fsyncing
+            # the whole store under the server lock would stall every API
+            # call for seconds at kubemark scale (the reference compacts in
+            # a background goroutine for the same reason)
+            self._compacting.set()
+            threading.Thread(
+                target=self._compact_async, daemon=True, name="wal-compact"
+            ).start()
+
+    def _compact_async(self) -> None:
+        try:
+            with self._lock:  # cheap structural copies only under the lock
+                rv = self._rv
+                objects = {
+                    kind: [copy.deepcopy(o) for o in store.values()]
+                    for kind, store in self._objects.items()
+                }
+            self._wal.write_snapshot(rv, objects)
+        finally:
+            self._compacting.clear()
 
     # -- helpers ------------------------------------------------------------
 
@@ -88,6 +132,7 @@ class APIServer:
             self._bump(obj)
             stored = copy.deepcopy(obj)
             store[key] = stored
+            self._log("create", kind, stored)
             self._notify(
                 kind,
                 Event(ADDED, copy.deepcopy(stored), stored.metadata.resource_version),
@@ -122,6 +167,7 @@ class APIServer:
             self._bump(obj)
             stored = copy.deepcopy(obj)
             store[key] = stored
+            self._log("update", kind, stored)
             self._notify(
                 kind,
                 Event(
@@ -153,6 +199,7 @@ class APIServer:
             obj = store.pop(key)
             self._admit("delete", kind, obj)
             self._rv += 1
+            self._log("delete", kind, obj)
             self._notify(kind, Event(DELETED, copy.deepcopy(obj), self._rv))
             return obj
 
@@ -220,6 +267,7 @@ class APIServer:
                         raise Conflict("uid mismatch on binding")
                     pod.spec.node_name = b.target_node
                     self._bump(pod)
+                    self._log("update", "pods", pod)
                     self._notify(
                         "pods",
                         Event(

@@ -66,15 +66,20 @@ class WorkqueueController:
             _, srv = self.server.list(res)
             sec_watches.append((res, self.server.watch(res, from_version=srv)))
         while not self._stop.is_set():
-            ev = primary_watch.get(timeout=0.2)
-            if ev is not None:
+            # block briefly on the primary, then DRAIN all streams — one
+            # event per tick would cap secondary throughput at ~5/s and
+            # leave endpoints/PDB status minutes behind a pod burst
+            ev = primary_watch.get(timeout=0.1)
+            while ev is not None:
                 self.queue.add(ev.object.metadata.key)
+                ev = primary_watch.get(timeout=0)
             for res, w in sec_watches:
-                sev = w.get(timeout=0.02)
-                if sev is not None:
+                sev = w.get(timeout=0)
+                while sev is not None:
                     key = self.enqueue_for_related(res, sev.object)
                     if key:
                         self.queue.add(key)
+                    sev = w.get(timeout=0)
         primary_watch.stop()
         for _, w in sec_watches:
             w.stop()

@@ -139,11 +139,18 @@ class DeploymentController(WorkqueueController):
 
     # -- rollout strategies ---------------------------------------------------
 
-    def _ready_count(self, rs: v1.ReplicaSet) -> int:
-        pods = self.owned_pods(
-            rs.metadata.namespace, "ReplicaSet", rs.metadata.name
-        )
-        return sum(1 for p in pods if pod_is_ready(p))
+    def _ready_by_rs(self, dep: v1.Deployment) -> dict:
+        """One pod listing per sync, partitioned by owning ReplicaSet name
+        (the reference controller works from informer-indexed pod lists)."""
+        pods, _ = self.server.list("pods", namespace=dep.metadata.namespace)
+        out: dict = {}
+        for p in pods:
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            ref = self.controller_owner(p, "ReplicaSet")
+            if ref is not None and pod_is_ready(p):
+                out[ref.name] = out.get(ref.name, 0) + 1
+        return out
 
     def _rollout_rolling(
         self, dep: v1.Deployment, new_rs: v1.ReplicaSet, old_rss: List[v1.ReplicaSet]
@@ -154,14 +161,16 @@ class DeploymentController(WorkqueueController):
         old_total = sum(rs.spec.replicas for rs in old_rss)
 
         # reconcileNewReplicaSet: scale new up to want, bounded by
-        # want + surge total pods across all RSs
+        # want + surge total pods across all RSs; scale DOWN when the
+        # deployment itself shrank (new RS above want with no rollout going)
         new_target = min(want, max(0, want + surge - old_total))
-        if new_target > new_rs.spec.replicas:
-            self._scale_rs(new_rs, new_target)
+        if new_target > new_rs.spec.replicas or new_rs.spec.replicas > want:
+            self._scale_rs(new_rs, new_target if new_target > new_rs.spec.replicas else want)
 
         # reconcileOldReplicaSets: scale old down as readiness allows
-        ready = self._ready_count(new_rs) + sum(
-            self._ready_count(rs) for rs in old_rss
+        ready_by_rs = self._ready_by_rs(dep)
+        ready = ready_by_rs.get(new_rs.metadata.name, 0) + sum(
+            ready_by_rs.get(rs.metadata.name, 0) for rs in old_rss
         )
         min_available = want - max_unavail
         can_remove = max(0, ready - min_available)
@@ -223,10 +232,10 @@ class DeploymentController(WorkqueueController):
         all_rss = [new_rs] + old_rss
         replicas = sum(rs.status.replicas for rs in all_rss)
         ready = sum(rs.status.ready_replicas for rs in all_rss)
+        upd = self._ready_by_rs(dep).get(new_rs.metadata.name, 0)
 
         def mutate(cur):
             st = cur.status
-            upd = self._ready_count(new_rs)
             if (
                 st.replicas == replicas
                 and st.ready_replicas == ready

@@ -1,0 +1,285 @@
+"""Node agent: pod sync loop + status + heartbeats.
+
+Reference: pkg/kubelet/kubelet.go — Run(:1401) starts the sync machinery,
+syncLoop(:1820)/syncLoopIteration(:1894) select over config updates, PLEG
+events, and housekeeping; syncPod(:1482) drives the runtime. This build
+keeps the same event structure but multiplexes many nodes onto shared
+threads (NodeAgentPool) so a 5k-node hollow cluster is cheap — one watch
+stream feeds per-node Kubelet objects that share one code path whether the
+runtime is fake (kubemark) or real.
+
+Heartbeats follow the nodelease KEP: renew a Lease every interval and keep
+the NodeStatus Ready condition fresh (pkg/kubelet/nodelease; nodelifecycle
+watches both).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api import objects as v1
+from ..client.apiserver import Conflict, NotFound
+from ..client.leaderelection import Lease
+from .runtime import FakeRuntime, PodRuntime
+
+logger = logging.getLogger("kubernetes_tpu.kubelet")
+
+NODE_LEASE_NS = "kube-node-lease"
+
+
+def make_node_object(
+    name: str,
+    cpu: str = "4",
+    memory: str = "32Gi",
+    pods: int = 110,
+    labels: Optional[dict] = None,
+) -> v1.Node:
+    return v1.Node(
+        metadata=v1.ObjectMeta(name=name, namespace="", labels=labels or {}),
+        spec=v1.NodeSpec(),
+        status=v1.NodeStatus(
+            capacity={"cpu": cpu, "memory": memory, "pods": pods},
+            allocatable={"cpu": cpu, "memory": memory, "pods": pods},
+            conditions=[v1.NodeCondition(type=v1.NODE_READY, status="True")],
+        ),
+    )
+
+
+class Kubelet:
+    """One node's agent. Thread-free: the pool (or a test) drives it via
+    handle_pod_event / housekeeping / heartbeat."""
+
+    def __init__(
+        self,
+        server,
+        node_name: str,
+        runtime: PodRuntime,
+        host_ip: Optional[str] = None,
+    ):
+        self.server = server
+        self.node_name = node_name
+        self.runtime = runtime
+        self.host_ip = host_ip  # the node's address (same for all its pods)
+        self._known: Dict[str, str] = {}  # pod key -> last posted phase
+
+    # -- pod lifecycle (syncPod, kubelet.go:1482) ----------------------------
+
+    def handle_pod_event(self, ev_type: str, pod: v1.Pod) -> None:
+        if pod.spec.node_name != self.node_name:
+            return
+        key = pod.metadata.key
+        if ev_type == "DELETED":
+            self.runtime.kill_pod(key)
+            self._known.pop(key, None)
+            return
+        if pod.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
+            # terminal: runtime resources are reclaimed, status stands
+            self.runtime.kill_pod(key)
+            self._known[key] = pod.status.phase
+            return
+        if key not in self._known:
+            ip = self.runtime.run_pod(pod)
+            self._known[key] = v1.POD_RUNNING
+            self._post_status(pod, v1.POD_RUNNING, ip)
+
+    def housekeeping(self) -> None:
+        """PLEG relist → post phase transitions (pleg/generic.go 1s relist)."""
+        for key, phase in self.runtime.relist().items():
+            if self._known.get(key) == phase:
+                continue
+            ns, _, name = key.partition("/")
+            try:
+                pod = self.server.get("pods", ns, name)
+            except NotFound:
+                self.runtime.kill_pod(key)
+                self._known.pop(key, None)
+                continue
+            self._known[key] = phase
+            if phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
+                self.runtime.kill_pod(key)
+                self._post_status(pod, phase, None)
+
+    def _post_status(self, pod: v1.Pod, phase: str, ip: Optional[str]) -> None:
+        def mutate(p):
+            if p.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
+                # never regress a terminal phase (a stale watch snapshot
+                # racing a completed pod must not flip it back to Running)
+                return None
+            if p.status.phase == phase and (ip is None or p.status.pod_ip == ip):
+                return None
+            p.status.phase = phase
+            if p.status.start_time is None:
+                p.status.start_time = time.time()
+            if ip is not None:
+                p.status.pod_ip = ip
+                p.status.host_ip = self.host_ip or ip
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            pass
+
+    # -- heartbeats (pkg/kubelet/nodelease) ----------------------------------
+
+    def heartbeat(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+
+        def renew(lease):
+            lease.renew_time = now
+            return lease
+
+        try:
+            self.server.guaranteed_update(
+                "leases", NODE_LEASE_NS, self.node_name, renew
+            )
+        except (NotFound, Conflict):
+            pass
+
+    def post_ready_condition(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+
+        def mutate(node):
+            for c in node.status.conditions:
+                if c.type == v1.NODE_READY:
+                    c.status = "True"
+                    c.last_heartbeat_time = now
+                    return node
+            node.status.conditions.append(
+                v1.NodeCondition(type=v1.NODE_READY, status="True")
+            )
+            return node
+
+        try:
+            self.server.guaranteed_update("nodes", "", self.node_name, mutate)
+        except NotFound:
+            pass
+
+
+class NodeAgentPool:
+    """Run many Kubelets on shared threads: one pod-watch dispatcher, one
+    heartbeat loop, one housekeeping (PLEG) loop. The kubemark trick of
+    multiplexing hollow nodes in-process — with the REAL kubelet sync code."""
+
+    def __init__(
+        self,
+        server,
+        heartbeat_interval: float = 10.0,
+        housekeeping_interval: float = 0.5,
+        runtime_factory: Optional[Callable[[str], PodRuntime]] = None,
+    ):
+        self.server = server
+        self.heartbeat_interval = heartbeat_interval
+        self.housekeeping_interval = housekeeping_interval
+        self.kubelets: Dict[str, Kubelet] = {}
+        self._runtime_factory = runtime_factory or self._default_runtime
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _default_runtime(node_name: str) -> PodRuntime:
+        from ..kubemark.hollow_node import _fake_pod_ip
+
+        return FakeRuntime(_fake_pod_ip)
+
+    # -- membership ----------------------------------------------------------
+
+    def add_node(self, name: str, register: bool = True, **node_kw) -> Kubelet:
+        if register:
+            self.server.create("nodes", make_node_object(name, **node_kw))
+            try:
+                self.server.create(
+                    "leases",
+                    Lease(
+                        metadata=v1.ObjectMeta(name=name, namespace=NODE_LEASE_NS),
+                        holder_identity=name,
+                        lease_duration_seconds=40.0,
+                        renew_time=time.time(),
+                    ),
+                )
+            except Exception:
+                pass
+        from ..kubemark.hollow_node import _fake_pod_ip
+
+        kl = Kubelet(
+            self.server,
+            name,
+            self._runtime_factory(name),
+            host_ip=_fake_pod_ip(name),
+        )
+        with self._lock:
+            self.kubelets[name] = kl
+        return kl
+
+    def remove_node(self, name: str) -> None:
+        """Stop the node's agent (the node 'dies'; object stays for
+        nodelifecycle to notice the missed heartbeats)."""
+        with self._lock:
+            self.kubelets.pop(name, None)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for target, name in (
+            (self._watch_loop, "kubelet-watch"),
+            (self._heartbeat_loop, "kubelet-heartbeat"),
+            (self._housekeeping_loop, "kubelet-pleg"),
+        ):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- shared loops --------------------------------------------------------
+
+    def _kubelet_for(self, pod: v1.Pod) -> Optional[Kubelet]:
+        with self._lock:
+            return self.kubelets.get(pod.spec.node_name)
+
+    def _watch_loop(self) -> None:
+        pods, rv = self.server.list("pods")
+        for pod in pods:
+            kl = self._kubelet_for(pod)
+            if kl is not None:
+                kl.handle_pod_event("ADDED", pod)
+        watcher = self.server.watch("pods", from_version=rv)
+        while not self._stop.is_set():
+            ev = watcher.get(timeout=0.2)
+            if ev is None:
+                continue
+            kl = self._kubelet_for(ev.object)
+            if kl is not None:
+                kl.handle_pod_event(ev.type, ev.object)
+        watcher.stop()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.time()
+            with self._lock:
+                kls = list(self.kubelets.values())
+            for kl in kls:
+                if self._stop.is_set():
+                    return
+                kl.heartbeat(now)
+            self._stop.wait(self.heartbeat_interval)
+
+    def _housekeeping_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                kls = list(self.kubelets.values())
+            for kl in kls:
+                if self._stop.is_set():
+                    return
+                try:
+                    kl.housekeeping()
+                except Exception:
+                    logger.exception("housekeeping failed for %s", kl.node_name)
+            self._stop.wait(self.housekeeping_interval)

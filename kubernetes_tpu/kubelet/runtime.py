@@ -1,0 +1,88 @@
+"""Container runtime boundary: the kubelet's CRI.
+
+Reference: the kubelet drives pods through the CRI gRPC services
+(pkg/kubelet/remote/remote_runtime.go:59, cri-api api.proto). Here the
+boundary is a small in-process interface; FakeRuntime is the kubemark
+hollow runtime (pkg/kubemark/hollow_kubelet.go:111-118 fake runtime/mounter)
+with optional scripted completion so Job/controller tests can exercise
+terminal phases.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..api import objects as v1
+
+# annotations understood by FakeRuntime (test/kubemark scripting)
+ANN_RUN_SECONDS = "kubelet.fake/run-seconds"  # complete after N seconds
+ANN_FAIL = "kubelet.fake/fail"  # terminal phase Failed instead of Succeeded
+
+
+class PodRuntime:
+    """What the kubelet needs from a runtime: start, kill, observe."""
+
+    def run_pod(self, pod: v1.Pod) -> str:
+        """Start the pod's sandbox+containers; returns the sandbox IP."""
+        raise NotImplementedError
+
+    def kill_pod(self, pod_key: str) -> None:
+        raise NotImplementedError
+
+    def relist(self) -> Dict[str, str]:
+        """PLEG relist (pkg/kubelet/pleg/generic.go): pod_key -> phase for
+        every pod the runtime knows; phases are POD_RUNNING / POD_SUCCEEDED
+        / POD_FAILED."""
+        raise NotImplementedError
+
+
+class _FakePod:
+    __slots__ = ("ip", "started", "run_seconds", "fail")
+
+    def __init__(self, ip: str, run_seconds: Optional[float], fail: bool):
+        self.ip = ip
+        self.started = time.monotonic()
+        self.run_seconds = run_seconds
+        self.fail = fail
+
+
+class FakeRuntime(PodRuntime):
+    """Instant-start fake: every pod is Running immediately; scripted pods
+    complete after ANN_RUN_SECONDS."""
+
+    def __init__(self, ip_alloc):
+        self._pods: Dict[str, _FakePod] = {}
+        self._lock = threading.Lock()
+        self._ip_alloc = ip_alloc  # seed -> ip
+
+    def run_pod(self, pod: v1.Pod) -> str:
+        ann = pod.metadata.annotations
+        run_s = ann.get(ANN_RUN_SECONDS)
+        fp = _FakePod(
+            ip=self._ip_alloc(pod.metadata.uid),
+            run_seconds=float(run_s) if run_s is not None else None,
+            fail=ann.get(ANN_FAIL, "") not in ("", "false"),
+        )
+        with self._lock:
+            self._pods[pod.metadata.key] = fp
+        return fp.ip
+
+    def kill_pod(self, pod_key: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_key, None)
+
+    def relist(self) -> Dict[str, str]:
+        now = time.monotonic()
+        out: Dict[str, str] = {}
+        with self._lock:
+            for key, fp in self._pods.items():
+                if (
+                    fp.run_seconds is not None
+                    and now - fp.started >= fp.run_seconds
+                ):
+                    out[key] = v1.POD_FAILED if fp.fail else v1.POD_SUCCEEDED
+                else:
+                    out[key] = v1.POD_RUNNING
+        return out

@@ -1,27 +1,27 @@
-"""Hollow kubelet: register, heartbeat, ack pods.
+"""Hollow nodes: the kubemark scale rig, built on the real kubelet.
 
-Reference: pkg/kubemark/hollow_kubelet.go — the kubelet's API interactions
-without a container runtime: (1) register a Node with capacity, (2) post
-NodeStatus Ready heartbeats + renew the per-node Lease
-(pkg/kubelet/nodelease), (3) watch for pods bound to this node and drive
-their status to Running (the fake runtime "starts" instantly).
+Reference: pkg/kubemark/hollow_kubelet.go — a REAL kubelet wired to a fake
+container runtime/mounter so a 5k-node control plane runs on a few
+machines. Round 1 shipped a separate hollow implementation; this now
+delegates to kubelet.NodeAgentPool so hollow and real nodes share one sync
+code path (kubelet/kubelet.py), differing only in the PodRuntime injected
+(kubelet/runtime.py FakeRuntime).
 
-One HollowCluster multiplexes many hollow nodes onto a few threads so a
-5k-node cluster is cheap (the reference runs one process per hollow node;
-in-process we can share the watch stream).
+HollowCluster keeps its original surface (add_node / start / stop /
+kill_node) for the perf harness and tests.
 """
 
 from __future__ import annotations
 
 import threading
-import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from ..api import objects as v1
-from ..client.apiserver import Conflict, NotFound
-from ..client.leaderelection import Lease
-
-NODE_LEASE_NS = "kube-node-lease"
+from ..kubelet.kubelet import (
+    NODE_LEASE_NS,  # noqa: F401 — re-exported for nodelifecycle
+    NodeAgentPool,
+    make_node_object,
+)
 
 _ip_lock = threading.Lock()
 _ip_by_seed: Dict[str, str] = {}
@@ -48,28 +48,18 @@ def make_hollow_node(
     pods: int = 110,
     labels: Optional[dict] = None,
 ) -> v1.Node:
-    return v1.Node(
-        metadata=v1.ObjectMeta(name=name, namespace="", labels=labels or {}),
-        spec=v1.NodeSpec(),
-        status=v1.NodeStatus(
-            capacity={"cpu": cpu, "memory": memory, "pods": pods},
-            allocatable={"cpu": cpu, "memory": memory, "pods": pods},
-            conditions=[
-                v1.NodeCondition(type=v1.NODE_READY, status="True")
-            ],
-        ),
-    )
+    return make_node_object(name, cpu=cpu, memory=memory, pods=pods, labels=labels)
 
 
 class HollowNode:
-    """One hollow node's state (registration handled by HollowCluster)."""
+    """Back-compat handle for one hollow node."""
 
     def __init__(self, node: v1.Node):
         self.node = node
         self.name = node.metadata.name
 
 
-class HollowCluster:
+class HollowCluster(NodeAgentPool):
     def __init__(
         self,
         server,
@@ -78,27 +68,23 @@ class HollowCluster:
         heartbeat_interval: float = 10.0,
         node_template=make_hollow_node,
     ):
-        self.server = server
-        self.heartbeat_interval = heartbeat_interval
+        super().__init__(server, heartbeat_interval=heartbeat_interval)
         self.nodes: Dict[str, HollowNode] = {}
         self._template = node_template
-        self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
         for i in range(num_nodes):
             self.add_node(f"{name_prefix}-{i}")
-
-    # -- registration --------------------------------------------------------
 
     def add_node(self, name: str, **kw) -> HollowNode:
         node = self._template(name, **kw)
         self.server.create("nodes", node)
         try:
+            from ..client.leaderelection import Lease
+            import time
+
             self.server.create(
                 "leases",
                 Lease(
-                    metadata=v1.ObjectMeta(
-                        name=name, namespace=NODE_LEASE_NS
-                    ),
+                    metadata=v1.ObjectMeta(name=name, namespace=NODE_LEASE_NS),
                     holder_identity=name,
                     lease_duration_seconds=40.0,
                     renew_time=time.time(),
@@ -106,93 +92,13 @@ class HollowCluster:
             )
         except Exception:
             pass
+        super().add_node(name, register=False)
         hn = HollowNode(node)
         self.nodes[name] = hn
         return hn
-
-    # -- lifecycle -----------------------------------------------------------
-
-    def start(self) -> None:
-        t = threading.Thread(
-            target=self._heartbeat_loop, name="hollow-heartbeat", daemon=True
-        )
-        t.start()
-        self._threads.append(t)
-        t2 = threading.Thread(
-            target=self._pod_ack_loop, name="hollow-pod-ack", daemon=True
-        )
-        t2.start()
-        self._threads.append(t2)
-
-    def stop(self) -> None:
-        self._stop.set()
-
-    # -- heartbeats (kubelet nodestatus + nodelease) -------------------------
-
-    def _heartbeat_loop(self) -> None:
-        while not self._stop.is_set():
-            now = time.time()
-            for name in list(self.nodes):
-                if self._stop.is_set():
-                    return
-                try:
-                    def renew(lease):
-                        lease.renew_time = now
-                        return lease
-
-                    self.server.guaranteed_update(
-                        "leases", NODE_LEASE_NS, name, renew
-                    )
-                except NotFound:
-                    pass
-                except Conflict:
-                    pass
-            # full NodeStatus heartbeat is lease-relieved (nodelease KEP):
-            # only bump conditions once per interval on a sample of nodes
-            self._stop.wait(self.heartbeat_interval)
-
-    # -- pod acknowledgment (the fake runtime) -------------------------------
-
-    def _pod_ack_loop(self) -> None:
-        pods, rv = self.server.list("pods")
-        for pod in pods:
-            self._maybe_ack(pod)
-        watcher = self.server.watch("pods", from_version=rv)
-        while not self._stop.is_set():
-            ev = watcher.get(timeout=0.5)
-            if ev is None:
-                continue
-            if ev.type in ("ADDED", "MODIFIED"):
-                self._maybe_ack(ev.object)
-        watcher.stop()
-
-    def _maybe_ack(self, pod: v1.Pod) -> None:
-        if not pod.spec.node_name or pod.spec.node_name not in self.nodes:
-            return
-        if pod.status.phase == v1.POD_RUNNING:
-            return
-
-        def mutate(p):
-            if p.status.phase == v1.POD_RUNNING or not p.spec.node_name:
-                return None
-            p.status.phase = v1.POD_RUNNING
-            p.status.start_time = time.time()
-            # fake sandbox IP (the real kubelet reports the CNI-assigned IP;
-            # endpoints controller needs one to publish an address)
-            p.status.pod_ip = _fake_pod_ip(p.metadata.uid)
-            p.status.host_ip = _fake_pod_ip(p.spec.node_name)
-            return p
-
-        try:
-            self.server.guaranteed_update(
-                "pods", pod.metadata.namespace, pod.metadata.name, mutate
-            )
-        except NotFound:
-            pass
-
-    # -- failure injection (chaosmonkey-style) -------------------------------
 
     def kill_node(self, name: str) -> None:
         """Stop heartbeating a node (the node 'dies'); nodelifecycle should
         detect and evict."""
         self.nodes.pop(name, None)
+        self.remove_node(name)

@@ -363,7 +363,9 @@ class Scheduler:
                 candidates: Optional[List[str]] = None
                 if i >= 0 and resolvable is not None:
                     mask = resolvable[i]
-                    if whatif is not None:
+                    # shapes can differ if a node joined between the batch
+                    # encode and the what-if re-flush (encoder row growth)
+                    if whatif is not None and whatif.shape[1] == mask.shape[0]:
                         mask = mask & whatif[i]
                     candidates = [
                         row_names[r]
@@ -497,7 +499,10 @@ class Scheduler:
             for pi, i in failed:
                 t = int(pod_tpl[i])
                 rows_mask = resolvable_tpl[t]
-                if whatif_tpl is not None:
+                if (
+                    whatif_tpl is not None
+                    and whatif_tpl.shape[1] == rows_mask.shape[0]
+                ):
                     rows_mask = rows_mask & whatif_tpl[t]
                 rows = np.nonzero(rows_mask)[0]
                 self._handle_failure(

@@ -123,6 +123,20 @@ def test_deployment_rollout_and_rolling_update():
         )
         assert dep2.spec.replicas == 3
 
+        # scale down: the (sole) new RS must shrink too
+        def shrink(cur):
+            cur.spec.replicas = 1
+            return cur
+
+        server.guaranteed_update("deployments", "default", "web", shrink)
+        assert wait_until(
+            lambda: sum(
+                1 for p in server.list("pods")[0] if p.status.phase == "Running"
+            )
+            == 1,
+            timeout=30,
+        ), [(p.metadata.name, p.status.phase) for p in server.list("pods")[0]]
+
 
 def test_job_runs_to_completion():
     server = APIServer()
