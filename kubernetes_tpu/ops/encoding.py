@@ -345,6 +345,7 @@ class SnapshotEncoder:
         self._alloc_masters()
         self._dirty_rows: set = set()
         self._full_upload = True
+        self._globals_dirty = False  # non-row fields (band_prio, eterm meta)
         self._device: Optional[DeviceSnapshot] = None
         self.generation = 0  # bumped on every mutation
 
@@ -470,6 +471,7 @@ class SnapshotEncoder:
         self._ensure_cap("t_cap", len(self.eterm_vocab))
         self.m_eterm_topo[tid] = key_id
         self.m_eterm_kind[tid] = kind
+        self._globals_dirty = True
         self.generation += 1
         return tid
 
@@ -503,6 +505,7 @@ class SnapshotEncoder:
         if empty.size:
             b = int(empty[0])
             bands[b] = priority
+            self._globals_dirty = True
             self.generation += 1
             return b
         lower = np.nonzero(bands <= priority)[0]
@@ -514,6 +517,7 @@ class SnapshotEncoder:
         # preemptors), never pessimistic — the invariant holds.
         b = int(np.argmin(bands))
         bands[b] = priority
+        self._globals_dirty = True
         self.generation += 1
         return b
 
@@ -655,7 +659,20 @@ class SnapshotEncoder:
                 ws.append(float(wt.weight))
         return ids, ws
 
-    def add_pod(self, node_name: str, pod: v1.Pod) -> None:
+    def add_pod(
+        self,
+        node_name: str,
+        pod: v1.Pod,
+        device_synced: bool = False,
+        prio_band: Optional[int] = None,
+    ) -> None:
+        """device_synced=True: the wave kernel already committed this pod's
+        occupancy (requested/nonzero/sel_counts/eterm_w/ports/prio_req) into
+        the device snapshot it returned (wavelattice finalize), so replaying
+        it here must update the host masters WITHOUT marking the row dirty —
+        a dirty mark would re-upload values the device already holds, and at
+        ~65 ms tunnel RTT per transfer those redundant scatters were the
+        1-2 s encode spikes in the round-2 bench."""
         row = self._row_by_name.get(node_name)
         if row is None:
             raise KeyError(f"unknown node {node_name}")
@@ -671,7 +688,10 @@ class SnapshotEncoder:
         nz[RES_PODS] = 1
         eids, ews = self._pod_eterms(pod)
         pids = [self.intern_port(proto, port) for (_, proto, port) in pod_host_ports(pod)]
-        band = self._band_of(pod.priority)
+        # device_synced replay must land in the band the kernel committed
+        # prio_req under (captured at encode time); recomputing could pick a
+        # different band after a relabel, silently diverging host vs device
+        band = prio_band if prio_band is not None else self._band_of(pod.priority)
         entry = _PodEntry(
             namespace=pod.metadata.namespace,
             labels=dict(pod.metadata.labels),
@@ -695,7 +715,8 @@ class SnapshotEncoder:
             self.m_eterm_w[row, tid] += w
         for pid in pids:
             self.m_port_counts[row, pid] += 1
-        self._dirty_rows.add(row)
+        if not device_synced:
+            self._dirty_rows.add(row)
         self.generation += 1
 
     def remove_pod(self, node_name: str, pod_key: str) -> None:
@@ -759,25 +780,33 @@ class SnapshotEncoder:
     def flush(self) -> DeviceSnapshot:
         """Return the device snapshot, applying pending row deltas.
 
-        Dirty-row scatter indices are padded to the next power of two so only
-        O(log N) distinct update programs are ever compiled; out-of-range pad
-        indices are dropped by the scatter. Capacity growth or first use
-        forces a full upload (the cold-start path, SURVEY.md §5 failure
-        recovery: device memory is a rebuildable cache).
+        Dirty-row scatter indices are padded to the next power of FOUR so
+        only O(log₄ N) distinct update programs are ever compiled — each
+        distinct pad size is an XLA compile that costs seconds through the
+        tunnel; out-of-range pad indices are dropped by the scatter.
+        Capacity growth or first use forces a full upload (the cold-start
+        path, SURVEY.md §5 failure recovery: device memory is a rebuildable
+        cache). Global (non-row) fields changed without any dirty row
+        (band allocation, eterm interning) refresh via a row-less scatter.
         """
         masters = self._masters()
         if self._device is None or self._full_upload:
             self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
             self._full_upload = False
+            self._globals_dirty = False
             self._dirty_rows.clear()
             return self._device
         if not self._dirty_rows:
-            return self._device
-        rows = sorted(self._dirty_rows)
-        self._dirty_rows.clear()
+            if not self._globals_dirty:
+                return self._device
+            rows = []
+        else:
+            rows = sorted(self._dirty_rows)
+            self._dirty_rows.clear()
+        self._globals_dirty = False
         pad = 1
-        while pad < len(rows):
-            pad *= 2
+        while pad < max(len(rows), 1):
+            pad *= 4
         n_cap = self.cfg.n_cap
         idx = np.full(pad, n_cap, np.int32)  # OOB pad rows -> dropped
         idx[: len(rows)] = rows
@@ -785,7 +814,7 @@ class SnapshotEncoder:
 
         updates = DeviceSnapshot(
             **{
-                name: jnp.asarray(
+                name: (
                     getattr(masters, name)
                     if name in _GLOBAL_FIELDS
                     else np.ascontiguousarray(getattr(masters, name)[sel])
@@ -793,8 +822,25 @@ class SnapshotEncoder:
                 for name in DeviceSnapshot._fields
             }
         )
-        self._device = _scatter_rows(self._device, jnp.asarray(idx), updates)
+        # one device_put for the whole update pytree: transfers pipeline in
+        # a single tunnel exchange instead of one ~65 ms RTT per field
+        idx_d, updates_d = jax.device_put((idx, updates))
+        self._device = _scatter_rows(self._device, idx_d, updates_d)
         return self._device
+
+    @property
+    def has_pending_updates(self) -> bool:
+        """True when flush() would need to touch the device snapshot."""
+        return bool(self._dirty_rows) or self._globals_dirty or self._full_upload
+
+    def mark_row_dirty(self, node_name: str) -> None:
+        """Force a re-upload of one node row from the host masters. Used when
+        a kernel-committed placement could NOT be replayed host-side (e.g.
+        duplicate assume): the device row then holds occupancy the masters
+        don't, and the next flush must overwrite it."""
+        row = self._row_by_name.get(node_name)
+        if row is not None:
+            self._dirty_rows.add(row)
 
     def invalidate_device(self) -> None:
         self._full_upload = True

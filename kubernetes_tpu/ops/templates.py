@@ -92,6 +92,7 @@ class TemplateBatch(NamedTuple):
     pod_valid: jnp.ndarray  # [P] bool
     pod_name_row: jnp.ndarray  # [P] int32 pinned node row (-1 none, -2 unknown)
     pod_prio: jnp.ndarray  # [P] int32
+    pod_band: jnp.ndarray  # [P] int32 priority band (prio_req commit target)
 
 
 @dataclass
@@ -101,6 +102,11 @@ class EncodedTemplateBatch:
     fallback: np.ndarray  # [P] bool (template overflowed device buckets)
     num_templates: int
     tpl_np: Optional[PodBatch] = None  # host mirror of batch.tpl (no D2H)
+    # host mirrors of per-pod arrays: failure paths read these, and a
+    # device_get of host-originated data would pay a pointless tunnel RTT
+    pod_tpl_np: Optional[np.ndarray] = None
+    pod_prio_np: Optional[np.ndarray] = None
+    pod_band_np: Optional[np.ndarray] = None
 
 
 class TemplateCache:
@@ -179,22 +185,33 @@ class TemplateCache:
         pod_valid = np.zeros(P, np.bool_)
         pod_name_row = np.full(P, -1, np.int32)
         pod_prio = np.zeros(P, np.int32)
+        pod_band = np.zeros(P, np.int32)
         fallback = np.zeros(P, np.bool_)
         for i, (pod, fp) in enumerate(zip(pods, fps)):
             t = self._rows[fp]
+            fb = self._fallback[t] if t < len(self._fallback) else False
             pod_tpl[i] = t
-            pod_valid[i] = True
+            # fallback pods run the host path; they must be INVALID to the
+            # kernel, else its finalize commits their occupancy on-device
+            # for a placement the host will make differently (device drift)
+            pod_valid[i] = not fb
             pod_prio[i] = pod.priority
+            pod_band[i] = self.encoder._band_of(pod.priority)
             if pod.spec.node_name:
                 row = self.encoder.row_of(pod.spec.node_name)
                 pod_name_row[i] = row if row >= 0 else -2
-            fallback[i] = self._fallback[t] if t < len(self._fallback) else False
+            fallback[i] = fb
+        # per-pod arrays ride one device_put (single tunnel exchange)
+        pt_d, pv_d, pn_d, pp_d, pb_d = jax.device_put(
+            (pod_tpl, pod_valid, pod_name_row, pod_prio, pod_band)
+        )
         batch = TemplateBatch(
             tpl=self._tpl_batch,
-            pod_tpl=jnp.asarray(pod_tpl),
-            pod_valid=jnp.asarray(pod_valid),
-            pod_name_row=jnp.asarray(pod_name_row),
-            pod_prio=jnp.asarray(pod_prio),
+            pod_tpl=pt_d,
+            pod_valid=pv_d,
+            pod_name_row=pn_d,
+            pod_prio=pp_d,
+            pod_band=pb_d,
         )
         return EncodedTemplateBatch(
             batch=batch,
@@ -202,6 +219,9 @@ class TemplateCache:
             fallback=fallback,
             num_templates=len(self._exemplars),
             tpl_np=self._tpl_batch_np,
+            pod_tpl_np=pod_tpl,
+            pod_prio_np=pod_prio,
+            pod_band_np=pod_band,
         )
 
     @staticmethod

@@ -355,6 +355,9 @@ def make_wave_kernel(
             (jnp.arange(m_c)[None, :] == 0) & pin_feas[:, None],
             cand_valid,
         )
+        # spec.nodeName names a node the cache doesn't know (row -2): the
+        # NodeName filter fails everywhere -> unschedulable, never placed
+        cand_valid = cand_valid & (tb.pod_name_row != -2)[:, None]
         cand_nodes = jnp.clip(cand_nodes, 0, n - 1)
 
         # which pods participate in pair exclusivity (contributor or
@@ -533,7 +536,11 @@ def make_wave_kernel(
         )
 
         # ================= finalize: commit occupancy to snapshot ==========
+        # Every field the host's add_pod touches is committed here (incl.
+        # prio_req by priority band), so the scheduler's replay can skip the
+        # dirty-row re-upload entirely (encoding.add_pod device_synced=True).
         ci = jnp.where(placed, chosen, n)
+        band = jnp.clip(tb.pod_band, 0, snap.prio_req.shape[1] - 1)
         new_snap = snap._replace(
             requested=snap.requested.at[ci].add(tpl.req[t_of], mode="drop"),
             nonzero_req=snap.nonzero_req.at[ci].add(
@@ -546,12 +553,16 @@ def make_wave_kernel(
             port_counts=snap.port_counts.at[ci].add(
                 tpl.port_mask[t_of].astype(jnp.int32), mode="drop"
             ),
+            prio_req=snap.prio_req.at[ci, band].add(tpl.req[t_of], mode="drop"),
         )
 
         feas_cnt = jnp.where(tb.pod_valid, feas_cnt_tpl[t_of], 0)
         feas_cnt = jnp.where(
             pinned, jnp.where(pin_feas & tb.pod_valid, 1, 0), feas_cnt
         )
+        # unknown pinned node: zero feasible so the pod FAILS (backoff +
+        # unschedulable event) instead of deferring into a requeue hot-loop
+        feas_cnt = jnp.where(tb.pod_name_row == -2, 0, feas_cnt)
         score_out = jnp.where(
             placed,
             total_score[t_of, jnp.clip(chosen, 0, n - 1)],

@@ -110,7 +110,12 @@ class SchedulerCache:
             if node is not None:
                 self._remove_pod_internal(key, node)
 
-    def _add_pod_internal(self, pod: v1.Pod) -> None:
+    def _add_pod_internal(
+        self,
+        pod: v1.Pod,
+        device_synced: bool = False,
+        prio_band: Optional[int] = None,
+    ) -> None:
         node = pod.spec.node_name
         ni = self._nodes.get(node)
         if ni is None:
@@ -120,7 +125,9 @@ class SchedulerCache:
         ni.add_pod(pod)
         self._bump(ni)
         self._pod_to_node[pod.metadata.key] = node
-        self.encoder.add_pod(node, pod)
+        self.encoder.add_pod(
+            node, pod, device_synced=device_synced, prio_band=prio_band
+        )
 
     def _remove_pod_internal(self, key: str, node: str) -> None:
         ni = self._nodes.get(node)
@@ -132,14 +139,27 @@ class SchedulerCache:
 
     # -- assume protocol -----------------------------------------------------
 
-    def assume_pod(self, pod: v1.Pod, node_name: str) -> None:
+    def assume_pod(
+        self,
+        pod: v1.Pod,
+        node_name: str,
+        device_synced: bool = False,
+        prio_band: Optional[int] = None,
+    ) -> None:
+        """device_synced=True: the placement came from the wave kernel, whose
+        finalize already committed the pod's occupancy into the device
+        snapshot — replay host-side only (ops/encoding.add_pod). prio_band
+        pins the priority band the kernel committed prio_req under (a band
+        relabel between encode and replay would otherwise diverge)."""
         key = pod.metadata.key
         with self.lock:
             if key in self._assumed or key in self._pod_to_node:
                 raise ValueError(f"pod {key} already assumed/added")
             assumed = pod.deep_copy()
             assumed.spec.node_name = node_name
-            self._add_pod_internal(assumed)
+            self._add_pod_internal(
+                assumed, device_synced=device_synced, prio_band=prio_band
+            )
             self._assumed[key] = _AssumedInfo(assumed, node_name, None)
 
     def finish_binding(self, pod: v1.Pod) -> None:
@@ -158,6 +178,11 @@ class SchedulerCache:
     def is_assumed(self, pod_key: str) -> bool:
         with self.lock:
             return pod_key in self._assumed
+
+    def has_pod(self, pod_key: str) -> bool:
+        """True if the pod is assumed or placed (any node)."""
+        with self.lock:
+            return pod_key in self._assumed or pod_key in self._pod_to_node
 
     def cleanup_expired(self, now: Optional[float] = None) -> int:
         now = now if now is not None else time.monotonic()

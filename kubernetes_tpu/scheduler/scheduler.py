@@ -84,6 +84,22 @@ def _stage_timer(stage: str):
             {"stage": stage},
         )
 
+class _InFlightBatch:
+    """A wave batch whose kernel is dispatched but whose results haven't
+    been read back yet (pipeline depth 1)."""
+
+    __slots__ = ("pis", "eb", "row_names", "res", "moves0", "trace", "t_start")
+
+    def __init__(self, pis, eb, row_names, res, moves0, trace, t_start):
+        self.pis = pis
+        self.eb = eb
+        self.row_names = row_names
+        self.res = res
+        self.moves0 = moves0
+        self.trace = trace
+        self.t_start = t_start
+
+
 _SCORE_NAME_TO_COMPONENT = {
     "NodeResourcesLeastAllocated": SC_LEAST_ALLOC,
     "NodeResourcesMostAllocated": SC_MOST_ALLOC,
@@ -166,6 +182,12 @@ class Scheduler:
         self._sched_thread: Optional[threading.Thread] = None
         self._rng_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(0)
+        # depth-1 pipeline: the launched-but-unresolved wave batch. Results
+        # are read back AFTER the next batch's kernel is dispatched, so the
+        # ~65 ms tunnel readback RTT overlaps the next batch's device time
+        # (the TPU-shaped analogue of the reference's async binding
+        # goroutine overlapping the next scheduleOne, scheduler.go:666).
+        self._pending: Optional[_InFlightBatch] = None
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table, n_waves)
@@ -233,21 +255,33 @@ class Scheduler:
         """Test helper: wait until no pending pods remain."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if len(self.queue) == 0 and not self.cache.encoder._dirty_rows:
+            enc = self.cache.encoder
+            if (
+                len(self.queue) == 0
+                and self._pending is None
+                and not enc._dirty_rows
+                and not enc._globals_dirty
+            ):
                 return True
             time.sleep(0.01)
-        return len(self.queue) == 0
+        return len(self.queue) == 0 and self._pending is None
 
     # -- the loop ------------------------------------------------------------
 
     def _scheduling_loop(self) -> None:
         while not self._stop.is_set():
+            # with a batch in flight don't block or linger waiting for
+            # arrivals — resolving the in-flight results (binding its pods)
+            # is the more urgent work, and any poll delay here would be
+            # charged to those pods' latency
+            inflight = self._pending is not None
             pis = self.queue.pop_batch(
                 self.cfg.device_batch_size,
-                timeout=0.2,
-                window=self.cfg.device_batch_window,
+                timeout=0.0 if inflight else 0.2,
+                window=0.0 if inflight else self.cfg.device_batch_window,
             )
             if not pis:
+                self._resolve_pending()
                 continue
             try:
                 self.schedule_pod_batch(pis)
@@ -275,6 +309,9 @@ class Scheduler:
                 extender_pis.append(pi)
                 continue
             known.append(pi)
+        if extender_pis:
+            # host path reads the host cache: in-flight replays must land
+            self._resolve_pending()
         for pi in extender_pis:
             # _schedule_one_host re-snapshots per pod
             self._schedule_one_host(pi, moves0)
@@ -283,12 +320,15 @@ class Scheduler:
         if self.cfg.use_device and self.cfg.use_wave:
             self._schedule_batch_wave(known, moves0, trace, t_start)
         elif self.cfg.use_device:
+            self._resolve_pending()
             self._schedule_batch_device(known, moves0, trace, t_start)
+            trace.log_if_long(0.1)
         else:
+            self._resolve_pending()
             self._snapshot = self.cache.update_snapshot()
             for pi in known:
                 self._schedule_one_host(pi, moves0)
-        trace.log_if_long(0.1)
+            trace.log_if_long(0.1)
 
     # -- device path ---------------------------------------------------------
 
@@ -316,8 +356,7 @@ class Scheduler:
         self._rng_key, sub = jax.random.split(self._rng_key)
         with _stage_timer("kernel"):
             res = kern(snap, eb.batch, np.asarray(self._weights), sub)
-            chosen = np.asarray(res.chosen)
-            feas = np.asarray(res.feasible_count)
+            chosen = jax.device_get(res.chosen)
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
 
@@ -424,17 +463,38 @@ class Scheduler:
     def _schedule_batch_wave(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
+        """Launch the wave kernel for this batch; resolve the PREVIOUS
+        in-flight batch while this one computes (depth-1 pipeline)."""
         # two padded-batch buckets: ragged tails use a small lattice, bursts
         # the full one. Exactly two jit variants per wave count — each extra
         # bucket is another multi-second XLA compile on first use
         small = min(256, self.cfg.device_batch_size)
         pad = small if len(pis) <= small else self.cfg.device_batch_size
-        with self.cache.lock, _stage_timer("encode"):
-            eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
-            ptab, n_waves = self._pair_table(eb)
-            snap = self.cache.encoder.flush()
-            enc_cfg = self.cache.encoder.cfg
-            row_names = list(self.cache.encoder.row_names)
+        # encode → drain-check → flush must be ATOMIC under the cache lock:
+        # a dirty-row scatter uploads full rows from the host masters, which
+        # must already include the in-flight batch's replayed placements or
+        # the scatter would erase its on-device commits; and the pod batch's
+        # node-row references must be captured under the same lock as the
+        # snapshot they index (node remove+re-add can reuse a row). Draining
+        # happens OUTSIDE the lock (readback + binds), then re-encode.
+        # cheap pre-check so the common drain case pays one encode, not two
+        # (the locked re-check below remains authoritative: encode itself
+        # can intern predicates and dirty rows)
+        if self._pending is not None and self.cache.encoder.has_pending_updates:
+            self._resolve_pending()
+        while True:
+            with self.cache.lock, _stage_timer("encode"):
+                eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
+                ptab, n_waves = self._pair_table(eb)
+                if (
+                    self._pending is None
+                    or not self.cache.encoder.has_pending_updates
+                ):
+                    snap = self.cache.encoder.flush()
+                    enc_cfg = self.cache.encoder.cfg
+                    row_names = list(self.cache.encoder.row_names)
+                    break
+            self._resolve_pending()
         trace.step("encoded+flushed")
         kern = make_wave_kernel_jit(
             enc_cfg.v_cap,
@@ -443,27 +503,69 @@ class Scheduler:
             self.cfg.hard_pod_affinity_weight,
         )
         self._rng_key, sub = jax.random.split(self._rng_key)
+        try:
+            new_snap, res = kern(
+                snap, eb.batch, ptab, np.asarray(self._weights), sub
+            )
+        except Exception:
+            self.cache.encoder.invalidate_device()
+            raise
+        with self.cache.lock:
+            self.cache.encoder.set_device_snapshot(new_snap)
+        prev, self._pending = self._pending, _InFlightBatch(
+            pis, eb, row_names, res, moves0, trace, t_start
+        )
+        if prev is not None:
+            self._resolve_batch(prev)
+
+    def _resolve_pending(self) -> None:
+        p, self._pending = self._pending, None
+        if p is not None:
+            self._resolve_batch(p)
+
+    def _resolve_batch(self, p: "_InFlightBatch") -> None:
+        """Resolve one in-flight batch; never raises. An exception mid-way
+        would otherwise be misattributed by the loop's handler to the batch
+        currently in self._pending (requeueing pods that are about to bind)
+        while dropping this batch's unprocessed tail."""
+        try:
+            self._resolve_batch_inner(p)
+        except Exception:
+            logger.exception("resolving wave batch failed")
+            moves = self.queue.moves
+            for pi in p.pis:
+                key = pi.pod.metadata.key
+                if self.cache.has_pod(key):
+                    continue  # already assumed/bound before the exception
+                self.queue.add_unschedulable_if_not_present(pi, moves)
+
+    def _resolve_batch_inner(self, p: "_InFlightBatch") -> None:
+        """Read back one in-flight batch's results and act on them."""
+        pis, eb, row_names, res = p.pis, p.eb, p.row_names, p.res
+        moves0, trace, t_start = p.moves0, p.trace, p.t_start
         with _stage_timer("kernel"):
+            # ONE pytree readback: each separate np.asarray is a full tunnel
+            # round trip (~65 ms); the round-2 "330 ms kernel" was mostly
+            # sequential readbacks. resolvable_tpl stays on device — it is
+            # only fetched on the (rare) failure path below.
             try:
-                new_snap, res = kern(
-                    snap, eb.batch, ptab, np.asarray(self._weights), sub
+                chosen, placed, deferred = jax.device_get(
+                    (res.chosen, res.placed, res.deferred)
                 )
             except Exception:
+                # device/tunnel error: the kernel's on-device commits are
+                # unknowable — rebuild HBM from the host masters and retry
                 self.cache.encoder.invalidate_device()
-                raise
-            with self.cache.lock:
-                self.cache.encoder.set_device_snapshot(new_snap)
-            jax.block_until_ready(
-                (res.chosen, res.placed, res.deferred, res.feasible_count)
-            )
-            chosen = np.asarray(res.chosen)
-            placed = np.asarray(res.placed)
-            deferred = np.asarray(res.deferred)
+                moves = self.queue.moves
+                for pi in pis:
+                    self.queue.add_unschedulable_if_not_present(pi, moves)
+                logger.exception("wave batch readback failed")
+                return
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
         metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
 
-        to_bind: List = []  # (pi, node_name)
+        to_bind: List = []  # (pi, node_name, prio_band)
         fallback_pis: List[QueuedPodInfo] = []
         failed: List = []  # (pi, tpl_index)
         for i, pi in enumerate(pis):
@@ -475,27 +577,31 @@ class Scheduler:
                 if node_name is None:
                     failed.append((pi, i))
                     continue
-                to_bind.append((pi, node_name))
+                to_bind.append((pi, node_name, int(eb.pod_band_np[i])))
             elif deferred[i]:
                 self.queue.readd(pi)
             else:
                 failed.append((pi, i))
 
-        self._assume_and_bind_bulk(to_bind, t_start)
+        self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
         if fallback_pis or failed:
+            # the host paths below read the host cache; a NEWER in-flight
+            # batch holds device-committed placements the cache can't see
+            # yet — resolve it first or fallback/preemption would grant the
+            # same capacity twice (bounded recursion: pending is detached
+            # before each resolve)
+            self._resolve_pending()
             self._snapshot = self.cache.update_snapshot()
         for pi in fallback_pis:
             self._schedule_one_host(pi, moves0)
         if failed:
-            resolvable_tpl = np.asarray(res.resolvable_tpl)
-            pod_tpl = np.asarray(eb.batch.pod_tpl)
+            resolvable_tpl = jax.device_get(res.resolvable_tpl)
+            pod_tpl = eb.pod_tpl_np
             # batched masked what-if (one device call for ALL failed pods):
             # per-template optimistic preemption mask, priority = max over
             # the batch's pods of that template so the mask stays a superset
             # for every pod; the host reprieve loop is the exact check
-            whatif_tpl = self._preempt_whatif_tpl(
-                eb, [(pi, i) for pi, i in failed], pod_tpl
-            )
+            whatif_tpl = self._preempt_whatif_tpl(eb, failed, pod_tpl)
             for pi, i in failed:
                 t = int(pod_tpl[i])
                 rows_mask = resolvable_tpl[t]
@@ -513,6 +619,7 @@ class Scheduler:
                         row_names[r] for r in rows if row_names[r]
                     ],
                 )
+        trace.log_if_long(0.1)
 
     def _preempt_whatif_tpl(self, eb, failed: List, pod_tpl: np.ndarray):
         """[TPL, N] optimistic preemption mask for the batch's templates
@@ -521,27 +628,40 @@ class Scheduler:
             from ..ops.lattice import preempt_whatif
 
             prios = np.zeros(eb.batch.tpl.valid.shape[0], np.int32)
-            pod_prio = np.asarray(eb.batch.pod_prio)
+            pod_prio = eb.pod_prio_np
             for pi, i in failed:
                 t = int(pod_tpl[i])
                 prios[t] = max(prios[t], int(pod_prio[i]))
             with self.cache.lock:
-                snap = self.cache.encoder.flush()
+                if (
+                    self._pending is not None
+                    and self.cache.encoder.has_pending_updates
+                ):
+                    # a newer batch is in flight: scattering master rows now
+                    # would erase its on-device commits. Use the snapshot
+                    # as-is — the mask is optimistic/advisory either way
+                    # (the host reprieve loop does the exact check).
+                    snap = self.cache.encoder._device
+                else:
+                    snap = self.cache.encoder.flush()
             return np.asarray(preempt_whatif(snap, eb.batch.tpl, prios))
         except Exception:
             logger.exception("preempt what-if kernel failed; using resolvable only")
             return None
 
-    def _assume_and_bind_bulk(self, to_bind: List, t_start: float) -> None:
-        """Assume + bind a whole wave of placements. When the profile has no
-        permit/prebind/postbind plugins and the binder is the default, the
-        binds collapse into one batch API call (the in-cycle fast path —
-        async per-pod binding remains for plugin-bearing profiles, matching
-        the reference's goroutine-per-bind at scheduler.go:666)."""
+    def _assume_and_bind_bulk(
+        self, to_bind: List, t_start: float, device_synced: bool = False
+    ) -> None:
+        """Assume + bind a whole wave of placements ((pi, node, band)
+        triples). When the profile has no permit/prebind/postbind plugins
+        and the binder is the default, the binds collapse into one batch API
+        call (the in-cycle fast path — async per-pod binding remains for
+        plugin-bearing profiles, matching the reference's goroutine-per-bind
+        at scheduler.go:666)."""
         if not to_bind:
             return
         simple: List = []
-        for pi, node_name in to_bind:
+        for pi, node_name, band in to_bind:
             pod = pi.pod
             prof = self.profiles.for_pod(pod)
             fw = prof.framework
@@ -555,8 +675,14 @@ class Scheduler:
                 and ps.bind == ["DefaultBinder"]
             )
             try:
-                self.cache.assume_pod(pod, node_name)
+                self.cache.assume_pod(
+                    pod, node_name, device_synced=device_synced, prio_band=band
+                )
             except ValueError as e:
+                if device_synced:
+                    # the kernel already committed this placement on-device;
+                    # with no host replay the row must be re-uploaded
+                    self.cache.encoder.mark_row_dirty(node_name)
                 self._handle_failure(
                     pi, self.queue.moves, message=str(e), error=True
                 )
