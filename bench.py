@@ -113,6 +113,40 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
 
+        # gang coscheduling burst (BASELINE.md: 15k pending pods in gangs of
+        # 50 on 5k nodes, all-or-nothing via the Coscheduling Permit plugin).
+        # Skipped on the CPU fallback: the unaccelerated kernel makes the
+        # 15-batch burst take minutes without saying anything new.
+        gang = None
+        if not platform.startswith("cpu"):
+            try:
+                from kubernetes_tpu.scheduler.config import (
+                    KubeSchedulerConfiguration,
+                    ProfileConfig,
+                )
+                from kubernetes_tpu.scheduler.framework.registry import (
+                    coscheduling_plugin_set,
+                )
+
+                gcfg = KubeSchedulerConfiguration(
+                    profiles=[ProfileConfig(plugin_set=coscheduling_plugin_set())]
+                )
+                gres = run_benchmark(
+                    WORKLOADS["Gang/5000"],
+                    sched_config=gcfg,
+                    quiet=True,
+                    timeout_s=600.0,
+                )
+                gang = {
+                    "workload": "Gang/5000 (300 gangs x 50, min-member 50)",
+                    "scheduled": gres.scheduled,
+                    "unscheduled": gres.unscheduled,
+                    "duration_s": round(gres.duration_s, 3),
+                    "pods_per_s": round(gres.throughput_pods_per_s, 1),
+                }
+            except Exception:
+                traceback.print_exc()
+
         out.update(
             value=round(res.throughput_pods_per_s, 1),
             vs_baseline=round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
@@ -131,6 +165,7 @@ def main() -> int:
                     "kernel_total": round(res.kernel_total_s, 3),
                     "n_batches": res.n_batches,
                 },
+                "gang": gang,
                 "steady_state_latency": (
                     {
                         "rate_pods_per_s": round(lat.rate_pods_per_s, 1),

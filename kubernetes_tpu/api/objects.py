@@ -1005,3 +1005,161 @@ class PriorityClass:
 
     def deep_copy(self) -> "PriorityClass":
         return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# ConfigMap / Secret / ServiceAccount (core/v1) — reference
+# staging/src/k8s.io/api/core/v1/types.go (ConfigMap, Secret, ServiceAccount)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    binary_data: Dict[str, bytes] = field(default_factory=dict)
+    immutable: bool = False
+    kind: str = "ConfigMap"
+
+    def deep_copy(self) -> "ConfigMap":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, bytes] = field(default_factory=dict)
+    string_data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+    immutable: bool = False
+    kind: str = "Secret"
+
+    def deep_copy(self) -> "Secret":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServiceAccount:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)  # token secret names
+    automount_service_account_token: bool = True
+    kind: str = "ServiceAccount"
+
+    def deep_copy(self) -> "ServiceAccount":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# HorizontalPodAutoscaler (autoscaling/v1) — reference
+# staging/src/k8s.io/api/autoscaling/v1/types.go; controller semantics at
+# pkg/controller/podautoscaler/horizontal.go
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CrossVersionObjectReference:
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference
+    )
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[float] = None
+    observed_generation: int = 0
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec
+    )
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus
+    )
+    kind: str = "HorizontalPodAutoscaler"
+
+    def deep_copy(self) -> "HorizontalPodAutoscaler":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# CronJob (batch/v1beta1) — reference staging/src/k8s.io/api/batch/v1beta1;
+# controller semantics at pkg/controller/cronjob/cronjob_controller.go
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: "JobSpec" = field(default_factory=lambda: JobSpec())
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = "* * * * *"  # 5-field cron
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    starting_deadline_seconds: Optional[int] = None
+    job_template: JobTemplateSpec = field(default_factory=lambda: JobTemplateSpec())
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+
+
+@dataclass
+class CronJobStatus:
+    active: List[str] = field(default_factory=list)  # job keys
+    last_schedule_time: Optional[float] = None
+
+
+@dataclass
+class CronJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+    kind: str = "CronJob"
+
+    def deep_copy(self) -> "CronJob":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# ResourceQuota (core/v1) — reference staging/src/k8s.io/api/core/v1 +
+# pkg/controller/resourcequota/resource_quota_controller.go
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    scopes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    used: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+    kind: str = "ResourceQuota"
+
+    def deep_copy(self) -> "ResourceQuota":
+        return copy.deepcopy(self)

@@ -37,6 +37,12 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "poddisruptionbudgets": v1.PodDisruptionBudget,
     "endpoints": v1.Endpoints,
     "priorityclasses": v1.PriorityClass,
+    "configmaps": v1.ConfigMap,
+    "secrets": v1.Secret,
+    "serviceaccounts": v1.ServiceAccount,
+    "horizontalpodautoscalers": v1.HorizontalPodAutoscaler,
+    "cronjobs": v1.CronJob,
+    "resourcequotas": v1.ResourceQuota,
 }
 
 KIND_TO_RESOURCE = {

@@ -181,6 +181,12 @@ class NodeAgentPool:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # the node-side service dataplane (kube-proxy-lite): one shared
+        # Proxier per pool — the table has no per-node state in this build,
+        # mirroring kubemark's HollowProxy sharing one iptables interface
+        from ..proxy import Proxier
+
+        self.proxy = Proxier(server)
 
     @staticmethod
     def _default_runtime(node_name: str) -> PodRuntime:
@@ -234,9 +240,11 @@ class NodeAgentPool:
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
+        self.proxy.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self.proxy.stop()
 
     # -- shared loops --------------------------------------------------------
 

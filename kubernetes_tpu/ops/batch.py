@@ -184,6 +184,10 @@ def encode_pod_batch(
         per_pod.append(d)
 
     # ---- pass 2: fixed-shape arrays (capacities now final) -----------------
+    # re-read the config: pass-1 interning may have GROWN capacities, and
+    # _grow replaces enc.cfg with a new object — the `c` bound above would
+    # silently allocate stale-shaped arrays (caught by the differential fuzz)
+    c = enc.cfg
     S, T = c.s_cap, c.t_cap
     b = {
         "valid": np.zeros(P, np.bool_),

@@ -347,6 +347,10 @@ class SnapshotEncoder:
         self._full_upload = True
         self._globals_dirty = False  # non-row fields (band_prio, eterm meta)
         self._device: Optional[DeviceSnapshot] = None
+        # multi-chip placement: snapshot sharding pytree + replicated spec
+        # (set by the scheduler when it owns a device mesh; None = one chip)
+        self._snap_shardings: Optional[DeviceSnapshot] = None
+        self._rep_sharding = None
         self.generation = 0  # bumped on every mutation
 
     # -- master allocation / growth ---------------------------------------
@@ -791,7 +795,10 @@ class SnapshotEncoder:
         """
         masters = self._masters()
         if self._device is None or self._full_upload:
-            self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
+            if self._snap_shardings is not None:
+                self._device = jax.device_put(masters, self._snap_shardings)
+            else:
+                self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
             self._full_upload = False
             self._globals_dirty = False
             self._dirty_rows.clear()
@@ -824,13 +831,29 @@ class SnapshotEncoder:
         )
         # one device_put for the whole update pytree: transfers pipeline in
         # a single tunnel exchange instead of one ~65 ms RTT per field
-        idx_d, updates_d = jax.device_put((idx, updates))
+        if self._rep_sharding is not None:
+            sh = jax.tree.map(lambda _: self._rep_sharding, (idx, updates))
+            idx_d, updates_d = jax.device_put((idx, updates), sh)
+        else:
+            idx_d, updates_d = jax.device_put((idx, updates))
         self._device = _scatter_rows(self._device, idx_d, updates_d)
         return self._device
 
+    def set_sharding(self, snap_shardings, replicated_sharding) -> None:
+        """Adopt multi-chip placement (parallel/mesh.snapshot_shardings):
+        row-major tensors shard over the mesh's node axis, update scatters
+        replicate. Forces a fresh (sharded) upload."""
+        self._snap_shardings = snap_shardings
+        self._rep_sharding = replicated_sharding
+        self.invalidate_device()
+
     @property
     def has_pending_updates(self) -> bool:
-        """True when flush() would need to touch the device snapshot."""
+        """True when the host masters have diverged from an EXISTING device
+        snapshot (flush would scatter or re-upload). Before the first flush
+        there is no device state to be stale, so nothing is pending."""
+        if self._device is None:
+            return False
         return bool(self._dirty_rows) or self._globals_dirty or self._full_upload
 
     def mark_row_dirty(self, node_name: str) -> None:

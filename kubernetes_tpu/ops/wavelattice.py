@@ -86,6 +86,8 @@ class WaveResult(NamedTuple):
     feasible_count: Any  # [P] int32 base-feasible node count
     score: Any  # [P] float32
     resolvable_tpl: Any  # [TPL, N] bool — preemption candidates per template
+    feasible_tpl: Any  # [TPL, N] bool — pre-commit filter verdicts (the
+    # differential-fuzz oracle surface; never fetched by the scheduler)
 
 
 def _group_prefix_sums(groups, sort_key, values):
@@ -576,6 +578,7 @@ def make_wave_kernel(
             feasible_count=feas_cnt,
             score=score_out,
             resolvable_tpl=resolvable_tpl,
+            feasible_tpl=feasible0,
         )
 
     return kernel

@@ -24,6 +24,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.encoding import DeviceSnapshot, PodBatch
 from ..ops.lattice import BatchResult, make_schedule_batch_raw
+from ..ops.templates import PairTable, TemplateBatch
+from ..ops.wavelattice import WaveResult, make_wave_kernel
 from .mesh import NODES_AXIS, replicated, snapshot_shardings
 
 
@@ -62,3 +64,66 @@ def make_sharded_schedule_batch(
         resolvable=NamedSharding(mesh, P(None, NODES_AXIS)),
     )
     return jax.jit(base, in_shardings=in_shardings, out_shardings=out_shardings)
+
+
+@functools.lru_cache(maxsize=32)
+def make_sharded_wave_kernel(
+    v_cap: int,
+    m_cand: int,
+    n_waves: int,
+    hard_pod_affinity_weight: float,
+    mesh: Mesh,
+):
+    """The PRODUCTION wave kernel (ops/wavelattice.py) jitted with the
+    snapshot sharded over the mesh's node axis.
+
+    Same program as make_wave_kernel_jit — the SPMD partitioner turns its
+    node-axis math into local work + ICI collectives:
+      * per-template filter masks / score matrices [TPL, N]: purely local,
+      * topology-domain segment-sums [J, V]: local partial sums + psum
+        (domain ids are global across shards),
+      * top-M candidate selection per template: local top-k + cross-shard
+        merge (all-gather of the [TPL, M] candidates),
+      * wave-loop conflict resolution on the POD axis: replicated (small),
+      * occupancy commit scatters (.at[rows].add): routed to the owning
+        shard.
+    The donated snapshot stays sharded across batches, so consecutive
+    batches chain on-device exactly like the single-chip path. This is the
+    multi-chip analogue of the reference's 16-way node fan-out
+    (generic_scheduler.go:490) with ICI collectives instead of goroutines.
+    """
+    base = make_wave_kernel(v_cap, m_cand, n_waves, hard_pod_affinity_weight)
+    rep = replicated(mesh)
+    snap_sh = snapshot_shardings(mesh)
+    in_shardings = (
+        snap_sh,
+        TemplateBatch(
+            tpl=PodBatch(*([rep] * len(PodBatch._fields))),
+            pod_tpl=rep,
+            pod_valid=rep,
+            pod_name_row=rep,
+            pod_prio=rep,
+            pod_band=rep,
+        ),
+        PairTable(*([rep] * len(PairTable._fields))),
+        rep,
+        rep,
+    )
+    out_shardings = (
+        snap_sh,
+        WaveResult(
+            chosen=rep,
+            placed=rep,
+            deferred=rep,
+            feasible_count=rep,
+            score=rep,
+            resolvable_tpl=NamedSharding(mesh, P(None, NODES_AXIS)),
+            feasible_tpl=NamedSharding(mesh, P(None, NODES_AXIS)),
+        ),
+    )
+    return jax.jit(
+        base,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(0,),
+    )

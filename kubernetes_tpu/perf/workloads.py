@@ -185,10 +185,20 @@ def build_workload(
 
     if cfg.name == "Gang":
         # gang burst: groups of 50 identical pods (PodGroup-style), all
-        # pending at once (BASELINE.md: 15k pending pods on 5k nodes)
+        # pending at once (BASELINE.md: 15k pending pods on 5k nodes);
+        # membership/quorum per the Coscheduling plugin's contract
+        from ..scheduler.framework.plugins.coscheduling import (
+            GROUP_LABEL,
+            MIN_MEMBER_ANNOTATION,
+        )
+
         def factory(i: int) -> Pod:
             g = i // 50
-            return _basic_pod(f"pod-{i}", labels={"app": "bench", "group": f"g{g}"})
+            p = _basic_pod(
+                f"pod-{i}", labels={"app": "bench", GROUP_LABEL: f"g{g}"}
+            )
+            p.metadata.annotations[MIN_MEMBER_ANNOTATION] = "50"
+            return p
 
         return nodes, [], factory
 

@@ -1,0 +1,1 @@
+from .proxy import ClusterIPAllocator, Proxier  # noqa: F401

@@ -1,0 +1,207 @@
+"""kube-proxy-lite: the per-node service VIP dataplane.
+
+Reference shape: pkg/proxy/iptables/proxier.go — the proxier watches
+Services + Endpoints, and `syncProxyRules` (proxier.go:775) rebuilds the
+node's full NAT table on every sync: one chain per service port
+(KUBE-SVC-*), one per endpoint (KUBE-SEP-*) with statistical round-robin,
+and ClientIP session affinity via `recent` match. Changes are accumulated
+in change-tracker maps and applied atomically by iptables-restore.
+
+This build has no netfilter to program; the dataplane is a process-local
+routing table the (hollow) pod runtime queries to reach a VIP:
+
+    table: (cluster_ip | "ns/name", port_name_or_number) -> [backends]
+    resolve(vip, port, client_key) -> one backend (RR or ClientIP-hash)
+
+The sync loop mirrors syncProxyRules' structure: event handlers only mark
+pending changes; a single sync rebuilds the whole table from the informer
+caches and swaps it atomically (readers never see a partial table); a
+min-sync interval coalesces event bursts the way the proxier's
+BoundedFrequencyRunner does.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..api import objects as v1
+from ..client.informers import SharedInformerFactory
+
+logger = logging.getLogger("kubernetes_tpu.proxy")
+
+AFFINITY_ANNOTATION = "service.kubernetes.io/session-affinity"  # "ClientIP"
+
+
+class Proxier:
+    """One per node (NodeAgentPool shares one per process — the table is
+    node-independent in this build since there is no real network)."""
+
+    def __init__(
+        self,
+        server,
+        node_name: str = "",
+        min_sync_period: float = 0.05,
+        informer_factory: Optional[SharedInformerFactory] = None,
+    ):
+        self.server = server
+        self.node_name = node_name
+        self.min_sync = min_sync_period
+        self._own_informers = informer_factory is None
+        self.informers = informer_factory or SharedInformerFactory(server)
+        self._lock = threading.Lock()
+        self._table: Dict[Tuple[str, object], List[Tuple[str, int]]] = {}
+        self._affinity: Dict[str, str] = {}  # every vip key -> affinity mode
+        self._rr: Dict[Tuple[str, object], int] = {}  # per-(vip, port) RR
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.syncs = 0  # sync counter (tests/metrics)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        svc_inf = self.informers.informer("services")
+        ep_inf = self.informers.informer("endpoints")
+        mark = lambda *_a, **_k: self._dirty.set()  # noqa: E731
+        svc_inf.add_handler(on_add=mark, on_update=mark, on_delete=mark)
+        ep_inf.add_handler(on_add=mark, on_update=mark, on_delete=mark)
+        if self._own_informers:
+            self.informers.start()
+            self.informers.wait_for_cache_sync()
+        self._dirty.set()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"proxier-{self.node_name}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        if self._own_informers:
+            self.informers.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait()
+            if self._stop.is_set():
+                return
+            self._dirty.clear()
+            try:
+                self.sync_proxy_rules()
+            except Exception:
+                logger.exception("proxy sync failed")
+            # BoundedFrequencyRunner: coalesce event bursts
+            self._stop.wait(self.min_sync)
+
+    # -- the sync (syncProxyRules-shaped: full rebuild, atomic swap) --------
+
+    def sync_proxy_rules(self) -> None:
+        services, _ = self.server.list("services")
+        table: Dict[Tuple[str, object], List[Tuple[str, int]]] = {}
+        affinity: Dict[str, str] = {}
+        for svc in services:
+            mode = svc.metadata.annotations.get(AFFINITY_ANNOTATION, "")
+            try:
+                eps = self.server.get(
+                    "endpoints", svc.metadata.namespace, svc.metadata.name
+                )
+            except Exception:
+                eps = None
+            backends_by_port: Dict[object, List[Tuple[str, int]]] = {}
+            if eps is not None:
+                for subset in eps.subsets:
+                    for pname, pnum in subset.ports or [("", 0)]:
+                        # route by number AND name: kube-proxy keys rules by
+                        # service port number; names are aliases
+                        lst: List[Tuple[str, int]] = []
+                        for addr in subset.addresses:
+                            lst.append((addr.ip or addr.target_pod, pnum))
+                        for port_id in {pname, pnum} - {""}:
+                            backends_by_port.setdefault(port_id, []).extend(lst)
+            for vip_key in self._vips(svc):
+                affinity[vip_key] = mode
+                for port_id, backends in backends_by_port.items():
+                    table[(vip_key, port_id)] = backends
+                if not backends_by_port:
+                    # service with no endpoints: present but empty (the
+                    # proxier emits a REJECT rule; resolve returns None)
+                    table[(vip_key, None)] = []
+        with self._lock:
+            self._table = table
+            self._affinity = affinity
+            self.syncs += 1
+
+    @staticmethod
+    def _vips(svc: v1.Service) -> List[str]:
+        vips = [svc.metadata.key]  # "ns/name" — DNS-ish lookup
+        if svc.spec.cluster_ip:
+            vips.append(svc.spec.cluster_ip)
+        return vips
+
+    # -- the query plane ----------------------------------------------------
+
+    def resolve(
+        self, vip: str, port: object = None, client_key: str = ""
+    ) -> Optional[Tuple[str, int]]:
+        """One backend for vip:port — round-robin, or a stable ClientIP hash
+        when the service requests session affinity (proxier.go `recent`
+        match equivalent)."""
+        with self._lock:
+            backends = self._table.get((vip, port))
+            if backends is None and port is None:
+                # unique port fallback: a service with one port resolves
+                # without naming it
+                cands = [
+                    v
+                    for (k, p), v in self._table.items()
+                    if k == vip and p is not None
+                ]
+                backends = cands[0] if len(cands) == 1 else None
+            if not backends:
+                return None
+            if self._affinity.get(vip, "") == "ClientIP" and client_key:
+                i = zlib.crc32(client_key.encode()) % len(backends)
+            else:
+                n = self._rr.get((vip, port), 0)
+                self._rr[(vip, port)] = n + 1
+                i = n % len(backends)
+            return backends[i]
+
+    def endpoints_of(self, vip: str, port: object = None) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._table.get((vip, port), []))
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.syncs > 0 and not self._dirty.is_set():
+                    return True
+            time.sleep(0.01)
+        return False
+
+
+class ClusterIPAllocator:
+    """Admit hook: assigns a virtual ClusterIP from a /16 at Service create —
+    the in-process stand-in for the apiserver's service IP allocator
+    (reference pkg/registry/core/service ipallocator)."""
+
+    def __init__(self, prefix: str = "10.96"):
+        self.prefix = prefix
+        self._next = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def __call__(self, verb: str, kind: str, obj) -> None:
+        if verb != "create" or kind != "services":
+            return
+        if getattr(obj.spec, "cluster_ip", ""):
+            return
+        with self._lock:
+            n = next(self._next)
+        obj.spec.cluster_ip = f"{self.prefix}.{(n >> 8) & 0xFF}.{n & 0xFF}"

@@ -36,8 +36,11 @@ class KubeSchedulerConfiguration:
     )
     extenders: List["ExtenderConfig"] = field(default_factory=list)
     hard_pod_affinity_weight: float = 1.0
+    coscheduling_permit_timeout: float = 30.0  # gang quorum wait (Permit)
     # --- TPU-native section -------------------------------------------------
     use_device: bool = True  # TPUBatchScore profile gate
+    use_mesh: bool = True  # shard the snapshot over all visible devices
+    # (node-axis pjit; single-device processes run the unsharded kernel)
     device_batch_size: int = 1024
     device_batch_window: float = 0.01  # linger to let bursts accumulate (tunnel
     # RTT dwarfs 10ms; fuller batches amortize it)

@@ -44,3 +44,4 @@ from .extended import (  # noqa: F401
     NodeResourceLimits,
     ServiceAffinity,
 )
+from .coscheduling import Coscheduling  # noqa: F401

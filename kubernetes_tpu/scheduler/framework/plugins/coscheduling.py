@@ -1,0 +1,174 @@
+"""Gang (co-)scheduling on the QueueSort + Permit extension points.
+
+The reference ships no in-tree coscheduling plugin — its extension points
+were designed so one could be built out-of-tree (Permit's WAIT verdict and
+the waitingPodsMap, framework/v1alpha1/interface.go:211-499,
+waiting_pods_map.go). This plugin is that build, adapted to the batched TPU
+cycle: a burst of gang members is typically placed by ONE wave-kernel batch,
+so the whole gang reaches Permit within a cycle and the quorum release is a
+single in-memory cascade — no per-pod polling.
+
+Gang contract:
+  * membership: label ``scheduling.k8s.io/group-name`` = gang id
+    (namespace-scoped);
+  * quorum: annotation ``scheduling.k8s.io/min-member`` (int, defaults to 1);
+  * all-or-nothing: members WAIT in Permit until `min-member` of them hold
+    reservations; any member's unreserve (bind failure, permit timeout)
+    rejects every waiting member so their resources release together.
+
+QueueSort keeps gang members adjacent (priority desc, then gang id, then
+FIFO), so the batch former pops whole gangs into one device batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+from ..interface import (
+    PermitPlugin,
+    PostBindPlugin,
+    QueueSortPlugin,
+    Status,
+    UnreservePlugin,
+)
+
+GROUP_LABEL = "scheduling.k8s.io/group-name"
+MIN_MEMBER_ANNOTATION = "scheduling.k8s.io/min-member"
+
+
+def gang_key(pod) -> Optional[str]:
+    name = pod.metadata.labels.get(GROUP_LABEL)
+    if not name:
+        return None
+    return f"{pod.metadata.namespace}/{name}"
+
+
+def min_member(pod) -> int:
+    try:
+        return max(1, int(pod.metadata.annotations.get(MIN_MEMBER_ANNOTATION, "1")))
+    except ValueError:
+        return 1
+
+
+class _GangState:
+    __slots__ = ("reserved", "released", "first_seen")
+
+    def __init__(self) -> None:
+        self.reserved: Set[str] = set()  # pod uids holding a reservation
+        self.released = False  # quorum reached, members flow through
+        self.first_seen = time.monotonic()
+
+
+class Coscheduling(QueueSortPlugin, PermitPlugin, UnreservePlugin, PostBindPlugin):
+    name = "Coscheduling"
+
+    def __init__(self, framework_getter=None, permit_timeout: float = 30.0):
+        # framework_getter breaks the construction cycle: the framework owns
+        # the plugin instances AND the waitingPodsMap the cascade signals
+        self._fw = framework_getter
+        self.permit_timeout = permit_timeout
+        self._lock = threading.Lock()
+        self._gangs: Dict[str, _GangState] = {}
+
+    # -- QueueSort ----------------------------------------------------------
+
+    def less(self, pi1, pi2) -> bool:
+        """priority desc, then gang id (members adjacent), then FIFO."""
+        p1, p2 = pi1.pod.priority, pi2.pod.priority
+        if p1 != p2:
+            return p1 > p2
+        g1 = gang_key(pi1.pod) or ""
+        g2 = gang_key(pi2.pod) or ""
+        if g1 != g2:
+            return g1 < g2
+        return pi1.timestamp < pi2.timestamp
+
+    # -- Permit -------------------------------------------------------------
+
+    def permit(self, state, pod, node_name) -> Tuple[Optional[Status], float]:
+        key = gang_key(pod)
+        if key is None:
+            return None, 0.0
+        quorum = min_member(pod)
+        with self._lock:
+            st = self._gangs.setdefault(key, _GangState())
+            st.reserved.add(pod.metadata.uid)
+            if st.released or len(st.reserved) >= quorum:
+                st.released = True
+                to_allow = list(st.reserved)
+            else:
+                return Status.wait(), self.permit_timeout
+        # quorum reached by THIS pod: release every parked member
+        self._cascade(to_allow, allow=True)
+        return None, 0.0
+
+    # -- Unreserve ----------------------------------------------------------
+
+    def unreserve(self, state, pod, node_name) -> None:
+        key = gang_key(pod)
+        if key is None:
+            return
+        with self._lock:
+            st = self._gangs.get(key)
+            if st is None:
+                return
+            st.reserved.discard(pod.metadata.uid)
+            # all-or-nothing: a lost reservation before release voids the
+            # gang attempt; reject parked members so their resources free
+            # together instead of idling until the permit timeout
+            reject = list(st.reserved) if not st.released else []
+            if not st.reserved:
+                self._gangs.pop(key, None)
+        if reject:
+            self._cascade(reject, allow=False, msg=f"gang {key} lost a member")
+
+    # -- helpers ------------------------------------------------------------
+
+    def _cascade(self, uids, allow: bool, msg: str = "") -> None:
+        fw = self._fw() if self._fw else None
+        if fw is None:
+            return
+        for uid in uids:
+            wp = fw.get_waiting_pod(uid)
+            if wp is None:
+                continue
+            if allow:
+                wp.allow(self.name)
+            else:
+                wp.reject(msg)
+
+    def handle_scheduling_failure(self, pod) -> None:
+        """A member hard-failed its scheduling cycle: quorum cannot arrive
+        this round, so reject the parked siblings NOW instead of letting 49
+        reservations idle-block cluster capacity until the permit timeout
+        (the community plugin does this from PostFilter; our scheduler calls
+        permit plugins' failure hook from _handle_failure)."""
+        key = gang_key(pod)
+        if key is None:
+            return
+        with self._lock:
+            st = self._gangs.get(key)
+            if st is None or st.released:
+                return
+            reject = list(st.reserved)
+        if reject:
+            self._cascade(
+                reject, allow=False, msg=f"gang {key}: member failed scheduling"
+            )
+
+    # -- PostBind -----------------------------------------------------------
+
+    def post_bind(self, state, pod, node_name) -> None:
+        """Drop a bound member's bookkeeping; reclaim the gang record once
+        every released member has bound."""
+        key = gang_key(pod)
+        if key is None:
+            return
+        with self._lock:
+            st = self._gangs.get(key)
+            if st is not None and st.released:
+                st.reserved.discard(pod.metadata.uid)
+                if not st.reserved:
+                    self._gangs.pop(key, None)

@@ -132,4 +132,19 @@ def default_registry() -> Registry:
         **ctx.get("service_affinity_args", {}),
     )
     r["NodeResourceLimits"] = lambda ctx: p.NodeResourceLimits()
+    r["Coscheduling"] = lambda ctx: p.Coscheduling(
+        ctx.get("framework_getter"),
+        permit_timeout=ctx.get("coscheduling_permit_timeout", 30.0),
+    )
     return r
+
+
+def coscheduling_plugin_set() -> PluginSet:
+    """Default set + gang scheduling: Coscheduling takes over QueueSort
+    (gang-adjacent pop order) and parks members in Permit until quorum."""
+    ps = default_plugin_set()
+    ps.queue_sort = ["Coscheduling"]
+    ps.permit = ["Coscheduling"]
+    ps.unreserve = ["Coscheduling"]
+    ps.post_bind = ["Coscheduling"]
+    return ps

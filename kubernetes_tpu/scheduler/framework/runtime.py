@@ -65,7 +65,10 @@ class Framework:
     ):
         self.registry = registry or default_registry()
         self.plugin_set = plugin_set or default_plugin_set()
-        self.context = context or {}
+        self.context = dict(context) if context else {}
+        # plugins that signal other waiting pods (coscheduling's quorum
+        # cascade) need their owning framework's waitingPodsMap
+        self.context.setdefault("framework_getter", lambda: self)
         self._instances: Dict[str, object] = {}
         self.waiting_pods: Dict[str, WaitingPod] = {}
         self._waiting_lock = threading.Lock()

@@ -128,10 +128,6 @@ class Scheduler:
             ttl_seconds=self.cfg.assume_ttl_seconds,
             encoding_config=self.cfg.encoding,
         )
-        self.queue = PriorityQueue(
-            pod_initial_backoff=self.cfg.pod_initial_backoff_seconds,
-            pod_max_backoff=self.cfg.pod_max_backoff_seconds,
-        )
         self._snapshot = None  # latest host snapshot (fallback/preemption)
         self.volume_binder = VolumeBinder(server)
         context = {
@@ -142,6 +138,7 @@ class Scheduler:
             "csinode_getter": self._csinode,
             "services_lister": lambda: server.list("services")[0],
             "selectors_for_pod": self._selectors_for_pod,
+            "coscheduling_permit_timeout": self.cfg.coscheduling_permit_timeout,
             # extender managedResources flagged ignoredByScheduler: the
             # extender owns their accounting (fit.go IgnoredResources)
             "ignored_extended_resources": frozenset(
@@ -152,6 +149,15 @@ class Scheduler:
             ),
         }
         self.profiles: ProfileMap = new_profile_map(self.cfg, context, server=server)
+        # queue order comes from the default profile's QueueSort plugin
+        # (Configurator wires profiles[0].QueueSortFunc into the queue,
+        # factory.go:127; coscheduling overrides it to keep gangs adjacent)
+        default_fw = next(iter(self.profiles.values())).framework
+        self.queue = PriorityQueue(
+            less=default_fw.queue_sort_less,
+            pod_initial_backoff=self.cfg.pod_initial_backoff_seconds,
+            pod_max_backoff=self.cfg.pod_max_backoff_seconds,
+        )
         self.informer_factory = SharedInformerFactory(server)
         self.extenders = build_extenders(self.cfg.extenders)
         self._algo: Dict[str, GenericScheduler] = {
@@ -182,6 +188,7 @@ class Scheduler:
         self._sched_thread: Optional[threading.Thread] = None
         self._rng_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(0)
+        self._mesh = None  # set by start() when >1 device is visible
         # depth-1 pipeline: the launched-but-unresolved wave batch. Results
         # are read back AFTER the next batch's kernel is dispatched, so the
         # ~65 ms tunnel readback RTT overlaps the next batch's device time
@@ -237,6 +244,17 @@ class Scheduler:
         )
         with self.cache.lock:
             self.cache.encoder.presize_for_cluster(max(n_nodes, 1))
+        # multi-chip: shard the snapshot over every visible device (node
+        # axis), production wave kernel included — SURVEY §7.6
+        self._mesh = None
+        if self.cfg.use_device and self.cfg.use_mesh and len(jax.devices()) > 1:
+            from ..parallel.mesh import make_mesh, replicated, snapshot_shardings
+
+            self._mesh = make_mesh()
+            with self.cache.lock:
+                self.cache.encoder.set_sharding(
+                    snapshot_shardings(self._mesh), replicated(self._mesh)
+                )
         self.queue.run()
         self.cache.start_janitor()
         self._sched_thread = threading.Thread(
@@ -255,12 +273,10 @@ class Scheduler:
         """Test helper: wait until no pending pods remain."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            enc = self.cache.encoder
             if (
                 len(self.queue) == 0
                 and self._pending is None
-                and not enc._dirty_rows
-                and not enc._globals_dirty
+                and not self.cache.encoder.has_pending_updates
             ):
                 return True
             time.sleep(0.01)
@@ -496,12 +512,23 @@ class Scheduler:
                     break
             self._resolve_pending()
         trace.step("encoded+flushed")
-        kern = make_wave_kernel_jit(
-            enc_cfg.v_cap,
-            self.cfg.wave_m_cand,
-            n_waves,
-            self.cfg.hard_pod_affinity_weight,
-        )
+        if self._mesh is not None:
+            from ..parallel.sharded import make_sharded_wave_kernel
+
+            kern = make_sharded_wave_kernel(
+                enc_cfg.v_cap,
+                self.cfg.wave_m_cand,
+                n_waves,
+                self.cfg.hard_pod_affinity_weight,
+                self._mesh,
+            )
+        else:
+            kern = make_wave_kernel_jit(
+                enc_cfg.v_cap,
+                self.cfg.wave_m_cand,
+                n_waves,
+                self.cfg.hard_pod_affinity_weight,
+            )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:
             new_snap, res = kern(
@@ -633,17 +660,11 @@ class Scheduler:
                 t = int(pod_tpl[i])
                 prios[t] = max(prios[t], int(pod_prio[i]))
             with self.cache.lock:
-                if (
-                    self._pending is not None
-                    and self.cache.encoder.has_pending_updates
-                ):
-                    # a newer batch is in flight: scattering master rows now
-                    # would erase its on-device commits. Use the snapshot
-                    # as-is — the mask is optimistic/advisory either way
-                    # (the host reprieve loop does the exact check).
-                    snap = self.cache.encoder._device
-                else:
-                    snap = self.cache.encoder.flush()
+                # _resolve_batch_inner drains the pipeline before the failed
+                # block, so no newer batch can be in flight here and flush's
+                # scatter cannot erase un-replayed device commits
+                assert self._pending is None
+                snap = self.cache.encoder.flush()
             return np.asarray(preempt_whatif(snap, eb.batch.tpl, prios))
         except Exception:
             logger.exception("preempt what-if kernel failed; using resolvable only")
@@ -915,6 +936,17 @@ class Scheduler:
         prof.recorder.eventf(
             pod, "Warning", "FailedScheduling", "Scheduling", message
         )
+        # permit plugins may hold siblings of this pod parked (gang quorum);
+        # tell them the member failed so reservations release promptly
+        for name in prof.framework.plugin_set.permit:
+            hook = getattr(
+                prof.framework.plugin(name), "handle_scheduling_failure", None
+            )
+            if hook is not None:
+                try:
+                    hook(pod)
+                except Exception:
+                    logger.exception("permit failure hook %s", name)
         self._set_pod_unschedulable_condition(pod, message)
         if not error and not self.cfg.disable_preemption:
             self._attempt_preemption(pod, prof, fit_error, candidate_nodes)

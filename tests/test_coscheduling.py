@@ -1,0 +1,185 @@
+"""Gang coscheduling: all-or-nothing placement on QueueSort+Permit.
+
+The reference has no in-tree coscheduling — the plugin is built on the same
+extension points (Permit WAIT + waitingPodsMap,
+framework/v1alpha1/interface.go:211-499). These tests pin the contract:
+quorum release, timeout rejection with resource release, and end-to-end
+gang bursts through the full batched scheduler."""
+
+import time
+
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.scheduler.config import ProfileConfig
+from kubernetes_tpu.scheduler.framework.plugins.coscheduling import (
+    GROUP_LABEL,
+    MIN_MEMBER_ANNOTATION,
+)
+from kubernetes_tpu.scheduler.framework.registry import coscheduling_plugin_set
+
+
+def make_node(name, cpu="4"):
+    return Node(
+        metadata=ObjectMeta(name=name),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={"cpu": cpu, "memory": "16Gi", "pods": 110}),
+    )
+
+
+def gang_pod(name, gang, min_member, cpu="500m"):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            labels={GROUP_LABEL: gang},
+            annotations={MIN_MEMBER_ANNOTATION: str(min_member)},
+        ),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})]),
+    )
+
+
+def _gang_scheduler(server, permit_timeout=30.0):
+    cfg = KubeSchedulerConfiguration(
+        profiles=[ProfileConfig(plugin_set=coscheduling_plugin_set())],
+        coscheduling_permit_timeout=permit_timeout,
+    )
+    return Scheduler(server, cfg)
+
+
+def _wait_bound(server, n, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = server.list("pods")
+        if sum(1 for p in pods if p.spec.node_name) >= n:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_gang_binds_all_or_nothing_success():
+    server = APIServer()
+    for i in range(8):
+        server.create("nodes", make_node(f"n{i}"))
+    sched = _gang_scheduler(server)
+    sched.start()
+    try:
+        for i in range(20):
+            server.create("pods", gang_pod(f"g0-{i}", "g0", 20))
+        assert _wait_bound(server, 20), "full gang must bind"
+    finally:
+        sched.stop()
+
+
+def test_gang_short_of_quorum_releases_resources():
+    """A gang that can never reach quorum must not hold reservations: after
+    the permit timeout, every member is unreserved and a later non-gang pod
+    can use the freed capacity."""
+    server = APIServer()
+    server.create("nodes", make_node("n0", cpu="2"))
+    sched = _gang_scheduler(server, permit_timeout=1.0)
+    sched.start()
+    try:
+        # quorum 8, but only 4x500m fits on the single 2-cpu node
+        for i in range(8):
+            server.create("pods", gang_pod(f"g1-{i}", "g1", 8))
+        time.sleep(3.0)  # permit timeout + unreserve cascade
+        pods, _ = server.list("pods")
+        assert all(not p.spec.node_name for p in pods), "no partial gang binds"
+        # freed capacity: a plain pod requesting the whole node must fit
+        server.create(
+            "pods",
+            Pod(
+                metadata=ObjectMeta(name="solo"),
+                spec=PodSpec(containers=[Container(requests={"cpu": "2"})]),
+            ),
+        )
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            solo = server.get("pods", "default", "solo")
+            if solo.spec.node_name:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "gang reservations were not released"
+    finally:
+        sched.stop()
+
+
+def test_gang_failed_member_rejects_siblings_promptly():
+    """When one member hard-fails (no feasible node), parked siblings must
+    release their reservations well before the permit timeout."""
+    server = APIServer()
+    server.create("nodes", make_node("n0", cpu="4"))
+    # long permit timeout: if release relied on the timeout, the freed-
+    # capacity check below would not pass within the poll window
+    sched = _gang_scheduler(server, permit_timeout=120.0)
+    sched.start()
+    try:
+        # 3 members fit; the 4th requests more cpu than any node has ->
+        # hard failure -> failure hook must reject the parked 3
+        for i in range(3):
+            server.create("pods", gang_pod(f"g2-{i}", "g2", 4, cpu="1"))
+        server.create("pods", gang_pod("g2-big", "g2", 4, cpu="64"))
+        server.create(
+            "pods",
+            Pod(
+                metadata=ObjectMeta(name="solo2"),
+                spec=PodSpec(containers=[Container(requests={"cpu": "4"})]),
+            ),
+        )
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            solo = server.get("pods", "default", "solo2")
+            if solo.spec.node_name:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "gang reservations not released on member failure"
+    finally:
+        sched.stop()
+
+
+def test_gang_members_pop_adjacent():
+    """Coscheduling QueueSort keeps gang members adjacent so one device
+    batch carries whole gangs."""
+    from kubernetes_tpu.scheduler.framework.plugins.coscheduling import Coscheduling
+    from kubernetes_tpu.scheduler.queue.scheduling_queue import (
+        PriorityQueue,
+        QueuedPodInfo,
+    )
+
+    plugin = Coscheduling()
+    q = PriorityQueue(less=plugin.less)
+    # interleave two gangs
+    for i in range(4):
+        for g in ("gb", "ga"):
+            q.add(gang_pod(f"{g}-{i}", g, 4))
+    popped = [pi.pod.metadata.labels[GROUP_LABEL] for pi in q.pop_batch(8)]
+    assert popped == sorted(popped), f"gangs interleaved in pop order: {popped}"
+
+
+def test_gang_burst_end_to_end():
+    """A multi-gang burst (10 gangs x 20) lands all-or-nothing per gang."""
+    server = APIServer()
+    for i in range(40):
+        server.create("nodes", make_node(f"n{i}", cpu="8"))
+    sched = _gang_scheduler(server)
+    sched.start()
+    try:
+        for g in range(10):
+            for i in range(20):
+                server.create("pods", gang_pod(f"g{g}-{i}", f"g{g}", 20))
+        assert _wait_bound(server, 200, timeout=120), "all gangs must bind"
+    finally:
+        sched.stop()

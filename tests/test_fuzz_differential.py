@@ -1,0 +1,331 @@
+"""Randomized device-kernel vs host-oracle differential fuzz.
+
+The reference pins its scheduler semantics with 2.5k LoC of table-driven
+oracle tests (pkg/scheduler/core/generic_scheduler_test.go); the TPU build's
+equivalent is this seeded fuzz: random clusters (labels, taints, capacities,
+existing pods with affinity terms) x random pod batches (requests, node
+selectors, required/preferred node affinity, tolerations, topology spread,
+inter-pod (anti-)affinity, host ports, priorities), asserting per (pod, node):
+
+  1. the wave kernel's pre-commit feasibility mask == the host framework's
+     filter verdict (the full default plugin chain, minus volume plugins
+     which are host-only by design);
+  2. every placement the kernel commits is feasible under the host filters
+     AND capacity-sound after sequential replay of the whole batch;
+  3. the kernel's committed occupancy tensors equal a host replay of the
+     same placements (device/host convergence invariant).
+
+Divergence policy (wave vs serial): the wave kernel may pick a different
+near-tie node than the serial oracle (documented staleness, wavelattice.py
+module docstring), so CHOICE equality is not asserted — feasibility and
+accounting are exact and are.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    compute_pod_resource_request,
+)
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.ops.encoding import RES_CPU, RES_MEM, RES_PODS, SnapshotEncoder
+from kubernetes_tpu.ops.lattice import DEFAULT_WEIGHTS
+from kubernetes_tpu.ops.templates import TemplateCache, build_pair_table
+from kubernetes_tpu.ops.wavelattice import make_wave_kernel_jit
+from kubernetes_tpu.scheduler.cache.nodeinfo import NodeInfo, Snapshot
+from kubernetes_tpu.scheduler.framework.interface import CycleState, is_success
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.framework.registry import (
+    PluginSet,
+    default_plugin_set,
+    default_registry,
+)
+
+ZONES = ["za", "zb", "zc"]
+RACKS = ["r0", "r1", "r2", "r3"]
+APPS = ["web", "db", "cache"]
+
+
+def _oracle_framework(snapshot_holder):
+    """Default filter chain minus the volume plugins (host-only fallback by
+    design — encode_pod_batch flags PVC pods for the host path)."""
+    ps = default_plugin_set()
+    ps.filter = [
+        n
+        for n in ps.filter
+        if n
+        not in (
+            "VolumeRestrictions",
+            "NodeVolumeLimits",
+            "EBSLimits",
+            "GCEPDLimits",
+            "AzureDiskLimits",
+            "VolumeBinding",
+            "VolumeZone",
+        )
+    ]
+    ctx = {
+        "snapshot_getter": lambda: snapshot_holder[0],
+        "hard_pod_affinity_weight": 1.0,
+        "ignored_extended_resources": frozenset(),
+    }
+    return Framework(default_registry(), ps, ctx)
+
+
+def _rand_selector(rng) -> LabelSelector:
+    return LabelSelector.make(match_labels={"app": rng.choice(APPS)})
+
+
+def _rand_affinity(rng):
+    """Random inter-pod affinity block (possibly None)."""
+    kind = rng.randrange(6)
+    sel = _rand_selector(rng)
+    key = rng.choice(["zone", "rack", "kubernetes.io/hostname"])
+    term = PodAffinityTerm(label_selector=sel, topology_key=key)
+    if kind == 0:
+        return Affinity(pod_anti_affinity=PodAntiAffinity(required=(term,)))
+    if kind == 1:
+        return Affinity(pod_affinity=PodAffinity(required=(term,)))
+    if kind == 2:
+        return Affinity(
+            pod_affinity=PodAffinity(
+                preferred=(WeightedPodAffinityTerm(weight=rng.randrange(1, 100), term=term),)
+            )
+        )
+    if kind == 3:
+        return Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                preferred=(WeightedPodAffinityTerm(weight=rng.randrange(1, 100), term=term),)
+            )
+        )
+    return None
+
+
+def _rand_node(rng, i: int) -> Node:
+    labels = {
+        "zone": rng.choice(ZONES),
+        "rack": rng.choice(RACKS),
+    }
+    if rng.random() < 0.5:
+        labels["disk"] = rng.choice(["ssd", "hdd"])
+    taints = []
+    if rng.random() < 0.2:
+        taints.append(
+            Taint(
+                "dedicated",
+                rng.choice(["infra", "gpu"]),
+                rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+            )
+        )
+    return Node(
+        metadata=ObjectMeta(name=f"n{i}", labels=labels),
+        spec=NodeSpec(
+            taints=taints, unschedulable=(rng.random() < 0.05)
+        ),
+        status=NodeStatus(
+            allocatable={
+                "cpu": str(rng.choice([2, 4, 8])),
+                "memory": f"{rng.choice([4, 8, 16])}Gi",
+                "pods": 32,
+            }
+        ),
+    )
+
+
+def _rand_pod(rng, name: str, allow_pin=None) -> Pod:
+    kw = {}
+    labels = {"app": rng.choice(APPS)}
+    if rng.random() < 0.3:
+        kw["node_selector"] = {"zone": rng.choice(ZONES)}
+    aff = _rand_affinity(rng)
+    if aff is not None:
+        kw["affinity"] = aff
+    if rng.random() < 0.25:
+        kw["topology_spread_constraints"] = [
+            TopologySpreadConstraint(
+                max_skew=rng.randrange(1, 3),
+                topology_key=rng.choice(["zone", "rack"]),
+                when_unsatisfiable=rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                label_selector=_rand_selector(rng),
+            )
+        ]
+    if rng.random() < 0.3:
+        kw["tolerations"] = [
+            Toleration(key="dedicated", operator="Exists")
+        ]
+    ports = []
+    if rng.random() < 0.2:
+        hp = rng.choice([8080, 9090])
+        ports.append(ContainerPort(container_port=hp, host_port=hp))
+    if allow_pin and rng.random() < 0.05:
+        kw["node_name"] = rng.choice(allow_pin)
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    requests={
+                        "cpu": rng.choice(["250m", "500m", "1", "2"]),
+                        "memory": rng.choice(["256Mi", "1Gi", "2Gi"]),
+                    },
+                    ports=ports,
+                )
+            ],
+            priority=rng.choice([0, 0, 0, 100, 1000]),
+            **kw,
+        ),
+    )
+
+
+def _build_random_cluster(rng, n_nodes: int):
+    """Returns (encoder, host NodeInfos dict, nodes list)."""
+    enc = SnapshotEncoder()
+    nodes = [_rand_node(rng, i) for i in range(n_nodes)]
+    infos = {}
+    for n in nodes:
+        enc.add_node(n)
+        infos[n.metadata.name] = NodeInfo(n)
+    # existing pods (some with eterms: anti/affinity carried by placed pods)
+    for j in range(n_nodes * 2):
+        node = rng.choice(nodes)
+        p = _rand_pod(rng, f"pre-{j}")
+        p.spec.node_name = node.metadata.name
+        enc.add_pod(node.metadata.name, p)
+        infos[node.metadata.name].add_pod(p)
+    return enc, infos, nodes
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6, 7, 8])
+def test_fuzz_device_mask_matches_host_filters(seed):
+    rng = random.Random(seed)
+    n_nodes = rng.randrange(8, 33)
+    enc, infos, nodes = _build_random_cluster(rng, n_nodes)
+    node_names = [n.metadata.name for n in nodes]
+    pods = [
+        _rand_pod(rng, f"p{i}", allow_pin=node_names)
+        for i in range(rng.randrange(4, 17))
+    ]
+
+    tc = TemplateCache(enc)
+    P = 1
+    while P < len(pods):
+        P *= 2
+    eb = tc.encode(pods, pad_to=P)
+    # overflow just grows the table's J capacity (scheduler logs + proceeds)
+    ptab, _overflow = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+    snap = enc.flush()
+    kern = make_wave_kernel_jit(enc.cfg.v_cap, 64, 8)
+    new_snap, res = kern(
+        snap, eb.batch, ptab, np.asarray(DEFAULT_WEIGHTS), jax.random.PRNGKey(seed)
+    )
+    feasible_tpl, chosen, placed, new_snap_h = jax.device_get(
+        (res.feasible_tpl, res.chosen, res.placed, new_snap)
+    )
+    enc.invalidate_device()
+    pod_tpl = eb.pod_tpl_np
+
+    # ---- host oracle: full framework filter chain per (pod, node) --------
+    snapshot = Snapshot([ni.clone() for ni in infos.values()])
+    holder = [snapshot]
+    fw = _oracle_framework(holder)
+    row_of = {n: enc.row_of(n) for n in node_names}
+
+    for i, pod in enumerate(pods):
+        if eb.fallback[i]:
+            continue
+        t = int(pod_tpl[i])
+        state = CycleState()
+        st = fw.run_pre_filter_plugins(state, pod)
+        if not is_success(st):
+            # prefilter rejection = infeasible everywhere
+            for nm in node_names:
+                assert not feasible_tpl[t, row_of[nm]], (seed, pod.metadata.name, nm)
+            continue
+        for nm in node_names:
+            ni = snapshot.get(nm)
+            host_ok = is_success(fw.run_filter_plugins(state, pod, ni))
+            # NodeName pinning is pod-level (not part of the template mask)
+            if pod.spec.node_name and nm != pod.spec.node_name:
+                continue
+            dev_ok = bool(feasible_tpl[t, row_of[nm]])
+            assert dev_ok == host_ok, (
+                f"seed={seed} pod={pod.metadata.name} node={nm}: "
+                f"device={dev_ok} host={host_ok}"
+            )
+
+    # ---- placements: feasible at commit time + capacity-sound replay -----
+    # (prefill pods are injected without capacity checks, so the invariant
+    # "requested <= allocatable" is asserted only on nodes that received a
+    # batch placement: the kernel must never have placed onto negative free)
+    replay = {nm: infos[nm].clone() for nm in node_names}
+    touched = set()
+    for i, pod in enumerate(pods):
+        if eb.fallback[i] or not placed[i]:
+            continue
+        nm = enc.row_names[int(chosen[i])]
+        assert nm is not None
+        if pod.spec.node_name:
+            assert nm == pod.spec.node_name, (seed, pod.metadata.name)
+        ni = replay[nm]
+        p2 = pod.deep_copy()
+        p2.spec.node_name = nm
+        ni.add_pod(p2)
+        touched.add(nm)
+    from kubernetes_tpu.api.resources import CPU, MEMORY, PODS
+
+    for nm in touched:
+        ni = replay[nm]
+        assert ni.requested.get(CPU, 0) <= ni.allocatable.get(CPU, 0), (seed, nm)
+        assert ni.requested.get(MEMORY, 0) <= ni.allocatable.get(MEMORY, 0), (
+            seed,
+            nm,
+        )
+        assert len(ni.pods) <= ni.allocatable.get(PODS, 10**9), (seed, nm)
+
+    # ---- failures must be justified: a hard-failed pod (not deferred) had
+    # no base-feasible node at batch start (the host filters agree via the
+    # mask equality above). Wave-vs-serial divergence is thereby bounded:
+    # the wave may DEFER a placeable pod to the next cycle (in-batch
+    # contention / affinity chaining), but never wrongly hard-fails one.
+    deferred = jax.device_get(res.deferred)
+    for i, pod in enumerate(pods):
+        if eb.fallback[i]:
+            continue
+        t = int(pod_tpl[i])
+        if not placed[i] and not deferred[i] and not pod.spec.node_name:
+            assert not feasible_tpl[t].any(), (
+                f"seed={seed} pod={pod.metadata.name} hard-failed with "
+                f"feasible nodes present"
+            )
+
+    # ---- device/host occupancy convergence -------------------------------
+    for i, pod in enumerate(pods):
+        if eb.fallback[i] or not placed[i]:
+            continue
+        nm = enc.row_names[int(chosen[i])]
+        enc.add_pod(nm, pod, device_synced=True, prio_band=int(eb.pod_band_np[i]))
+    np.testing.assert_array_equal(enc.m_req, new_snap_h.requested)
+    np.testing.assert_array_equal(enc.m_sel_counts, new_snap_h.sel_counts)
+    np.testing.assert_array_equal(enc.m_port_counts, new_snap_h.port_counts)
+    np.testing.assert_array_equal(enc.m_prio_req, new_snap_h.prio_req)
+    np.testing.assert_allclose(enc.m_eterm_w, new_snap_h.eterm_w, rtol=1e-6)

@@ -106,3 +106,118 @@ def test_sharded_collectives_in_hlo(cluster):
     )
     hlo = lowered.compile().as_text()
     assert "all-reduce" in hlo or "all-gather" in hlo or "reduce-scatter" in hlo
+
+
+# ---------------------------------------------------------------------------
+# Production wave kernel, sharded (VERDICT r2 item 3: the dryrun must
+# exercise the kernel production runs, not the deprecated scan lattice)
+# ---------------------------------------------------------------------------
+
+
+def _wave_inputs(enc, pods):
+    from kubernetes_tpu.ops.templates import TemplateCache, build_pair_table
+
+    tc = TemplateCache(enc)
+    eb = tc.encode(pods, pad_to=4)
+    ptab, _ = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+    return eb, ptab
+
+
+def test_sharded_wave_matches_single_device(cluster):
+    from kubernetes_tpu.ops.wavelattice import make_wave_kernel_jit
+    from kubernetes_tpu.parallel.sharded import make_sharded_wave_kernel
+    from kubernetes_tpu.ops.lattice import DEFAULT_WEIGHTS
+
+    enc = cluster
+    eb, ptab = _wave_inputs(enc, _mk_pods())
+    w = np.asarray(DEFAULT_WEIGHTS)
+    key = jax.random.PRNGKey(7)
+
+    snap = enc.flush()  # donated by the single-device kernel
+    single_snap, single = make_wave_kernel_jit(enc.cfg.v_cap, 64, 8)(
+        snap, eb.batch, ptab, w, key
+    )
+    single_snap = jax.device_get(single_snap)
+
+    mesh = make_mesh()
+    enc.invalidate_device()
+    from kubernetes_tpu.parallel.mesh import replicated, snapshot_shardings
+
+    enc.set_sharding(snapshot_shardings(mesh), replicated(mesh))
+    snap_sharded = enc.flush()
+    kern = make_sharded_wave_kernel(enc.cfg.v_cap, 64, 8, 1.0, mesh)
+    sh_snap, sharded = kern(snap_sharded, eb.batch, ptab, w, key)
+
+    np.testing.assert_array_equal(
+        np.asarray(single.placed), np.asarray(sharded.placed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.chosen), np.asarray(sharded.chosen)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.feasible_count), np.asarray(sharded.feasible_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.resolvable_tpl), np.asarray(sharded.resolvable_tpl)
+    )
+    # the committed occupancy must agree too (chained-batch invariant)
+    sh_snap = jax.device_get(sh_snap)
+    np.testing.assert_array_equal(single_snap.requested, sh_snap.requested)
+    np.testing.assert_array_equal(single_snap.sel_counts, sh_snap.sel_counts)
+    np.testing.assert_array_equal(single_snap.prio_req, sh_snap.prio_req)
+
+
+def test_sharded_wave_collectives_in_hlo(cluster):
+    from kubernetes_tpu.parallel.sharded import make_sharded_wave_kernel
+    from kubernetes_tpu.parallel.mesh import replicated, snapshot_shardings
+    from kubernetes_tpu.ops.lattice import DEFAULT_WEIGHTS
+
+    enc = cluster
+    eb, ptab = _wave_inputs(enc, _mk_pods())
+    mesh = make_mesh()
+    enc.set_sharding(snapshot_shardings(mesh), replicated(mesh))
+    snap_sharded = enc.flush()
+    kern = make_sharded_wave_kernel(enc.cfg.v_cap, 64, 8, 1.0, mesh)
+    hlo = (
+        kern.lower(
+            snap_sharded,
+            eb.batch,
+            ptab,
+            np.asarray(DEFAULT_WEIGHTS),
+            jax.random.PRNGKey(0),
+        )
+        .compile()
+        .as_text()
+    )
+    assert "all-reduce" in hlo or "all-gather" in hlo or "reduce-scatter" in hlo
+
+
+def test_scheduler_uses_mesh_end_to_end():
+    """Full production path on the 8-device mesh: Scheduler.start() adopts
+    the mesh, the wave kernel runs sharded, pods bind."""
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+    server = APIServer()
+    for i in range(16):
+        server.create("nodes", make_node(f"n{i}", cpu="8", labels={"zone": f"z{i%4}"}))
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    sched.start()
+    try:
+        assert sched._mesh is not None, "scheduler must adopt the mesh"
+        for i in range(24):
+            server.create("pods", make_pod(f"p{i}", cpu="500m"))
+        # poll for binds (wait_for_idle can win the race against informer
+        # delivery of the just-created pods)
+        import time
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            pods, _ = server.list("pods")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.1)
+        pods, _ = server.list("pods")
+        assert all(p.spec.node_name for p in pods)
+    finally:
+        sched.stop()

@@ -1,5 +1,11 @@
-"""Wave-commit kernel tests: semantics on small clusters + agreement with the
-serial scan lattice on randomized workloads."""
+"""Wave-commit kernel tests: deterministic semantics on small hand-built
+clusters (fit, in-batch conflict, anti-affinity, spread, chaining).
+
+Randomized coverage lives in test_fuzz_differential.py: seeded random
+clusters x random pod batches, device feasibility mask diffed against the
+host framework's full filter chain per (pod, node), placement soundness,
+and the bounded wave-vs-serial divergence contract (defer, never wrongly
+hard-fail)."""
 
 import jax
 import jax.numpy as jnp
