@@ -841,6 +841,7 @@ class JobSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     active_deadline_seconds: Optional[int] = None
+    ttl_seconds_after_finished: Optional[int] = None  # ttlafterfinished GC
 
 
 @dataclass

@@ -12,6 +12,7 @@ strings ("Pod") to classes, standing in for runtime.Scheme's GVK mapping.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import typing
 from typing import Any, Dict, Optional, Type, get_args, get_origin, get_type_hints
@@ -98,6 +99,9 @@ def to_dict(obj: Any) -> Any:
         return sorted(obj)
     if isinstance(obj, dict):
         return {k: to_dict(val) for k, val in obj.items()}
+    if isinstance(obj, bytes):
+        # Secret.data wire form is base64 (the k8s []byte convention)
+        return base64.b64encode(obj).decode("ascii")
     return obj
 
 
@@ -147,6 +151,8 @@ def from_dict(cls: Type, data: Any) -> Any:
         return data
     if cls is float and isinstance(data, int):
         return float(data)
+    if cls is bytes and isinstance(data, str):
+        return base64.b64decode(data)
     return data
 
 

@@ -1,0 +1,277 @@
+"""Authentication, authorization, and admission for the API layer.
+
+Reference shape (staging/src/k8s.io/apiserver/pkg/server/config.go:660,
+DefaultBuildHandlerChain): requests pass authn → authz before reaching the
+REST storage; write requests then run the ADMISSION chain (mutating plugins
+first, then validating — apiserver/pkg/admission/chain.go) before
+persisting. Here:
+
+  * ``TokenAuthenticator`` — bearer-token table (the reference's
+    --token-auth-file static tokens + ServiceAccount token secrets from the
+    tokens controller);
+  * ``RBACAuthorizer`` — RBAC-lite: rules (verbs × resources × namespaces)
+    bound to users/groups, with ``system:masters`` always allowed
+    (plugin/pkg/auth/authorizer/rbac);
+  * ``AdmissionChain`` — ordered mutating → validating plugins, installed
+    as a store admit hook so in-process clients and the HTTP façade pass
+    through the same gate;
+  * ``QuotaAdmission`` — the first real validating plugin: rejects pod
+    creates that would exceed any ResourceQuota hard limit
+    (plugin/pkg/admission/resourcequota).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ..api import objects as v1
+from ..api.resources import CPU, MEMORY, cpu_to_millis, to_int_value
+
+ANONYMOUS = "system:anonymous"
+MASTERS_GROUP = "system:masters"
+ALL = "*"
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    name: str
+    groups: Tuple[str, ...] = ()
+
+
+class Forbidden(PermissionError):
+    pass
+
+
+class Unauthorized(PermissionError):
+    pass
+
+
+class AdmissionDenied(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# authn
+# ---------------------------------------------------------------------------
+
+
+class TokenAuthenticator:
+    """Static bearer tokens + ServiceAccount token secrets.
+
+    ``authenticate`` returns a UserInfo, or None for requests with no
+    credentials (the caller decides whether anonymous is allowed)."""
+
+    def __init__(self, server=None, allow_anonymous: bool = True):
+        self._tokens: Dict[str, UserInfo] = {}
+        self._server = server  # for ServiceAccount token secret lookup
+        self.allow_anonymous = allow_anonymous
+        self._lock = threading.Lock()
+        # SA-token index: rebuilt at most every _sa_ttl seconds, so the
+        # authn hot path is an O(1) dict hit instead of a full secret list
+        # + linear scan per request
+        self._sa_index: Dict[str, UserInfo] = {}
+        self._sa_built_at = float("-inf")
+        self._sa_ttl = 2.0
+
+    def add_token(self, token: str, user: str, groups: Sequence[str] = ()) -> None:
+        with self._lock:
+            self._tokens[token] = UserInfo(user, tuple(groups))
+
+    def _sa_tokens(self) -> Dict[str, UserInfo]:
+        """ServiceAccount token index (tokens controller secrets): identity
+        system:serviceaccount:<ns>:<name>."""
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            if now - self._sa_built_at < self._sa_ttl:
+                return self._sa_index
+        idx: Dict[str, UserInfo] = {}
+        secrets, _ = self._server.list("secrets")
+        for s in secrets:
+            if s.type != "kubernetes.io/service-account-token":
+                continue
+            tok = s.data.get("token", b"")
+            tok = tok.decode() if isinstance(tok, bytes) else str(tok)
+            if not tok:
+                continue
+            sa = s.metadata.annotations.get(
+                "kubernetes.io/service-account.name", "default"
+            )
+            idx[tok] = UserInfo(
+                f"system:serviceaccount:{s.metadata.namespace}:{sa}",
+                ("system:serviceaccounts",),
+            )
+        with self._lock:
+            self._sa_index = idx
+            self._sa_built_at = now
+        return idx
+
+    def authenticate_token(self, token: str) -> Optional[UserInfo]:
+        with self._lock:
+            ui = self._tokens.get(token)
+        if ui is not None:
+            return ui
+        if self._server is not None:
+            return self._sa_tokens().get(token)
+        return None
+
+    def authenticate_header(self, authorization: str) -> Optional[UserInfo]:
+        if not authorization:
+            return None
+        scheme, _, cred = authorization.partition(" ")
+        if scheme.lower() != "bearer" or not cred:
+            return None
+        return self.authenticate_token(cred.strip())
+
+
+# ---------------------------------------------------------------------------
+# authz (RBAC-lite)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    verbs: FrozenSet[str]  # get/list/watch/create/update/delete or *
+    resources: FrozenSet[str]  # resource names or *
+    namespaces: FrozenSet[str] = frozenset({ALL})
+
+    def allows(self, verb: str, resource: str, namespace: str) -> bool:
+        return (
+            (ALL in self.verbs or verb in self.verbs)
+            and (ALL in self.resources or resource in self.resources)
+            and (ALL in self.namespaces or namespace in self.namespaces)
+        )
+
+
+def make_rule(
+    verbs: Sequence[str], resources: Sequence[str], namespaces: Sequence[str] = (ALL,)
+) -> Rule:
+    return Rule(frozenset(verbs), frozenset(resources), frozenset(namespaces))
+
+
+# the verbs read-only roles get (rbac bootstrap "view")
+READ_VERBS = ("get", "list", "watch")
+
+
+class RBACAuthorizer:
+    """Subject (user or group) → list of rules. ``system:masters`` is the
+    reference's superuser group (rbac bootstrap cluster-admin binding)."""
+
+    def __init__(self):
+        self._subjects: Dict[str, List[Rule]] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, subject: str, rule: Rule) -> None:
+        with self._lock:
+            self._subjects.setdefault(subject, []).append(rule)
+
+    def authorize(
+        self, user: Optional[UserInfo], verb: str, resource: str, namespace: str
+    ) -> bool:
+        if user is None:
+            return False
+        if MASTERS_GROUP in user.groups:
+            return True
+        with self._lock:
+            rules = list(self._subjects.get(user.name, []))
+            for g in user.groups:
+                rules.extend(self._subjects.get(g, []))
+        return any(r.allows(verb, resource, namespace) for r in rules)
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+
+class AdmissionPlugin:
+    """mutate() may modify obj in place; validate() raises AdmissionDenied."""
+
+    name = "plugin"
+
+    def mutate(self, verb: str, resource: str, obj) -> None:  # pragma: no cover
+        pass
+
+    def validate(self, verb: str, resource: str, obj) -> None:  # pragma: no cover
+        pass
+
+
+class AdmissionChain:
+    """Ordered mutating phase, then validating phase (admission/chain.go:
+    mutators run first so validators see final content). Installable as a
+    store admit hook (APIServer.admit_hooks)."""
+
+    def __init__(
+        self,
+        mutating: Sequence[AdmissionPlugin] = (),
+        validating: Sequence[AdmissionPlugin] = (),
+    ):
+        self.mutating = list(mutating)
+        self.validating = list(validating)
+
+    def __call__(self, verb: str, resource: str, obj) -> None:
+        for p in self.mutating:
+            p.mutate(verb, resource, obj)
+        for p in self.validating:
+            p.validate(verb, resource, obj)
+
+
+class QuotaAdmission(AdmissionPlugin):
+    """Deny pod creates that would exceed any ResourceQuota hard limit in
+    the namespace (plugin/pkg/admission/resourcequota). Usage is recomputed
+    live (not from quota status) so the gate can't be raced stale."""
+
+    name = "ResourceQuota"
+
+    def __init__(self, server):
+        self.server = server
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        ns = obj.metadata.namespace
+        quotas, _ = self.server.list("resourcequotas", namespace=ns)
+        if not quotas:
+            return
+        from ..controller.resourcequota import compute_namespace_usage
+
+        usage = compute_namespace_usage(self.server, ns)
+        req = v1.compute_pod_resource_request(obj)
+        delta = {
+            "pods": 1,
+            "requests.cpu": int(req.get(CPU, 0)),
+            "cpu": int(req.get(CPU, 0)),
+            "requests.memory": int(req.get(MEMORY, 0)),
+            "memory": int(req.get(MEMORY, 0)),
+        }
+        for q in quotas:
+            for res_name, hard in q.spec.hard.items():
+                # hard limits are k8s quantities ("2", "500m", "4Gi"); usage
+                # is millicores/bytes/counts — parse with the same units
+                if "cpu" in res_name:
+                    limit = cpu_to_millis(hard)
+                else:
+                    limit = to_int_value(hard)
+                want = usage.get(res_name, 0) + delta.get(res_name, 0)
+                if want > limit:
+                    raise AdmissionDenied(
+                        f"exceeded quota {q.metadata.name}: requested "
+                        f"{res_name}={delta.get(res_name, 0)}, used "
+                        f"{usage.get(res_name, 0)}, limited {hard}"
+                    )
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """Default pod spec.service_account to "default" (the mutating half of
+    plugin/pkg/admission/serviceaccount, minus volume injection)."""
+
+    name = "ServiceAccount"
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        if hasattr(obj.spec, "service_account_name") and not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"

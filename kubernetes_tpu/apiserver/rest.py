@@ -6,6 +6,9 @@ to panic recovery + optional admit hooks):
 
   GET    /healthz | /readyz | /livez
   GET    /api/v1/{resource}                     (cluster list)
+  (authn/authz: optional bearer-token authenticator + RBAC-lite authorizer
+  run before every resource verb — apiserver/auth.py; admission runs inside
+  the store's admit hooks so HTTP and in-process clients share the gate)
   GET    /api/v1/{resource}?watch=1&resourceVersion=N   (watch stream)
   GET    /api/v1/namespaces/{ns}/{resource}
   GET    /api/v1/namespaces/{ns}/{resource}/{name}
@@ -35,6 +38,7 @@ from ..client.apiserver import (
     Conflict,
     NotFound,
 )
+from .auth import AdmissionDenied
 
 _WATCH_POLL_S = 0.5
 
@@ -98,6 +102,35 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    def _authorize(self, verb: str, resource: str, ns: Optional[str]) -> bool:
+        """authn → authz (DefaultBuildHandlerChain order). True = proceed;
+        False = a 401/403 response was already written. No authenticator
+        configured = insecure port semantics (everything allowed)."""
+        authn = self.server.authenticator
+        authz = self.server.authorizer
+        if authn is None:
+            return True
+        from .auth import ANONYMOUS, UserInfo
+
+        user = authn.authenticate_header(self.headers.get("Authorization", ""))
+        if user is None:
+            if not authn.allow_anonymous:
+                self._status_error(401, "Unauthorized", "authentication required")
+                return False
+            user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
+        # ns None = cluster-scoped / cluster-wide request: requires a rule
+        # covering all namespaces (the ClusterRole analogue)
+        if authz is not None and not authz.authorize(
+            user, verb, resource, ns if ns is not None else "*"
+        ):
+            self._status_error(
+                403,
+                "Forbidden",
+                f'user "{user.name}" cannot {verb} resource "{resource}"',
+            )
+            return False
+        return True
+
     # -- verbs ---------------------------------------------------------------
 
     def do_GET(self):
@@ -113,6 +146,13 @@ class _Handler(BaseHTTPRequestHandler):
         resource, ns, name, query = self._parse()
         if resource is None:
             return self._status_error(404, "NotFound", "unknown path")
+        verb = (
+            "get"
+            if name
+            else ("watch" if query.get("watch") in ("1", "true") else "list")
+        )
+        if not self._authorize(verb, resource, ns):
+            return
         try:
             if name:
                 obj = self.store.get(resource, ns or "", name)
@@ -168,6 +208,8 @@ class _Handler(BaseHTTPRequestHandler):
         resource, ns, name, _q = self._parse()
         if resource is None:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._authorize("create", resource, ns):
+            return
         try:
             body = self._read_body()
             if resource == "pods" and name and name.endswith("/binding"):
@@ -186,6 +228,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(201, codec.encode(created))
         except AlreadyExists as e:
             return self._status_error(409, "AlreadyExists", str(e))
+        except AdmissionDenied as e:
+            # quota denial is 403 Forbidden like the reference's admission
+            return self._status_error(403, "Forbidden", str(e))
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 
@@ -193,6 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
         resource, ns, name, _q = self._parse()
         if resource is None or not name:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._authorize("update", resource, ns):
+            return
         try:
             obj = codec.decode(resource, self._read_body())
             if ns is not None:
@@ -203,6 +250,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", str(e))
         except Conflict as e:
             return self._status_error(409, "Conflict", str(e))
+        except AdmissionDenied as e:
+            return self._status_error(403, "Forbidden", str(e))
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 
@@ -210,19 +259,25 @@ class _Handler(BaseHTTPRequestHandler):
         resource, ns, name, _q = self._parse()
         if resource is None or not name:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._authorize("delete", resource, ns):
+            return
         try:
             self.store.delete(resource, ns or "", name)
             return self._json(200, {"kind": "Status", "status": "Success"})
         except NotFound as e:
             return self._status_error(404, "NotFound", str(e))
+        except AdmissionDenied as e:
+            return self._status_error(403, "Forbidden", str(e))
 
 
 class APIServerHTTP(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr, store: APIServer):
+    def __init__(self, addr, store: APIServer, authenticator=None, authorizer=None):
         super().__init__(addr, _Handler)
         self.store = store
+        self.authenticator = authenticator  # None = insecure port semantics
+        self.authorizer = authorizer
         self.stopping = threading.Event()
 
     def shutdown(self):
@@ -231,10 +286,13 @@ class APIServerHTTP(ThreadingHTTPServer):
 
 
 def serve(
-    store: Optional[APIServer] = None, port: int = 0
+    store: Optional[APIServer] = None,
+    port: int = 0,
+    authenticator=None,
+    authorizer=None,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store)."""
     store = store or APIServer()
-    srv = APIServerHTTP(("0.0.0.0", port), store)
+    srv = APIServerHTTP(("0.0.0.0", port), store, authenticator, authorizer)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

@@ -12,16 +12,21 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
+from .hpa import HPAController
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .ttl import TTLAfterFinishedController, TTLController
 
 logger = logging.getLogger("kubernetes_tpu.controller.manager")
 
@@ -37,6 +42,12 @@ CONTROLLER_INITIALIZERS = {
     "nodelifecycle": NodeLifecycleController,
     "garbagecollector": GarbageCollector,
     "namespace": NamespaceController,
+    "horizontalpodautoscaling": HPAController,
+    "cronjob": CronJobController,
+    "resourcequota": ResourceQuotaController,
+    "serviceaccount": ServiceAccountController,
+    "ttl": TTLController,
+    "ttlafterfinished": TTLAfterFinishedController,
 }
 
 

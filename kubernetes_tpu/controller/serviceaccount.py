@@ -1,0 +1,99 @@
+"""ServiceAccount + token controllers.
+
+Reference: pkg/controller/serviceaccount/serviceaccounts_controller.go —
+ensure every (non-terminating) namespace has the "default" ServiceAccount —
+and tokens_controller.go — ensure every ServiceAccount has a token Secret
+(type kubernetes.io/service-account-token) referenced from its
+``secrets`` list; deleting the SA deletes its tokens.
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets as _secrets
+from typing import Optional
+
+from ..api import objects as v1
+from ..client.apiserver import AlreadyExists, NotFound
+from .base import WorkqueueController
+
+logger = logging.getLogger("kubernetes_tpu.controller.serviceaccount")
+
+TOKEN_SECRET_TYPE = "kubernetes.io/service-account-token"
+SA_ANNOTATION = "kubernetes.io/service-account.name"
+
+
+class ServiceAccountController(WorkqueueController):
+    """Namespaces are the primary: each sync ensures default SA + token."""
+
+    name = "serviceaccount"
+    primary_kind = "namespaces"
+    secondary_kinds = ("serviceaccounts",)
+
+    def enqueue_for_related(self, resource: str, obj) -> Optional[str]:
+        # SA deleted/changed -> re-sync its namespace. Namespace objects sit
+        # in the store under the default namespace (their metadata.namespace
+        # is not themselves), so reconstruct that store key.
+        ns = obj.metadata.namespace
+        if not ns:
+            return None
+        for cand in self.server.list("namespaces")[0]:
+            if cand.metadata.name == ns:
+                return cand.metadata.key
+        return None
+
+    def sync(self, key: str) -> None:
+        store_ns, _, name = key.rpartition("/")
+        try:
+            ns_obj = self.server.get("namespaces", store_ns, name)
+        except NotFound:
+            return
+        if ns_obj.metadata.deletion_timestamp is not None:
+            return
+        # ensure the default ServiceAccount
+        try:
+            sa = self.server.get("serviceaccounts", name, "default")
+        except NotFound:
+            sa = v1.ServiceAccount(
+                metadata=v1.ObjectMeta(name="default", namespace=name)
+            )
+            try:
+                sa = self.server.create("serviceaccounts", sa)
+            except AlreadyExists:
+                sa = self.server.get("serviceaccounts", name, "default")
+        self._ensure_token(sa)
+
+    def _ensure_token(self, sa: v1.ServiceAccount) -> None:
+        """tokens_controller.go ensureReferencedToken: a token Secret exists
+        and is referenced from sa.secrets."""
+        ns = sa.metadata.namespace
+        token_name = f"{sa.metadata.name}-token"
+        try:
+            self.server.get("secrets", ns, token_name)
+        except NotFound:
+            secret = v1.Secret(
+                metadata=v1.ObjectMeta(
+                    name=token_name,
+                    namespace=ns,
+                    annotations={SA_ANNOTATION: sa.metadata.name},
+                ),
+                type=TOKEN_SECRET_TYPE,
+                data={"token": _secrets.token_urlsafe(24).encode()},
+            )
+            try:
+                self.server.create("secrets", secret)
+            except AlreadyExists:
+                pass
+        if token_name not in sa.secrets:
+            def mutate(cur):
+                if token_name in cur.secrets:
+                    return None
+                cur.secrets.append(token_name)
+                return cur
+
+            try:
+                self.server.guaranteed_update(
+                    "serviceaccounts", ns, sa.metadata.name, mutate
+                )
+            except NotFound:
+                pass

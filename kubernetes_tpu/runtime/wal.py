@@ -31,12 +31,16 @@ class WriteAheadLog:
         self,
         path: str,
         compact_every: int = 50_000,
-        fsync: bool = False,
+        fsync: bool = True,
     ):
         """`path` is a prefix: <path>.wal + <path>.snapshot.json.
-        fsync=False trades durability-to-media for throughput (matches
-        etcd's unsafe-no-fsync testing mode); the write is still flushed to
-        the OS before acknowledgment."""
+
+        fsync=True (the DEFAULT, matching etcd: acknowledged means on
+        media) fsyncs every append before the mutation is acknowledged.
+        fsync=False trades media-durability for throughput — the write is
+        still flushed to the OS, so it survives process crashes but not
+        machine crashes (etcd's --unsafe-no-fsync testing mode); benchmarks
+        and tests may opt out explicitly."""
         self.path = path
         self.log_path = path + LOG_SUFFIX
         self.snap_path = path + SNAPSHOT_SUFFIX

@@ -86,12 +86,43 @@ def _has_affinity(pod: v1.Pod) -> bool:
     )
 
 
+def zone_interleave(node_infos: List[NodeInfo]) -> List[NodeInfo]:
+    """Zone-aware iteration order (internal/cache/node_tree.go): nodes are
+    grouped by failure zone and emitted round-robin across zones, so the
+    host path's adaptive sampling + round-robin start index spreads
+    sequential placements over zones instead of exhausting one zone first.
+    The device path doesn't need this (it scores ALL nodes every batch);
+    it shapes only the host fallback's truncated scan."""
+    zones: Dict[str, List[NodeInfo]] = {}
+    for ni in node_infos:
+        labels = ni.node.metadata.labels if ni.node is not None else {}
+        zone = (
+            labels.get("topology.kubernetes.io/zone")
+            or labels.get("failure-domain.beta.kubernetes.io/zone")
+            or labels.get("zone")
+            or ""
+        )
+        zones.setdefault(zone, []).append(ni)
+    out: List[NodeInfo] = []
+    buckets = list(zones.values())
+    i = 0
+    while buckets:
+        buckets = [b for b in buckets if b]
+        for b in buckets:
+            if i < len(b):
+                out.append(b[i])
+        buckets = [b for b in buckets if len(b) > i + 1]
+        i += 1
+    return out
+
+
 class Snapshot:
-    """Immutable-per-cycle view (SharedLister): nodeInfoMap + ordered list +
-    affinity sublist (snapshot.go:31, HavePodsWithAffinityList)."""
+    """Immutable-per-cycle view (SharedLister): nodeInfoMap + zone-aware
+    ordered list + affinity sublist (snapshot.go:31, node_tree.go,
+    HavePodsWithAffinityList)."""
 
     def __init__(self, node_infos: Optional[List[NodeInfo]] = None):
-        self.node_info_list: List[NodeInfo] = node_infos or []
+        self.node_info_list: List[NodeInfo] = zone_interleave(node_infos or [])
         self.node_info_map: Dict[str, NodeInfo] = {
             ni.name: ni for ni in self.node_info_list
         }

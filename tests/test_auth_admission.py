@@ -1,0 +1,171 @@
+"""Authn/authz/admission chain (reference DefaultBuildHandlerChain,
+apiserver/pkg/server/config.go:660 + plugin/pkg/admission/resourcequota)."""
+
+import json
+import urllib.request
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.auth import (
+    AdmissionChain,
+    AdmissionDenied,
+    QuotaAdmission,
+    RBACAuthorizer,
+    Rule,
+    ServiceAccountAdmission,
+    TokenAuthenticator,
+    UserInfo,
+    make_rule,
+)
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.client.apiserver import APIServer
+
+
+def _req(port, path, method="GET", body=None, token=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method)
+    r.add_header("Content-Type", "application/json")
+    if token:
+        r.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_rbac_rules_and_masters_group():
+    authz = RBACAuthorizer()
+    authz.bind("alice", make_rule(["get", "list"], ["pods"], ["team-a"]))
+    authz.bind("system:schedulers", make_rule(["*"], ["pods", "bindings"]))
+    alice = UserInfo("alice")
+    assert authz.authorize(alice, "get", "pods", "team-a")
+    assert not authz.authorize(alice, "create", "pods", "team-a")
+    assert not authz.authorize(alice, "get", "pods", "team-b")
+    sched = UserInfo("kube-scheduler", ("system:schedulers",))
+    assert authz.authorize(sched, "create", "bindings", "anywhere")
+    root = UserInfo("admin", ("system:masters",))
+    assert authz.authorize(root, "delete", "nodes", "kube-system")
+
+
+def test_http_authn_authz_rejections():
+    authn = TokenAuthenticator(allow_anonymous=False)
+    authn.add_token("sekrit", "alice", groups=())
+    authz = RBACAuthorizer()
+    authz.bind("alice", make_rule(["get", "list"], ["pods"]))
+    srv, port, store = serve(authenticator=authn, authorizer=authz)
+    try:
+        code, body = _req(port, "/api/v1/pods")
+        assert code == 401, body
+        code, body = _req(port, "/api/v1/pods", token="wrong")
+        assert code == 401, body
+        code, body = _req(port, "/api/v1/pods", token="sekrit")
+        assert code == 200, body
+        # alice may read but not create
+        code, body = _req(
+            port,
+            "/api/v1/namespaces/default/pods",
+            method="POST",
+            body={"kind": "Pod", "metadata": {"name": "nope"}},
+            token="sekrit",
+        )
+        assert code == 403, body
+    finally:
+        srv.shutdown()
+
+
+def test_service_account_token_authenticates():
+    store = APIServer()
+    store.create(
+        "secrets",
+        v1.Secret(
+            metadata=v1.ObjectMeta(
+                name="default-token",
+                namespace="team-a",
+                annotations={"kubernetes.io/service-account.name": "default"},
+            ),
+            type="kubernetes.io/service-account-token",
+            data={"token": b"sa-token-123"},
+        ),
+    )
+    authn = TokenAuthenticator(server=store)
+    ui = authn.authenticate_token("sa-token-123")
+    assert ui is not None
+    assert ui.name == "system:serviceaccount:team-a:default"
+
+
+def test_quota_admission_denies_over_limit():
+    store = APIServer()
+    store.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="q", namespace="default"),
+            spec=v1.ResourceQuotaSpec(hard={"pods": 1, "requests.cpu": "1"}),
+        ),
+    )
+    chain = AdmissionChain(
+        mutating=[ServiceAccountAdmission()], validating=[QuotaAdmission(store)]
+    )
+    store.admit_hooks.append(chain)
+    p1 = v1.Pod(
+        metadata=v1.ObjectMeta(name="p1"),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "500m"})]),
+    )
+    store.create("pods", p1)
+    # mutating phase ran before validation
+    assert store.get("pods", "default", "p1").spec.service_account_name == "default"
+    # second pod trips the pods=1 hard limit
+    p2 = v1.Pod(
+        metadata=v1.ObjectMeta(name="p2"),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "100m"})]),
+    )
+    try:
+        store.create("pods", p2)
+        raise AssertionError("quota must deny the second pod")
+    except AdmissionDenied as e:
+        assert "exceeded quota" in str(e)
+    # cpu limit: a single fat pod in another namespace-free quota scenario
+    store2 = APIServer()
+    store2.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="q2", namespace="default"),
+            spec=v1.ResourceQuotaSpec(
+                hard={"requests.cpu": "1", "requests.memory": "4Gi"}
+            ),
+        ),
+    )
+    store2.admit_hooks.append(AdmissionChain(validating=[QuotaAdmission(store2)]))
+    fat = v1.Pod(
+        metadata=v1.ObjectMeta(name="fat"),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "2"})]),
+    )
+    try:
+        store2.create("pods", fat)
+        raise AssertionError("cpu quota must deny")
+    except AdmissionDenied:
+        pass
+
+
+def test_quota_denial_over_http_is_403():
+    store = APIServer()
+    store.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="q", namespace="default"),
+            spec=v1.ResourceQuotaSpec(hard={"pods": 0}),
+        ),
+    )
+    store.admit_hooks.append(AdmissionChain(validating=[QuotaAdmission(store)]))
+    srv, port, _ = serve(store=store)
+    try:
+        code, body = _req(
+            port,
+            "/api/v1/namespaces/default/pods",
+            method="POST",
+            body={"kind": "Pod", "metadata": {"name": "denied"}},
+        )
+        assert code == 403, body
+        assert "exceeded quota" in body.get("message", "")
+    finally:
+        srv.shutdown()

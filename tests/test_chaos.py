@@ -1,0 +1,172 @@
+"""Chaos tier: kill control-plane components mid-burst and assert the
+cluster converges after recovery.
+
+Reference stance: test/e2e/chaosmonkey/chaosmonkey.go:22-48 (register a
+Disruption and Tests that must hold across it) + the crash-only fault model
+(components are stateless against the store; restart = re-list + rebuild).
+Here the disruption is a REAL mid-burst kill: the scheduler is stopped with
+a wave batch potentially in flight and binds half-done, the process state
+dropped, and a fresh control plane recovers from the WAL.
+"""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
+from kubernetes_tpu.runtime.wal import WriteAheadLog
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+
+def wait_until(fn, timeout=60.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def make_pod(name, cpu="100m"):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": cpu})]),
+    )
+
+
+def _bound_count(server):
+    return server.count("pods", lambda p: bool(p.spec.node_name))
+
+
+def test_kill_scheduler_mid_burst_recovery_converges(tmp_path):
+    """Burst 200 pods; kill scheduler+kubelets after ~a third have bound;
+    recover the store from the WAL, start a FRESH control plane, and
+    require full convergence: every pod bound exactly once, each node's
+    commitments consistent."""
+    path = str(tmp_path / "chaos")
+    # fsync=False: this test kills the PROCESS state, not the machine —
+    # OS-flushed records survive (and the suite stays fast)
+    server = APIServer(wal=WriteAheadLog(path, fsync=False))
+    pool = NodeAgentPool(server, housekeeping_interval=0.1)
+    for i in range(8):
+        pool.add_node(f"node-{i}")
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    n_pods = 200
+    try:
+        for i in range(n_pods):
+            server.create("pods", make_pod(f"burst-{i}"))
+        # let part of the burst land, then pull the plug mid-flight
+        assert wait_until(lambda: _bound_count(server) >= n_pods // 3)
+    finally:
+        sched.stop()  # the in-flight wave batch dies with the process
+        pool.stop()
+    bound_at_crash = _bound_count(server)
+    assert bound_at_crash < n_pods, "crash must interrupt the burst"
+
+    # ---- recover on a fresh control plane --------------------------------
+    server2 = APIServer.recover(path)
+    assert _bound_count(server2) == bound_at_crash, (
+        "recovered store must replay exactly the acknowledged binds"
+    )
+    pool2 = NodeAgentPool(server2, housekeeping_interval=0.1)
+    for i in range(8):
+        pool2.add_node(f"node-{i}", register=False)
+    sched2 = Scheduler(server2, KubeSchedulerConfiguration())
+    pool2.start()
+    sched2.start()
+    try:
+        assert wait_until(
+            lambda: _bound_count(server2) == n_pods, timeout=120
+        ), f"only {_bound_count(server2)}/{n_pods} pods bound after recovery"
+        # consistency: every pod exactly one node; per-node pod count sane
+        pods, _ = server2.list("pods")
+        per_node = {}
+        for p in pods:
+            assert p.spec.node_name, p.metadata.name
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert sum(per_node.values()) == n_pods
+        # all burst pods eventually Running via the recovered kubelets
+        assert wait_until(
+            lambda: server2.count(
+                "pods", lambda p: p.status.phase == v1.POD_RUNNING
+            )
+            == n_pods,
+            timeout=60,
+        )
+    finally:
+        sched2.stop()
+        pool2.stop()
+
+
+def test_kill_kubelet_node_evicts_and_reschedules(tmp_path):
+    """Kill one kubelet (node stops heartbeating): nodelifecycle must taint/
+    evict, and the workload controller must replace the pods elsewhere —
+    the node-failure chaos path."""
+    from kubernetes_tpu.controller.nodelifecycle import NodeLifecycleController
+    from kubernetes_tpu.controller.replicaset import ReplicaSetController
+    from kubernetes_tpu.kubemark.hollow_node import HollowCluster
+
+    server = APIServer()
+    cluster = HollowCluster(server, num_nodes=3, heartbeat_interval=0.2)
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    rs = ReplicaSetController(server)
+    nlc = NodeLifecycleController(
+        server,
+        node_monitor_period=0.2,
+        node_monitor_grace_period=1.0,
+        pod_eviction_timeout=0.5,
+    )
+    cluster.start()
+    sched.start()
+    rs.start()
+    nlc.start()
+    try:
+        server.create(
+            "replicasets",
+            v1.ReplicaSet(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.ReplicaSetSpec(
+                    replicas=6,
+                    selector={"app": "web"},
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "web"}),
+                        spec=v1.PodSpec(
+                            containers=[v1.Container(requests={"cpu": "100m"})]
+                        ),
+                    ),
+                ),
+            ),
+        )
+        assert wait_until(
+            lambda: server.count(
+                "pods",
+                lambda p: bool(p.spec.node_name)
+                and p.metadata.labels.get("app") == "web",
+            )
+            == 6,
+            timeout=60,
+        )
+        cluster.kill_node("hollow-node-0")
+        # convergence: 6 replicas bound on the surviving nodes
+        def healthy():
+            pods, _ = server.list("pods")
+            live = [
+                p
+                for p in pods
+                if p.metadata.labels.get("app") == "web"
+                and p.metadata.deletion_timestamp is None
+                and p.spec.node_name
+                and p.spec.node_name != "hollow-node-0"
+            ]
+            return len(live) >= 6
+
+        assert wait_until(healthy, timeout=90), (
+            "replicas must re-land on surviving nodes after node death"
+        )
+    finally:
+        nlc.stop()
+        rs.stop()
+        sched.stop()
+        cluster.stop()

@@ -216,6 +216,12 @@ def test_controller_manager_runs_all():
             "nodelifecycle",
             "garbagecollector",
             "namespace",
+            "horizontalpodautoscaling",
+            "cronjob",
+            "resourcequota",
+            "serviceaccount",
+            "ttl",
+            "ttlafterfinished",
         }
     finally:
         mgr.stop()

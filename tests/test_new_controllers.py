@@ -1,0 +1,453 @@
+"""HPA, CronJob, ResourceQuota, ServiceAccount, TTL(+AfterFinished)
+controllers — the round-3 controller-breadth slice (reference list:
+cmd/kube-controller-manager/app/controllermanager.go:372-414)."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.cronjob import CronJobController
+from kubernetes_tpu.controller.hpa import CPU_USAGE_ANNOTATION, HPAController
+from kubernetes_tpu.controller.job import JobController
+from kubernetes_tpu.controller.replicaset import ReplicaSetController
+from kubernetes_tpu.controller.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controller.serviceaccount import (
+    TOKEN_SECRET_TYPE,
+    ServiceAccountController,
+)
+from kubernetes_tpu.controller.ttl import (
+    TTL_ANNOTATION,
+    TTLAfterFinishedController,
+    TTLController,
+    ttl_for_cluster_size,
+)
+from kubernetes_tpu.utils.cron import CronSchedule
+
+
+def wait_until(fn, timeout=25.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def _template(labels, cpu="100m"):
+    return v1.PodTemplateSpec(
+        metadata=v1.ObjectMeta(labels=dict(labels)),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": cpu})]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# cron parser
+# ---------------------------------------------------------------------------
+
+
+def test_cron_schedule_parsing_and_next():
+    s = CronSchedule("*/15 * * * *")
+    t0 = time.mktime((2026, 7, 29, 10, 3, 0, 0, 0, -1))
+    nxt = time.localtime(s.next_after(t0))
+    assert (nxt.tm_hour, nxt.tm_min) == (10, 15)
+    s2 = CronSchedule("30 2 * * *")
+    nxt2 = time.localtime(s2.next_after(t0))
+    assert (nxt2.tm_hour, nxt2.tm_min) == (2, 30)
+    # every minute fires next minute
+    s3 = CronSchedule("* * * * *")
+    assert s3.next_after(t0) - t0 <= 60
+
+
+# ---------------------------------------------------------------------------
+# HPA
+# ---------------------------------------------------------------------------
+
+
+def test_hpa_scales_deployment_up_and_down():
+    server = APIServer()
+    server.create(
+        "deployments",
+        v1.Deployment(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.DeploymentSpec(
+                replicas=2, selector={"app": "web"}, template=_template({"app": "web"})
+            ),
+        ),
+    )
+    # two pods at 180m usage each against 100m requests -> 180% of the 60%
+    # target -> desired = ceil(2 * 180/60) = 6, clamped to max 5
+    for i in range(2):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(
+                    name=f"web-{i}",
+                    labels={"app": "web"},
+                    annotations={CPU_USAGE_ANNOTATION: "180m"},
+                ),
+                spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "100m"})]),
+            ),
+        )
+    server.create(
+        "horizontalpodautoscalers",
+        v1.HorizontalPodAutoscaler(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.HorizontalPodAutoscalerSpec(
+                scale_target_ref=v1.CrossVersionObjectReference(
+                    kind="Deployment", name="web"
+                ),
+                min_replicas=1,
+                max_replicas=5,
+                target_cpu_utilization_percentage=60,
+            ),
+        ),
+    )
+    hpa = HPAController(server, sync_period=0.2)
+    hpa.start()
+    try:
+        assert wait_until(
+            lambda: server.get("deployments", "default", "web").spec.replicas == 5
+        ), "hpa must scale up to max"
+        st = server.get("horizontalpodautoscalers", "default", "web").status
+        assert st.desired_replicas == 5
+        assert st.current_cpu_utilization_percentage == 180
+        # drop usage to 6m -> 6% of target 60% -> scale down to min
+        for i in range(2):
+            def mutate(p):
+                p.metadata.annotations[CPU_USAGE_ANNOTATION] = "6m"
+                return p
+
+            server.guaranteed_update("pods", "default", f"web-{i}", mutate)
+        assert wait_until(
+            lambda: server.get("deployments", "default", "web").spec.replicas == 1
+        ), "hpa must scale down to min"
+    finally:
+        hpa.stop()
+
+
+def test_hpa_within_tolerance_no_scale():
+    server = APIServer()
+    server.create(
+        "deployments",
+        v1.Deployment(
+            metadata=v1.ObjectMeta(name="calm"),
+            spec=v1.DeploymentSpec(
+                replicas=2,
+                selector={"app": "calm"},
+                template=_template({"app": "calm"}),
+            ),
+        ),
+    )
+    for i in range(2):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(
+                    name=f"calm-{i}",
+                    labels={"app": "calm"},
+                    annotations={CPU_USAGE_ANNOTATION: "63m"},  # 63% vs 60% target
+                ),
+                spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "100m"})]),
+            ),
+        )
+    server.create(
+        "horizontalpodautoscalers",
+        v1.HorizontalPodAutoscaler(
+            metadata=v1.ObjectMeta(name="calm"),
+            spec=v1.HorizontalPodAutoscalerSpec(
+                scale_target_ref=v1.CrossVersionObjectReference(
+                    kind="Deployment", name="calm"
+                ),
+                min_replicas=1,
+                max_replicas=5,
+                target_cpu_utilization_percentage=60,
+            ),
+        ),
+    )
+    hpa = HPAController(server, sync_period=0.1)
+    hpa.start()
+    try:
+        assert wait_until(
+            lambda: server.get(
+                "horizontalpodautoscalers", "default", "calm"
+            ).status.observed_generation
+            >= 0
+            and server.get(
+                "horizontalpodautoscalers", "default", "calm"
+            ).status.current_cpu_utilization_percentage
+            is not None
+        )
+        time.sleep(0.5)
+        assert server.get("deployments", "default", "calm").spec.replicas == 2, (
+            "within +/-10% tolerance the HPA must not scale"
+        )
+    finally:
+        hpa.stop()
+
+
+# ---------------------------------------------------------------------------
+# CronJob
+# ---------------------------------------------------------------------------
+
+
+def test_cronjob_spawns_job_and_tracks_history():
+    server = APIServer()
+    cj = v1.CronJob(
+        metadata=v1.ObjectMeta(name="tick"),
+        spec=v1.CronJobSpec(
+            schedule="* * * * *",
+            job_template=v1.JobTemplateSpec(
+                spec=v1.JobSpec(completions=1, template=_template({"app": "tick"}))
+            ),
+        ),
+    )
+    # anchor creation in the past so a schedule is already due
+    cj.metadata.creation_timestamp = time.time() - 120
+    server.create("cronjobs", cj)
+    ctrl = CronJobController(server, sync_period=0.2)
+    ctrl.start()
+    try:
+        assert wait_until(lambda: len(server.list("jobs")[0]) >= 1), (
+            "cronjob must spawn a job for the due schedule"
+        )
+        job = server.list("jobs")[0][0]
+        assert job.metadata.name.startswith("tick-")
+        assert any(
+            r.kind == "CronJob" and r.controller
+            for r in job.metadata.owner_references
+        )
+        cur = server.get("cronjobs", "default", "tick")
+        assert cur.status.last_schedule_time is not None
+    finally:
+        ctrl.stop()
+
+
+def test_cronjob_forbid_concurrency():
+    server = APIServer()
+    cj = v1.CronJob(
+        metadata=v1.ObjectMeta(name="serial"),
+        spec=v1.CronJobSpec(
+            schedule="* * * * *",
+            concurrency_policy="Forbid",
+            job_template=v1.JobTemplateSpec(
+                spec=v1.JobSpec(completions=1, template=_template({"app": "s"}))
+            ),
+        ),
+    )
+    cj.metadata.creation_timestamp = time.time() - 120
+    server.create("cronjobs", cj)
+    ctrl = CronJobController(server, sync_period=0.1)
+    ctrl.start()
+    try:
+        assert wait_until(lambda: len(server.list("jobs")[0]) == 1)
+        # active job never finishes (no kubelet); Forbid must not spawn more
+        time.sleep(1.0)
+        assert len(server.list("jobs")[0]) == 1
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# ResourceQuota
+# ---------------------------------------------------------------------------
+
+
+def test_resourcequota_status_tracks_usage():
+    server = APIServer()
+    server.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="q"),
+            spec=v1.ResourceQuotaSpec(
+                hard={"pods": 10, "requests.cpu": 4000, "requests.memory": 2**33}
+            ),
+        ),
+    )
+    ctrl = ResourceQuotaController(server, resync_period=0.5)
+    ctrl.start()
+    try:
+        for i in range(3):
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"q-{i}"),
+                    spec=v1.PodSpec(
+                        containers=[
+                            v1.Container(requests={"cpu": "500m", "memory": "1Gi"})
+                        ]
+                    ),
+                ),
+            )
+        def used_ok():
+            st = server.get("resourcequotas", "default", "q").status
+            return st.used.get("pods") == 3 and st.used.get("requests.cpu") == 1500
+
+        assert wait_until(used_ok), "quota status must track namespace usage"
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# ServiceAccount + token
+# ---------------------------------------------------------------------------
+
+
+def test_serviceaccount_default_sa_and_token():
+    server = APIServer()
+    server.create("namespaces", v1.Namespace(metadata=v1.ObjectMeta(name="team-a")))
+    ctrl = ServiceAccountController(server)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: any(
+                sa.metadata.name == "default"
+                for sa in server.list("serviceaccounts", namespace="team-a")[0]
+            )
+        ), "default ServiceAccount must be created per namespace"
+
+        def token_ok():
+            try:
+                sa = server.get("serviceaccounts", "team-a", "default")
+                sec = server.get("secrets", "team-a", "default-token")
+            except KeyError:
+                return False
+            return sec.type == TOKEN_SECRET_TYPE and "default-token" in sa.secrets
+
+        assert wait_until(token_ok), "token secret must exist and be referenced"
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# TTL + TTLAfterFinished
+# ---------------------------------------------------------------------------
+
+
+def test_ttl_boundaries():
+    assert ttl_for_cluster_size(10) == 0
+    assert ttl_for_cluster_size(101) == 15
+    assert ttl_for_cluster_size(501) == 30
+    assert ttl_for_cluster_size(5000) == 60
+
+
+def test_ttl_controller_annotates_nodes():
+    server = APIServer()
+    for i in range(3):
+        server.create(
+            "nodes",
+            v1.Node(metadata=v1.ObjectMeta(name=f"n{i}"), spec=v1.NodeSpec()),
+        )
+    ctrl = TTLController(server)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: all(
+                n.metadata.annotations.get(TTL_ANNOTATION) == "0"
+                for n in server.list("nodes")[0]
+            )
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_ttl_after_finished_deletes_job():
+    server = APIServer()
+    job = v1.Job(
+        metadata=v1.ObjectMeta(name="done"),
+        spec=v1.JobSpec(completions=1, ttl_seconds_after_finished=1),
+    )
+    job.status.conditions.append(
+        v1.PodCondition(type="Complete", status="True")
+    )
+    server.create("jobs", job)
+    ctrl = TTLAfterFinishedController(server, tick=0.2)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: not any(
+                j.metadata.name == "done" for j in server.list("jobs")[0]
+            ),
+            timeout=15,
+        ), "finished job must be GC'd after its TTL"
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# HPA drives scale-up bursts into the scheduler (VERDICT item 8's ask)
+# ---------------------------------------------------------------------------
+
+
+def test_hpa_scaleup_burst_flows_through_scheduler():
+    from kubernetes_tpu.kubemark import HollowCluster
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+    server = APIServer()
+    hollow = HollowCluster(server, num_nodes=4)
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    rs = ReplicaSetController(server)
+    hpa = HPAController(server, sync_period=0.2)
+    hollow.start()
+    sched.start()
+    rs.start()
+    hpa.start()
+    try:
+        server.create(
+            "replicasets",
+            v1.ReplicaSet(
+                metadata=v1.ObjectMeta(name="burst"),
+                spec=v1.ReplicaSetSpec(
+                    replicas=2,
+                    selector={"app": "burst"},
+                    template=_template({"app": "burst"}),
+                ),
+            ),
+        )
+        # wait for the initial 2, annotate them hot, watch HPA fan out to 8
+        # and every new pod get scheduled + run
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in server.list("pods")[0]
+                if p.spec.node_name and p.metadata.labels.get("app") == "burst"
+            )
+            >= 2,
+            timeout=60,
+        )
+        for p in server.list("pods")[0]:
+            if p.metadata.labels.get("app") == "burst":
+                def hot(cur):
+                    cur.metadata.annotations[CPU_USAGE_ANNOTATION] = "400m"
+                    return cur
+
+                server.guaranteed_update(
+                    "pods", p.metadata.namespace, p.metadata.name, hot
+                )
+        server.create(
+            "horizontalpodautoscalers",
+            v1.HorizontalPodAutoscaler(
+                metadata=v1.ObjectMeta(name="burst"),
+                spec=v1.HorizontalPodAutoscalerSpec(
+                    scale_target_ref=v1.CrossVersionObjectReference(
+                        kind="ReplicaSet", name="burst"
+                    ),
+                    min_replicas=2,
+                    max_replicas=8,
+                    target_cpu_utilization_percentage=50,
+                ),
+            ),
+        )
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in server.list("pods")[0]
+                if p.spec.node_name and p.metadata.labels.get("app") == "burst"
+            )
+            >= 8,
+            timeout=90,
+        ), "HPA burst must scale out and schedule"
+    finally:
+        hpa.stop()
+        rs.stop()
+        sched.stop()
+        hollow.stop()

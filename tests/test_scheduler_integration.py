@@ -146,3 +146,29 @@ def test_preemption_end_to_end(sched_env):
     # victim got deleted
     pods = {p.metadata.name for p in server.list("pods")[0]}
     assert "low" not in pods
+
+
+def test_snapshot_zone_interleave_order():
+    """node_tree.go equivalent: snapshot iteration alternates zones."""
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.scheduler.cache.nodeinfo import NodeInfo, Snapshot
+
+    nodes = []
+    for z in ("za", "zb"):
+        for i in range(3):
+            nodes.append(
+                NodeInfo(
+                    v1.Node(
+                        metadata=v1.ObjectMeta(
+                            name=f"{z}-{i}", labels={"zone": z}
+                        ),
+                        spec=v1.NodeSpec(),
+                    )
+                )
+            )
+    snap = Snapshot(nodes)
+    order = [ni.name for ni in snap.node_info_list]
+    zones = [n.split("-")[0] for n in order]
+    # consecutive entries alternate zones until one zone is exhausted
+    assert zones[:4] == ["za", "zb", "za", "zb"], order
+    assert len(order) == 6 and len(set(order)) == 6
