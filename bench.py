@@ -102,9 +102,10 @@ def main() -> int:
 
         d = jax.device_put(np.zeros(16, np.float32))
         np.asarray(d + 1)  # warmup readback
+        probe = jax.jit(lambda x: x + 1)  # hoisted: one compile, 5 runs
         rtts = []
         for _ in range(5):
-            r = jax.jit(lambda x: x + 1)(d)
+            r = probe(d)
             t0 = time.monotonic()
             jax.device_get(r)
             rtts.append((time.monotonic() - t0) * 1e3)
@@ -119,7 +120,11 @@ def main() -> int:
         warm = WORKLOADS["SchedulingPodAffinity/500"]
         run_benchmark(warm, quiet=True, presize_nodes=cfg.num_nodes)
 
-        res = run_benchmark(cfg, quiet=True)
+        # BENCH_XPLANE_DIR=<dir>: dump a jax-profiler trace of the measured
+        # window (per-batch device timeline: dispatch vs compute vs sync)
+        res = run_benchmark(
+            cfg, quiet=True, xplane_dir=os.environ.get("BENCH_XPLANE_DIR") or None
+        )
 
         # steady-state latency: inject at ~30% of measured burst throughput
         # (capped) so queue depth stays ~0 and the percentiles measure the

@@ -1164,3 +1164,151 @@ class ResourceQuota:
 
     def deep_copy(self) -> "ResourceQuota":
         return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# CustomResourceDefinition + Unstructured (apiextensions-apiserver
+# equivalent) — reference staging/src/k8s.io/apiextensions-apiserver/pkg/
+# apis/apiextensions/types.go; dynamic clients use unstructured objects
+# (apimachinery/pkg/apis/meta/v1/unstructured).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CustomResourceDefinitionNames:
+    plural: str = ""
+    singular: str = ""
+    kind: str = ""
+    list_kind: str = ""
+    short_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinitionSpec:
+    group: str = ""
+    names: CustomResourceDefinitionNames = field(
+        default_factory=CustomResourceDefinitionNames
+    )
+    scope: str = "Namespaced"  # or Cluster
+    versions: List[str] = field(default_factory=lambda: ["v1"])
+
+
+@dataclass
+class CustomResourceDefinitionStatus:
+    accepted_names: CustomResourceDefinitionNames = field(
+        default_factory=CustomResourceDefinitionNames
+    )
+    conditions: List[PodCondition] = field(default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinition:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CustomResourceDefinitionSpec = field(
+        default_factory=CustomResourceDefinitionSpec
+    )
+    status: CustomResourceDefinitionStatus = field(
+        default_factory=CustomResourceDefinitionStatus
+    )
+    kind: str = "CustomResourceDefinition"
+
+    def deep_copy(self) -> "CustomResourceDefinition":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Unstructured:
+    """Schema-less object for custom resources: typed metadata (so the
+    store/watch/WAL machinery works unchanged) + raw content for the rest."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    content: Dict[str, Any] = field(default_factory=dict)
+    kind: str = ""
+    api_version: str = "v1"
+
+    def deep_copy(self) -> "Unstructured":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# APIService (kube-aggregator) — reference
+# staging/src/k8s.io/kube-aggregator/pkg/apis/apiregistration/types.go:
+# claims a (group, version) and names the backend serving it.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class APIServiceSpec:
+    group: str = ""
+    version: str = "v1"
+    service_url: str = ""  # backend base URL ("" = served locally)
+    priority: int = 100
+
+
+@dataclass
+class APIService:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: APIServiceSpec = field(default_factory=APIServiceSpec)
+    kind: str = "APIService"
+
+    def deep_copy(self) -> "APIService":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# EndpointSlice (discovery.k8s.io/v1beta1) — reference
+# staging/src/k8s.io/api/discovery/v1beta1/types.go; produced by
+# pkg/controller/endpointslice with at most 100 endpoints per slice.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Endpoint:
+    addresses: List[str] = field(default_factory=list)
+    ready: bool = True
+    target_pod: str = ""  # namespace/name of backing pod
+    node_name: str = ""
+
+
+@dataclass
+class EndpointSlice:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    address_type: str = "IPv4"
+    endpoints: List[Endpoint] = field(default_factory=list)
+    ports: List[Tuple[str, int]] = field(default_factory=list)
+    kind: str = "EndpointSlice"
+
+    def deep_copy(self) -> "EndpointSlice":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# VolumeAttachment (storage.k8s.io/v1) — reference
+# staging/src/k8s.io/api/storage/v1/types.go; written by the attach-detach
+# controller (pkg/controller/volume/attachdetach), consumed by CSI.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VolumeAttachmentSpec:
+    attacher: str = ""  # driver name
+    node_name: str = ""
+    pv_name: str = ""  # source.persistentVolumeName
+
+
+@dataclass
+class VolumeAttachmentStatus:
+    attached: bool = False
+
+
+@dataclass
+class VolumeAttachment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: VolumeAttachmentSpec = field(default_factory=VolumeAttachmentSpec)
+    status: VolumeAttachmentStatus = field(
+        default_factory=VolumeAttachmentStatus
+    )
+    kind: str = "VolumeAttachment"
+
+    def deep_copy(self) -> "VolumeAttachment":
+        return copy.deepcopy(self)

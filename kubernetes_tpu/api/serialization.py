@@ -44,6 +44,10 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "horizontalpodautoscalers": v1.HorizontalPodAutoscaler,
     "cronjobs": v1.CronJob,
     "resourcequotas": v1.ResourceQuota,
+    "customresourcedefinitions": v1.CustomResourceDefinition,
+    "apiservices": v1.APIService,
+    "endpointslices": v1.EndpointSlice,
+    "volumeattachments": v1.VolumeAttachment,
 }
 
 KIND_TO_RESOURCE = {
@@ -156,10 +160,14 @@ def from_dict(cls: Type, data: Any) -> Any:
     return data
 
 
-def decode(resource: str, data: dict) -> Any:
-    """JSON body → typed object for a REST resource."""
+def decode(resource: str, data: dict, allow_unstructured: bool = True) -> Any:
+    """JSON body → typed object for a REST resource. Unknown resources
+    decode as Unstructured (custom resources — the REST layer gates which
+    unknown resources are actually served; the WAL replays them blindly)."""
     cls = RESOURCE_KINDS.get(resource)
     if cls is None:
+        if allow_unstructured:
+            return decode_unstructured(data)
         raise KeyError(f"unknown resource {resource!r}")
     return from_dict(cls, data)
 
@@ -174,11 +182,35 @@ def decode_any(data: dict) -> Any:
 
 
 def encode(obj: Any) -> dict:
+    if isinstance(obj, v1.Unstructured):
+        # custom resources round-trip their raw content; typed metadata is
+        # re-attached under the standard key
+        d = dict(obj.content)
+        d["metadata"] = to_dict(obj.metadata)
+        d["kind"] = obj.kind or "Unstructured"
+        d["apiVersion"] = obj.api_version
+        return d
     d = to_dict(obj)
     if isinstance(d, dict):
         d.setdefault("kind", type(obj).__name__)
         d.setdefault("apiVersion", "v1")
     return d
+
+
+def decode_unstructured(data: dict) -> v1.Unstructured:
+    """JSON body → Unstructured (dynamic-client path for CRD resources)."""
+    meta = from_dict(v1.ObjectMeta, data.get("metadata", {}) or {})
+    content = {
+        k: val
+        for k, val in data.items()
+        if k not in ("metadata", "kind", "apiVersion")
+    }
+    return v1.Unstructured(
+        metadata=meta,
+        content=content,
+        kind=data.get("kind", ""),
+        api_version=data.get("apiVersion", "v1"),
+    )
 
 
 def _register_late() -> None:

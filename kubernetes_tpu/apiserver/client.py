@@ -66,6 +66,10 @@ class RESTClient:
                 raise Conflict(msg) from None
             raise
 
+    def get_raw(self, path: str) -> dict:
+        """GET an arbitrary API path (aggregated APIs like metrics.k8s.io)."""
+        return self._request("GET", self.base + path)
+
     # -- the APIServer interface ---------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:

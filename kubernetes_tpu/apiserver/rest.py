@@ -78,13 +78,20 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _parse(self) -> Tuple[Optional[str], Optional[str], Optional[str], dict]:
-        """(resource, namespace, name, query) or (None, ...) on bad path."""
+        """(resource, namespace, name, query) or (None, ...) on bad path.
+
+        Serves the core group (/api/v1/...) and named groups
+        (/apis/{group}/{version}/... — the apiextensions/aggregator path;
+        group routing is decided by _serve_group before this is used)."""
         u = urlparse(self.path)
         parts = [p for p in u.path.split("/") if p]
         query = {k: v[-1] for k, v in parse_qs(u.query).items()}
-        if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+        if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+            rest = parts[2:]
+        elif len(parts) >= 3 and parts[0] == "apis":
+            rest = parts[3:]  # /apis/{group}/{version}/...
+        else:
             return None, None, None, query
-        rest = parts[2:]
         if not rest:
             return None, None, None, query
         if rest[0] == "namespaces" and len(rest) >= 3:
@@ -96,6 +103,75 @@ class _Handler(BaseHTTPRequestHandler):
         resource = rest[0]
         name = rest[1] if len(rest) > 1 else None
         return resource, None, name, query
+
+    def _group_of_path(self) -> Optional[str]:
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) >= 2 and parts[0] == "apis":
+            return parts[1]
+        return None
+
+    def _resource_served(self, resource: str) -> bool:
+        """Built-in, or defined by an established CRD (the apiextensions
+        gate: unknown resources 404 unless a CRD claims them)."""
+        if resource in codec.RESOURCE_KINDS:
+            return True
+        try:
+            crds, _ = self.store.list("customresourcedefinitions")
+        except Exception:
+            return False
+        return any(c.spec.names.plural == resource for c in crds)
+
+    def _maybe_proxy(self) -> bool:
+        """kube-aggregator: if an APIService claims this path's group with a
+        backend URL, forward the request verbatim and relay the response
+        (staging/src/k8s.io/kube-aggregator proxy handler). Returns True if
+        the request was proxied."""
+        group = self._group_of_path()
+        if group is None:
+            return False
+        try:
+            svcs, _ = self.store.list("apiservices")
+        except Exception:
+            return False
+        backend = next(
+            (
+                s.spec.service_url
+                for s in sorted(svcs, key=lambda s: s.spec.priority)
+                if s.spec.group == group and s.spec.service_url
+            ),
+            None,
+        )
+        if not backend:
+            return False
+        import urllib.error
+        import urllib.request
+
+        url = backend.rstrip("/") + self.path
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else None
+        req = urllib.request.Request(url, data=body, method=self.command)
+        for h in ("Content-Type", "Authorization"):
+            if self.headers.get(h):
+                req.add_header(h, self.headers[h])
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+                self.send_response(resp.status)
+                for h, val in resp.headers.items():
+                    if h.lower() in ("content-type",):
+                        self.send_header(h, val)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except OSError as e:
+            self._status_error(502, "BadGateway", f"aggregated backend: {e}")
+        return True
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -133,6 +209,75 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs ---------------------------------------------------------------
 
+    def _serve_metrics_api(self) -> bool:
+        """metrics.k8s.io equivalent (staging/src/k8s.io/metrics +
+        metrics-server): node/pod usage. Usage comes from the pods'
+        ``metrics.kubernetes.io/cpu-usage`` annotations when present (the
+        same source the HPA reads), else falls back to requests — a
+        deterministic synthetic signal, the hollow-cluster analogue of
+        cAdvisor. Served locally unless an APIService claims the group."""
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) < 4 or parts[:2] != ["apis", "metrics.k8s.io"]:
+            return False
+        # usage data is cluster-visibility: authn/authz like any resource
+        # (grant via Rule(resources={"metrics"}))
+        if not self._authorize("get", "metrics", None):
+            return True  # a 401/403 was written
+        rest = parts[3:]
+        from ..api.objects import compute_pod_resource_request
+        from ..api.resources import CPU, MEMORY, cpu_to_millis
+
+        def pod_usage(p):
+            raw = p.metadata.annotations.get("metrics.kubernetes.io/cpu-usage")
+            req = compute_pod_resource_request(p)
+            try:
+                cpu = cpu_to_millis(raw) if raw else int(req.get(CPU, 0))
+            except ValueError:
+                cpu = int(req.get(CPU, 0))
+            return {"cpu": f"{cpu}m", "memory": f"{int(req.get(MEMORY, 0))}"}
+
+        pods, _ = self.store.list("pods")
+        running = [p for p in pods if p.spec.node_name]
+        if rest and rest[0] == "nodes":
+            per_node = {}
+            for p in running:
+                u = pod_usage(p)
+                agg = per_node.setdefault(p.spec.node_name, [0, 0])
+                agg[0] += int(u["cpu"][:-1])
+                agg[1] += int(u["memory"])
+            nodes, _ = self.store.list("nodes")
+            items = [
+                {
+                    "metadata": {"name": n.metadata.name},
+                    "usage": {
+                        "cpu": f"{per_node.get(n.metadata.name, [0, 0])[0]}m",
+                        "memory": str(per_node.get(n.metadata.name, [0, 0])[1]),
+                    },
+                }
+                for n in nodes
+                if not rest[1:] or n.metadata.name == rest[1]
+            ]
+            self._json(200, {"kind": "NodeMetricsList", "items": items})
+            return True
+        ns = None
+        if rest and rest[0] == "namespaces" and len(rest) >= 3:
+            ns, rest = rest[1], rest[2:]
+        if rest and rest[0] == "pods":
+            items = [
+                {
+                    "metadata": {
+                        "name": p.metadata.name,
+                        "namespace": p.metadata.namespace,
+                    },
+                    "usage": pod_usage(p),
+                }
+                for p in running
+                if ns is None or p.metadata.namespace == ns
+            ]
+            self._json(200, {"kind": "PodMetricsList", "items": items})
+            return True
+        return False
+
     def do_GET(self):
         u = urlparse(self.path)
         if u.path in ("/healthz", "/readyz", "/livez"):
@@ -143,9 +288,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self._maybe_proxy():
+            return
+        if self._serve_metrics_api():
+            return
         resource, ns, name, query = self._parse()
         if resource is None:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._resource_served(resource):
+            return self._status_error(404, "NotFound", f"no such resource {resource}")
         verb = (
             "get"
             if name
@@ -205,9 +356,13 @@ class _Handler(BaseHTTPRequestHandler):
             watcher.stop()
 
     def do_POST(self):
+        if self._maybe_proxy():
+            return
         resource, ns, name, _q = self._parse()
         if resource is None:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._resource_served(resource):
+            return self._status_error(404, "NotFound", f"no such resource {resource}")
         if not self._authorize("create", resource, ns):
             return
         try:
@@ -235,9 +390,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(400, "BadRequest", str(e))
 
     def do_PUT(self):
+        if self._maybe_proxy():
+            return
         resource, ns, name, _q = self._parse()
         if resource is None or not name:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._resource_served(resource):
+            return self._status_error(404, "NotFound", f"no such resource {resource}")
         if not self._authorize("update", resource, ns):
             return
         try:
@@ -256,9 +415,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(400, "BadRequest", str(e))
 
     def do_DELETE(self):
+        if self._maybe_proxy():
+            return
         resource, ns, name, _q = self._parse()
         if resource is None or not name:
             return self._status_error(404, "NotFound", "unknown path")
+        if not self._resource_served(resource):
+            return self._status_error(404, "NotFound", f"no such resource {resource}")
         if not self._authorize("delete", resource, ns):
             return
         try:

@@ -29,6 +29,26 @@ ALIASES = {
     "sc": "storageclasses",
     "ev": "events",
     "event": "events",
+    "deployment": "deployments",
+    "deploy": "deployments",
+    "replicaset": "replicasets",
+    "rs": "replicasets",
+    "statefulset": "statefulsets",
+    "sts": "statefulsets",
+    "daemonset": "daemonsets",
+    "ds": "daemonsets",
+    "job": "jobs",
+    "cronjob": "cronjobs",
+    "cj": "cronjobs",
+    "hpa": "horizontalpodautoscalers",
+    "quota": "resourcequotas",
+    "cm": "configmaps",
+    "configmap": "configmaps",
+    "secret": "secrets",
+    "sa": "serviceaccounts",
+    "crd": "customresourcedefinitions",
+    "ns": "namespaces",
+    "namespace": "namespaces",
 }
 
 
@@ -186,6 +206,75 @@ def cmd_taint(client: RESTClient, args) -> int:
     return 0
 
 
+def cmd_top(client: RESTClient, args) -> int:
+    """kubectl top nodes|pods (metrics.k8s.io, kubectl/pkg/cmd/top)."""
+    what = _resource(args.resource)
+    if what == "nodes":
+        data = client.get_raw("/apis/metrics.k8s.io/v1beta1/nodes")
+        print(f"{'NAME':32} {'CPU(cores)':>12} {'MEMORY(bytes)':>16}")
+        for it in data.get("items", []):
+            print(
+                f"{it['metadata']['name']:32} {it['usage']['cpu']:>12} "
+                f"{it['usage']['memory']:>16}"
+            )
+        return 0
+    data = client.get_raw(
+        f"/apis/metrics.k8s.io/v1beta1/namespaces/{args.namespace}/pods"
+    )
+    print(f"{'NAME':40} {'CPU(cores)':>12} {'MEMORY(bytes)':>16}")
+    for it in data.get("items", []):
+        print(
+            f"{it['metadata']['name']:40} {it['usage']['cpu']:>12} "
+            f"{it['usage']['memory']:>16}"
+        )
+    return 0
+
+
+SCALABLE = {"deployments", "replicasets", "statefulsets", "jobs"}
+
+
+def cmd_scale(client: RESTClient, args) -> int:
+    """kubectl scale <resource> <name> --replicas=N (cmd/scale)."""
+    resource = _resource(args.resource)
+    if resource not in SCALABLE:
+        print(f"error: {resource} is not scalable", file=sys.stderr)
+        return 1
+    obj = client.get(resource, args.namespace, args.name)
+    if resource == "jobs":
+        obj.spec.parallelism = args.replicas
+    else:
+        obj.spec.replicas = args.replicas
+    client.update(resource, obj)
+    print(f"{resource}/{args.name} scaled")
+    return 0
+
+
+def cmd_rollout_status(client: RESTClient, args) -> int:
+    """kubectl rollout status deployment/<name> (cmd/rollout): poll until
+    updated == desired and available == desired."""
+    import time as _time
+
+    kind, _, name = args.target.partition("/")
+    resource = _resource(kind)
+    if resource != "deployments":
+        print("error: rollout status supports deployments", file=sys.stderr)
+        return 1
+    deadline = _time.time() + args.timeout
+    while _time.time() < deadline:
+        d = client.get(resource, args.namespace, name)
+        want = d.spec.replicas
+        if (
+            d.status.updated_replicas >= want
+            and d.status.available_replicas >= want
+            and d.status.replicas == want
+        ):
+            print(f'deployment "{name}" successfully rolled out')
+            return 0
+        _time.sleep(0.2)
+    print(f'error: deployment "{name}" rollout timed out', file=sys.stderr)
+    return 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kubectl-tpu")
     parser.add_argument(
@@ -217,6 +306,16 @@ def main(argv=None) -> int:
     p_taint.add_argument("nodes")  # literal "nodes"
     p_taint.add_argument("name")
     p_taint.add_argument("taint")
+    p_top = sub.add_parser("top")
+    p_top.add_argument("resource")  # nodes | pods
+    p_scale = sub.add_parser("scale")
+    p_scale.add_argument("resource")
+    p_scale.add_argument("name")
+    p_scale.add_argument("--replicas", type=int, required=True)
+    p_roll = sub.add_parser("rollout")
+    p_roll.add_argument("action")  # status
+    p_roll.add_argument("target")  # deployment/<name>
+    p_roll.add_argument("--timeout", type=float, default=60.0)
 
     args = parser.parse_args(argv)
     client = RESTClient(args.server)
@@ -237,6 +336,15 @@ def main(argv=None) -> int:
             return cmd_cordon(client, args, False)
         if args.verb == "taint":
             return cmd_taint(client, args)
+        if args.verb == "top":
+            return cmd_top(client, args)
+        if args.verb == "scale":
+            return cmd_scale(client, args)
+        if args.verb == "rollout":
+            if args.action != "status":
+                print("error: only 'rollout status' is supported", file=sys.stderr)
+                return 1
+            return cmd_rollout_status(client, args)
     except NotFound as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

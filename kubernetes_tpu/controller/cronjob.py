@@ -111,7 +111,9 @@ class CronJobController(WorkqueueController):
 
         if active:
             if cj.spec.concurrency_policy == v1_FORBID:
-                self._bump_last_schedule(ns, name, scheduled_t)
+                # do NOT bump last_schedule_time: the missed run starts once
+                # the active job finishes (subject to startingDeadline) —
+                # bumping here would drop it permanently (syncOne semantics)
                 return
             if cj.spec.concurrency_policy == v1_REPLACE:
                 for j in active:

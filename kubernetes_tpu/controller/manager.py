@@ -12,8 +12,10 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .attachdetach import AttachDetachController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
+from .endpointslice import EndpointSliceController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
 from .endpoints import EndpointsController
@@ -21,7 +23,9 @@ from .garbagecollector import GarbageCollector
 from .hpa import HPAController
 from .job import JobController
 from .namespace import NamespaceController
+from .nodeipam import NodeIpamController
 from .nodelifecycle import NodeLifecycleController
+from .pv_binder import PVBinderController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
@@ -48,6 +52,10 @@ CONTROLLER_INITIALIZERS = {
     "serviceaccount": ServiceAccountController,
     "ttl": TTLController,
     "ttlafterfinished": TTLAfterFinishedController,
+    "endpointslice": EndpointSliceController,
+    "nodeipam": NodeIpamController,
+    "attachdetach": AttachDetachController,
+    "persistentvolume-binder": PVBinderController,
 }
 
 

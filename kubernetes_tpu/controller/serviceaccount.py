@@ -62,6 +62,25 @@ class ServiceAccountController(WorkqueueController):
             except AlreadyExists:
                 sa = self.server.get("serviceaccounts", name, "default")
         self._ensure_token(sa)
+        self._gc_orphaned_tokens(name)
+
+    def _gc_orphaned_tokens(self, namespace: str) -> None:
+        """Token secrets whose ServiceAccount is gone must be DELETED —
+        otherwise the bearer credential keeps authenticating a revoked
+        identity (tokens_controller deletes on SA deletion)."""
+        sas = {
+            sa.metadata.name
+            for sa in self.server.list("serviceaccounts", namespace=namespace)[0]
+        }
+        for s in self.server.list("secrets", namespace=namespace)[0]:
+            if s.type != TOKEN_SECRET_TYPE:
+                continue
+            owner = s.metadata.annotations.get(SA_ANNOTATION, "")
+            if owner and owner not in sas:
+                try:
+                    self.server.delete("secrets", namespace, s.metadata.name)
+                except NotFound:
+                    pass
 
     def _ensure_token(self, sa: v1.ServiceAccount) -> None:
         """tokens_controller.go ensureReferencedToken: a token Secret exists

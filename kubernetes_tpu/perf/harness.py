@@ -52,7 +52,12 @@ def run_benchmark(
     timeout_s: float = 300.0,
     quiet: bool = True,
     presize_nodes: Optional[int] = None,
+    xplane_dir: Optional[str] = None,
 ) -> BenchResult:
+    """xplane_dir: capture a jax-profiler (XPlane/TensorBoard) trace of the
+    measured window — the device-side profiling hook SURVEY §5 calls for
+    (the reference's /debug/pprof analogue for the TPU data plane). View
+    with TensorBoard or xprof."""
     metrics.reset()
     server = APIServer()
     scfg = sched_config or KubeSchedulerConfiguration()
@@ -67,6 +72,13 @@ def run_benchmark(
 
     sched.start()
     try:
+        if xplane_dir:
+            import jax
+
+            with jax.profiler.trace(xplane_dir):
+                return _run_benchmark_body(
+                    cfg, server, sched, init_pods, factory, timeout_s, quiet
+                )
         return _run_benchmark_body(
             cfg, server, sched, init_pods, factory, timeout_s, quiet
         )

@@ -80,14 +80,26 @@ class CronSchedule:
                 )
                 continue
             if tm.tm_hour not in self.hours:
-                t = int(t // 3600 + 1) * 3600
+                # next LOCAL hour boundary — unix-hour arithmetic breaks in
+                # zones with non-whole-hour offsets (e.g. +5:30)
+                t = int(
+                    time.mktime(
+                        (tm.tm_year, tm.tm_mon, tm.tm_mday, tm.tm_hour + 1,
+                         0, 0, 0, 0, -1)
+                    )
+                )
                 continue
             if tm.tm_min in self.minutes:
                 return float(t)
-            # next matching minute within this hour, else next hour
+            # next matching minute within this hour, else next local hour
             later = [m for m in self.minutes if m > tm.tm_min]
             if later:
                 t += (min(later) - tm.tm_min) * 60
             else:
-                t = int(t // 3600 + 1) * 3600
+                t = int(
+                    time.mktime(
+                        (tm.tm_year, tm.tm_mon, tm.tm_mday, tm.tm_hour + 1,
+                         0, 0, 0, 0, -1)
+                    )
+                )
         raise ValueError(f"no run time within {limit_days} days for {self.spec!r}")

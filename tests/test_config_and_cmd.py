@@ -7,6 +7,7 @@ import urllib.request
 
 import pytest
 
+from kubernetes_tpu.api import objects as v1
 from kubernetes_tpu.api.objects import (
     Container,
     Node,
@@ -223,3 +224,54 @@ def test_cmd_run_serves_healthz_and_metrics():
         assert not any("schedule_attempts_total" in k for k in m2)
     finally:
         sched.stop()
+
+
+def test_metrics_api_and_kubectl_top_scale_rollout(capsys):
+    """metrics.k8s.io serving + kubectl top/scale/rollout status."""
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    srv, port, store = serve()
+    try:
+        store.create("nodes", make_node("m0"))
+        p = make_pod("mp")
+        p.spec.node_name = "m0"
+        p.metadata.annotations["metrics.kubernetes.io/cpu-usage"] = "750m"
+        store.create("pods", p)
+        base = ["--server", f"http://127.0.0.1:{port}"]
+
+        assert kubectl.main(base + ["top", "nodes"]) == 0
+        out = capsys.readouterr().out
+        assert "m0" in out and "750m" in out
+        assert kubectl.main(base + ["top", "pods"]) == 0
+        out = capsys.readouterr().out
+        assert "mp" in out and "750m" in out
+
+        dep = v1.Deployment(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.DeploymentSpec(replicas=2, selector={"app": "web"}),
+        )
+        store.create("deployments", dep)
+        assert (
+            kubectl.main(base + ["scale", "deployments", "web", "--replicas", "5"])
+            == 0
+        )
+        capsys.readouterr()
+        assert store.get("deployments", "default", "web").spec.replicas == 5
+
+        def done(d):
+            d.status.replicas = 5
+            d.status.updated_replicas = 5
+            d.status.available_replicas = 5
+            return d
+
+        store.guaranteed_update("deployments", "default", "web", done)
+        assert (
+            kubectl.main(
+                base + ["rollout", "status", "deployment/web", "--timeout", "10"]
+            )
+            == 0
+        )
+        assert "successfully rolled out" in capsys.readouterr().out
+    finally:
+        srv.shutdown()

@@ -222,6 +222,10 @@ def test_controller_manager_runs_all():
             "serviceaccount",
             "ttl",
             "ttlafterfinished",
+            "endpointslice",
+            "nodeipam",
+            "attachdetach",
+            "persistentvolume-binder",
         }
     finally:
         mgr.stop()

@@ -168,7 +168,9 @@ def test_wal_snapshot_compaction_and_torn_tail(tmp_path):
     for i in range(25):
         server.create("pods", make_pod(f"p{i}"))
     # compaction runs async off the mutation path; wait for the snapshot
-    assert wait_until(lambda: os.path.exists(path + ".snapshot.json"), timeout=10)
+    # generous timeout: fsync-per-append + async compaction under a
+    # CPU-contended suite can stretch well past 10s
+    assert wait_until(lambda: os.path.exists(path + ".snapshot.json"), timeout=60)
     # simulate a torn final record (crash mid-append)
     with open(path + ".wal", "a", encoding="utf-8") as f:
         f.write('{"rv": 99999, "verb": "create", "kind": "pods", "obj": {tru')

@@ -451,3 +451,68 @@ def test_hpa_scaleup_burst_flows_through_scheduler():
         rs.stop()
         sched.stop()
         hollow.stop()
+
+
+def test_serviceaccount_deletion_revokes_token():
+    """Deleting an SA must delete its token secret (the credential revokes)."""
+    server = APIServer()
+    server.create("namespaces", v1.Namespace(metadata=v1.ObjectMeta(name="rm")))
+    ctrl = ServiceAccountController(server)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: any(
+                s.type == TOKEN_SECRET_TYPE
+                for s in server.list("secrets", namespace="rm")[0]
+            )
+        )
+        server.delete("serviceaccounts", "rm", "default")
+        # the controller recreates default + token, but the OLD secret must
+        # have been GC'd in between; force the explicit orphan case:
+        # create a token secret for an SA that never existed
+        server.create(
+            "secrets",
+            v1.Secret(
+                metadata=v1.ObjectMeta(
+                    name="ghost-token",
+                    namespace="rm",
+                    annotations={"kubernetes.io/service-account.name": "ghost"},
+                ),
+                type=TOKEN_SECRET_TYPE,
+                data={"token": b"zombie"},
+            ),
+        )
+        assert wait_until(
+            lambda: not any(
+                s.metadata.name == "ghost-token"
+                for s in server.list("secrets", namespace="rm")[0]
+            )
+        ), "orphaned token secret must be deleted"
+    finally:
+        ctrl.stop()
+
+
+def test_cron_next_after_non_whole_hour_timezone():
+    """Hour jumps must land on LOCAL hour boundaries (+5:30 zones)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import time; from kubernetes_tpu.utils.cron import CronSchedule; "
+        "s = CronSchedule('0 5 * * *'); "
+        "t = s.next_after(time.time()); "
+        "tm = time.localtime(t); "
+        "assert (tm.tm_hour, tm.tm_min) == (5, 0), (tm.tm_hour, tm.tm_min); "
+        "print('TZ_OK')"
+    )
+    env = dict(os.environ, TZ="Asia/Kolkata")
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=60,
+        cwd="/root/repo",
+    )
+    assert r.returncode == 0 and "TZ_OK" in r.stdout, r.stderr[-500:]

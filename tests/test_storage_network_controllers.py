@@ -1,0 +1,221 @@
+"""EndpointSlice, NodeIPAM, attach-detach, PV binder controllers
+(reference: pkg/controller/{endpointslice,nodeipam,volume/attachdetach,
+volume/persistentvolume})."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.attachdetach import AttachDetachController
+from kubernetes_tpu.controller.endpointslice import (
+    SERVICE_NAME_LABEL,
+    EndpointSliceController,
+)
+from kubernetes_tpu.controller.nodeipam import NodeIpamController
+from kubernetes_tpu.controller.pv_binder import PVBinderController
+
+
+def wait_until(fn, timeout=25.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def _running_pod(name, labels, ip, node="n0"):
+    p = v1.Pod(
+        metadata=v1.ObjectMeta(name=name, labels=labels),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": "100m"})], node_name=node
+        ),
+    )
+    p.status.phase = v1.POD_RUNNING
+    p.status.pod_ip = ip
+    return p
+
+
+def test_endpointslice_slicing_and_cleanup():
+    server = APIServer()
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.ServiceSpec(selector={"app": "web"}, ports=[("http", 80)]),
+        ),
+    )
+    for i in range(5):
+        server.create(
+            "pods", _running_pod(f"w{i}", {"app": "web"}, f"10.0.0.{i+1}")
+        )
+    ctrl = EndpointSliceController(server, max_endpoints_per_slice=2)
+    ctrl.start()
+    try:
+        def sliced():
+            slices, _ = server.list("endpointslices")
+            mine = [
+                s
+                for s in slices
+                if s.metadata.labels.get(SERVICE_NAME_LABEL) == "web"
+            ]
+            total = sum(len(s.endpoints) for s in mine)
+            return len(mine) == 3 and total == 5 and all(
+                len(s.endpoints) <= 2 for s in mine
+            )
+
+        assert wait_until(sliced), "5 endpoints must split into 3 slices of <=2"
+        # shrink the pod set -> surplus slices deleted
+        for i in range(4):
+            server.delete("pods", "default", f"w{i}")
+        def shrunk():
+            slices, _ = server.list("endpointslices")
+            mine = [
+                s
+                for s in slices
+                if s.metadata.labels.get(SERVICE_NAME_LABEL) == "web"
+            ]
+            return len(mine) == 1 and len(mine[0].endpoints) == 1
+
+        assert wait_until(shrunk), "slices must shrink with the pod set"
+    finally:
+        ctrl.stop()
+
+
+def test_nodeipam_allocates_unique_cidrs():
+    server = APIServer()
+    for i in range(5):
+        server.create(
+            "nodes", v1.Node(metadata=v1.ObjectMeta(name=f"n{i}"), spec=v1.NodeSpec())
+        )
+    ctrl = NodeIpamController(server, cluster_cidr="10.244.0.0/20", node_mask_size=24)
+    ctrl.start()
+    try:
+        def all_allocated():
+            nodes, _ = server.list("nodes")
+            cidrs = [n.spec.pod_cidr for n in nodes]
+            return all(cidrs) and len(set(cidrs)) == 5
+
+        assert wait_until(all_allocated), "every node needs a distinct pod CIDR"
+        nodes, _ = server.list("nodes")
+        assert all(n.spec.pod_cidr.startswith("10.244.") for n in nodes)
+    finally:
+        ctrl.stop()
+
+
+def _pv(name, size="10Gi", sc=""):
+    return v1.PersistentVolume(
+        metadata=v1.ObjectMeta(name=name, namespace=""),
+        spec=v1.PersistentVolumeSpec(
+            capacity={"storage": size},
+            access_modes=["ReadWriteOnce"],
+            storage_class_name=sc,
+        ),
+    )
+
+
+def _pvc(name, size="5Gi", sc=None):
+    return v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteOnce"],
+            resources={"storage": size},
+            storage_class_name=sc,
+        ),
+    )
+
+
+def test_pv_binder_matches_smallest_fit():
+    server = APIServer()
+    server.create("persistentvolumes", _pv("big", "100Gi"))
+    server.create("persistentvolumes", _pv("small", "10Gi"))
+    server.create("persistentvolumeclaims", _pvc("claim", "5Gi"))
+    ctrl = PVBinderController(server)
+    ctrl.start()
+    try:
+        def bound():
+            c = server.get("persistentvolumeclaims", "default", "claim")
+            return c.spec.volume_name == "small" and c.status.phase == "Bound"
+
+        assert wait_until(bound), "binder must pick the smallest satisfying PV"
+        pv = server.get("persistentvolumes", "", "small")
+        assert pv.spec.claim_ref == "default/claim"
+        assert pv.status.phase == "Bound"
+        # deleting the claim releases the volume
+        server.delete("persistentvolumeclaims", "default", "claim")
+        assert wait_until(
+            lambda: server.get("persistentvolumes", "", "small").status.phase
+            == "Released"
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_pv_binder_dynamic_provisioning():
+    server = APIServer()
+    server.create(
+        "storageclasses",
+        v1.StorageClass(
+            metadata=v1.ObjectMeta(name="fast", namespace=""),
+            provisioner="csi.example.com",
+        ),
+    )
+    server.create("persistentvolumeclaims", _pvc("dyn", "2Gi", sc="fast"))
+    ctrl = PVBinderController(server)
+    ctrl.start()
+    try:
+        def provisioned():
+            c = server.get("persistentvolumeclaims", "default", "dyn")
+            if not c.spec.volume_name:
+                return False
+            pv = server.get("persistentvolumes", "", c.spec.volume_name)
+            return (
+                pv.spec.csi is not None
+                and pv.spec.csi.driver == "csi.example.com"
+                and pv.spec.storage_class_name == "fast"
+            )
+
+        assert wait_until(provisioned), "provisioner class must create + bind a PV"
+    finally:
+        ctrl.stop()
+
+
+def test_attach_detach_follows_pod_placement():
+    server = APIServer()
+    pv = _pv("disk-1", "10Gi")
+    pv.spec.claim_ref = "default/data"
+    pv.status.phase = "Bound"
+    server.create("persistentvolumes", pv)
+    pvc = _pvc("data", "5Gi")
+    pvc.spec.volume_name = "disk-1"
+    pvc.status.phase = "Bound"
+    server.create("persistentvolumeclaims", pvc)
+
+    pod = v1.Pod(
+        metadata=v1.ObjectMeta(name="db"),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": "100m"})],
+            node_name="n3",
+            volumes=[v1.Volume(name="data", persistent_volume_claim="data")],
+        ),
+    )
+    server.create("pods", pod)
+    ctrl = AttachDetachController(server)
+    ctrl.start()
+    try:
+        def attached():
+            vas, _ = server.list("volumeattachments")
+            return any(
+                a.spec.pv_name == "disk-1"
+                and a.spec.node_name == "n3"
+                and a.status.attached
+                for a in vas
+            )
+
+        assert wait_until(attached), "placed pod's PV must attach to its node"
+        server.delete("pods", "default", "db")
+        assert wait_until(
+            lambda: not server.list("volumeattachments")[0]
+        ), "attachment must detach when no pod uses it"
+    finally:
+        ctrl.stop()
