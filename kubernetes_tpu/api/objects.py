@@ -773,6 +773,8 @@ class ServiceSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     cluster_ip: str = ""
     ports: List[Tuple[str, int]] = field(default_factory=list)
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+    external_ips: List[str] = field(default_factory=list)  # LB-assigned
 
 
 @dataclass

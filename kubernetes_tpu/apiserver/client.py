@@ -25,6 +25,7 @@ class RESTClient:
     def __init__(self, base_url: str, timeout: float = 30.0):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self._headers: dict = {}
 
     # -- plumbing ------------------------------------------------------------
 
@@ -45,7 +46,7 @@ class RESTClient:
             url,
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **self._headers},
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -123,7 +124,7 @@ class RESTClient:
 
         def pump():
             try:
-                req = urllib.request.Request(url)
+                req = urllib.request.Request(url, headers=dict(self._headers))
                 with urllib.request.urlopen(req, timeout=None) as resp:
                     for line in resp:
                         if w.stopped:
@@ -163,3 +164,11 @@ class RESTClient:
             except Exception as e:
                 errors.append(str(e))
         return errors
+
+
+class AuthRESTClient(RESTClient):
+    """RESTClient sending a bearer token (kubeconfig user credentials)."""
+
+    def __init__(self, base_url: str, token: str, timeout: float = 30.0):
+        super().__init__(base_url, timeout=timeout)
+        self._headers["Authorization"] = f"Bearer {token}"

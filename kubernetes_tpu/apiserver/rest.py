@@ -111,15 +111,23 @@ class _Handler(BaseHTTPRequestHandler):
         return None
 
     def _resource_served(self, resource: str) -> bool:
-        """Built-in, or defined by an established CRD (the apiextensions
-        gate: unknown resources 404 unless a CRD claims them)."""
-        if resource in codec.RESOURCE_KINDS:
-            return True
+        """Group-aware serving gate: core-path (/api/v1) requests serve
+        built-ins only; /apis/{group}/... serves a resource only when an
+        established CRD claims that exact (group, plural). (CR storage is
+        keyed by plural; two CRDs reusing one plural across groups is
+        rejected at routing granularity, mirroring the reference's
+        ambiguous-plural restrictions.)"""
+        group = self._group_of_path()
+        if group is None:
+            return resource in codec.RESOURCE_KINDS
         try:
             crds, _ = self.store.list("customresourcedefinitions")
         except Exception:
             return False
-        return any(c.spec.names.plural == resource for c in crds)
+        return any(
+            c.spec.group == group and c.spec.names.plural == resource
+            for c in crds
+        )
 
     def _maybe_proxy(self) -> bool:
         """kube-aggregator: if an APIService claims this path's group with a
@@ -143,6 +151,19 @@ class _Handler(BaseHTTPRequestHandler):
         )
         if not backend:
             return False
+        # the aggregator AUTHENTICATES before proxying (authorization is the
+        # backend's job, like the reference forwarding user headers); an
+        # anonymous-rejecting front server must not leak a bypass
+        authn = self.server.authenticator
+        if authn is not None:
+            from .auth import ANONYMOUS, UserInfo
+
+            user = authn.authenticate_header(
+                self.headers.get("Authorization", "")
+            )
+            if user is None and not authn.allow_anonymous:
+                self._status_error(401, "Unauthorized", "authentication required")
+                return True
         import urllib.error
         import urllib.request
 

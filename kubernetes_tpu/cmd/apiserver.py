@@ -1,7 +1,8 @@
 """kube-apiserver process entry: the REST façade as a standalone process.
 
-Reference: cmd/kube-apiserver/app/server.go (reduced: one server, no
-aggregation layers — CRDs/aggregation are tracked as follow-ups).
+Reference: cmd/kube-apiserver/app/server.go — one process serving the core
+group, CRD-defined groups (apiextensions path), and aggregated groups
+(APIService proxying), with optional authn/authz via apiserver/auth.py.
 """
 
 from __future__ import annotations

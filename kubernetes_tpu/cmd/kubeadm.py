@@ -1,0 +1,219 @@
+"""kubeadm-lite: phased cluster bootstrap.
+
+Reference: cmd/kubeadm/app/cmd/phases/init/ — `kubeadm init` runs named
+phases (certs → kubeconfig → control-plane → bootstrap-token → addons) and
+prints a join command; `kubeadm join` registers a node using the bootstrap
+token. Here the control plane is the in-process stack (store+WAL → REST
+facade with authn/RBAC/admission → scheduler → controller-manager), the
+"certs" phase is the bearer-token trust root (no x509 in this build), and
+join starts a kubelet against the API over its token.
+
+Programmatic surface (used by tests and the CLI):
+    handle = init_cluster(data_dir, port)   # phases, returns running stack
+    join_node(server_url, token, node_name) # register + run a node agent
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import secrets
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger("kubernetes_tpu.cmd.kubeadm")
+
+BOOTSTRAP_TOKEN_SECRET = "bootstrap-token"
+ADMIN_CONF = "admin.conf.json"
+
+
+@dataclass
+class ClusterHandle:
+    store: object
+    http_server: object
+    port: int
+    scheduler: object
+    controller_manager: object
+    admin_token: str
+    bootstrap_token: str
+    data_dir: str
+    _joined: List[object] = field(default_factory=list)
+
+    @property
+    def server_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        for pool in self._joined:
+            pool.stop()
+        self.controller_manager.stop()
+        self.scheduler.stop()
+        self.http_server.shutdown()
+
+
+def init_cluster(
+    data_dir: str, port: int = 0, controllers: Optional[List[str]] = None
+) -> ClusterHandle:
+    """Run every init phase; returns the live control plane."""
+    from ..apiserver.auth import (
+        MASTERS_GROUP,
+        AdmissionChain,
+        QuotaAdmission,
+        RBACAuthorizer,
+        Rule,
+        ServiceAccountAdmission,
+        TokenAuthenticator,
+        make_rule,
+    )
+    from ..apiserver.rest import serve
+    from ..client.apiserver import APIServer
+    from ..controller.manager import ControllerManager
+    from ..runtime.wal import WriteAheadLog
+    from ..scheduler import KubeSchedulerConfiguration, Scheduler
+
+    os.makedirs(data_dir, exist_ok=True)
+
+    # -- phase certs: trust material (bearer tokens stand in for x509) ------
+    admin_token = secrets.token_urlsafe(24)
+    bootstrap_token = secrets.token_urlsafe(16)
+    logger.info("[certs] generated admin + bootstrap tokens")
+
+    # -- phase etcd/control-plane: durable store + REST facade --------------
+    store = APIServer(wal=WriteAheadLog(os.path.join(data_dir, "cluster")))
+    authn = TokenAuthenticator(server=store, allow_anonymous=False)
+    authn.add_token(admin_token, "kubernetes-admin", groups=(MASTERS_GROUP,))
+    authn.add_token(
+        bootstrap_token, "system:bootstrap", groups=("system:bootstrappers",)
+    )
+    authz = RBACAuthorizer()
+    # bootstrappers run node agents: register + heartbeat, sync pods, and
+    # feed the node-side service dataplane (the system:node role shape)
+    authz.bind("system:bootstrappers", make_rule(["create", "update", "get"], ["nodes", "leases"]))
+    authz.bind("system:bootstrappers", make_rule(["get", "list", "watch", "update"], ["pods"]))
+    authz.bind(
+        "system:bootstrappers",
+        make_rule(["get", "list", "watch"], ["services", "endpoints"]),
+    )
+    store.admit_hooks.append(
+        AdmissionChain(
+            mutating=[ServiceAccountAdmission()],
+            validating=[QuotaAdmission(store)],
+        )
+    )
+    http_server, port, _ = serve(
+        store=store, port=port, authenticator=authn, authorizer=authz
+    )
+    logger.info("[control-plane] apiserver on :%d (WAL at %s)", port, data_dir)
+
+    # -- phase kubeconfig ----------------------------------------------------
+    conf = {
+        "server": f"http://127.0.0.1:{port}",
+        "token": admin_token,
+        "user": "kubernetes-admin",
+    }
+    with open(os.path.join(data_dir, ADMIN_CONF), "w") as f:
+        json.dump(conf, f, indent=2)
+    logger.info("[kubeconfig] wrote %s", ADMIN_CONF)
+
+    # -- phase control-plane components -------------------------------------
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    sched.start()
+    cm = ControllerManager(store, controllers=controllers)
+    cm.start()
+    logger.info("[control-plane] scheduler + controller-manager running")
+
+    # -- phase bootstrap-token: discoverable join secret ---------------------
+    from ..api import objects as v1
+
+    store.create(
+        "secrets",
+        v1.Secret(
+            metadata=v1.ObjectMeta(
+                name=BOOTSTRAP_TOKEN_SECRET, namespace="kube-system"
+            ),
+            type="bootstrap.kubernetes.io/token",
+            data={"token": bootstrap_token.encode()},
+        ),
+    )
+    logger.info("[bootstrap-token] join token stored")
+
+    return ClusterHandle(
+        store=store,
+        http_server=http_server,
+        port=port,
+        scheduler=sched,
+        controller_manager=cm,
+        admin_token=admin_token,
+        bootstrap_token=bootstrap_token,
+        data_dir=data_dir,
+    )
+
+
+def join_node(
+    server_url: str,
+    token: str,
+    node_name: str,
+    cpu: str = "8",
+    memory: str = "32Gi",
+):
+    """`kubeadm join`: register the node over the bootstrap token and run a
+    node agent against the API (remote client, same kubelet code path)."""
+    from ..apiserver.client import AuthRESTClient
+    from ..kubelet.kubelet import NodeAgentPool
+    from ..kubemark.hollow_node import make_hollow_node
+
+    client = AuthRESTClient(server_url, token=token)
+    node = make_hollow_node(node_name, cpu=cpu, memory=memory)
+    try:
+        client.create("nodes", node)
+    except Exception as e:  # AlreadyExists on re-join is fine
+        if "exists" not in str(e).lower():
+            raise
+    pool = NodeAgentPool(client)
+    pool.add_node(node_name, register=False)
+    pool.start()
+    logger.info("[join] node %s registered and heartbeating", node_name)
+    return pool
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeadm-tpu")
+    sub = parser.add_subparsers(dest="verb", required=True)
+    p_init = sub.add_parser("init")
+    p_init.add_argument("--data-dir", default="./kubeadm-data")
+    p_init.add_argument("--port", type=int, default=18080)
+    p_join = sub.add_parser("join")
+    p_join.add_argument("server")
+    p_join.add_argument("--token", required=True)
+    p_join.add_argument("--node-name", default="node-joined")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.verb == "init":
+        handle = init_cluster(args.data_dir, args.port)
+        print(
+            "cluster initialized.\n"
+            f"  admin conf: {os.path.join(args.data_dir, ADMIN_CONF)}\n"
+            "join nodes with:\n"
+            f"  kubeadm-tpu join {handle.server_url} --token {handle.bootstrap_token}"
+        )
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            handle.stop()
+        return 0
+    if args.verb == "join":
+        pool = join_node(args.server, args.token, args.node_name)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pool.stop()
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,9 +4,10 @@ Reference: pkg/controller/volume/attachdetach — reconciles the desired
 state (pods scheduled to nodes referencing PV-backed volumes) against the
 actual state (VolumeAttachment objects): attach volumes whose pods landed
 on a node, detach when no pod on that node uses the volume anymore.
-Attachment names are deterministic (``<pv>-<node>``) so reconcile is
-idempotent. The hollow runtime "attaches" instantly (status.attached) the
-way kubemark fakes the mounter.
+Attachment names are deterministic hashes of (pv, node) — like the
+reference's GetAttachmentName — so reconcile is idempotent and distinct
+pairs can't collide. The hollow runtime "attaches" instantly
+(status.attached) the way kubemark fakes the mounter.
 """
 
 from __future__ import annotations
@@ -46,16 +47,14 @@ class AttachDetachController(WorkqueueController):
     primary_kind = "pods"
     secondary_kinds = ("persistentvolumeclaims",)
 
+    def primary_key_of(self, obj) -> str:
+        # sync() rebuilds the whole desired-state-of-world; a constant key
+        # lets the workqueue collapse a pod burst into ONE rebuild instead
+        # of N full-cluster scans
+        return "reconcile"
+
     def enqueue_for_related(self, resource: str, obj) -> Optional[str]:
-        # PVC binding changes re-evaluate pods in its namespace using it
-        pods, _ = self.server.list("pods", namespace=obj.metadata.namespace)
-        for p in pods:
-            if any(
-                vol.persistent_volume_claim == obj.metadata.name
-                for vol in p.spec.volumes
-            ):
-                self.queue.add(p.metadata.key)
-        return None
+        return "reconcile"  # PVC binding changes: same world rebuild
 
     def sync(self, key: str) -> None:
         # desired state of the WORLD, not of one pod: rebuild the full

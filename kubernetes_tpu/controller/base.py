@@ -56,10 +56,17 @@ class WorkqueueController:
 
     # -- event plumbing ------------------------------------------------------
 
+    def primary_key_of(self, obj) -> str:
+        """Queue key for a primary-kind event. Controllers whose sync
+        rebuilds WORLD state (not per-object state) override this to a
+        constant so the rate-limited queue collapses event bursts into one
+        reconcile (the reference's desired-state-of-world populator)."""
+        return obj.metadata.key
+
     def _watch_loop(self) -> None:
         objs, rv = self.server.list(self.primary_kind)
         for o in objs:
-            self.queue.add(o.metadata.key)
+            self.queue.add(self.primary_key_of(o))
         primary_watch = self.server.watch(self.primary_kind, from_version=rv)
         sec_watches = []
         for res in self.secondary_kinds:
@@ -71,7 +78,7 @@ class WorkqueueController:
             # leave endpoints/PDB status minutes behind a pod burst
             ev = primary_watch.get(timeout=0.1)
             while ev is not None:
-                self.queue.add(ev.object.metadata.key)
+                self.queue.add(self.primary_key_of(ev.object))
                 ev = primary_watch.get(timeout=0)
             for res, w in sec_watches:
                 sev = w.get(timeout=0)

@@ -1,0 +1,127 @@
+"""cloud-controller-manager: service load balancers + routes against a
+cloud-provider interface.
+
+Reference: cmd/cloud-controller-manager + pkg/controller/cloud +
+staging/src/k8s.io/cloud-provider — the cloud loops talk to a provider
+interface (LoadBalancer / Routes / Instances); kubernetes ships the
+interface and providers implement it. Here ``FakeCloudProvider`` is the
+in-tree test provider equivalent (cloud-provider/fake): an in-memory
+cloud whose state the tests can inspect.
+
+Loops:
+  * ServiceLBController — Services of type LoadBalancer get a provisioned
+    cloud LB (external IP written back to spec.external_ips); deleting the
+    service or flipping its type tears the LB down.
+  * RouteController — one cloud route per node pod CIDR
+    (pkg/controller/route): created when nodeipam assigns the CIDR,
+    removed with the node.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..api import objects as v1
+from ..client.apiserver import NotFound
+from .base import WorkqueueController
+
+logger = logging.getLogger("kubernetes_tpu.controller.cloud")
+
+
+class FakeCloudProvider:
+    """In-memory cloud (cloud-provider/fake equivalent)."""
+
+    def __init__(self, lb_prefix: str = "203.0.113"):
+        self._lock = threading.Lock()
+        self.load_balancers: Dict[str, str] = {}  # service key -> external IP
+        self.routes: Dict[str, str] = {}  # node name -> pod CIDR
+        self._next_lb = 1
+        self.lb_prefix = lb_prefix
+
+    # LoadBalancer interface
+    def ensure_load_balancer(self, service_key: str) -> str:
+        with self._lock:
+            ip = self.load_balancers.get(service_key)
+            if ip is None:
+                ip = f"{self.lb_prefix}.{self._next_lb}"
+                self._next_lb += 1
+                self.load_balancers[service_key] = ip
+            return ip
+
+    def delete_load_balancer(self, service_key: str) -> None:
+        with self._lock:
+            self.load_balancers.pop(service_key, None)
+
+    # Routes interface
+    def create_route(self, node: str, cidr: str) -> None:
+        with self._lock:
+            self.routes[node] = cidr
+
+    def delete_route(self, node: str) -> None:
+        with self._lock:
+            self.routes.pop(node, None)
+
+    def list_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.routes)
+
+
+class ServiceLBController(WorkqueueController):
+    name = "service-lb"
+    primary_kind = "services"
+    secondary_kinds = ()
+
+    def __init__(self, server, cloud: Optional[FakeCloudProvider] = None, workers: int = 1):
+        super().__init__(server, workers=workers)
+        self.cloud = cloud or FakeCloudProvider()
+
+    def sync(self, key: str) -> None:
+        ns, _, name = key.partition("/")
+        try:
+            svc = self.server.get("services", ns, name)
+        except NotFound:
+            self.cloud.delete_load_balancer(key)
+            return
+        if svc.spec.type != "LoadBalancer":
+            if key in self.cloud.load_balancers:
+                self.cloud.delete_load_balancer(key)
+                self._set_external_ips(ns, name, [])
+            return
+        ip = self.cloud.ensure_load_balancer(key)
+        if ip not in svc.spec.external_ips:
+            self._set_external_ips(ns, name, [ip])
+
+    def _set_external_ips(self, ns: str, name: str, ips) -> None:
+        def mutate(s):
+            if s.spec.external_ips == ips:
+                return None
+            s.spec.external_ips = list(ips)
+            return s
+
+        try:
+            self.server.guaranteed_update("services", ns, name, mutate)
+        except NotFound:
+            pass
+
+
+class RouteController(WorkqueueController):
+    name = "route"
+    primary_kind = "nodes"
+    secondary_kinds = ()
+
+    def __init__(self, server, cloud: Optional[FakeCloudProvider] = None, workers: int = 1):
+        super().__init__(server, workers=workers)
+        self.cloud = cloud or FakeCloudProvider()
+
+    def sync(self, key: str) -> None:
+        ns, _, name = key.rpartition("/")
+        try:
+            node = self.server.get("nodes", ns, name)
+        except NotFound:
+            self.cloud.delete_route(name)
+            return
+        if node.spec.pod_cidr:
+            if self.cloud.list_routes().get(name) != node.spec.pod_cidr:
+                self.cloud.create_route(name, node.spec.pod_cidr)

@@ -71,7 +71,13 @@ class NodeIpamController(WorkqueueController):
             return n
 
         try:
-            self.server.guaranteed_update("nodes", ns, name, mutate)
+            final = self.server.guaranteed_update("nodes", ns, name, mutate)
+            if final.spec.pod_cidr != cidr:
+                # lost the race: release the block we reserved or it would
+                # leak out of the pool permanently
+                with self._alloc_lock:
+                    if self._used is not None:
+                        self._used.discard(cidr)
         except NotFound:
             with self._alloc_lock:
                 if self._used is not None:

@@ -1,0 +1,14 @@
+"""Test helpers: fluent object builders, a plugin-registration DSL, and a
+fake cache (reference pkg/scheduler/testing + internal/cache/fake)."""
+
+from .fake_cache import FakeCache  # noqa: F401
+from .framework_helpers import (  # noqa: F401
+    new_framework,
+    register_bind,
+    register_filter,
+    register_plugin,
+    register_pre_filter,
+    register_queue_sort,
+    register_score,
+)
+from .wrappers import NodeWrapper, PodWrapper  # noqa: F401

@@ -1,0 +1,83 @@
+"""cloud-controller-manager loops against the fake cloud provider
+(reference cmd/cloud-controller-manager + pkg/controller/{cloud,route} +
+cloud-provider/fake)."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.cloud import (
+    FakeCloudProvider,
+    RouteController,
+    ServiceLBController,
+)
+from kubernetes_tpu.controller.nodeipam import NodeIpamController
+
+
+def wait_until(fn, timeout=25.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_loadbalancer_services_get_external_ips():
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    ctrl = ServiceLBController(server, cloud=cloud)
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="lb"),
+            spec=v1.ServiceSpec(type="LoadBalancer", ports=[("http", 80)]),
+        ),
+    )
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="plain"),
+            spec=v1.ServiceSpec(ports=[("http", 80)]),
+        ),
+    )
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: server.get("services", "default", "lb").spec.external_ips
+        ), "LoadBalancer service must get an external IP"
+        ip = server.get("services", "default", "lb").spec.external_ips[0]
+        assert ip.startswith("203.0.113.")
+        assert cloud.load_balancers == {"default/lb": ip}
+        # ClusterIP services never touch the cloud
+        assert not server.get("services", "default", "plain").spec.external_ips
+        # deleting the service tears down the LB
+        server.delete("services", "default", "lb")
+        assert wait_until(lambda: not cloud.load_balancers)
+    finally:
+        ctrl.stop()
+
+
+def test_routes_follow_node_pod_cidrs():
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    ipam = NodeIpamController(server)
+    routes = RouteController(server, cloud=cloud)
+    for i in range(3):
+        server.create(
+            "nodes",
+            v1.Node(metadata=v1.ObjectMeta(name=f"n{i}"), spec=v1.NodeSpec()),
+        )
+    ipam.start()
+    routes.start()
+    try:
+        def routed():
+            r = cloud.list_routes()
+            return len(r) == 3 and all(c.startswith("10.244.") for c in r.values())
+
+        assert wait_until(routed), "every node CIDR needs a cloud route"
+        server.delete("nodes", "default", "n0")
+        assert wait_until(lambda: "n0" not in cloud.list_routes())
+    finally:
+        routes.stop()
+        ipam.stop()

@@ -1,0 +1,93 @@
+"""The test-helper tier itself: fluent wrappers, plugin DSL, fake cache
+(reference pkg/scheduler/testing/wrappers.go + framework_helpers.go +
+internal/cache/fake)."""
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.scheduler.cache.nodeinfo import NodeInfo, Snapshot
+from kubernetes_tpu.scheduler.core import GenericScheduler
+from kubernetes_tpu.scheduler.framework.interface import (
+    CycleState,
+    FilterPlugin,
+    Status,
+    is_success,
+)
+from kubernetes_tpu.testing import (
+    FakeCache,
+    NodeWrapper,
+    PodWrapper,
+    new_framework,
+    register_filter,
+    register_plugin,
+    register_score,
+)
+
+
+def test_wrappers_build_full_specs():
+    pod = (
+        PodWrapper("p")
+        .namespace("ns1")
+        .label("app", "web")
+        .req(cpu="500m", memory="1Gi")
+        .priority(100)
+        .toleration("dedicated")
+        .pod_anti_affinity("zone", {"app": "web"})
+        .spread_constraint(1, "zone", match_labels={"app": "web"})
+        .host_port(8080)
+        .node_selector({"disk": "ssd"})
+        .obj()
+    )
+    assert pod.metadata.namespace == "ns1"
+    assert pod.spec.containers[0].requests["cpu"] == "500m"
+    assert pod.priority == 100
+    assert pod.spec.affinity.pod_anti_affinity.required[0].topology_key == "zone"
+    assert pod.spec.topology_spread_constraints[0].max_skew == 1
+    assert pod.spec.containers[0].ports[0].host_port == 8080
+
+    node = (
+        NodeWrapper("n")
+        .zone("za")
+        .capacity(cpu="4", pods=32)
+        .taint("gpu", "true")
+        .obj()
+    )
+    assert node.metadata.labels["zone"] == "za"
+    assert node.status.allocatable["cpu"] == "4"
+    assert node.spec.taints[0].key == "gpu"
+
+
+def test_framework_dsl_runs_selected_plugins():
+    snap = Snapshot.from_literals(
+        pods=[],
+        nodes=[NodeWrapper("n1").capacity(cpu="2").obj(),
+               NodeWrapper("n2").capacity(cpu="8").obj()],
+    )
+
+    class OnlyBigNodes(FilterPlugin):
+        name = "OnlyBigNodes"
+
+        def filter(self, state, pod, node_info):
+            from kubernetes_tpu.api.resources import CPU, parse_quantity
+
+            if parse_quantity(node_info.node.status.allocatable["cpu"]) < 4:
+                return Status.unschedulable("node too small")
+            return None
+
+    fw = new_framework(
+        register_filter("NodeResourcesFit"),
+        register_plugin("OnlyBigNodes", lambda ctx: OnlyBigNodes(), filter=True),
+        register_score("NodeResourcesLeastAllocated"),
+    )
+    algo = GenericScheduler(fw)
+    pod = PodWrapper("p").req(cpu="1").obj()
+    result = algo.schedule(pod, snap, CycleState())
+    assert result.suggested_host == "n2"
+
+
+def test_fake_cache_records_assumes():
+    cache = FakeCache()
+    pod = PodWrapper("p").obj()
+    cache.assume_pod(pod, "n1")
+    assert cache.is_assumed("default/p")
+    assert cache.assumed == [("default/p", "n1")]
+    cache.forget_pod(pod)
+    assert not cache.is_assumed("default/p")
