@@ -118,12 +118,17 @@ class _Handler(BaseHTTPRequestHandler):
         rejected at routing granularity, mirroring the reference's
         ambiguous-plural restrictions.)"""
         group = self._group_of_path()
-        if group is None:
-            return resource in codec.RESOURCE_KINDS
         try:
             crds, _ = self.store.list("customresourcedefinitions")
         except Exception:
-            return False
+            crds = []
+        if group is None:
+            # the core path also serves established CRD plurals: the typed
+            # REST client and kubectl build /api/v1 paths for every
+            # resource (single internal version — no per-group clients)
+            return resource in codec.RESOURCE_KINDS or any(
+                c.spec.names.plural == resource for c in crds
+            )
         return any(
             c.spec.group == group and c.spec.names.plural == resource
             for c in crds
@@ -156,8 +161,6 @@ class _Handler(BaseHTTPRequestHandler):
         # anonymous-rejecting front server must not leak a bypass
         authn = self.server.authenticator
         if authn is not None:
-            from .auth import ANONYMOUS, UserInfo
-
             user = authn.authenticate_header(
                 self.headers.get("Authorization", "")
             )

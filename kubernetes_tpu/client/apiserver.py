@@ -74,6 +74,16 @@ class APIServer:
         if self._wal is None:
             return
         self._wal.append(self._rv, verb, kind, obj)
+        self._maybe_compact()
+
+    def _log_batch(self, records) -> None:
+        """records: [(rv, verb, kind, obj)] — one group-committed append."""
+        if self._wal is None or not records:
+            return
+        self._wal.append_batch(records)
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
         if self._wal.due() and not self._compacting.is_set():
             # compaction runs OFF the mutation path: serializing + fsyncing
             # the whole store under the server lock would stall every API
@@ -254,6 +264,8 @@ class APIServer:
         """
         errors = []
         with self._lock:
+            records = []  # WAL batch: group-committed in ONE fsync
+            events = []
             for b in bindings:
                 try:
                     store = self._objects.get("pods", {})
@@ -267,18 +279,24 @@ class APIServer:
                         raise Conflict("uid mismatch on binding")
                     pod.spec.node_name = b.target_node
                     self._bump(pod)
-                    self._log("update", "pods", pod)
-                    self._notify(
-                        "pods",
+                    records.append(
+                        (pod.metadata.resource_version, "update", "pods", pod)
+                    )
+                    events.append(
                         Event(
                             MODIFIED,
                             copy.deepcopy(pod),
                             pod.metadata.resource_version,
-                        ),
+                        )
                     )
                     errors.append(None)
                 except (NotFound, Conflict) as e:
                     errors.append(str(e))
+            # durable BEFORE any watcher learns of the binds (etcd fires
+            # watch events post-commit); the batch shares one fsync
+            self._log_batch(records)
+            for ev in events:
+                self._notify("pods", ev)
         return errors
 
     def bind_pod(self, binding) -> None:

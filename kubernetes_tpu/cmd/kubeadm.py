@@ -158,10 +158,14 @@ def join_node(
     node_name: str,
     cpu: str = "8",
     memory: str = "32Gi",
+    handle: Optional[ClusterHandle] = None,
 ):
     """`kubeadm join`: register the node over the bootstrap token and run a
-    node agent against the API (remote client, same kubelet code path)."""
+    node agent against the API (remote client, same kubelet code path).
+    When `handle` is given (in-process clusters), the pool is owned by it
+    and stops with ClusterHandle.stop()."""
     from ..apiserver.client import AuthRESTClient
+    from ..client.apiserver import AlreadyExists
     from ..kubelet.kubelet import NodeAgentPool
     from ..kubemark.hollow_node import make_hollow_node
 
@@ -169,12 +173,13 @@ def join_node(
     node = make_hollow_node(node_name, cpu=cpu, memory=memory)
     try:
         client.create("nodes", node)
-    except Exception as e:  # AlreadyExists on re-join is fine
-        if "exists" not in str(e).lower():
-            raise
+    except AlreadyExists:
+        pass  # re-join of a registered node
     pool = NodeAgentPool(client)
     pool.add_node(node_name, register=False)
     pool.start()
+    if handle is not None:
+        handle._joined.append(pool)
     logger.info("[join] node %s registered and heartbeating", node_name)
     return pool
 

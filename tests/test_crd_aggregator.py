@@ -163,3 +163,27 @@ def test_aggregator_proxies_apiservice_group():
     finally:
         front_srv.shutdown()
         backend_srv.shutdown()
+
+
+def test_custom_resource_reachable_via_core_client():
+    """The typed REST client (and kubectl) build /api/v1 paths for every
+    resource; established CRD plurals must serve there too — while bogus
+    named groups still 404."""
+    from kubernetes_tpu.apiserver.client import RESTClient
+
+    srv, port, store = serve()
+    try:
+        store.create("customresourcedefinitions", _crd())
+        client = RESTClient(f"http://127.0.0.1:{port}")
+        client.create(
+            "widgets",
+            codec.decode_unstructured(
+                {"kind": "Widget", "metadata": {"name": "cw"}, "spec": {"n": 1}}
+            ),
+        )
+        objs, _ = client.list("widgets")
+        assert len(objs) == 1 and objs[0].content["spec"]["n"] == 1
+        code, _ = _req(port, "/apis/wrong.io/v7/namespaces/default/widgets")
+        assert code == 404, "CRD resources must not serve under foreign groups"
+    finally:
+        srv.shutdown()

@@ -42,8 +42,11 @@ def test_init_join_and_schedule(tmp_path):
         except urllib.error.HTTPError as e:
             assert e.code == 401
 
-        # join a node with the bootstrap token
-        pool = join_node(handle.server_url, handle.bootstrap_token, "worker-0")
+        # join a node with the bootstrap token; owned by the handle
+        pool = join_node(
+            handle.server_url, handle.bootstrap_token, "worker-0", handle=handle
+        )
+        assert pool in handle._joined
         assert wait_until(
             lambda: any(
                 n.metadata.name == "worker-0" for n in admin.list("nodes")[0]

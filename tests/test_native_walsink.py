@@ -1,0 +1,109 @@
+"""Native group-commit WAL sink (kubernetes_tpu/native/walsink.cpp).
+
+The reference's durability layer (etcd) group-commits raft appends — many
+proposals, one fsync. These tests pin: correctness of the native path
+(records recoverable, compaction rotation survives), the group-commit win
+(a bulk bind's N records cost far fewer than N fsyncs), and the pure
+Python fallback staying equivalent."""
+
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.native import load_walsink
+from kubernetes_tpu.runtime.wal import WriteAheadLog
+
+
+def make_pod(name):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "100m"})]),
+    )
+
+
+def test_native_sink_builds_and_roundtrips(tmp_path):
+    if load_walsink() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "n")
+    wal = WriteAheadLog(path)
+    assert wal.native, "native sink must load where g++ exists"
+    server = APIServer(wal=wal)
+    for i in range(50):
+        server.create("pods", make_pod(f"p{i}"))
+    wal.close()
+    recovered = APIServer.recover(path)
+    pods, _ = recovered.list("pods")
+    assert len(pods) == 50
+
+
+def test_bulk_bind_group_commits(tmp_path):
+    if load_walsink() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "g")
+    wal = WriteAheadLog(path)
+    server = APIServer(wal=wal)
+    n = 256
+    for i in range(n):
+        server.create("pods", make_pod(f"b{i}"))
+    before = wal.fsync_count()
+    bindings = [
+        v1.Binding(pod_name=f"b{i}", pod_namespace="default", target_node="n0")
+        for i in range(n)
+    ]
+    errs = server.bind_pods(bindings)
+    assert all(e is None for e in errs)
+    extra = wal.fsync_count() - before
+    # one enqueue burst -> the committer batches; allow a little slack for
+    # scheduling but require the collapse to be dramatic
+    assert extra <= 8, f"{extra} fsyncs for a {n}-record bulk bind"
+    wal.close()
+    recovered = APIServer.recover(path)
+    pods, _ = recovered.list("pods")
+    assert sum(1 for p in pods if p.spec.node_name == "n0") == n
+
+
+def test_compaction_rotation_with_native_sink(tmp_path):
+    if load_walsink() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "c")
+    wal = WriteAheadLog(path, compact_every=20)
+    server = APIServer(wal=wal)
+    for i in range(75):
+        server.create("pods", make_pod(f"c{i}"))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(path + ".snapshot.json"):
+            break
+        time.sleep(0.05)
+    assert os.path.exists(path + ".snapshot.json")
+    # appends after rotation still land
+    server.create("pods", make_pod("after-rotate"))
+    wal.close()
+    recovered = APIServer.recover(path)
+    pods, _ = recovered.list("pods")
+    assert len(pods) == 76
+
+
+def test_python_fallback_equivalence(tmp_path, monkeypatch):
+    """Force the fallback and require identical WAL semantics."""
+    import kubernetes_tpu.runtime.wal as wal_mod
+
+    monkeypatch.setattr(
+        "kubernetes_tpu.native.load_walsink", lambda: None
+    )
+    # wal.py imports load_walsink inside _open_sink via the package — patch
+    # there too for safety
+    path = str(tmp_path / "f")
+    wal = wal_mod.WriteAheadLog(path)
+    if wal.native:
+        pytest.skip("monkeypatch did not take (import binding)")
+    server = APIServer(wal=wal)
+    for i in range(10):
+        server.create("pods", make_pod(f"f{i}"))
+    wal.close()
+    recovered = APIServer.recover(path)
+    pods, _ = recovered.list("pods")
+    assert len(pods) == 10
