@@ -264,6 +264,44 @@ class QuotaAdmission(AdmissionPlugin):
                     )
 
 
+class PriorityAdmission(AdmissionPlugin):
+    """Resolve pod spec.priority_class_name -> spec.priority at create
+    (plugin/pkg/admission/priority/admission.go): named class sets the
+    value, a globalDefault class covers unnamed pods, and an unknown class
+    name is rejected."""
+
+    name = "Priority"
+
+    def __init__(self, server):
+        self.server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        if obj.spec.priority is not None and not obj.spec.priority_class_name:
+            return
+        classes, _ = self.server.list("priorityclasses")
+        if obj.spec.priority_class_name:
+            pc = next(
+                (
+                    c
+                    for c in classes
+                    if c.metadata.name == obj.spec.priority_class_name
+                ),
+                None,
+            )
+            if pc is None:
+                raise AdmissionDenied(
+                    f"no PriorityClass {obj.spec.priority_class_name!r}"
+                )
+            obj.spec.priority = pc.value
+            return
+        default = next((c for c in classes if c.global_default), None)
+        if default is not None and obj.spec.priority is None:
+            obj.spec.priority = default.value
+            obj.spec.priority_class_name = default.metadata.name
+
+
 class ServiceAccountAdmission(AdmissionPlugin):
     """Default pod spec.service_account to "default" (the mutating half of
     plugin/pkg/admission/serviceaccount, minus volume injection)."""

@@ -176,6 +176,24 @@ class APIServer:
                 )
             self._bump(obj)
             stored = copy.deepcopy(obj)
+            # graceful deletion completes when the last finalizer is
+            # stripped from a deletion-pending object (the registry's
+            # deleteForEmptyFinalizers path)
+            if (
+                stored.metadata.deletion_timestamp is not None
+                and not stored.metadata.finalizers
+            ):
+                store.pop(key, None)
+                self._log("delete", kind, stored)
+                self._notify(
+                    kind,
+                    Event(
+                        DELETED,
+                        copy.deepcopy(stored),
+                        stored.metadata.resource_version,
+                    ),
+                )
+                return copy.deepcopy(stored)
             store[key] = stored
             self._log("update", kind, stored)
             self._notify(
@@ -206,8 +224,29 @@ class APIServer:
             store = self._objects.get(kind, {})
             if key not in store:
                 raise NotFound(f"{kind} {key} not found")
-            obj = store.pop(key)
+            obj = store[key]
             self._admit("delete", kind, obj)
+            if obj.metadata.finalizers:
+                # graceful deletion (registry store.Delete with pending
+                # finalizers): mark intent, keep the object; finalizer
+                # owners strip their entries via update, and the LAST strip
+                # removes it (see update())
+                if obj.metadata.deletion_timestamp is None:
+                    import time as _time
+
+                    obj.metadata.deletion_timestamp = _time.time()
+                    self._bump(obj)
+                    self._log("update", kind, obj)
+                    self._notify(
+                        kind,
+                        Event(
+                            MODIFIED,
+                            copy.deepcopy(obj),
+                            obj.metadata.resource_version,
+                        ),
+                    )
+                return copy.deepcopy(obj)
+            store.pop(key)
             self._rv += 1
             self._log("delete", kind, obj)
             self._notify(kind, Event(DELETED, copy.deepcopy(obj), self._rv))

@@ -61,9 +61,9 @@ def init_cluster(
     from ..apiserver.auth import (
         MASTERS_GROUP,
         AdmissionChain,
+        PriorityAdmission,
         QuotaAdmission,
         RBACAuthorizer,
-        Rule,
         ServiceAccountAdmission,
         TokenAuthenticator,
         make_rule,
@@ -99,7 +99,7 @@ def init_cluster(
     )
     store.admit_hooks.append(
         AdmissionChain(
-            mutating=[ServiceAccountAdmission()],
+            mutating=[ServiceAccountAdmission(), PriorityAdmission(store)],
             validating=[QuotaAdmission(store)],
         )
     )

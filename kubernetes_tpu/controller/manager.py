@@ -24,6 +24,12 @@ from .hpa import HPAController
 from .job import JobController
 from .namespace import NamespaceController
 from .nodeipam import NodeIpamController
+from .podgc import (
+    PodGCController,
+    PVCProtectionController,
+    PVProtectionController,
+    RootCACertPublisher,
+)
 from .nodelifecycle import NodeLifecycleController
 from .pv_binder import PVBinderController
 from .replicaset import ReplicaSetController
@@ -56,6 +62,10 @@ CONTROLLER_INITIALIZERS = {
     "nodeipam": NodeIpamController,
     "attachdetach": AttachDetachController,
     "persistentvolume-binder": PVBinderController,
+    "podgc": PodGCController,
+    "pvc-protection": PVCProtectionController,
+    "pv-protection": PVProtectionController,
+    "root-ca-cert-publisher": RootCACertPublisher,
 }
 
 

@@ -226,6 +226,10 @@ def test_controller_manager_runs_all():
             "nodeipam",
             "attachdetach",
             "persistentvolume-binder",
+            "podgc",
+            "pvc-protection",
+            "pv-protection",
+            "root-ca-cert-publisher",
         }
     finally:
         mgr.stop()

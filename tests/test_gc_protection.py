@@ -1,0 +1,173 @@
+"""Pod GC, PVC/PV protection finalizers, root-CA publisher, priority
+admission, and the store's graceful-deletion core.
+
+Reference: pkg/controller/podgc, pkg/controller/volume/{pvcprotection,
+pvprotection}, pkg/controller/certificates/rootcacertpublisher,
+plugin/pkg/admission/priority, and the registry's finalizer-aware
+deletion."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.auth import (
+    AdmissionChain,
+    AdmissionDenied,
+    PriorityAdmission,
+)
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.controller.podgc import (
+    PVC_FINALIZER,
+    PodGCController,
+    PVCProtectionController,
+    RootCACertPublisher,
+)
+
+
+def wait_until(fn, timeout=25.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def make_pod(name, phase=None, node=None):
+    p = v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "10m"})]),
+    )
+    if phase:
+        p.status.phase = phase
+    if node:
+        p.spec.node_name = node
+    return p
+
+
+def test_store_graceful_deletion_with_finalizers():
+    server = APIServer()
+    pod = make_pod("fin")
+    pod.metadata.finalizers.append("example.com/hold")
+    server.create("pods", pod)
+    server.delete("pods", "default", "fin")
+    # still present, marked deleting
+    cur = server.get("pods", "default", "fin")
+    assert cur.metadata.deletion_timestamp is not None
+    # stripping the last finalizer completes the deletion
+    def strip(p):
+        p.metadata.finalizers.clear()
+        return p
+
+    server.guaranteed_update("pods", "default", "fin", strip)
+    try:
+        server.get("pods", "default", "fin")
+        raise AssertionError("object must be gone after finalizer strip")
+    except KeyError:
+        pass
+
+
+def test_podgc_threshold_and_orphans():
+    server = APIServer()
+    server.create("nodes", v1.Node(metadata=v1.ObjectMeta(name="live"), spec=v1.NodeSpec()))
+    # 5 finished pods with a threshold of 2 -> 3 oldest GC'd
+    for i in range(5):
+        p = make_pod(f"done-{i}", phase=v1.POD_SUCCEEDED)
+        p.metadata.creation_timestamp = 1000.0 + i
+        server.create("pods", p)
+    server.create("pods", make_pod("ghost", node="gone-node"))
+    ctrl = PodGCController(server, terminated_pod_threshold=2, tick=0.2)
+    ctrl.start()
+    try:
+        def gcd():
+            names = {p.metadata.name for p in server.list("pods")[0]}
+            return names == {"done-3", "done-4"}
+
+        assert wait_until(gcd), "oldest finished + orphaned pods must be GC'd"
+    finally:
+        ctrl.stop()
+
+
+def test_pvc_protection_defers_deletion_while_in_use():
+    server = APIServer()
+    pvc = v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="data"),
+        spec=v1.PersistentVolumeClaimSpec(resources={"storage": "1Gi"}),
+    )
+    server.create("persistentvolumeclaims", pvc)
+    user = make_pod("user")
+    user.spec.volumes.append(v1.Volume(name="data", persistent_volume_claim="data"))
+    server.create("pods", user)
+    ctrl = PVCProtectionController(server)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: PVC_FINALIZER
+            in server.get("persistentvolumeclaims", "default", "data").metadata.finalizers
+        )
+        server.delete("persistentvolumeclaims", "default", "data")
+        time.sleep(0.5)
+        cur = server.get("persistentvolumeclaims", "default", "data")
+        assert cur.metadata.deletion_timestamp is not None, "deletion deferred"
+        # the using pod goes away -> protection releases -> claim removed
+        server.delete("pods", "default", "user")
+        def gone():
+            try:
+                server.get("persistentvolumeclaims", "default", "data")
+                return False
+            except KeyError:
+                return True
+
+        assert wait_until(gone), "claim must be removed once unused"
+    finally:
+        ctrl.stop()
+
+
+def test_root_ca_published_per_namespace():
+    server = APIServer()
+    server.create("namespaces", v1.Namespace(metadata=v1.ObjectMeta(name="apps")))
+    ctrl = RootCACertPublisher(server)
+    ctrl.start()
+    try:
+        def published():
+            try:
+                cm = server.get("configmaps", "apps", "kube-root-ca.crt")
+            except KeyError:
+                return False
+            return "ca.crt" in cm.data
+
+        assert wait_until(published)
+    finally:
+        ctrl.stop()
+
+
+def test_priority_admission_resolves_class():
+    server = APIServer()
+    server.create(
+        "priorityclasses",
+        v1.PriorityClass(metadata=v1.ObjectMeta(name="high"), value=1000),
+    )
+    server.create(
+        "priorityclasses",
+        v1.PriorityClass(
+            metadata=v1.ObjectMeta(name="base"), value=7, global_default=True
+        ),
+    )
+    server.admit_hooks.append(AdmissionChain(mutating=[PriorityAdmission(server)]))
+
+    named = make_pod("named")
+    named.spec.priority_class_name = "high"
+    server.create("pods", named)
+    assert server.get("pods", "default", "named").spec.priority == 1000
+
+    plain = make_pod("plain")
+    server.create("pods", plain)
+    got = server.get("pods", "default", "plain")
+    assert got.spec.priority == 7 and got.spec.priority_class_name == "base"
+
+    bogus = make_pod("bogus")
+    bogus.spec.priority_class_name = "nope"
+    try:
+        server.create("pods", bogus)
+        raise AssertionError("unknown priority class must be denied")
+    except AdmissionDenied:
+        pass
