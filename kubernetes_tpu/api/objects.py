@@ -1314,3 +1314,13 @@ class VolumeAttachment:
 
     def deep_copy(self) -> "VolumeAttachment":
         return copy.deepcopy(self)
+
+
+@dataclass
+class Eviction:
+    """pods/{name}/eviction subresource payload (policy/v1beta1 Eviction;
+    reference registry/core/pod/rest/eviction.go): a PDB-respecting delete."""
+
+    pod_name: str = ""
+    pod_namespace: str = ""
+    kind: str = "Eviction"

@@ -385,10 +385,16 @@ class _Handler(BaseHTTPRequestHandler):
         resource, ns, name, _q = self._parse()
         if resource is None:
             return self._status_error(404, "NotFound", "unknown path")
-        if not self._resource_served(resource):
-            return self._status_error(404, "NotFound", f"no such resource {resource}")
-        if not self._authorize("create", resource, ns):
-            return
+        # any authenticated user may ask "can I?" about themselves — the
+        # review endpoint is exempt from the resource gate and authz
+        # (apiserver authorizes selfsubjectaccessreviews for system:authenticated)
+        if resource != "selfsubjectaccessreviews":
+            if not self._resource_served(resource):
+                return self._status_error(
+                    404, "NotFound", f"no such resource {resource}"
+                )
+            if not self._authorize("create", resource, ns):
+                return
         try:
             body = self._read_body()
             if resource == "pods" and name and name.endswith("/binding"):
@@ -400,6 +406,58 @@ class _Handler(BaseHTTPRequestHandler):
                 if errs and errs[0]:
                     return self._status_error(409, "Conflict", errs[0])
                 return self._json(201, {"kind": "Status", "status": "Success"})
+            if resource == "pods" and name and name.endswith("/eviction"):
+                # PDB-respecting delete (registry/core/pod/rest/eviction.go)
+                from ..api.objects import Eviction
+                from ..client.apiserver import TooManyRequests
+
+                ev = codec.from_dict(Eviction, body)
+                pod_name = name.rsplit("/", 1)[0]
+                if ev.pod_name and ev.pod_name != pod_name:
+                    return self._status_error(
+                        400, "BadRequest", "eviction body names a different pod"
+                    )
+                try:
+                    self.store.evict_pod(ns or "default", pod_name)
+                except TooManyRequests as e:
+                    return self._status_error(429, "TooManyRequests", str(e))
+                return self._json(201, {"kind": "Status", "status": "Success"})
+            if resource == "selfsubjectaccessreviews":
+                # authz introspection (SelfSubjectAccessReview): evaluate
+                # the chain's own authorizer for the requesting user. The
+                # AUTHN gate still applies — a caller who would be 401'd
+                # everywhere must be 401'd here too, not told "allowed"
+                from .auth import ANONYMOUS, UserInfo
+
+                attrs = body.get("spec", {}).get("resourceAttributes", {})
+                user = None
+                authn = self.server.authenticator
+                if authn is not None:
+                    user = authn.authenticate_header(
+                        self.headers.get("Authorization", "")
+                    )
+                    if user is None:
+                        if not authn.allow_anonymous:
+                            return self._status_error(
+                                401, "Unauthorized", "authentication required"
+                            )
+                        user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
+                allowed = (
+                    self.server.authorizer is None
+                    or self.server.authorizer.authorize(
+                        user,
+                        attrs.get("verb", "get"),
+                        attrs.get("resource", ""),
+                        attrs.get("namespace") or "*",
+                    )
+                )
+                return self._json(
+                    201,
+                    {
+                        "kind": "SelfSubjectAccessReview",
+                        "status": {"allowed": allowed},
+                    },
+                )
             obj = codec.decode(resource, body)
             if ns is not None:
                 obj.metadata.namespace = ns
@@ -410,6 +468,10 @@ class _Handler(BaseHTTPRequestHandler):
         except AdmissionDenied as e:
             # quota denial is 403 Forbidden like the reference's admission
             return self._status_error(403, "Forbidden", str(e))
+        except NotFound as e:
+            # e.g. evicting/binding a pod that vanished — NotFound is a
+            # KeyError subclass, so this must precede the 400 handler
+            return self._status_error(404, "NotFound", str(e))
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 

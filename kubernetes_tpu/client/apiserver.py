@@ -32,6 +32,11 @@ class AlreadyExists(ValueError):
     pass
 
 
+class TooManyRequests(ValueError):
+    """Eviction blocked by a PodDisruptionBudget (HTTP 429, the registry's
+    eviction.go DisruptionBudget error)."""
+
+
 class Conflict(ValueError):
     """Stale resource_version on update (optimistic-concurrency failure)."""
 
@@ -337,6 +342,55 @@ class APIServer:
             for ev in events:
                 self._notify("pods", ev)
         return errors
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """pods/{name}/eviction: a PDB-respecting delete (reference
+        registry/core/pod/rest/eviction.go). Blocked evictions raise
+        TooManyRequests (HTTP 429) and consume no budget; allowed ones
+        decrement every covering PDB's disruptionsAllowed optimistically,
+        exactly like the registry's checkAndDecrement."""
+        with self._lock:
+            pods = self._objects.get("pods", {})
+            key = f"{namespace}/{name}"
+            pod = pods.get(key)
+            if pod is None:
+                raise NotFound(f"pods {key} not found")
+            if pod.status.phase in ("Succeeded", "Failed"):
+                # terminal pods disrupt nothing: no PDB check, no budget
+                # charge (eviction.go deletes them outright)
+                covering = []
+            else:
+                covering = self._covering_pdbs(namespace, pod)
+            for pdb in covering:
+                if pdb.status.disruptions_allowed <= 0:
+                    raise TooManyRequests(
+                        f"Cannot evict pod as it would violate the pod's "
+                        f"disruption budget {pdb.metadata.name}"
+                    )
+            for pdb in covering:
+                pdb.status.disruptions_allowed -= 1
+                self._bump(pdb)
+                self._log("update", "poddisruptionbudgets", pdb)
+                self._notify(
+                    "poddisruptionbudgets",
+                    Event(
+                        MODIFIED,
+                        copy.deepcopy(pdb),
+                        pdb.metadata.resource_version,
+                    ),
+                )
+        self.delete("pods", namespace, name)
+
+    def _covering_pdbs(self, namespace: str, pod) -> list:
+        from ..api.selectors import match_labels
+
+        return [
+            pdb
+            for pdb in self._objects.get("poddisruptionbudgets", {}).values()
+            if pdb.metadata.namespace == namespace
+            and pdb.spec.selector
+            and match_labels(pdb.spec.selector, pod.metadata.labels)
+        ]
 
     def bind_pod(self, binding) -> None:
         """POST pods/{name}/binding: set spec.nodeName if not already bound.

@@ -275,6 +275,90 @@ def cmd_rollout_status(client: RESTClient, args) -> int:
     return 1
 
 
+def cmd_drain(client: RESTClient, args) -> int:
+    """kubectl drain: cordon, then EVICT every non-daemon pod off the node
+    through the PDB-respecting eviction subresource, retrying 429s until
+    --timeout (kubectl/pkg/drain)."""
+    import time as _time
+    import urllib.error
+
+    def mutate(n):
+        n.spec.unschedulable = True
+        return n
+
+    try:
+        client.guaranteed_update("nodes", "", args.name, mutate)
+    except NotFound:
+        # nodes are cluster-scoped but ObjectMeta defaults their store key
+        # under "default" — NOT the -n flag, which scopes pods only
+        client.guaranteed_update("nodes", "default", args.name, mutate)
+    print(f"node/{args.name} cordoned")
+    deadline = _time.time() + args.timeout
+    while True:
+        pods, _ = client.list("pods")
+        victims = [
+            p
+            for p in pods
+            if p.spec.node_name == args.name
+            and p.metadata.deletion_timestamp is None
+            and not any(
+                r.controller and r.kind == "DaemonSet"
+                for r in p.metadata.owner_references
+            )
+        ]
+        if not victims:
+            print(f"node/{args.name} drained")
+            return 0
+        blocked = 0
+        for p in victims:
+            try:
+                client._request(
+                    "POST",
+                    client.base
+                    + f"/api/v1/namespaces/{p.metadata.namespace}/pods/"
+                    + f"{p.metadata.name}/eviction",
+                    {"kind": "Eviction"},
+                )
+                print(f"pod/{p.metadata.name} evicted")
+            except NotFound:
+                continue  # vanished between list and eviction: already gone
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    blocked += 1  # PDB: retry after the controller catches up
+                else:
+                    raise
+        if not blocked:
+            continue
+        if _time.time() > deadline:
+            print(
+                f"error: {blocked} pods blocked by disruption budgets",
+                file=sys.stderr,
+            )
+            return 1
+        _time.sleep(0.5)
+
+
+def cmd_auth_can_i(client: RESTClient, args) -> int:
+    """kubectl auth can-i VERB RESOURCE (SelfSubjectAccessReview)."""
+    out = client._request(
+        "POST",
+        client.base + "/api/v1/selfsubjectaccessreviews",
+        {
+            "kind": "SelfSubjectAccessReview",
+            "spec": {
+                "resourceAttributes": {
+                    "verb": args.can_verb,
+                    "resource": _resource(args.can_resource),
+                    "namespace": args.namespace,
+                }
+            },
+        },
+    )
+    allowed = bool(out.get("status", {}).get("allowed"))
+    print("yes" if allowed else "no")
+    return 0 if allowed else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kubectl-tpu")
     parser.add_argument(
@@ -316,6 +400,13 @@ def main(argv=None) -> int:
     p_roll.add_argument("action")  # status
     p_roll.add_argument("target")  # deployment/<name>
     p_roll.add_argument("--timeout", type=float, default=60.0)
+    p_drain = sub.add_parser("drain")
+    p_drain.add_argument("name")
+    p_drain.add_argument("--timeout", type=float, default=60.0)
+    p_can = sub.add_parser("auth")
+    p_can.add_argument("subverb")  # can-i
+    p_can.add_argument("can_verb")
+    p_can.add_argument("can_resource")
 
     args = parser.parse_args(argv)
     client = RESTClient(args.server)
@@ -345,6 +436,13 @@ def main(argv=None) -> int:
                 print("error: only 'rollout status' is supported", file=sys.stderr)
                 return 1
             return cmd_rollout_status(client, args)
+        if args.verb == "drain":
+            return cmd_drain(client, args)
+        if args.verb == "auth":
+            if args.subverb != "can-i":
+                print("error: only 'auth can-i' is supported", file=sys.stderr)
+                return 1
+            return cmd_auth_can_i(client, args)
     except NotFound as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

@@ -22,7 +22,7 @@ import threading
 from typing import Optional
 
 from ..api import objects as v1
-from ..client.apiserver import NotFound
+from ..client.apiserver import AlreadyExists, NotFound
 from .base import WorkqueueController
 
 logger = logging.getLogger("kubernetes_tpu.controller.podgc")
@@ -96,17 +96,10 @@ class PodGCController(WorkqueueController):
                 self._force_delete(p)
 
     def _force_delete(self, pod: v1.Pod) -> None:
+        # plain delete: foreign finalizers still gate the actual removal
+        # (their owners run cleanup and strip) — podgc must never bypass
+        # another component's finalizer, it only expresses deletion intent
         try:
-            if pod.metadata.finalizers:
-                def strip(p):
-                    if not p.metadata.finalizers:
-                        return None
-                    p.metadata.finalizers.clear()
-                    return p
-
-                self.server.guaranteed_update(
-                    "pods", pod.metadata.namespace, pod.metadata.name, strip
-                )
             self.server.delete("pods", pod.metadata.namespace, pod.metadata.name)
         except NotFound:
             pass
@@ -222,13 +215,23 @@ class RootCACertPublisher(WorkqueueController):
 
     name = "root-ca-cert-publisher"
     primary_kind = "namespaces"
-    secondary_kinds = ()
+    secondary_kinds = ("configmaps",)
 
     CONFIGMAP = "kube-root-ca.crt"
 
     def __init__(self, server, workers: int = 1, ca_data: str = "tpu-cluster-trust-root"):
         super().__init__(server, workers=workers)
         self.ca_data = ca_data
+
+    def enqueue_for_related(self, resource: str, obj) -> Optional[str]:
+        # deleted/tampered bundle: re-publish (the reference watches the
+        # configmaps too, rootcacertpublisher.go)
+        if obj.metadata.name != self.CONFIGMAP:
+            return None
+        for ns in self.server.list("namespaces")[0]:
+            if ns.metadata.name == obj.metadata.namespace:
+                return ns.metadata.key
+        return None
 
     def sync(self, key: str) -> None:
         name = key.rpartition("/")[2]
@@ -251,5 +254,7 @@ class RootCACertPublisher(WorkqueueController):
                     data={"ca.crt": self.ca_data},
                 ),
             )
-        except Exception:
+        except AlreadyExists:
             pass
+        except Exception:
+            logger.exception("publishing %s to %s failed", self.CONFIGMAP, name)

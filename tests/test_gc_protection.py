@@ -171,3 +171,112 @@ def test_priority_admission_resolves_class():
         raise AssertionError("unknown priority class must be denied")
     except AdmissionDenied:
         pass
+
+
+def test_eviction_api_respects_pdb_and_kubectl_drain(capsys):
+    """POST pods/{name}/eviction honors PDBs (429 when exhausted);
+    kubectl drain cordons + evicts, retrying blocked pods until the
+    disruption controller frees budget."""
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.client.apiserver import TooManyRequests
+    from kubernetes_tpu.cmd import kubectl
+
+    server = APIServer()
+    for n in ("n0", "n1"):
+        server.create(
+            "nodes", v1.Node(metadata=v1.ObjectMeta(name=n), spec=v1.NodeSpec())
+        )
+    for i in range(3):
+        p = make_pod(f"web-{i}", node="n0" if i < 2 else "n1")
+        p.metadata.labels["app"] = "web"
+        p.status.phase = v1.POD_RUNNING
+        server.create("pods", p)
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="web-pdb"),
+        spec=v1.PodDisruptionBudgetSpec(min_available=2, selector={"app": "web"}),
+    )
+    pdb.status.disruptions_allowed = 1  # 3 healthy, min 2
+    server.create("poddisruptionbudgets", pdb)
+
+    # direct store semantics: first eviction consumes the budget, second 429s
+    server.evict_pod("default", "web-2")
+    try:
+        server.evict_pod("default", "web-1")
+        raise AssertionError("second eviction must violate the PDB")
+    except TooManyRequests:
+        pass
+
+    # over HTTP: 429 carries TooManyRequests
+    srv, port, _ = serve(store=server)
+    try:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/namespaces/default/pods/web-1/eviction",
+            data=_json.dumps({"kind": "Eviction"}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+
+        # free the budget (as the disruption controller would) and drain n0
+        def free(b):
+            b.status.disruptions_allowed = 2
+            return b
+
+        server.guaranteed_update("poddisruptionbudgets", "default", "web-pdb", free)
+        rc = kubectl.main(
+            ["--server", f"http://127.0.0.1:{port}", "drain", "n0", "--timeout", "20"]
+        )
+        assert rc == 0, capsys.readouterr().err
+        left = [p.metadata.name for p in server.list("pods")[0]]
+        assert left == [], f"drained node pods must be evicted: {left}"
+    finally:
+        srv.shutdown()
+
+
+def test_auth_can_i(capsys):
+    from kubernetes_tpu.apiserver.auth import (
+        RBACAuthorizer,
+        TokenAuthenticator,
+        make_rule,
+    )
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    authn = TokenAuthenticator(allow_anonymous=False)
+    authn.add_token("tok", "alice")
+    authz = RBACAuthorizer()
+    authz.bind("alice", make_rule(["get", "list"], ["pods"]))
+    srv, port, _ = serve(authenticator=authn, authorizer=authz)
+    try:
+        import urllib.request
+
+        def can(verb, resource):
+            import json as _json
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/selfsubjectaccessreviews",
+                data=_json.dumps(
+                    {"spec": {"resourceAttributes": {"verb": verb, "resource": resource}}}
+                ).encode(),
+                method="POST",
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": "Bearer tok",
+                },
+            )
+            with urllib.request.urlopen(req) as resp:
+                return _json.loads(resp.read())["status"]["allowed"]
+
+        assert can("get", "pods") is True
+        assert can("delete", "pods") is False
+        assert can("get", "secrets") is False
+    finally:
+        srv.shutdown()
