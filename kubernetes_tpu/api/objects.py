@@ -1324,3 +1324,59 @@ class Eviction:
     pod_name: str = ""
     pod_namespace: str = ""
     kind: str = "Eviction"
+
+
+# ---------------------------------------------------------------------------
+# ReplicationController (core/v1 — the pre-apps ancestor of ReplicaSet) and
+# CertificateSigningRequest (certificates.k8s.io/v1beta1)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicationController:
+    """Same reconcile contract as ReplicaSet (the reference implements both
+    with one shared controller core, pkg/controller/replication)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: "ReplicaSetSpec" = None  # shared spec shape
+    status: "ReplicaSetStatus" = None
+    kind: str = "ReplicationController"
+
+    def __post_init__(self):
+        if self.spec is None:
+            self.spec = ReplicaSetSpec()
+        if self.status is None:
+            self.status = ReplicaSetStatus()
+
+    def deep_copy(self) -> "ReplicationController":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class CertificateSigningRequestSpec:
+    request: str = ""  # CSR payload (opaque in this build; no x509)
+    username: str = ""
+    groups: List[str] = field(default_factory=list)
+    usages: List[str] = field(default_factory=list)
+    signer_name: str = "kubernetes.io/kube-apiserver-client-kubelet"
+
+
+@dataclass
+class CertificateSigningRequestStatus:
+    conditions: List[PodCondition] = field(default_factory=list)  # Approved/Denied
+    certificate: str = ""  # issued credential
+
+
+@dataclass
+class CertificateSigningRequest:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CertificateSigningRequestSpec = field(
+        default_factory=CertificateSigningRequestSpec
+    )
+    status: CertificateSigningRequestStatus = field(
+        default_factory=CertificateSigningRequestStatus
+    )
+    kind: str = "CertificateSigningRequest"
+
+    def deep_copy(self) -> "CertificateSigningRequest":
+        return copy.deepcopy(self)

@@ -48,6 +48,8 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "apiservices": v1.APIService,
     "endpointslices": v1.EndpointSlice,
     "volumeattachments": v1.VolumeAttachment,
+    "replicationcontrollers": v1.ReplicationController,
+    "certificatesigningrequests": v1.CertificateSigningRequest,
 }
 
 KIND_TO_RESOURCE = {

@@ -159,14 +159,9 @@ class _Handler(BaseHTTPRequestHandler):
         # the aggregator AUTHENTICATES before proxying (authorization is the
         # backend's job, like the reference forwarding user headers); an
         # anonymous-rejecting front server must not leak a bypass
-        authn = self.server.authenticator
-        if authn is not None:
-            user = authn.authenticate_header(
-                self.headers.get("Authorization", "")
-            )
-            if user is None and not authn.allow_anonymous:
-                self._status_error(401, "Unauthorized", "authentication required")
-                return True
+        _user, ok = self._authenticate()
+        if not ok:
+            return True  # 401 already written
         import urllib.error
         import urllib.request
 
@@ -202,22 +197,33 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
-    def _authorize(self, verb: str, resource: str, ns: Optional[str]) -> bool:
-        """authn → authz (DefaultBuildHandlerChain order). True = proceed;
-        False = a 401/403 response was already written. No authenticator
-        configured = insecure port semantics (everything allowed)."""
+    def _authenticate(self):
+        """(user, ok): resolve the request identity. ok=False means a 401
+        was already written. user is None only on the insecure port (no
+        authenticator configured)."""
         authn = self.server.authenticator
-        authz = self.server.authorizer
         if authn is None:
-            return True
+            return None, True
         from .auth import ANONYMOUS, UserInfo
 
         user = authn.authenticate_header(self.headers.get("Authorization", ""))
         if user is None:
             if not authn.allow_anonymous:
                 self._status_error(401, "Unauthorized", "authentication required")
-                return False
+                return None, False
             user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
+        return user, True
+
+    def _authorize(self, verb: str, resource: str, ns: Optional[str]) -> bool:
+        """authn → authz (DefaultBuildHandlerChain order). True = proceed;
+        False = a 401/403 response was already written. No authenticator
+        configured = insecure port semantics (everything allowed)."""
+        authz = self.server.authorizer
+        user, ok = self._authenticate()
+        if not ok:
+            return False
+        if user is None:
+            return True
         # ns None = cluster-scoped / cluster-wide request: requires a rule
         # covering all namespaces (the ClusterRole analogue)
         if authz is not None and not authz.authorize(
@@ -427,23 +433,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # the chain's own authorizer for the requesting user. The
                 # AUTHN gate still applies — a caller who would be 401'd
                 # everywhere must be 401'd here too, not told "allowed"
-                from .auth import ANONYMOUS, UserInfo
-
                 attrs = body.get("spec", {}).get("resourceAttributes", {})
-                user = None
-                authn = self.server.authenticator
-                if authn is not None:
-                    user = authn.authenticate_header(
-                        self.headers.get("Authorization", "")
-                    )
-                    if user is None:
-                        if not authn.allow_anonymous:
-                            return self._status_error(
-                                401, "Unauthorized", "authentication required"
-                            )
-                        user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
+                user, ok = self._authenticate()
+                if not ok:
+                    return
                 allowed = (
                     self.server.authorizer is None
+                    or user is None  # insecure port: everything allowed
                     or self.server.authorizer.authorize(
                         user,
                         attrs.get("verb", "get"),

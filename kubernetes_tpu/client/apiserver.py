@@ -355,9 +355,13 @@ class APIServer:
             pod = pods.get(key)
             if pod is None:
                 raise NotFound(f"pods {key} not found")
-            if pod.status.phase in ("Succeeded", "Failed"):
-                # terminal pods disrupt nothing: no PDB check, no budget
-                # charge (eviction.go deletes them outright)
+            if (
+                pod.status.phase in ("Succeeded", "Failed")
+                or pod.metadata.deletion_timestamp is not None
+            ):
+                # terminal or already-terminating pods disrupt nothing: no
+                # PDB check, no budget charge (eviction.go deletes them
+                # outright; a drain retry must not double-charge)
                 covering = []
             else:
                 covering = self._covering_pdbs(namespace, pod)
@@ -384,11 +388,14 @@ class APIServer:
     def _covering_pdbs(self, namespace: str, pod) -> list:
         from ..api.selectors import match_labels
 
+        # NOTE no truthiness guard on the selector: the empty selector
+        # matches everything (selectors.match_labels convention) — the
+        # disruption controller and preemptor treat it that way, and the
+        # eviction gate must agree with them
         return [
             pdb
             for pdb in self._objects.get("poddisruptionbudgets", {}).values()
             if pdb.metadata.namespace == namespace
-            and pdb.spec.selector
             and match_labels(pdb.spec.selector, pod.metadata.labels)
         ]
 

@@ -175,12 +175,21 @@ def cmd_delete(client: RESTClient, args) -> int:
     return 0
 
 
+def _update_node(client: RESTClient, name: str, mutate) -> None:
+    """Nodes are cluster-scoped, but ObjectMeta defaults their store key
+    under "default" — try both (shared by cordon/uncordon/taint/drain)."""
+    try:
+        client.guaranteed_update("nodes", "", name, mutate)
+    except NotFound:
+        client.guaranteed_update("nodes", "default", name, mutate)
+
+
 def cmd_cordon(client: RESTClient, args, unschedulable=True) -> int:
     def mutate(n):
         n.spec.unschedulable = unschedulable
         return n
 
-    client.guaranteed_update("nodes", "", args.name, mutate)
+    _update_node(client, args.name, mutate)
     print(f"node/{args.name} {'cordoned' if unschedulable else 'uncordoned'}")
     return 0
 
@@ -201,7 +210,7 @@ def cmd_taint(client: RESTClient, args) -> int:
             n.spec.taints.append(Taint(key, value, effect))
         return n
 
-    client.guaranteed_update("nodes", "", args.name, mutate)
+    _update_node(client, args.name, mutate)
     print(f"node/{args.name} {'untainted' if remove else 'tainted'}")
     return 0
 
@@ -286,12 +295,7 @@ def cmd_drain(client: RESTClient, args) -> int:
         n.spec.unschedulable = True
         return n
 
-    try:
-        client.guaranteed_update("nodes", "", args.name, mutate)
-    except NotFound:
-        # nodes are cluster-scoped but ObjectMeta defaults their store key
-        # under "default" — NOT the -n flag, which scopes pods only
-        client.guaranteed_update("nodes", "default", args.name, mutate)
+    _update_node(client, args.name, mutate)
     print(f"node/{args.name} cordoned")
     deadline = _time.time() + args.timeout
     while True:
@@ -327,15 +331,17 @@ def cmd_drain(client: RESTClient, args) -> int:
                     blocked += 1  # PDB: retry after the controller catches up
                 else:
                     raise
-        if not blocked:
-            continue
+        # deadline + pacing apply to EVERY round — a workload recreating
+        # pods as fast as they evict must hit the timeout, not spin forever
         if _time.time() > deadline:
+            remaining = blocked or len(victims)
             print(
-                f"error: {blocked} pods blocked by disruption budgets",
+                f"error: {remaining} pods still on the node "
+                f"({blocked} blocked by disruption budgets)",
                 file=sys.stderr,
             )
             return 1
-        _time.sleep(0.5)
+        _time.sleep(0.5 if blocked else 0.05)
 
 
 def cmd_auth_can_i(client: RESTClient, args) -> int:

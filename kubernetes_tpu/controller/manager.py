@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
 from .attachdetach import AttachDetachController
+from .certificates import CSRSigningController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .endpointslice import EndpointSliceController
@@ -32,7 +33,7 @@ from .podgc import (
 )
 from .nodelifecycle import NodeLifecycleController
 from .pv_binder import PVBinderController
-from .replicaset import ReplicaSetController
+from .replicaset import ReplicaSetController, ReplicationControllerController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
@@ -66,6 +67,8 @@ CONTROLLER_INITIALIZERS = {
     "pvc-protection": PVCProtectionController,
     "pv-protection": PVProtectionController,
     "root-ca-cert-publisher": RootCACertPublisher,
+    "replicationcontroller": ReplicationControllerController,
+    "csrsigning": CSRSigningController,
 }
 
 

@@ -242,7 +242,19 @@ class RootCACertPublisher(WorkqueueController):
         if ns_obj.metadata.deletion_timestamp is not None:
             return
         try:
-            self.server.get("configmaps", name, self.CONFIGMAP)
+            cm = self.server.get("configmaps", name, self.CONFIGMAP)
+            if cm.data.get("ca.crt") != self.ca_data:
+                # tampered bundle: restore it (the reference publisher
+                # updates on data mismatch, not just absence)
+                def repair(cur):
+                    if cur.data.get("ca.crt") == self.ca_data:
+                        return None
+                    cur.data["ca.crt"] = self.ca_data
+                    return cur
+
+                self.server.guaranteed_update(
+                    "configmaps", name, self.CONFIGMAP, repair
+                )
             return
         except NotFound:
             pass

@@ -21,6 +21,12 @@ logger = logging.getLogger("kubernetes_tpu.controller.replicaset")
 
 
 class ReplicaSetController:
+    # parameterized so ReplicationControllerController shares the identical
+    # reconcile core (the reference implements RC as a thin wrapper over the
+    # same logic, pkg/controller/replication)
+    resource = "replicasets"
+    owner_kind = "ReplicaSet"
+
     def __init__(self, server, resync_period: float = 5.0, workers: int = 2):
         self.server = server
         self.resync = resync_period
@@ -47,10 +53,10 @@ class ReplicaSetController:
     # -- event plumbing ------------------------------------------------------
 
     def _watch_loop(self) -> None:
-        sets, rv = self.server.list("replicasets")
+        sets, rv = self.server.list(self.resource)
         for rs in sets:
             self.queue.add(rs.metadata.key)
-        rs_watch = self.server.watch("replicasets", from_version=rv)
+        rs_watch = self.server.watch(self.resource, from_version=rv)
         pods, prv = self.server.list("pods")
         pod_watch = self.server.watch("pods", from_version=prv)
         while not self._stop.is_set():
@@ -63,7 +69,7 @@ class ReplicaSetController:
                     (
                         r
                         for r in pev.object.metadata.owner_references
-                        if r.kind == "ReplicaSet"
+                        if r.kind == self.owner_kind
                     ),
                     None,
                 )
@@ -93,7 +99,7 @@ class ReplicaSetController:
     def _sync(self, key: str) -> None:
         ns, _, name = key.partition("/")
         try:
-            rs = self.server.get("replicasets", ns, name)
+            rs = self.server.get(self.resource, ns, name)
         except NotFound:
             return  # GC deletes orphans
         pods, _ = self.server.list("pods", namespace=ns)
@@ -102,7 +108,7 @@ class ReplicaSetController:
             for p in pods
             if p.metadata.deletion_timestamp is None
             and any(
-                r.kind == "ReplicaSet" and r.name == name
+                r.kind == self.owner_kind and r.name == name
                 for r in p.metadata.owner_references
             )
         ]
@@ -133,7 +139,7 @@ class ReplicaSetController:
             return cur
 
         try:
-            self.server.guaranteed_update("replicasets", ns, name, update_status)
+            self.server.guaranteed_update(self.resource, ns, name, update_status)
         except NotFound:
             pass
 
@@ -146,7 +152,7 @@ class ReplicaSetController:
                 labels=dict(tmpl.metadata.labels or rs.spec.selector),
                 owner_references=[
                     v1.OwnerReference(
-                        kind="ReplicaSet",
+                        kind=self.owner_kind,
                         name=rs.metadata.name,
                         uid=rs.metadata.uid,
                         controller=True,
@@ -159,3 +165,11 @@ class ReplicaSetController:
             self.server.create("pods", pod)
         except AlreadyExists:
             pass
+
+
+class ReplicationControllerController(ReplicaSetController):
+    """ReplicationController loop: the same reconcile over the older core
+    kind (pkg/controller/replication wraps the replicaset core identically)."""
+
+    resource = "replicationcontrollers"
+    owner_kind = "ReplicationController"

@@ -170,11 +170,17 @@ class WriteAheadLog:
                             keep.append(line)
                     except json.JSONDecodeError:
                         continue
-            with open(self.log_path, "w", encoding="utf-8") as f:
+            # ATOMIC rotation (tmp + replace): a concurrent recover() must
+            # never observe a truncated in-place rewrite — it sees either
+            # the old full log or the rewritten tail, both consistent with
+            # the published snapshot
+            log_tmp = self.log_path + ".tmp"
+            with open(log_tmp, "w", encoding="utf-8") as f:
                 for line in keep:
                     f.write(line + "\n")
                 f.flush()
                 os.fsync(f.fileno())
+            os.replace(log_tmp, self.log_path)
             self._open_sink()
             self._since_compact = len(keep)
 

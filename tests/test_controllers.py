@@ -230,6 +230,8 @@ def test_controller_manager_runs_all():
             "pvc-protection",
             "pv-protection",
             "root-ca-cert-publisher",
+            "replicationcontroller",
+            "csrsigning",
         }
     finally:
         mgr.stop()

@@ -1,0 +1,31 @@
+"""Density threshold: the CI throughput floor the reference enforces
+(test/integration/scheduler_perf/scheduler_test.go:40-42: fail below
+30 pods/s, warn below 100 on the 3k-pod/100-node density config). Runs on
+the CPU backend, so the floor guards against host-path regressions (queue,
+encode, store) — device speed is bench.py's job."""
+
+import logging
+
+from kubernetes_tpu.perf.harness import run_benchmark
+from kubernetes_tpu.perf.workloads import WorkloadConfig
+
+logger = logging.getLogger(__name__)
+
+THRESHOLD = 30.0  # hard floor (scheduler_test.go threshold3K)
+WARNING = 100.0
+
+
+def test_density_3k_pods_100_nodes_min_throughput():
+    cfg = WorkloadConfig("SchedulingBasic", 100, 0, 3000)
+    res = run_benchmark(cfg, quiet=True, timeout_s=240)
+    assert res.unscheduled == 0, f"{res.unscheduled} pods unscheduled"
+    if res.throughput_pods_per_s < WARNING:
+        logger.warning(
+            "density throughput %.1f pods/s below warning level %.0f",
+            res.throughput_pods_per_s,
+            WARNING,
+        )
+    assert res.throughput_pods_per_s >= THRESHOLD, (
+        f"density throughput {res.throughput_pods_per_s:.1f} pods/s "
+        f"below the {THRESHOLD:.0f} pods/s floor"
+    )

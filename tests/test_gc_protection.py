@@ -280,3 +280,65 @@ def test_auth_can_i(capsys):
         assert can("get", "secrets") is False
     finally:
         srv.shutdown()
+
+
+def test_replication_controller_and_csr_signing():
+    """RC shares the replicaset reconcile core; CSR approve+sign flow
+    (pkg/controller/replication + pkg/controller/certificates)."""
+    from kubernetes_tpu.controller.certificates import APPROVED, CSRSigningController
+    from kubernetes_tpu.controller.replicaset import (
+        ReplicationControllerController,
+    )
+
+    server = APIServer()
+    rc = v1.ReplicationController(
+        metadata=v1.ObjectMeta(name="legacy"),
+        spec=v1.ReplicaSetSpec(
+            replicas=3,
+            selector={"app": "legacy"},
+            template=v1.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": "legacy"}),
+                spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "10m"})]),
+            ),
+        ),
+    )
+    server.create("replicationcontrollers", rc)
+    ctrl = ReplicationControllerController(server)
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in server.list("pods")[0]
+                if any(
+                    r.kind == "ReplicationController" and r.controller
+                    for r in p.metadata.owner_references
+                )
+            )
+            == 3
+        ), "RC must maintain 3 replicas"
+    finally:
+        ctrl.stop()
+
+    csr = v1.CertificateSigningRequest(
+        metadata=v1.ObjectMeta(name="node-csr", namespace=""),
+        spec=v1.CertificateSigningRequestSpec(
+            request="worker-0-pubkey",
+            username="system:bootstrap",
+            groups=["system:bootstrappers"],
+        ),
+    )
+    server.create("certificatesigningrequests", csr)
+    signer = CSRSigningController(server)
+    signer.start()
+    try:
+        def signed():
+            cur = server.get("certificatesigningrequests", "", "node-csr")
+            return (
+                any(c.type == APPROVED and c.status == "True" for c in cur.status.conditions)
+                and bool(cur.status.certificate)
+            )
+
+        assert wait_until(signed), "bootstrap kubelet CSR must auto-approve + sign"
+    finally:
+        signer.stop()
