@@ -1380,3 +1380,27 @@ class CertificateSigningRequest:
 
     def deep_copy(self) -> "CertificateSigningRequest":
         return copy.deepcopy(self)
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"  # Container | Pod
+    max: Dict[str, Quantity] = field(default_factory=dict)
+    min: Dict[str, Quantity] = field(default_factory=dict)
+    default: Dict[str, Quantity] = field(default_factory=dict)  # limits
+    default_request: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+    kind: str = "LimitRange"
+
+    def deep_copy(self) -> "LimitRange":
+        return copy.deepcopy(self)

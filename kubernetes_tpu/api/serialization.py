@@ -50,6 +50,7 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "volumeattachments": v1.VolumeAttachment,
     "replicationcontrollers": v1.ReplicationController,
     "certificatesigningrequests": v1.CertificateSigningRequest,
+    "limitranges": v1.LimitRange,
 }
 
 KIND_TO_RESOURCE = {

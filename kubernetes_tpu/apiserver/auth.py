@@ -264,6 +264,102 @@ class QuotaAdmission(AdmissionPlugin):
                     )
 
 
+class NamespaceLifecycleAdmission(AdmissionPlugin):
+    """Reject creates in terminating or nonexistent namespaces
+    (plugin/pkg/admission/namespace/lifecycle). System namespaces
+    (default, kube-system) are implicit."""
+
+    name = "NamespaceLifecycle"
+
+    IMPLICIT = {"default", "kube-system", "kube-public", ""}
+    CLUSTER_SCOPED = {"namespaces"}
+
+    def __init__(self, server):
+        self.server = server
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource in self.CLUSTER_SCOPED:
+            return
+        ns = obj.metadata.namespace
+        if ns in self.IMPLICIT:
+            return
+        try:
+            ns_obj = self.server.get("namespaces", "default", ns)
+        except KeyError:
+            try:
+                ns_obj = self.server.get("namespaces", "", ns)
+            except KeyError:
+                raise AdmissionDenied(f"namespace {ns!r} not found") from None
+        if ns_obj.metadata.deletion_timestamp is not None:
+            raise AdmissionDenied(
+                f"namespace {ns!r} is terminating: no new objects"
+            )
+
+
+class LimitRangerAdmission(AdmissionPlugin):
+    """Apply LimitRange defaults and enforce min/max on pod containers
+    (plugin/pkg/admission/limitranger): containers without requests get the
+    range's defaultRequest; requests outside [min, max] are denied."""
+
+    name = "LimitRanger"
+
+    def __init__(self, server):
+        self.server = server
+
+    def _ranges(self, ns: str):
+        # fail-CLOSED: an enforcement gate that cannot read its policy must
+        # deny, not wave pods through — list errors propagate to the caller
+        items, _ = self.server.list("limitranges", namespace=ns)
+        return [
+            item
+            for lr in items
+            for item in lr.spec.limits
+            if item.type == "Container"
+        ]
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        for item in self._ranges(obj.metadata.namespace):
+            for c in obj.spec.containers:
+                for res_name, q in item.default_request.items():
+                    c.requests.setdefault(res_name, q)
+                for res_name, q in item.default.items():
+                    c.limits.setdefault(res_name, q)
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        from ..api.resources import cpu_to_millis, to_int_value
+
+        def units(res_name, q):
+            return cpu_to_millis(q) if "cpu" in res_name else to_int_value(q)
+
+        for item in self._ranges(obj.metadata.namespace):
+            for c in obj.spec.containers:
+                for res_name, lo in item.min.items():
+                    have = c.requests.get(res_name)
+                    # absent request FAILS min (the reference denies when no
+                    # value is specified against a min constraint — the
+                    # mutating pass already applied any defaultRequest)
+                    if have is None or units(res_name, have) < units(
+                        res_name, lo
+                    ):
+                        raise AdmissionDenied(
+                            f"minimum {res_name} usage per Container is {lo}"
+                        )
+                for res_name, hi in item.max.items():
+                    # max binds requests AND limits: either exceeding it is
+                    # a denial (limitranger checks both value classes)
+                    for have in (c.requests.get(res_name), c.limits.get(res_name)):
+                        if have is not None and units(res_name, have) > units(
+                            res_name, hi
+                        ):
+                            raise AdmissionDenied(
+                                f"maximum {res_name} usage per Container is {hi}"
+                            )
+
+
 class PriorityAdmission(AdmissionPlugin):
     """Resolve pod spec.priority_class_name -> spec.priority at create
     (plugin/pkg/admission/priority/admission.go): named class sets the

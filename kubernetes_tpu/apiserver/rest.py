@@ -308,7 +308,41 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         return False
 
+
+    # -- max-in-flight (DefaultBuildHandlerChain's WithMaxInFlightLimit) ----
+
+    def _is_long_running(self) -> bool:
+        """Watch streams are exempt from in-flight limits (the reference's
+        longRunningRequestCheck)."""
+        q = parse_qs(urlparse(self.path).query)
+        return q.get("watch", ["0"])[-1] in ("1", "true")
+
+    def _limited(self, handler):
+        sem = self.server.inflight
+        if sem is None or self._is_long_running():
+            return handler()
+        if not sem.acquire(blocking=False):
+            return self._status_error(
+                429, "TooManyRequests", "max in-flight requests exceeded"
+            )
+        try:
+            return handler()
+        finally:
+            sem.release()
+
     def do_GET(self):
+        return self._limited(self._handle_GET)
+
+    def do_POST(self):
+        return self._limited(self._handle_POST)
+
+    def do_PUT(self):
+        return self._limited(self._handle_PUT)
+
+    def do_DELETE(self):
+        return self._limited(self._handle_DELETE)
+
+    def _handle_GET(self):
         u = urlparse(self.path)
         if u.path in ("/healthz", "/readyz", "/livez"):
             body = b"ok"
@@ -385,7 +419,7 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             watcher.stop()
 
-    def do_POST(self):
+    def _handle_POST(self):
         if self._maybe_proxy():
             return
         resource, ns, name, _q = self._parse()
@@ -471,7 +505,7 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 
-    def do_PUT(self):
+    def _handle_PUT(self):
         if self._maybe_proxy():
             return
         resource, ns, name, _q = self._parse()
@@ -496,7 +530,7 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 
-    def do_DELETE(self):
+    def _handle_DELETE(self):
         if self._maybe_proxy():
             return
         resource, ns, name, _q = self._parse()
@@ -518,11 +552,23 @@ class _Handler(BaseHTTPRequestHandler):
 class APIServerHTTP(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr, store: APIServer, authenticator=None, authorizer=None):
+    def __init__(
+        self,
+        addr,
+        store: APIServer,
+        authenticator=None,
+        authorizer=None,
+        max_in_flight: int = 400,
+    ):
         super().__init__(addr, _Handler)
         self.store = store
         self.authenticator = authenticator  # None = insecure port semantics
         self.authorizer = authorizer
+        # WithMaxInFlightLimit (config.go:662-666): bounded concurrent
+        # non-watch requests; 0/None disables
+        self.inflight = (
+            threading.BoundedSemaphore(max_in_flight) if max_in_flight else None
+        )
         self.stopping = threading.Event()
 
     def shutdown(self):
@@ -535,9 +581,17 @@ def serve(
     port: int = 0,
     authenticator=None,
     authorizer=None,
+    max_in_flight: int = 400,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
-    """Start the façade on a background thread; returns (server, port, store)."""
+    """Start the façade on a background thread; returns (server, port, store).
+    max_in_flight=0 disables the in-flight limiter."""
     store = store or APIServer()
-    srv = APIServerHTTP(("0.0.0.0", port), store, authenticator, authorizer)
+    srv = APIServerHTTP(
+        ("0.0.0.0", port),
+        store,
+        authenticator,
+        authorizer,
+        max_in_flight=max_in_flight,
+    )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

@@ -97,6 +97,9 @@ def init_cluster(
         "system:bootstrappers",
         make_rule(["get", "list", "watch"], ["services", "endpoints"]),
     )
+    from ..proxy import ClusterIPAllocator
+
+    store.admit_hooks.append(ClusterIPAllocator())
     store.admit_hooks.append(
         AdmissionChain(
             mutating=[ServiceAccountAdmission(), PriorityAdmission(store)],

@@ -371,6 +371,11 @@ def main(argv=None) -> int:
         "--server",
         default=os.environ.get("KUBECTL_TPU_SERVER", "http://127.0.0.1:18080"),
     )
+    parser.add_argument(
+        "--token",
+        default=os.environ.get("KUBECTL_TPU_TOKEN", ""),
+        help="bearer token for secured clusters",
+    )
     parser.add_argument("-n", "--namespace", default="default")
     parser.add_argument("-o", "--output", default="table", choices=["table", "json"])
     sub = parser.add_subparsers(dest="verb", required=True)
@@ -415,7 +420,12 @@ def main(argv=None) -> int:
     p_can.add_argument("can_resource")
 
     args = parser.parse_args(argv)
-    client = RESTClient(args.server)
+    if args.token:
+        from ..apiserver.client import AuthRESTClient
+
+        client = AuthRESTClient(args.server, token=args.token)
+    else:
+        client = RESTClient(args.server)
     try:
         if args.verb == "get":
             return cmd_get(client, args)

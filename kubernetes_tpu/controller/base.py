@@ -54,6 +54,22 @@ class WorkqueueController:
         self._stop.set()
         self.queue.shut_down()
 
+    def start_ticker(self, name: str, period: float, fn) -> None:
+        """Guarded periodic thread: time-driven controllers (expirations,
+        resyncs, world sweeps) enqueue work on a clock, and ONE transient
+        error must never kill the clock."""
+
+        def loop():
+            while not self._stop.wait(period):
+                try:
+                    fn()
+                except Exception:
+                    logger.exception("%s tick failed", name)
+
+        t = threading.Thread(target=loop, daemon=True, name=name)
+        t.start()
+        self._threads.append(t)
+
     # -- event plumbing ------------------------------------------------------
 
     def primary_key_of(self, obj) -> str:

@@ -44,22 +44,14 @@ class CronJobController(WorkqueueController):
 
     def start(self) -> None:
         super().start()
-        t = threading.Thread(
-            target=self._tick_loop, daemon=True, name="cronjob-tick"
-        )
-        t.start()
-        self._threads.append(t)
+        # the reference controller re-lists every 10s (syncAll); schedules
+        # fire from this tick, not from watch events
+        self.start_ticker("cronjob-tick", self.sync_period, self._enqueue_all)
 
-    def _tick_loop(self) -> None:
-        """The reference controller re-lists every 10s (syncAll); schedules
-        fire from this tick, not from watch events."""
-        while not self._stop.wait(self.sync_period):
-            try:
-                cjs, _ = self.server.list("cronjobs")
-                for cj in cjs:
-                    self.queue.add(cj.metadata.key)
-            except Exception:
-                logger.exception("cronjob tick enqueue failed")
+    def _enqueue_all(self) -> None:
+        cjs, _ = self.server.list("cronjobs")
+        for cj in cjs:
+            self.queue.add(cj.metadata.key)
 
     def sync(self, key: str) -> None:
         ns, _, name = key.partition("/")

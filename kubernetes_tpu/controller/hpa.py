@@ -74,22 +74,14 @@ class HPAController(WorkqueueController):
 
     def start(self) -> None:
         super().start()
-        t = threading.Thread(
-            target=self._resync_loop, daemon=True, name="hpa-resync"
-        )
-        t.start()
-        self._threads.append(t)
+        # periodic re-evaluation (the reference reconciles every
+        # --horizontal-pod-autoscaler-sync-period, default 15s)
+        self.start_ticker("hpa-resync", self.sync_period, self._enqueue_all)
 
-    def _resync_loop(self) -> None:
-        """Periodic re-evaluation (the reference reconciles every
-        --horizontal-pod-autoscaler-sync-period, default 15s)."""
-        while not self._stop.wait(self.sync_period):
-            try:
-                hpas, _ = self.server.list("horizontalpodautoscalers")
-                for h in hpas:
-                    self.queue.add(h.metadata.key)
-            except Exception:
-                logger.exception("hpa resync enqueue failed")
+    def _enqueue_all(self) -> None:
+        hpas, _ = self.server.list("horizontalpodautoscalers")
+        for h in hpas:
+            self.queue.add(h.metadata.key)
 
     # -- reconcile ------------------------------------------------------------
 

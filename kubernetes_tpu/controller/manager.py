@@ -35,7 +35,7 @@ from .nodelifecycle import NodeLifecycleController
 from .pv_binder import PVBinderController
 from .replicaset import ReplicaSetController, ReplicationControllerController
 from .resourcequota import ResourceQuotaController
-from .serviceaccount import ServiceAccountController
+from .serviceaccount import ServiceAccountController, TokenCleaner
 from .statefulset import StatefulSetController
 from .ttl import TTLAfterFinishedController, TTLController
 
@@ -69,6 +69,7 @@ CONTROLLER_INITIALIZERS = {
     "root-ca-cert-publisher": RootCACertPublisher,
     "replicationcontroller": ReplicationControllerController,
     "csrsigning": CSRSigningController,
+    "tokencleaner": TokenCleaner,
 }
 
 

@@ -51,13 +51,7 @@ class PodGCController(WorkqueueController):
 
     def start(self) -> None:
         super().start()
-        t = threading.Thread(target=self._tick_loop, daemon=True, name="podgc-tick")
-        t.start()
-        self._threads.append(t)
-
-    def _tick_loop(self) -> None:
-        while not self._stop.wait(self.tick):
-            self.queue.add("gc")
+        self.start_ticker("podgc-tick", self.tick, lambda: self.queue.add("gc"))
 
     def sync(self, key: str) -> None:
         # copy-free prefilter: skip the world copy when nothing can be

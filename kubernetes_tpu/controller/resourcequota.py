@@ -64,20 +64,12 @@ class ResourceQuotaController(WorkqueueController):
 
     def start(self) -> None:
         super().start()
-        t = threading.Thread(
-            target=self._resync_loop, daemon=True, name="quota-resync"
-        )
-        t.start()
-        self._threads.append(t)
+        self.start_ticker("quota-resync", self.resync_period, self._enqueue_all)
 
-    def _resync_loop(self) -> None:
-        while not self._stop.wait(self.resync_period):
-            try:
-                quotas, _ = self.server.list("resourcequotas")
-                for q in quotas:
-                    self.queue.add(q.metadata.key)
-            except Exception:
-                logger.exception("quota resync enqueue failed")
+    def _enqueue_all(self) -> None:
+        quotas, _ = self.server.list("resourcequotas")
+        for q in quotas:
+            self.queue.add(q.metadata.key)
 
     def enqueue_for_related(self, resource: str, obj):
         # a pod event re-syncs every quota in its namespace

@@ -116,3 +116,61 @@ class ServiceAccountController(WorkqueueController):
                 )
             except NotFound:
                 pass
+
+
+class TokenCleaner(WorkqueueController):
+    """Delete expired bootstrap token secrets
+    (pkg/controller/bootstrap/tokencleaner.go): secrets of type
+    ``bootstrap.kubernetes.io/token`` carry an ``expiration`` annotation
+    (unix seconds); past it, the join credential is revoked."""
+
+    name = "tokencleaner"
+    primary_kind = "secrets"
+    secondary_kinds = ()
+
+    EXPIRATION_ANNOTATION = "expiration"
+    BOOTSTRAP_TYPE = "bootstrap.kubernetes.io/token"
+
+    def __init__(self, server, workers: int = 1, tick: float = 5.0):
+        super().__init__(server, workers=workers)
+        self.tick = tick
+
+    def start(self) -> None:
+        super().start()
+        # expirations fire by time, not by watch events. Bootstrap tokens
+        # live in kube-system only, and only expiring ones need ticks — the
+        # cleaner must not deep-copy every secret in the cluster each tick.
+        self.start_ticker("tokencleaner-tick", self.tick, self._enqueue_expiring)
+
+    def _enqueue_expiring(self) -> None:
+        for s in self.server.list("secrets", namespace="kube-system")[0]:
+            if (
+                s.type == self.BOOTSTRAP_TYPE
+                and self.EXPIRATION_ANNOTATION in s.metadata.annotations
+            ):
+                self.queue.add(s.metadata.key)
+
+    def sync(self, key: str) -> None:
+        import time as _time
+
+        ns, _, name = key.rpartition("/")
+        try:
+            secret = self.server.get("secrets", ns, name)
+        except NotFound:
+            return
+        if secret.type != self.BOOTSTRAP_TYPE:
+            return
+        raw = secret.metadata.annotations.get(self.EXPIRATION_ANNOTATION)
+        if raw is None:
+            return  # non-expiring token
+        try:
+            expires = float(raw)
+        except ValueError:
+            logger.warning("token %s: bad expiration %r; deleting", key, raw)
+            expires = 0.0
+        if _time.time() >= expires:
+            try:
+                self.server.delete("secrets", ns, name)
+                logger.info("expired bootstrap token %s deleted", key)
+            except NotFound:
+                pass

@@ -69,22 +69,14 @@ class TTLAfterFinishedController(WorkqueueController):
 
     def start(self) -> None:
         super().start()
-        t = threading.Thread(
-            target=self._tick_loop, daemon=True, name="ttlafterfinished-tick"
-        )
-        t.start()
-        self._threads.append(t)
-
-    def _tick_loop(self) -> None:
         # expirations fire by time, not by watch events
-        while not self._stop.wait(self.tick):
-            try:
-                jobs, _ = self.server.list("jobs")
-                for j in jobs:
-                    if getattr(j.spec, "ttl_seconds_after_finished", None) is not None:
-                        self.queue.add(j.metadata.key)
-            except Exception:
-                logger.exception("ttlafterfinished tick failed")
+        self.start_ticker("ttlafterfinished-tick", self.tick, self._enqueue_ttl_jobs)
+
+    def _enqueue_ttl_jobs(self) -> None:
+        jobs, _ = self.server.list("jobs")
+        for j in jobs:
+            if getattr(j.spec, "ttl_seconds_after_finished", None) is not None:
+                self.queue.add(j.metadata.key)
 
     @staticmethod
     def _finish_time(job: v1.Job) -> Optional[float]:

@@ -1,0 +1,223 @@
+"""Conformance capstone: one secured cluster, every controller running,
+the major API machine flows exercised together (the e2e/conformance tier,
+reference test/conformance + test/e2e framework).
+
+Flow: kubeadm init (secured REST + WAL + scheduler + ALL controllers) →
+token join 3 worker nodes → Deployment rollout → Service with endpoints +
+slices + proxy resolution → quota enforcement → CRD create/use → drain a
+node under a PDB → everything converges.
+"""
+
+import json
+import time
+import urllib.request
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.client import AuthRESTClient
+from kubernetes_tpu.cmd.kubeadm import init_cluster, join_node
+
+
+def wait_until(fn, timeout=90.0, period=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_conformance_end_to_end(tmp_path):
+    handle = init_cluster(str(tmp_path / "conf"), port=0)
+    try:
+        conf = json.load(
+            open(f"{handle.data_dir}/admin.conf.json")
+        )
+        admin = AuthRESTClient(conf["server"], token=conf["token"])
+        for i in range(3):
+            join_node(
+                handle.server_url,
+                handle.bootstrap_token,
+                f"worker-{i}",
+                handle=handle,
+            )
+        assert wait_until(lambda: len(admin.list("nodes")[0]) == 3)
+
+        # -- node infra controllers: CIDРs + TTL annotations ---------------
+        assert wait_until(
+            lambda: all(
+                n.spec.pod_cidr and "node.alpha.kubernetes.io/ttl" in
+                n.metadata.annotations
+                for n in admin.list("nodes")[0]
+            )
+        ), "nodeipam + ttl controllers must dress every node"
+
+        # -- workload: Deployment -> ReplicaSet -> running pods ------------
+        admin.create(
+            "deployments",
+            v1.Deployment(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.DeploymentSpec(
+                    replicas=4,
+                    selector={"app": "web"},
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "web"}),
+                        spec=v1.PodSpec(
+                            containers=[
+                                v1.Container(requests={"cpu": "100m"})
+                            ]
+                        ),
+                    ),
+                ),
+            ),
+        )
+
+        def web_running():
+            pods, _ = admin.list("pods")
+            mine = [
+                p
+                for p in pods
+                if p.metadata.labels.get("app") == "web"
+                and p.status.phase == v1.POD_RUNNING
+                and p.status.pod_ip
+            ]
+            return len(mine) == 4
+
+        assert wait_until(web_running), "deployment must converge to 4 running"
+
+        # -- service dataplane: endpoints + slices + VIP resolution --------
+        admin.create(
+            "services",
+            v1.Service(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.ServiceSpec(
+                    selector={"app": "web"}, ports=[("http", 80)]
+                ),
+            ),
+        )
+        svc = admin.get("services", "default", "web")
+        assert svc.spec.cluster_ip, "ClusterIP allocator must assign a VIP"
+
+        def endpoints_ready():
+            try:
+                eps = admin.get("endpoints", "default", "web")
+            except KeyError:
+                return False
+            n_ep = sum(len(s.addresses) for s in eps.subsets)
+            slices, _ = admin.list("endpointslices")
+            n_sl = sum(
+                len(s.endpoints)
+                for s in slices
+                if s.metadata.labels.get("kubernetes.io/service-name") == "web"
+            )
+            return n_ep == 4 and n_sl == 4
+
+        assert wait_until(endpoints_ready), "endpoints + slices must publish"
+        # one of the joined node agents resolves the VIP
+        pool = handle._joined[0]
+        assert wait_until(
+            lambda: pool.proxy.resolve(svc.spec.cluster_ip, "http") is not None
+        ), "node proxy must route the service VIP"
+
+        # -- quota: hard limit enforced through admission ------------------
+        admin.create(
+            "resourcequotas",
+            v1.ResourceQuota(
+                metadata=v1.ObjectMeta(name="cap"),
+                spec=v1.ResourceQuotaSpec(hard={"pods": 5}),
+            ),
+        )
+
+        def quota_tracked():
+            q = admin.get("resourcequotas", "default", "cap")
+            return q.status.used.get("pods") == 4
+
+        assert wait_until(quota_tracked), "quota status must track usage"
+        denied = False
+        try:
+            admin.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name="sixth"),
+                    spec=v1.PodSpec(containers=[v1.Container()]),
+                ),
+            )
+            admin.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name="seventh"),
+                    spec=v1.PodSpec(containers=[v1.Container()]),
+                ),
+            )
+        except urllib.error.HTTPError as e:
+            denied = e.code == 403
+        assert denied, "the pod over quota must be denied with 403"
+
+        # -- CRDs: define + use a custom resource through the API ----------
+        admin.create(
+            "customresourcedefinitions",
+            v1.CustomResourceDefinition(
+                metadata=v1.ObjectMeta(name="gadgets.conf.io"),
+                spec=v1.CustomResourceDefinitionSpec(
+                    group="conf.io",
+                    names=v1.CustomResourceDefinitionNames(
+                        plural="gadgets", kind="Gadget"
+                    ),
+                ),
+            ),
+        )
+        req = urllib.request.Request(
+            f"{handle.server_url}/apis/conf.io/v1/namespaces/default/gadgets",
+            data=json.dumps(
+                {"kind": "Gadget", "metadata": {"name": "g1"}, "spec": {"x": 1}}
+            ).encode(),
+            method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {conf['token']}",
+            },
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 201
+
+        # -- disruption: PDB + drain one node, workload re-converges -------
+        admin.create(
+            "poddisruptionbudgets",
+            v1.PodDisruptionBudget(
+                metadata=v1.ObjectMeta(name="web-pdb"),
+                spec=v1.PodDisruptionBudgetSpec(
+                    max_unavailable=1, selector={"app": "web"}
+                ),
+            ),
+        )
+        assert wait_until(
+            lambda: admin.get(
+                "poddisruptionbudgets", "default", "web-pdb"
+            ).status.disruptions_allowed
+            >= 1
+        ), "disruption controller must grant budget"
+        from kubernetes_tpu.cmd import kubectl
+
+        rc = kubectl.main(
+            [
+                "--server",
+                handle.server_url,
+                "--token",
+                conf["token"],
+                "drain",
+                "worker-0",
+                "--timeout",
+                "60",
+            ]
+        )
+        assert rc == 0, "drain must succeed within the PDB budget"
+        assert wait_until(
+            lambda: web_running()
+            and all(
+                p.spec.node_name != "worker-0"
+                for p in admin.list("pods")[0]
+                if p.metadata.labels.get("app") == "web"
+            ),
+            timeout=120,
+        ), "drained workload must re-land on surviving nodes"
+    finally:
+        handle.stop()

@@ -232,6 +232,7 @@ def test_controller_manager_runs_all():
             "root-ca-cert-publisher",
             "replicationcontroller",
             "csrsigning",
+            "tokencleaner",
         }
     finally:
         mgr.stop()
