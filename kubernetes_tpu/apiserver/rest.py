@@ -313,7 +313,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _is_long_running(self) -> bool:
         """Watch streams are exempt from in-flight limits (the reference's
-        longRunningRequestCheck)."""
+        longRunningRequestCheck). ONLY GET watches qualify — a write with
+        ?watch=1 appended is an ordinary request and must consume a slot,
+        or the limiter is trivially bypassable."""
+        if self.command != "GET":
+            return False
         q = parse_qs(urlparse(self.path).query)
         return q.get("watch", ["0"])[-1] in ("1", "true")
 

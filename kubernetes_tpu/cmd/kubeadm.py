@@ -61,6 +61,8 @@ def init_cluster(
     from ..apiserver.auth import (
         MASTERS_GROUP,
         AdmissionChain,
+        LimitRangerAdmission,
+        NamespaceLifecycleAdmission,
         PriorityAdmission,
         QuotaAdmission,
         RBACAuthorizer,
@@ -100,10 +102,20 @@ def init_cluster(
     from ..proxy import ClusterIPAllocator
 
     store.admit_hooks.append(ClusterIPAllocator())
+    # mutators first, then validators (admission/chain.go ordering); the
+    # plugin set mirrors the reference's default enabled admission list
     store.admit_hooks.append(
         AdmissionChain(
-            mutating=[ServiceAccountAdmission(), PriorityAdmission(store)],
-            validating=[QuotaAdmission(store)],
+            mutating=[
+                ServiceAccountAdmission(),
+                PriorityAdmission(store),
+                LimitRangerAdmission(store),
+            ],
+            validating=[
+                NamespaceLifecycleAdmission(store),
+                LimitRangerAdmission(store),
+                QuotaAdmission(store),
+            ],
         )
     )
     http_server, port, _ = serve(

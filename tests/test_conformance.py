@@ -132,19 +132,22 @@ def test_conformance_end_to_end(tmp_path):
             return q.status.used.get("pods") == 4
 
         assert wait_until(quota_tracked), "quota status must track usage"
+        # the FIFTH pod is within the hard pods=5 limit and must be admitted
+        admin.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name="fifth"),
+                spec=v1.PodSpec(containers=[v1.Container()]),
+            ),
+        )
+        # the SIXTH trips the limit: a real boundary check, not just
+        # "something was denied"
         denied = False
         try:
             admin.create(
                 "pods",
                 v1.Pod(
                     metadata=v1.ObjectMeta(name="sixth"),
-                    spec=v1.PodSpec(containers=[v1.Container()]),
-                ),
-            )
-            admin.create(
-                "pods",
-                v1.Pod(
-                    metadata=v1.ObjectMeta(name="seventh"),
                     spec=v1.PodSpec(containers=[v1.Container()]),
                 ),
             )
