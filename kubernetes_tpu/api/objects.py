@@ -643,6 +643,9 @@ class PersistentVolumeClaimSpec:
 @dataclass
 class PersistentVolumeClaimStatus:
     phase: str = CLAIM_PENDING
+    # actual provisioned size; the expand controller reconciles
+    # spec.resources["storage"] > status.capacity["storage"]
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
 
 
 @dataclass
@@ -699,6 +702,7 @@ class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     volume_binding_mode: str = BINDING_IMMEDIATE
+    allow_volume_expansion: bool = False
     kind: str = "StorageClass"
 
     def deep_copy(self) -> "StorageClass":
@@ -1403,4 +1407,58 @@ class LimitRange:
     kind: str = "LimitRange"
 
     def deep_copy(self) -> "LimitRange":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# RBAC (staging/src/k8s.io/api/rbac/v1/types.go): ClusterRole carries
+# PolicyRules and optionally an AggregationRule; the aggregation controller
+# (pkg/controller/clusterroleaggregation) unions rules of selected roles.
+
+
+@dataclass
+class PolicyRule:
+    verbs: List[str] = field(default_factory=list)  # "*" = all
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AggregationRule:
+    cluster_role_selectors: List[LabelSelector] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+    aggregation_rule: Optional[AggregationRule] = None
+    kind: str = "ClusterRole"
+
+    def deep_copy(self) -> "ClusterRole":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class RoleRef:
+    kind: str = "ClusterRole"
+    name: str = ""
+
+
+@dataclass
+class Subject:
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""
+
+
+@dataclass
+class ClusterRoleBinding:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+    subjects: List[Subject] = field(default_factory=list)
+    kind: str = "ClusterRoleBinding"
+
+    def deep_copy(self) -> "ClusterRoleBinding":
         return copy.deepcopy(self)

@@ -51,6 +51,8 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "replicationcontrollers": v1.ReplicationController,
     "certificatesigningrequests": v1.CertificateSigningRequest,
     "limitranges": v1.LimitRange,
+    "clusterroles": v1.ClusterRole,
+    "clusterrolebindings": v1.ClusterRoleBinding,
 }
 
 KIND_TO_RESOURCE = {

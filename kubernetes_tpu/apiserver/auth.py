@@ -22,12 +22,15 @@ persisting. Here:
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from ..api import objects as v1
 from ..api.resources import CPU, MEMORY, cpu_to_millis, to_int_value
+
+logger = logging.getLogger("kubernetes_tpu.apiserver.auth")
 
 ANONYMOUS = "system:anonymous"
 MASTERS_GROUP = "system:masters"
@@ -137,19 +140,34 @@ class Rule:
     verbs: FrozenSet[str]  # get/list/watch/create/update/delete or *
     resources: FrozenSet[str]  # resource names or *
     namespaces: FrozenSet[str] = frozenset({ALL})
+    # specific object names (PolicyRule.resourceNames). A name-restricted
+    # rule never matches unnamed requests (list/watch/create), matching
+    # the reference's RuleAllows
+    names: FrozenSet[str] = frozenset({ALL})
 
-    def allows(self, verb: str, resource: str, namespace: str) -> bool:
+    def allows(
+        self, verb: str, resource: str, namespace: str, name: str = ""
+    ) -> bool:
         return (
             (ALL in self.verbs or verb in self.verbs)
             and (ALL in self.resources or resource in self.resources)
             and (ALL in self.namespaces or namespace in self.namespaces)
+            and (ALL in self.names or (bool(name) and name in self.names))
         )
 
 
 def make_rule(
-    verbs: Sequence[str], resources: Sequence[str], namespaces: Sequence[str] = (ALL,)
+    verbs: Sequence[str],
+    resources: Sequence[str],
+    namespaces: Sequence[str] = (ALL,),
+    names: Sequence[str] = (ALL,),
 ) -> Rule:
-    return Rule(frozenset(verbs), frozenset(resources), frozenset(namespaces))
+    return Rule(
+        frozenset(verbs),
+        frozenset(resources),
+        frozenset(namespaces),
+        frozenset(names),
+    )
 
 
 # the verbs read-only roles get (rbac bootstrap "view")
@@ -158,18 +176,80 @@ READ_VERBS = ("get", "list", "watch")
 
 class RBACAuthorizer:
     """Subject (user or group) → list of rules. ``system:masters`` is the
-    reference's superuser group (rbac bootstrap cluster-admin binding)."""
+    reference's superuser group (rbac bootstrap cluster-admin binding).
 
-    def __init__(self):
+    Two rule sources: programmatic ``bind`` calls (the bootstrap policy,
+    plugin/pkg/auth/authorizer/rbac/bootstrappolicy) and — when built with
+    a server — ClusterRole/ClusterRoleBinding API objects, rebuilt into a
+    subject index on a short TTL like the SA-token index (the reference's
+    RBAC authorizer resolves through informer caches)."""
+
+    def __init__(self, server=None):
         self._subjects: Dict[str, List[Rule]] = {}
         self._lock = threading.Lock()
+        self._server = server
+        self._obj_index: Dict[str, List[Rule]] = {}
+        self._obj_built_at = float("-inf")
+        self._obj_ttl = 2.0
 
     def bind(self, subject: str, rule: Rule) -> None:
         with self._lock:
             self._subjects.setdefault(subject, []).append(rule)
 
+    def _object_rules(self) -> Dict[str, List[Rule]]:
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            if now - self._obj_built_at < self._obj_ttl:
+                return self._obj_index
+        idx: Dict[str, List[Rule]] = {}
+        try:
+            roles = {
+                r.metadata.name: r
+                for r in self._server.list("clusterroles")[0]
+            }
+            for b in self._server.list("clusterrolebindings")[0]:
+                role = roles.get(b.role_ref.name)
+                if role is None:
+                    continue
+                rules = [
+                    make_rule(
+                        r.verbs,
+                        r.resources,
+                        names=r.resource_names or (ALL,),
+                    )
+                    for r in role.rules
+                ]
+                for s in b.subjects:
+                    if s.kind == "ServiceAccount":
+                        name = f"system:serviceaccount:{s.namespace}:{s.name}"
+                    else:  # User and Group subjects are both plain keys
+                        name = s.name
+                    idx.setdefault(name, []).extend(rules)
+        except Exception:
+            # transient store failure: keep serving the stale index rather
+            # than caching an empty one (which would 403 every
+            # object-bound subject for a TTL); built_at still advances so
+            # a broken store isn't hammered per request
+            logger.exception(
+                "rebuilding RBAC object index failed; serving stale index"
+            )
+            with self._lock:
+                self._obj_built_at = now
+                return self._obj_index
+        with self._lock:
+            self._obj_index = idx
+            self._obj_built_at = now
+        return idx
+
     def authorize(
-        self, user: Optional[UserInfo], verb: str, resource: str, namespace: str
+        self,
+        user: Optional[UserInfo],
+        verb: str,
+        resource: str,
+        namespace: str,
+        name: str = "",
     ) -> bool:
         if user is None:
             return False
@@ -179,7 +259,12 @@ class RBACAuthorizer:
             rules = list(self._subjects.get(user.name, []))
             for g in user.groups:
                 rules.extend(self._subjects.get(g, []))
-        return any(r.allows(verb, resource, namespace) for r in rules)
+        if self._server is not None:
+            obj = self._object_rules()
+            rules.extend(obj.get(user.name, []))
+            for g in user.groups:
+                rules.extend(obj.get(g, []))
+        return any(r.allows(verb, resource, namespace, name) for r in rules)
 
 
 # ---------------------------------------------------------------------------

@@ -214,7 +214,9 @@ class _Handler(BaseHTTPRequestHandler):
             user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
         return user, True
 
-    def _authorize(self, verb: str, resource: str, ns: Optional[str]) -> bool:
+    def _authorize(
+        self, verb: str, resource: str, ns: Optional[str], name: str = ""
+    ) -> bool:
         """authn → authz (DefaultBuildHandlerChain order). True = proceed;
         False = a 401/403 response was already written. No authenticator
         configured = insecure port semantics (everything allowed)."""
@@ -227,7 +229,7 @@ class _Handler(BaseHTTPRequestHandler):
         # ns None = cluster-scoped / cluster-wide request: requires a rule
         # covering all namespaces (the ClusterRole analogue)
         if authz is not None and not authz.authorize(
-            user, verb, resource, ns if ns is not None else "*"
+            user, verb, resource, ns if ns is not None else "*", name
         ):
             self._status_error(
                 403,
@@ -370,7 +372,7 @@ class _Handler(BaseHTTPRequestHandler):
             if name
             else ("watch" if query.get("watch") in ("1", "true") else "list")
         )
-        if not self._authorize(verb, resource, ns):
+        if not self._authorize(verb, resource, ns, name or ""):
             return
         try:
             if name:
@@ -483,6 +485,7 @@ class _Handler(BaseHTTPRequestHandler):
                         attrs.get("verb", "get"),
                         attrs.get("resource", ""),
                         attrs.get("namespace") or "*",
+                        attrs.get("name", ""),
                     )
                 )
                 return self._json(
@@ -517,7 +520,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", "unknown path")
         if not self._resource_served(resource):
             return self._status_error(404, "NotFound", f"no such resource {resource}")
-        if not self._authorize("update", resource, ns):
+        if not self._authorize("update", resource, ns, name or ""):
             return
         try:
             obj = codec.decode(resource, self._read_body())
@@ -542,7 +545,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", "unknown path")
         if not self._resource_served(resource):
             return self._status_error(404, "NotFound", f"no such resource {resource}")
-        if not self._authorize("delete", resource, ns):
+        if not self._authorize("delete", resource, ns, name or ""):
             return
         try:
             self.store.delete(resource, ns or "", name)

@@ -80,7 +80,12 @@ def init_cluster(
 
     # -- phase certs: trust material (bearer tokens stand in for x509) ------
     admin_token = secrets.token_urlsafe(24)
-    bootstrap_token = secrets.token_urlsafe(16)
+    # bootstrap token in the reference's <id>.<secret> form
+    # (cluster-bootstrap/token/util): the id is public (names the JWS
+    # signature key on cluster-info), the secret half proves possession
+    token_id = secrets.token_hex(3)
+    token_secret = secrets.token_urlsafe(16)
+    bootstrap_token = f"{token_id}.{token_secret}"
     logger.info("[certs] generated admin + bootstrap tokens")
 
     # -- phase etcd/control-plane: durable store + REST facade --------------
@@ -90,7 +95,9 @@ def init_cluster(
     authn.add_token(
         bootstrap_token, "system:bootstrap", groups=("system:bootstrappers",)
     )
-    authz = RBACAuthorizer()
+    # server-backed: ClusterRole/ClusterRoleBinding objects created via the
+    # API feed authorization alongside the programmatic bootstrap policy
+    authz = RBACAuthorizer(server=store)
     # bootstrappers run node agents: register + heartbeat, sync pods, and
     # feed the node-side service dataplane (the system:node role shape)
     authz.bind("system:bootstrappers", make_rule(["create", "update", "get"], ["nodes", "leases"]))
@@ -98,6 +105,10 @@ def init_cluster(
     authz.bind(
         "system:bootstrappers",
         make_rule(["get", "list", "watch"], ["services", "endpoints"]),
+    )
+    # token discovery: joining nodes read the signed cluster-info document
+    authz.bind(
+        "system:bootstrappers", make_rule(["get"], ["configmaps"], ["kube-public"])
     )
     from ..proxy import ClusterIPAllocator
 
@@ -150,10 +161,28 @@ def init_cluster(
                 name=BOOTSTRAP_TOKEN_SECRET, namespace="kube-system"
             ),
             type="bootstrap.kubernetes.io/token",
-            data={"token": bootstrap_token.encode()},
+            data={
+                "token": bootstrap_token.encode(),
+                "token-id": token_id.encode(),
+                "token-secret": token_secret.encode(),
+                "usage-bootstrap-signing": b"true",
+            },
         ),
     )
     logger.info("[bootstrap-token] join token stored")
+
+    # -- phase upload-config/addons: public discovery document ---------------
+    # cluster-info in kube-public carries ONLY the server location (no
+    # credentials); the bootstrapsigner controller attaches per-token JWS
+    # signatures so a joining node can verify it with just its token
+    store.create(
+        "configmaps",
+        v1.ConfigMap(
+            metadata=v1.ObjectMeta(name="cluster-info", namespace="kube-public"),
+            data={"kubeconfig": json.dumps({"server": f"http://127.0.0.1:{port}"})},
+        ),
+    )
+    logger.info("[upload-config] cluster-info published to kube-public")
 
     return ClusterHandle(
         store=store,
@@ -165,6 +194,45 @@ def init_cluster(
         bootstrap_token=bootstrap_token,
         data_dir=data_dir,
     )
+
+
+def discover_cluster_info(
+    server_url: str, token: str, timeout: float = 10.0
+) -> dict:
+    """Bootstrap token discovery (cmd/kubeadm/app/discovery/token): fetch
+    the kube-public cluster-info document and verify its detached JWS
+    signature with the `<id>.<secret>` token before trusting anything in
+    it. Raises PermissionError on a missing or wrong signature — an
+    unsigned endpoint could be an impostor control plane."""
+    import time as _time
+
+    from ..apiserver.client import AuthRESTClient
+    from ..controller.bootstrap import JWS_PREFIX, compute_detached_signature
+
+    token_id, _, token_secret = token.partition(".")
+    client = AuthRESTClient(server_url, token=token)
+    deadline = _time.monotonic() + timeout
+    last = "cluster-info not found"
+    while _time.monotonic() < deadline:
+        try:
+            cm = client.get("configmaps", "kube-public", "cluster-info")
+            content = cm.data.get("kubeconfig", "")
+            sig = cm.data.get(JWS_PREFIX + token_id, "")
+            if sig and content:
+                want = compute_detached_signature(content, token_id, token_secret)
+                if sig == want:
+                    return json.loads(content)
+                raise PermissionError(
+                    "cluster-info signature mismatch for token id "
+                    f"{token_id!r}: refusing to join"
+                )
+            last = f"no signature yet for token id {token_id!r}"
+        except PermissionError:
+            raise
+        except Exception as e:  # not served yet / signer still reconciling
+            last = str(e)
+        _time.sleep(0.2)
+    raise PermissionError(f"cluster-info discovery failed: {last}")
 
 
 def join_node(
@@ -184,6 +252,11 @@ def join_node(
     from ..kubelet.kubelet import NodeAgentPool
     from ..kubemark.hollow_node import make_hollow_node
 
+    if "." in token:
+        # <id>.<secret> form: verify the control plane's identity via the
+        # signed discovery document before registering with it
+        info = discover_cluster_info(server_url, token)
+        server_url = info.get("server", server_url)
     client = AuthRESTClient(server_url, token=token)
     node = make_hollow_node(node_name, cpu=cpu, memory=memory)
     try:

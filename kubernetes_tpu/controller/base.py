@@ -82,7 +82,9 @@ class WorkqueueController:
     def _watch_loop(self) -> None:
         objs, rv = self.server.list(self.primary_kind)
         for o in objs:
-            self.queue.add(self.primary_key_of(o))
+            key = self.primary_key_of(o)
+            if key:
+                self.queue.add(key)
         primary_watch = self.server.watch(self.primary_kind, from_version=rv)
         sec_watches = []
         for res in self.secondary_kinds:
@@ -94,7 +96,10 @@ class WorkqueueController:
             # leave endpoints/PDB status minutes behind a pod burst
             ev = primary_watch.get(timeout=0.1)
             while ev is not None:
-                self.queue.add(self.primary_key_of(ev.object))
+                key = self.primary_key_of(ev.object)
+                if key:
+                    # falsy key = controller filtered the event out
+                    self.queue.add(key)
                 ev = primary_watch.get(timeout=0)
             for res, w in sec_watches:
                 sev = w.get(timeout=0)

@@ -13,7 +13,14 @@ from typing import Dict, List, Optional
 
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
 from .attachdetach import AttachDetachController
-from .certificates import CSRSigningController
+from .bootstrap import BootstrapSignerController
+from .certificates import (
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+)
+from .rbac import ClusterRoleAggregationController
+from .volume_expand import VolumeExpandController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .endpointslice import EndpointSliceController
@@ -69,7 +76,12 @@ CONTROLLER_INITIALIZERS = {
     "root-ca-cert-publisher": RootCACertPublisher,
     "replicationcontroller": ReplicationControllerController,
     "csrsigning": CSRSigningController,
+    "csrapproving": CSRApprovingController,
+    "csrcleaner": CSRCleanerController,
     "tokencleaner": TokenCleaner,
+    "bootstrapsigner": BootstrapSignerController,
+    "persistentvolume-expander": VolumeExpandController,
+    "clusterrole-aggregation": ClusterRoleAggregationController,
 }
 
 

@@ -143,6 +143,11 @@ class PVBinderController(WorkqueueController):
         def bind_pvc(c):
             c.spec.volume_name = pv.metadata.name
             c.status.phase = v1.CLAIM_BOUND
+            # provisioned size baseline the expand controller compares
+            # spec.resources against (pv_controller's bindClaimToVolume
+            # copies volume capacity into claim status)
+            if "storage" in pv.spec.capacity:
+                c.status.capacity["storage"] = pv.spec.capacity["storage"]
             return c
 
         try:

@@ -236,9 +236,20 @@ class VolumeBinder:
                     p.status.phase = "Available"
                     return p
 
-                def bind_claim(c, _pv=pv_name):
+                try:
+                    pv_capacity = self.server.get(
+                        "persistentvolumes", "", pv_name
+                    ).spec.capacity.get("storage")
+                except NotFound:
+                    pv_capacity = None
+
+                def bind_claim(c, _pv=pv_name, _cap=pv_capacity):
                     c.spec.volume_name = _pv
                     c.status.phase = v1.CLAIM_BOUND
+                    if _cap is not None:
+                        # provisioned-size baseline for the expander
+                        # (pv_binder._bind copies the same way)
+                        c.status.capacity["storage"] = _cap
                     return c
 
                 self.server.guaranteed_update("persistentvolumes", "", pv_name, bind_pv)

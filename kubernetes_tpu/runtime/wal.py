@@ -25,6 +25,17 @@ from ..api import serialization
 SNAPSHOT_SUFFIX = ".snapshot.json"
 LOG_SUFFIX = ".wal"
 
+_DEBUG = bool(os.environ.get("KTPU_WAL_DEBUG"))
+
+
+def _trace(path: str, msg: str) -> None:
+    if not _DEBUG:
+        return
+    import time as _t
+
+    with open(path + ".trace", "a", encoding="utf-8") as f:
+        f.write(f"{_t.monotonic():.6f} [{threading.get_ident()}] {msg}\n")
+
 
 class WriteAheadLog:
     def __init__(
@@ -128,6 +139,8 @@ class WriteAheadLog:
                 if self.fsync:
                     os.fsync(self._f.fileno())
             self._since_compact += len(lines)
+            if _DEBUG:
+                _trace(self.path, f"append acked rvs={[r[0] for r in records]} native={self._native is not None}")
 
     def due(self) -> bool:
         with self._lock:
@@ -146,6 +159,8 @@ class WriteAheadLog:
                 for kind, objs in objects.items()
             },
         }
+        if _DEBUG:
+            _trace(self.path, f"compact start rv={rv} nobjs={sum(len(v) for v in objects.values())}")
         tmp = self.snap_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(snap, f, default=str)
@@ -155,6 +170,7 @@ class WriteAheadLog:
             if self._closed:
                 return  # shut down mid-compaction: don't resurrect the sink
             os.replace(tmp, self.snap_path)  # atomic publish
+            _trace(self.path, f"snapshot published rv={rv}")
             # rewrite the log keeping only records newer than the snapshot
             # (the sink is closed around the rewrite and reopened after —
             # appends are excluded by the wal lock for the duration)
@@ -183,6 +199,8 @@ class WriteAheadLog:
             os.replace(log_tmp, self.log_path)
             self._open_sink()
             self._since_compact = len(keep)
+            if _DEBUG:
+                _trace(self.path, f"log rewritten keep={len(keep)} rvs={[json.loads(l)['rv'] for l in keep[:40]]}")
 
     def close(self) -> None:
         with self._lock:
@@ -201,33 +219,50 @@ class WriteAheadLog:
         (atomic replace) BEFORE rewriting the log, so every on-disk state a
         crash can leave behind recovers fully. A LIVE writer compacting
         concurrently (tests; split-brain probes) can still interleave our
-        two reads — detected by re-reading the snapshot rv after the log
-        and retrying (etcd forbids the scenario outright via flock)."""
+        two reads — stale snapshot paired with an already-rewritten log
+        tail, silently losing the records in between. Detected by
+        re-reading the snapshot rv after the log and retrying unless it
+        still equals the rv of the snapshot we actually loaded (comparing
+        against the REPLAYED rv is not enough: tail records replayed past
+        the new snapshot's rv would mask the staleness — found by a
+        14/25-pod recovery under a compacting writer). etcd forbids the
+        scenario outright via flock."""
         for _ in range(10):
-            rv, objects = WriteAheadLog._recover_once(path)
+            rv, objects, snap_rv = WriteAheadLog._recover_once(path)
+            if _DEBUG:
+                _trace(path, f"recover pass snap_rv={snap_rv} rv={rv} nobjs={sum(len(v) for v in objects.values())}")
             snap_path = path + SNAPSHOT_SUFFIX
-            if not os.path.exists(snap_path):
-                return rv, objects
             try:
                 with open(snap_path, encoding="utf-8") as f:
                     current_rv = json.load(f)["rv"]
+            except FileNotFoundError:
+                current_rv = 0
             except (json.JSONDecodeError, OSError):
                 continue  # snapshot replaced mid-read: retry
-            if current_rv <= rv:
+            if current_rv == snap_rv:
+                # no snapshot was published between our two reads, so the
+                # log tail we replayed is consistent with the snapshot we
+                # loaded (a pending rewrite of THIS snapshot's log only
+                # drops records the snapshot already covers)
                 return rv, objects
-            # a newer snapshot landed between our snapshot and log reads
         return rv, objects
 
     @staticmethod
-    def _recover_once(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
+    def _recover_once(
+        path: str,
+    ) -> Tuple[int, Dict[str, Dict[str, Any]], int]:
+        """Returns (rv, objects, snap_rv) — snap_rv is the rv of the
+        snapshot file as loaded (0 if none), for the caller's staleness
+        re-check."""
         rv = 0
+        snap_rv = 0
         objects: Dict[str, Dict[str, Any]] = {}
         snap_path = path + SNAPSHOT_SUFFIX
         log_path = path + LOG_SUFFIX
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
                 snap = json.load(f)
-            rv = snap["rv"]
+            rv = snap_rv = snap["rv"]
             for kind, objs in snap["objects"].items():
                 d = objects.setdefault(kind, {})
                 for data in objs:
@@ -255,4 +290,4 @@ class WriteAheadLog:
                     else:
                         obj = serialization.decode(kind, rec["obj"])
                         d[obj.metadata.key] = obj
-        return rv, objects
+        return rv, objects, snap_rv

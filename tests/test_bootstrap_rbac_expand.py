@@ -1,0 +1,385 @@
+"""The five controllers completing the reference's 35-loop set
+(cmd/kube-controller-manager/app/controllermanager.go:372-414):
+bootstrapsigner, csrapproving/csrcleaner (approver split from signer),
+persistentvolume-expander, clusterrole-aggregation — plus the RBAC object
+model (ClusterRole/ClusterRoleBinding) feeding the authorizer."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def make_token_secret(name, tid, tsec, signing=True):
+    data = {
+        "token-id": tid.encode(),
+        "token-secret": tsec.encode(),
+    }
+    if signing:
+        data["usage-bootstrap-signing"] = b"true"
+    return v1.Secret(
+        metadata=v1.ObjectMeta(name=name, namespace="kube-system"),
+        type="bootstrap.kubernetes.io/token",
+        data=data,
+    )
+
+
+def test_bootstrap_signer_signs_and_prunes():
+    from kubernetes_tpu.controller.bootstrap import (
+        JWS_PREFIX,
+        BootstrapSignerController,
+        compute_detached_signature,
+    )
+
+    server = APIServer()
+    server.create(
+        "configmaps",
+        v1.ConfigMap(
+            metadata=v1.ObjectMeta(name="cluster-info", namespace="kube-public"),
+            data={"kubeconfig": '{"server": "http://127.0.0.1:1"}'},
+        ),
+    )
+    server.create("secrets", make_token_secret("bootstrap-token-abc", "abc123", "s3cret"))
+    ctrl = BootstrapSignerController(server)
+    ctrl.start()
+    try:
+        def has_sig():
+            cm = server.get("configmaps", "kube-public", "cluster-info")
+            return JWS_PREFIX + "abc123" in cm.data
+
+        assert wait_until(has_sig), "signature must appear for the signing token"
+        cm = server.get("configmaps", "kube-public", "cluster-info")
+        assert cm.data[JWS_PREFIX + "abc123"] == compute_detached_signature(
+            cm.data["kubeconfig"], "abc123", "s3cret"
+        )
+
+        # a non-signing token gets no signature
+        server.create(
+            "secrets", make_token_secret("bootstrap-token-x", "nosign", "x", signing=False)
+        )
+        # deleting the signing token prunes its signature
+        server.delete("secrets", "kube-system", "bootstrap-token-abc")
+        assert wait_until(
+            lambda: JWS_PREFIX + "abc123"
+            not in server.get("configmaps", "kube-public", "cluster-info").data
+        ), "signature must be pruned when its token goes away"
+        assert (
+            JWS_PREFIX + "nosign"
+            not in server.get("configmaps", "kube-public", "cluster-info").data
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_csr_cleaner_reaps_stale_requests():
+    from kubernetes_tpu.controller.certificates import (
+        APPROVED,
+        DENIED,
+        CSRCleanerController,
+    )
+
+    server = APIServer()
+    old = time.time() - 3600
+
+    def csr(name, conds=(), cert=""):
+        c = v1.CertificateSigningRequest(
+            metadata=v1.ObjectMeta(name=name, namespace=""),
+            status=v1.CertificateSigningRequestStatus(
+                conditions=[
+                    v1.PodCondition(type=t, status="True") for t in conds
+                ],
+                certificate=cert,
+            ),
+        )
+        c.metadata.creation_timestamp = old
+        return c
+
+    server.create("certificatesigningrequests", csr("signed", (APPROVED,), "certdata"))
+    server.create("certificatesigningrequests", csr("denied", (DENIED,)))
+    server.create("certificatesigningrequests", csr("pending"))
+    server.create("certificatesigningrequests", csr("inflight", (APPROVED,)))  # approved, unsigned
+    fresh = v1.CertificateSigningRequest(metadata=v1.ObjectMeta(name="fresh", namespace=""))
+    server.create("certificatesigningrequests", fresh)
+
+    ctrl = CSRCleanerController(
+        server, tick=0.1, signed_ttl=1.0, denied_ttl=1.0, pending_ttl=7200.0
+    )
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: {
+                c.metadata.name
+                for c in server.list("certificatesigningrequests")[0]
+            }
+            == {"pending", "inflight", "fresh"}
+        ), "signed + denied past TTL reaped; pending/in-flight/fresh kept"
+    finally:
+        ctrl.stop()
+
+
+def test_volume_expand_controller():
+    from kubernetes_tpu.controller.pv_binder import PVBinderController
+    from kubernetes_tpu.controller.volume_expand import VolumeExpandController
+
+    server = APIServer()
+    server.create(
+        "storageclasses",
+        v1.StorageClass(
+            metadata=v1.ObjectMeta(name="fast", namespace=""),
+            provisioner="tpu.csi",
+            allow_volume_expansion=True,
+        ),
+    )
+    server.create(
+        "storageclasses",
+        v1.StorageClass(
+            metadata=v1.ObjectMeta(name="fixed", namespace=""),
+            provisioner="tpu.csi",
+        ),
+    )
+    for sc in ("fast", "fixed"):
+        server.create(
+            "persistentvolumes",
+            v1.PersistentVolume(
+                metadata=v1.ObjectMeta(name=f"pv-{sc}", namespace=""),
+                spec=v1.PersistentVolumeSpec(
+                    capacity={"storage": "10Gi"}, storage_class_name=sc
+                ),
+            ),
+        )
+        server.create(
+            "persistentvolumeclaims",
+            v1.PersistentVolumeClaim(
+                metadata=v1.ObjectMeta(name=f"claim-{sc}"),
+                spec=v1.PersistentVolumeClaimSpec(
+                    resources={"storage": "10Gi"}, storage_class_name=sc
+                ),
+            ),
+        )
+    binder = PVBinderController(server)
+    expander = VolumeExpandController(server)
+    binder.start()
+    expander.start()
+    try:
+        assert wait_until(
+            lambda: all(
+                server.get("persistentvolumeclaims", "default", f"claim-{sc}").status.phase
+                == v1.CLAIM_BOUND
+                for sc in ("fast", "fixed")
+            )
+        )
+        # bind copies provisioned capacity into claim status
+        assert (
+            server.get("persistentvolumeclaims", "default", "claim-fast")
+            .status.capacity["storage"]
+            == "10Gi"
+        )
+        for sc in ("fast", "fixed"):
+            server.guaranteed_update(
+                "persistentvolumeclaims", "default", f"claim-{sc}",
+                lambda c: (c.spec.resources.__setitem__("storage", "20Gi"), c)[1],
+            )
+        assert wait_until(
+            lambda: server.get("persistentvolumeclaims", "default", "claim-fast")
+            .status.capacity.get("storage")
+            == "20Gi"
+        ), "expandable class must grow"
+        assert (
+            server.get("persistentvolumes", "", "pv-fast").spec.capacity["storage"]
+            == "20Gi"
+        )
+        time.sleep(0.3)
+        assert (
+            server.get("persistentvolumeclaims", "default", "claim-fixed")
+            .status.capacity.get("storage")
+            == "10Gi"
+        ), "class without allowVolumeExpansion must not grow"
+    finally:
+        binder.stop()
+        expander.stop()
+
+
+def test_clusterrole_aggregation():
+    from kubernetes_tpu.api.selectors import LabelSelector
+    from kubernetes_tpu.controller.rbac import ClusterRoleAggregationController
+
+    server = APIServer()
+    server.create(
+        "clusterroles",
+        v1.ClusterRole(
+            metadata=v1.ObjectMeta(name="view", namespace=""),
+            aggregation_rule=v1.AggregationRule(
+                cluster_role_selectors=[
+                    LabelSelector.make({"rbac.tpu/aggregate-to-view": "true"})
+                ]
+            ),
+        ),
+    )
+    ctrl = ClusterRoleAggregationController(server)
+    ctrl.start()
+    try:
+        server.create(
+            "clusterroles",
+            v1.ClusterRole(
+                metadata=v1.ObjectMeta(
+                    name="view-pods",
+                    namespace="",
+                    labels={"rbac.tpu/aggregate-to-view": "true"},
+                ),
+                rules=[v1.PolicyRule(verbs=["get", "list"], resources=["pods"])],
+            ),
+        )
+        assert wait_until(
+            lambda: any(
+                "pods" in r.resources
+                for r in server.get("clusterroles", "", "view").rules
+            )
+        ), "matching role's rules must aggregate in"
+
+        server.create(
+            "clusterroles",
+            v1.ClusterRole(
+                metadata=v1.ObjectMeta(
+                    name="view-cms",
+                    namespace="",
+                    labels={"rbac.tpu/aggregate-to-view": "true"},
+                ),
+                rules=[v1.PolicyRule(verbs=["get"], resources=["configmaps"])],
+            ),
+        )
+        assert wait_until(
+            lambda: {
+                res
+                for r in server.get("clusterroles", "", "view").rules
+                for res in r.resources
+            }
+            == {"pods", "configmaps"}
+        )
+        server.delete("clusterroles", "", "view-pods")
+        assert wait_until(
+            lambda: {
+                res
+                for r in server.get("clusterroles", "", "view").rules
+                for res in r.resources
+            }
+            == {"configmaps"}
+        ), "removing a source role must shrink the aggregate"
+
+        # chained aggregation (admin <- view): view is itself labeled into
+        # admin's selector; a change flowing into view must propagate on
+        # to admin even though view is an aggregating role
+        server.create(
+            "clusterroles",
+            v1.ClusterRole(
+                metadata=v1.ObjectMeta(name="admin", namespace=""),
+                aggregation_rule=v1.AggregationRule(
+                    cluster_role_selectors=[
+                        LabelSelector.make({"rbac.tpu/aggregate-to-admin": "true"})
+                    ]
+                ),
+            ),
+        )
+        server.guaranteed_update(
+            "clusterroles", "", "view",
+            lambda r: (
+                r.metadata.labels.__setitem__(
+                    "rbac.tpu/aggregate-to-admin", "true"
+                ),
+                r,
+            )[1],
+        )
+        server.create(
+            "clusterroles",
+            v1.ClusterRole(
+                metadata=v1.ObjectMeta(
+                    name="view-nodes",
+                    namespace="",
+                    labels={"rbac.tpu/aggregate-to-view": "true"},
+                ),
+                rules=[v1.PolicyRule(verbs=["get"], resources=["nodes"])],
+            ),
+        )
+        assert wait_until(
+            lambda: {
+                res
+                for r in server.get("clusterroles", "", "admin").rules
+                for res in r.resources
+            }
+            >= {"configmaps", "nodes"}
+        ), "rules must flow through the chain view -> admin"
+    finally:
+        ctrl.stop()
+
+
+def test_rbac_objects_drive_authorizer():
+    from kubernetes_tpu.apiserver.auth import RBACAuthorizer, UserInfo
+
+    server = APIServer()
+    server.create(
+        "clusterroles",
+        v1.ClusterRole(
+            metadata=v1.ObjectMeta(name="pod-reader", namespace=""),
+            rules=[v1.PolicyRule(verbs=["get", "list"], resources=["pods"])],
+        ),
+    )
+    server.create(
+        "clusterrolebindings",
+        v1.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="alice-reads", namespace=""),
+            role_ref=v1.RoleRef(name="pod-reader"),
+            subjects=[
+                v1.Subject(kind="User", name="alice"),
+                v1.Subject(kind="Group", name="auditors"),
+                v1.Subject(kind="ServiceAccount", name="sa1", namespace="ns1"),
+            ],
+        ),
+    )
+    authz = RBACAuthorizer(server=server)
+    alice = UserInfo("alice", ())
+    bob = UserInfo("bob", ())
+    auditor = UserInfo("carol", ("auditors",))
+    sa = UserInfo("system:serviceaccount:ns1:sa1", ("system:serviceaccounts",))
+    assert authz.authorize(alice, "get", "pods", "default")
+    assert authz.authorize(auditor, "list", "pods", "default")
+    assert authz.authorize(sa, "get", "pods", "default")
+    assert not authz.authorize(bob, "get", "pods", "default")
+    assert not authz.authorize(alice, "delete", "pods", "default")
+    assert not authz.authorize(alice, "get", "secrets", "default")
+
+    # resourceNames scoping: the grant covers only the named object, and a
+    # name-restricted rule never matches unnamed requests (list)
+    server.create(
+        "clusterroles",
+        v1.ClusterRole(
+            metadata=v1.ObjectMeta(name="one-secret", namespace=""),
+            rules=[
+                v1.PolicyRule(
+                    verbs=["get"],
+                    resources=["secrets"],
+                    resource_names=["the-cert"],
+                )
+            ],
+        ),
+    )
+    server.create(
+        "clusterrolebindings",
+        v1.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="bob-one-secret", namespace=""),
+            role_ref=v1.RoleRef(name="one-secret"),
+            subjects=[v1.Subject(kind="User", name="bob")],
+        ),
+    )
+    authz._obj_built_at = float("-inf")  # bust the TTL cache
+    assert authz.authorize(bob, "get", "secrets", "default", name="the-cert")
+    assert not authz.authorize(bob, "get", "secrets", "default", name="other")
+    assert not authz.authorize(bob, "get", "secrets", "default")
+    assert not authz.authorize(bob, "list", "secrets", "default")

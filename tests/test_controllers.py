@@ -232,7 +232,12 @@ def test_controller_manager_runs_all():
             "root-ca-cert-publisher",
             "replicationcontroller",
             "csrsigning",
+            "csrapproving",
+            "csrcleaner",
             "tokencleaner",
+            "bootstrapsigner",
+            "persistentvolume-expander",
+            "clusterrole-aggregation",
         }
     finally:
         mgr.stop()

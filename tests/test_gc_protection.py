@@ -284,8 +284,13 @@ def test_auth_can_i(capsys):
 
 def test_replication_controller_and_csr_signing():
     """RC shares the replicaset reconcile core; CSR approve+sign flow
-    (pkg/controller/replication + pkg/controller/certificates)."""
-    from kubernetes_tpu.controller.certificates import APPROVED, CSRSigningController
+    (pkg/controller/replication + pkg/controller/certificates — approver
+    and signer are separate loops, as in the reference)."""
+    from kubernetes_tpu.controller.certificates import (
+        APPROVED,
+        CSRApprovingController,
+        CSRSigningController,
+    )
     from kubernetes_tpu.controller.replicaset import (
         ReplicationControllerController,
     )
@@ -329,7 +334,9 @@ def test_replication_controller_and_csr_signing():
         ),
     )
     server.create("certificatesigningrequests", csr)
+    approver = CSRApprovingController(server)
     signer = CSRSigningController(server)
+    approver.start()
     signer.start()
     try:
         def signed():
@@ -341,4 +348,5 @@ def test_replication_controller_and_csr_signing():
 
         assert wait_until(signed), "bootstrap kubelet CSR must auto-approve + sign"
     finally:
+        approver.stop()
         signer.stop()

@@ -179,6 +179,31 @@ def test_wal_snapshot_compaction_and_torn_tail(tmp_path):
     assert len(pods) == 25  # torn record dropped, everything else intact
 
 
+def test_wal_recover_races_live_compaction(tmp_path):
+    """Regression: a reader whose snapshot read lands before a compaction
+    publish and whose log read lands after that compaction's log rewrite
+    silently lost the records in between (observed as 14/25 pods). The
+    staleness re-check must compare against the LOADED snapshot's rv —
+    replayed tail records can push the recovered rv past the new
+    snapshot's rv and mask the stale read."""
+    for trial in range(15):
+        path = str(tmp_path / f"c{trial}")
+        wal = WriteAheadLog(path, compact_every=10, fsync=False)
+        server = APIServer(wal=wal)
+        for i in range(25):
+            server.create("pods", make_pod(f"p{i}"))
+            if i == 12:
+                time.sleep(0.01)  # let the first compaction land mid-stream
+        server._maybe_compact()  # second compaction races the recover below
+        recovered = APIServer.recover(path)
+        pods, _ = recovered.list("pods")
+        assert len(pods) == 25, f"trial {trial}: lost {25 - len(pods)} records"
+        t0 = time.time()
+        while server._compacting.is_set() and time.time() - t0 < 10:
+            time.sleep(0.005)
+        wal.close()
+
+
 def test_wal_scheduler_end_to_end_restart(tmp_path):
     """Full crash-restart: scheduler + kubelet pool against a durable store;
     after 'crash', a fresh control plane on the recovered store sees the

@@ -41,7 +41,11 @@ def make_pod(name, cpu="100m", mem="128Mi", labels=None, **spec_kw):
     )
 
 
-def wait_scheduled(server, names, timeout=15.0):
+def wait_scheduled(server, names, timeout=60.0):
+    # generous: device-mode cases that flip kernel variants (e.g. hard
+    # anti-affinity pairs -> a different wave count) pay a fresh XLA
+    # compile on first use, which under a CPU-contended suite can take
+    # tens of seconds before the first pod places
     deadline = time.time() + timeout
     while time.time() < deadline:
         pods = {p.metadata.name: p for p in server.list("pods")[0]}
