@@ -15,7 +15,22 @@ from typing import List, Optional
 
 from ..api import serialization as codec
 from ..apiserver.client import RESTClient
-from ..client.apiserver import NotFound
+from ..client.apiserver import Conflict, NotFound
+
+
+def _update_with_retry(client: RESTClient, resource: str, mutate, ns: str, name: str):
+    """get → mutate → update, retrying version conflicts (the CLI's
+    RetryOnConflict helper): controllers touch status concurrently."""
+    for _ in range(5):
+        obj = client.get(resource, ns, name)
+        res = mutate(obj)
+        if res is None:
+            return obj
+        try:
+            return client.update(resource, obj)
+        except Conflict:
+            continue
+    raise SystemExit(f"error: conflict updating {resource}/{name} persisted")
 
 ALIASES = {
     "pod": "pods",
@@ -284,6 +299,272 @@ def cmd_rollout_status(client: RESTClient, args) -> int:
     return 1
 
 
+def _kv_edits(pairs: List[str]) -> tuple:
+    """kubectl's key=val / key- syntax → (sets dict, removes list)."""
+    sets, removes = {}, []
+    for p in pairs:
+        if p.endswith("-") and "=" not in p:
+            removes.append(p[:-1])
+        elif "=" in p:
+            k, _, val = p.partition("=")
+            sets[k] = val
+        else:
+            raise SystemExit(f"invalid key=value pair: {p!r}")
+    return sets, removes
+
+
+def cmd_label(client: RESTClient, args, field: str = "labels") -> int:
+    """kubectl label/annotate <resource> <name> k=v ... k- (cmd/label,
+    cmd/annotate)."""
+    resource = _resource(args.resource)
+    sets, removes = _kv_edits(args.pairs)
+    clobbered: List[str] = []
+
+    def mutate(obj):
+        target = getattr(obj.metadata, field)
+        if not args.overwrite:
+            clobbered[:] = [
+                k for k in sets if k in target and target[k] != sets[k]
+            ]
+            if clobbered:
+                return None
+        for k in removes:
+            target.pop(k, None)
+        target.update(sets)
+        return obj
+
+    _update_with_retry(client, resource, mutate, args.namespace, args.name)
+    if clobbered:
+        print(
+            f"error: {clobbered[0]} already has a value; --overwrite to replace",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"{resource}/{args.name} {'labeled' if field == 'labels' else 'annotated'}")
+    return 0
+
+
+def _merge_patch(obj, patch: dict) -> None:
+    """RFC 7386 merge-patch onto a decoded object (strategic-merge-lite:
+    dicts merge recursively, null deletes, everything else replaces).
+    Unknown fields are an error, as for a strategic merge on typed
+    objects — silently dropping them would report success for a typo."""
+    for key, val in patch.items():
+        snake = codec._snake(key)
+        if not hasattr(obj, snake):
+            raise SystemExit(
+                f"error: unknown field {key!r} in patch for {type(obj).__name__}"
+            )
+        cur = getattr(obj, snake)
+        if isinstance(val, dict) and hasattr(cur, "__dataclass_fields__"):
+            _merge_patch(cur, val)
+        elif isinstance(val, dict) and isinstance(cur, dict):
+            for k, v in val.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+        else:
+            import typing as _t
+
+            hints = _t.get_type_hints(type(obj))
+            setattr(obj, snake, codec.from_dict(hints[snake], val))
+
+
+def cmd_patch(client: RESTClient, args) -> int:
+    """kubectl patch <resource> <name> -p '<json>' (cmd/patch)."""
+    resource = _resource(args.resource)
+    try:
+        patch = json.loads(args.patch)
+    except json.JSONDecodeError as e:
+        print(f"error: invalid patch JSON: {e}", file=sys.stderr)
+        return 1
+    def mutate(obj):
+        _merge_patch(obj, patch)
+        return obj
+
+    _update_with_retry(client, resource, mutate, args.namespace, args.name)
+    print(f"{resource}/{args.name} patched")
+    return 0
+
+
+def _deployment_rses(client: RESTClient, ns: str, name: str):
+    """The deployment's owned ReplicaSets, oldest first."""
+    rses, _ = client.list("replicasets", namespace=ns)
+    owned = [
+        rs
+        for rs in rses
+        if any(
+            r.kind == "Deployment" and r.name == name and r.controller
+            for r in rs.metadata.owner_references
+        )
+    ]
+    owned.sort(key=lambda rs: rs.metadata.creation_timestamp)
+    return owned
+
+
+def cmd_rollout_history(client: RESTClient, args) -> int:
+    kind, _, name = args.target.partition("/")
+    if _resource(kind) != "deployments":
+        print("error: rollout supports deployments", file=sys.stderr)
+        return 1
+    print("REVISION  REPLICASET  TEMPLATE-HASH  REPLICAS")
+    for i, rs in enumerate(_deployment_rses(client, args.namespace, name), 1):
+        h = rs.metadata.labels.get("pod-template-hash", "")
+        print(f"{i:<9} {rs.metadata.name:<11} {h:<14} {rs.spec.replicas}")
+    return 0
+
+
+def cmd_rollout_restart(client: RESTClient, args) -> int:
+    """Bump the restartedAt template annotation: a new template hash rolls
+    every pod through the ordinary rolling-update machinery."""
+    import time as _time
+
+    kind, _, name = args.target.partition("/")
+    if _resource(kind) != "deployments":
+        print("error: rollout supports deployments", file=sys.stderr)
+        return 1
+    def mutate(d):
+        d.spec.template.metadata.annotations[
+            "kubectl.kubernetes.io/restartedAt"
+        ] = str(_time.time())
+        return d
+
+    _update_with_retry(client, "deployments", mutate, args.namespace, name)
+    print(f'deployment.apps/{name} restarted')
+    return 0
+
+
+def cmd_rollout_undo(client: RESTClient, args) -> int:
+    """Roll the deployment template back to the previous ReplicaSet's
+    (cmd/rollout undo)."""
+    import copy as _copy
+
+    kind, _, name = args.target.partition("/")
+    if _resource(kind) != "deployments":
+        print("error: rollout supports deployments", file=sys.stderr)
+        return 1
+    d = client.get("deployments", args.namespace, name)
+    from ..controller.deployment import template_hash
+
+    cur_hash = template_hash(d.spec.template)
+    history = [
+        rs
+        for rs in _deployment_rses(client, args.namespace, name)
+        if rs.metadata.labels.get("pod-template-hash") != cur_hash
+    ]
+    if not history:
+        print("error: no rollout history found", file=sys.stderr)
+        return 1
+    prev = history[-1]  # newest non-current revision
+
+    def mutate(dep):
+        tmpl = _copy.deepcopy(prev.spec.template)
+        tmpl.metadata.labels.pop("pod-template-hash", None)
+        dep.spec.template = tmpl
+        return dep
+
+    _update_with_retry(client, "deployments", mutate, args.namespace, name)
+    print(f"deployment.apps/{name} rolled back")
+    return 0
+
+
+def cmd_expose(client: RESTClient, args) -> int:
+    """kubectl expose deployment/<name> --port N (cmd/expose): create a
+    Service selecting the workload's pods."""
+    from ..api import objects as v1
+
+    kind, _, name = args.target.partition("/")
+    resource = _resource(kind)
+    if resource not in ("deployments", "replicasets", "replicationcontrollers"):
+        print(f"error: cannot expose {resource}", file=sys.stderr)
+        return 1
+    obj = client.get(resource, args.namespace, name)
+    selector = dict(obj.spec.selector)
+    selector.pop("pod-template-hash", None)
+    svc = v1.Service(
+        metadata=v1.ObjectMeta(name=args.name or name, namespace=args.namespace),
+        spec=v1.ServiceSpec(
+            selector=selector, ports=[(args.protocol, args.port)]
+        ),
+    )
+    client.create("services", svc)
+    print(f"service/{svc.metadata.name} exposed")
+    return 0
+
+
+def cmd_wait(client: RESTClient, args) -> int:
+    """kubectl wait <resource> <name> --for=delete|condition=X[=V]
+    (cmd/wait)."""
+    import time as _time
+
+    resource = _resource(args.resource)
+    spec = args.wait_for
+    if spec != "delete" and not spec.startswith("condition="):
+        print(
+            f"error: unsupported --for {spec!r} (use delete or "
+            "condition=<Type>[=<Value>])",
+            file=sys.stderr,
+        )
+        return 1
+    deadline = _time.time() + args.timeout
+    while _time.time() < deadline:
+        try:
+            obj = client.get(resource, args.namespace, args.name)
+        except NotFound:
+            if spec == "delete":
+                print(f"{resource}/{args.name} condition met")
+                return 0
+            _time.sleep(0.2)
+            continue
+        if spec != "delete" and spec.startswith("condition="):
+            _, _, cond = spec.partition("=")
+            cond, _, want = cond.partition("=")
+            want = want or "True"
+            conds = getattr(obj.status, "conditions", [])
+            if any(c.type == cond and c.status == want for c in conds):
+                print(f"{resource}/{args.name} condition met")
+                return 0
+        _time.sleep(0.2)
+    print(f"error: timed out waiting for {spec}", file=sys.stderr)
+    return 1
+
+
+def cmd_explain(client: RESTClient, args) -> int:
+    """kubectl explain <resource>[.field...]: field names + types from the
+    dataclass model (the build's OpenAPI stand-in)."""
+    import dataclasses as _dc
+    import typing as _t
+
+    path = args.resource.split(".")
+    resource = _resource(path[0])
+    cls = codec.RESOURCE_KINDS.get(resource)
+    if cls is None:
+        print(f"error: unknown resource {path[0]}", file=sys.stderr)
+        return 1
+    for seg in path[1:]:
+        hints = _t.get_type_hints(cls)
+        if seg not in hints:
+            print(f"error: field {seg!r} not found in {cls.__name__}", file=sys.stderr)
+            return 1
+        nxt = hints[seg]
+        origin = _t.get_origin(nxt)
+        if origin in (list, tuple, dict):
+            nxt = (_t.get_args(nxt) or (object,))[-1]
+        if _t.get_origin(nxt) is _t.Union:
+            nxt = next(a for a in _t.get_args(nxt) if a is not type(None))
+        cls = nxt
+    print(f"KIND: {cls.__name__ if hasattr(cls, '__name__') else cls}")
+    if _dc.is_dataclass(cls):
+        print("FIELDS:")
+        hints = _t.get_type_hints(cls)
+        for f in _dc.fields(cls):
+            tp = hints[f.name]
+            tname = getattr(tp, "__name__", None) or str(tp).replace("typing.", "")
+            print(f"  {f.name:<28} <{tname}>")
+    return 0
+
+
 def cmd_drain(client: RESTClient, args) -> int:
     """kubectl drain: cordon, then EVICT every non-daemon pod off the node
     through the PDB-respecting eviction subresource, retrying 429s until
@@ -408,9 +689,35 @@ def main(argv=None) -> int:
     p_scale.add_argument("name")
     p_scale.add_argument("--replicas", type=int, required=True)
     p_roll = sub.add_parser("rollout")
-    p_roll.add_argument("action")  # status
+    p_roll.add_argument("action")  # status | history | restart | undo
     p_roll.add_argument("target")  # deployment/<name>
     p_roll.add_argument("--timeout", type=float, default=60.0)
+    p_label = sub.add_parser("label")
+    p_label.add_argument("resource")
+    p_label.add_argument("name")
+    p_label.add_argument("pairs", nargs="+")  # k=v or k-
+    p_label.add_argument("--overwrite", action="store_true")
+    p_ann = sub.add_parser("annotate")
+    p_ann.add_argument("resource")
+    p_ann.add_argument("name")
+    p_ann.add_argument("pairs", nargs="+")
+    p_ann.add_argument("--overwrite", action="store_true")
+    p_patch = sub.add_parser("patch")
+    p_patch.add_argument("resource")
+    p_patch.add_argument("name")
+    p_patch.add_argument("-p", "--patch", required=True)
+    p_expose = sub.add_parser("expose")
+    p_expose.add_argument("target")  # deployment/<name>
+    p_expose.add_argument("--port", type=int, required=True)
+    p_expose.add_argument("--protocol", default="TCP")
+    p_expose.add_argument("--name", default="")
+    p_wait = sub.add_parser("wait")
+    p_wait.add_argument("resource")
+    p_wait.add_argument("name")
+    p_wait.add_argument("--for", dest="wait_for", required=True)
+    p_wait.add_argument("--timeout", type=float, default=30.0)
+    p_explain = sub.add_parser("explain")
+    p_explain.add_argument("resource")  # resource[.field.path]
     p_drain = sub.add_parser("drain")
     p_drain.add_argument("name")
     p_drain.add_argument("--timeout", type=float, default=60.0)
@@ -448,10 +755,31 @@ def main(argv=None) -> int:
         if args.verb == "scale":
             return cmd_scale(client, args)
         if args.verb == "rollout":
-            if args.action != "status":
-                print("error: only 'rollout status' is supported", file=sys.stderr)
-                return 1
-            return cmd_rollout_status(client, args)
+            if args.action == "status":
+                return cmd_rollout_status(client, args)
+            if args.action == "history":
+                return cmd_rollout_history(client, args)
+            if args.action == "restart":
+                return cmd_rollout_restart(client, args)
+            if args.action == "undo":
+                return cmd_rollout_undo(client, args)
+            print(
+                "error: rollout supports status|history|restart|undo",
+                file=sys.stderr,
+            )
+            return 1
+        if args.verb == "label":
+            return cmd_label(client, args, "labels")
+        if args.verb == "annotate":
+            return cmd_label(client, args, "annotations")
+        if args.verb == "patch":
+            return cmd_patch(client, args)
+        if args.verb == "expose":
+            return cmd_expose(client, args)
+        if args.verb == "wait":
+            return cmd_wait(client, args)
+        if args.verb == "explain":
+            return cmd_explain(client, args)
         if args.verb == "drain":
             return cmd_drain(client, args)
         if args.verb == "auth":

@@ -26,11 +26,64 @@ from .batch import EncodedBatch, encode_pod_batch
 from .encoding import PodBatch, SnapshotEncoder
 
 
-def pod_fingerprint(pod: v1.Pod) -> Tuple:
+def _own_selector_matches(pod: v1.Pod) -> Tuple:
+    """Whether each of the pod's OWN term selectors matches its own labels
+    (the encodings' aff_self/spr_self bits), in deterministic term order."""
+    labels = pod.metadata.labels
+    out = []
+    aff = pod.spec.affinity
+    if aff is not None:
+        for pa in (aff.pod_affinity, aff.pod_anti_affinity):
+            if pa is None:
+                continue
+            for term in pa.required:
+                sel = term.label_selector
+                out.append(sel is not None and sel.matches(labels))
+            for wt in pa.preferred:
+                sel = wt.term.label_selector
+                out.append(sel is not None and sel.matches(labels))
+    for c in pod.spec.topology_spread_constraints:
+        sel = c.label_selector
+        out.append(sel is not None and sel.matches(labels))
+    return tuple(out)
+
+
+def _label_effect_key(encoder: SnapshotEncoder, pod: v1.Pod) -> Tuple:
+    """Labels as the ENCODING sees them: which interned predicates (selector
+    vocab + existing-pod term vocab) match, plus the pod's own-term
+    self-matches. Two pods whose labels differ only in ways no predicate
+    observes — e.g. 300 gangs distinguished solely by a group-name label —
+    collapse to one template instead of 300 (each extra template count is
+    another XLA variant; a 15k-pod gang burst compiled per batch without
+    this). Vocab lengths are part of the key so growth never aliases masks
+    across vocab versions."""
+    ns, labels = pod.metadata.namespace, pod.metadata.labels
+    sel_mask = 0
+    for i, pred in enumerate(encoder.sel_vocab.items):
+        if pred.matches(ns, labels):
+            sel_mask |= 1 << i
+    et_mask = 0
+    for i, et in enumerate(encoder.eterm_vocab.items):
+        if et.predicate.matches(ns, labels):
+            et_mask |= 1 << i
+    return (
+        "enc",
+        len(encoder.sel_vocab),
+        len(encoder.eterm_vocab),
+        sel_mask,
+        et_mask,
+        _own_selector_matches(pod),
+    )
+
+
+def pod_fingerprint(pod: v1.Pod, encoder: Optional[SnapshotEncoder] = None) -> Tuple:
     """Structural key over every field the device encoding depends on.
 
     Everything here is hashable: dataclasses in api/objects.py that feed the
-    encoder are frozen, labels/node_selector collapse to frozensets."""
+    encoder are frozen, labels/node_selector collapse to frozensets. With an
+    encoder, raw labels are replaced by their encoded effect (see
+    _label_effect_key) so scheduling-irrelevant label diversity doesn't
+    multiply templates."""
     spec = pod.spec
     containers = tuple(
         (
@@ -70,7 +123,11 @@ def pod_fingerprint(pod: v1.Pod) -> Tuple:
     )
     return (
         pod.metadata.namespace,
-        frozenset(pod.metadata.labels.items()),
+        (
+            _label_effect_key(encoder, pod)
+            if encoder is not None
+            else frozenset(pod.metadata.labels.items())
+        ),
         containers,
         inits,
         tuple(sorted(spec.overhead.items())),
@@ -145,41 +202,53 @@ class TemplateCache:
     ) -> EncodedTemplateBatch:
         P = pad_to or max(1, len(pods))
         assert len(pods) <= P
-        # pass 1: fingerprint; collect templates needing encoding
-        fps = [pod_fingerprint(p) for p in pods]
-        changed = False
-        for pod, fp in zip(pods, fps):
-            if fp not in self._rows:
-                self._rows[fp] = len(self._exemplars)
-                self._exemplars.append(pod)
-                changed = True
-        if len(self._exemplars) > self.max_templates:
-            # template churn: rebuild the cache from this batch's templates
-            # only (rare; steady workloads have a stable template set)
-            first_by_fp: Dict[Tuple, v1.Pod] = {}
+        # Fingerprint + encode to a FIXED POINT of the vocabularies:
+        # encoding a batch's templates can intern new predicates (a pod's
+        # own affinity terms), and fingerprints taken BEFORE that interning
+        # may have collapsed pods the new predicate distinguishes — the
+        # kernel would then see one pod wearing another's label masks.
+        # Vocabs only grow and re-encoding the same exemplars interns
+        # nothing new, so this converges in <= 2 extra passes.
+        for _ in range(4):
+            sig0 = self._sig()
+            fps = [pod_fingerprint(p, self.encoder) for p in pods]
+            changed = False
             for pod, fp in zip(pods, fps):
-                first_by_fp.setdefault(fp, pod)
-            uniq = list(first_by_fp)
-            self._rows = {fp: i for i, fp in enumerate(uniq)}
-            self._exemplars = [first_by_fp[fp] for fp in uniq]
-            changed = True
+                if fp not in self._rows:
+                    self._rows[fp] = len(self._exemplars)
+                    self._exemplars.append(pod)
+                    changed = True
+            if len(self._exemplars) > self.max_templates:
+                # template churn: rebuild the cache from this batch's
+                # templates only (rare; steady workloads have a stable set)
+                first_by_fp: Dict[Tuple, v1.Pod] = {}
+                for pod, fp in zip(pods, fps):
+                    first_by_fp.setdefault(fp, pod)
+                uniq = list(first_by_fp)
+                self._rows = {fp: i for i, fp in enumerate(uniq)}
+                self._exemplars = [first_by_fp[fp] for fp in uniq]
+                changed = True
 
-        if self._sig() != self._vocab_sig or changed:
-            # (re-)encode every template with current vocabularies
-            eb = encode_pod_batch(
-                self.encoder, self._exemplars, pad_to=self._pad(len(self._exemplars))
-            )
-            # encoding may have grown vocabs again; encode once more if so
-            if self._sig() != self._vocab_sig:
+            if self._sig() != self._vocab_sig or changed:
+                # (re-)encode every template with current vocabularies
                 eb = encode_pod_batch(
                     self.encoder,
                     self._exemplars,
                     pad_to=self._pad(len(self._exemplars)),
                 )
                 self._vocab_sig = self._sig()
-            self._tpl_batch = eb.batch
-            self._tpl_batch_np = eb.batch_np
-            self._fallback = list(eb.fallback[: len(self._exemplars)])
+                self._tpl_batch = eb.batch
+                self._tpl_batch_np = eb.batch_np
+                self._fallback = list(eb.fallback[: len(self._exemplars)])
+            if self._sig() == sig0:
+                break  # no interning this pass: fingerprints are current
+            # interning happened: vocab lengths are embedded in every
+            # fingerprint, so EVERY cached row is now dead weight — drop
+            # them and rebuild from this batch (other batches' templates
+            # re-register on their next encode)
+            self._rows = {}
+            self._exemplars = []
+            self._fallback = []
 
         pod_tpl = np.full(P, -1, np.int32)
         pod_valid = np.zeros(P, np.bool_)

@@ -501,7 +501,9 @@ class Scheduler:
         while True:
             with self.cache.lock, _stage_timer("encode"):
                 eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
+                trace.step("tpl-encode")
                 ptab, n_waves = self._pair_table(eb)
+                trace.step("pair-table")
                 if (
                     self._pending is None
                     or not self.cache.encoder.has_pending_updates
@@ -511,7 +513,7 @@ class Scheduler:
                     row_names = list(self.cache.encoder.row_names)
                     break
             self._resolve_pending()
-        trace.step("encoded+flushed")
+        trace.step("flush")
         if self._mesh is not None:
             from ..parallel.sharded import make_sharded_wave_kernel
 
@@ -537,6 +539,7 @@ class Scheduler:
         except Exception:
             self.cache.encoder.invalidate_device()
             raise
+        trace.step("launch")
         with self.cache.lock:
             self.cache.encoder.set_device_snapshot(new_snap)
         prev, self._pending = self._pending, _InFlightBatch(
@@ -611,6 +614,7 @@ class Scheduler:
                 failed.append((pi, i))
 
         self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
+        trace.step("assume+bind")
         if fallback_pis or failed:
             # the host paths below read the host cache; a NEWER in-flight
             # batch holds device-committed placements the cache can't see

