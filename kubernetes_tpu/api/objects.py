@@ -230,12 +230,34 @@ class ContainerPort:
 
 
 @dataclass
+class Probe:
+    """v1.Probe (types.go): the handler itself is the runtime's health
+    check in this build — the kubelet asks the PodRuntime, the way the
+    reference's prober execs/GETs into the container."""
+
+    period_seconds: float = 10.0
+    initial_delay_seconds: float = 0.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    ready: bool = False
+    restart_count: int = 0
+    state: str = "running"  # waiting | running | terminated
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
     requests: Dict[str, Quantity] = field(default_factory=dict)
     limits: Dict[str, Quantity] = field(default_factory=dict)
     ports: List[ContainerPort] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
 
 @dataclass
@@ -331,6 +353,7 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 
 COND_POD_SCHEDULED = "PodScheduled"
+COND_POD_READY = "Ready"
 
 
 @dataclass
@@ -352,6 +375,7 @@ class PodStatus:
     start_time: Optional[float] = None
     pod_ip: str = ""  # set by the node agent once the sandbox is up
     host_ip: str = ""
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
 
 
 @dataclass
@@ -412,6 +436,8 @@ def _copy_container(c: Container) -> Container:
             ContainerPort(p.container_port, p.host_port, p.protocol, p.host_ip)
             for p in c.ports
         ],
+        liveness_probe=c.liveness_probe,  # Probe is treated as immutable
+        readiness_probe=c.readiness_probe,
     )
 
 
@@ -469,6 +495,10 @@ def _copy_pod_status(st: PodStatus) -> PodStatus:
         start_time=st.start_time,
         pod_ip=st.pod_ip,
         host_ip=st.host_ip,
+        container_statuses=[
+            ContainerStatus(cs.name, cs.ready, cs.restart_count, cs.state)
+            for cs in st.container_statuses
+        ],
     )
 
 

@@ -197,10 +197,14 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    _request_user = None  # per-request memo set by _limited's APF path
+
     def _authenticate(self):
         """(user, ok): resolve the request identity. ok=False means a 401
         was already written. user is None only on the insecure port (no
         authenticator configured)."""
+        if self._request_user is not None:
+            return self._request_user
         authn = self.server.authenticator
         if authn is None:
             return None, True
@@ -324,8 +328,35 @@ class _Handler(BaseHTTPRequestHandler):
         return q.get("watch", ["0"])[-1] in ("1", "true")
 
     def _limited(self, handler):
+        """WithPriorityAndFairness when a FlowController is configured,
+        else WithMaxInFlightLimit, else unlimited (insecure dev port).
+        Request order through the chain matches DefaultBuildHandlerChain:
+        authn happens before flow classification, authz after."""
+        fc = getattr(self.server, "flow", None)
+        if self._is_long_running():
+            return handler()
+        if fc is not None:
+            from .flowcontrol import RequestRejected
+
+            user, ok = self._authenticate()
+            if not ok:
+                return
+            resource, _, _, _ = self._parse()
+            try:
+                lv = fc.begin(user, resource or "", self.command.lower())
+            except RequestRejected as e:
+                return self._status_error(429, "TooManyRequests", str(e))
+            # the handler's _authorize re-resolves the identity; cache the
+            # classification's result for this one request (cleared below:
+            # keep-alive connections reuse the handler across requests)
+            self._request_user = (user, True)
+            try:
+                return handler()
+            finally:
+                self._request_user = None
+                fc.end(lv)
         sem = self.server.inflight
-        if sem is None or self._is_long_running():
+        if sem is None:
             return handler()
         if not sem.acquire(blocking=False):
             return self._status_error(
@@ -566,13 +597,23 @@ class APIServerHTTP(ThreadingHTTPServer):
         authenticator=None,
         authorizer=None,
         max_in_flight: int = 400,
+        priority_and_fairness: bool = True,
     ):
         super().__init__(addr, _Handler)
         self.store = store
         self.authenticator = authenticator  # None = insecure port semantics
         self.authorizer = authorizer
-        # WithMaxInFlightLimit (config.go:662-666): bounded concurrent
-        # non-watch requests; 0/None disables
+        # WithPriorityAndFairness over the same total budget; falls back to
+        # WithMaxInFlightLimit (config.go:662-666) when disabled. 0/None
+        # max_in_flight disables both
+        self.flow = None
+        # APF needs identities to classify; on the insecure port every
+        # request would be anonymous and the whole server would collapse
+        # into global-default's share — fall back to the plain limiter
+        if max_in_flight and priority_and_fairness and authenticator is not None:
+            from .flowcontrol import FlowController
+
+            self.flow = FlowController(total_concurrency=max_in_flight)
         self.inflight = (
             threading.BoundedSemaphore(max_in_flight) if max_in_flight else None
         )
@@ -589,6 +630,7 @@ def serve(
     authenticator=None,
     authorizer=None,
     max_in_flight: int = 400,
+    priority_and_fairness: bool = True,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store).
     max_in_flight=0 disables the in-flight limiter."""
@@ -599,6 +641,7 @@ def serve(
         authenticator,
         authorizer,
         max_in_flight=max_in_flight,
+        priority_and_fairness=priority_and_fairness,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

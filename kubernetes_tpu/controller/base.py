@@ -170,6 +170,12 @@ from ..api.selectors import match_labels  # noqa: E402 — re-export for control
 
 
 def pod_is_ready(pod: v1.Pod) -> bool:
-    """Running phase stands in for the Ready condition (the node agent sets
-    phases; reference controllers check podutil.IsPodReady)."""
-    return pod.status.phase == v1.POD_RUNNING
+    """podutil.IsPodReady: the Ready condition when the node agent posts
+    one (readiness probes), else Running phase stands in (pods with no
+    probe are Ready as soon as they run)."""
+    if pod.status.phase != v1.POD_RUNNING:
+        return False
+    for c in pod.status.conditions:
+        if c.type == v1.COND_POD_READY:
+            return c.status == "True"
+    return True

@@ -48,6 +48,45 @@ def make_node_object(
     )
 
 
+class _ProbeWorker:
+    """One (pod, kind) prober (pkg/kubelet/prober/worker.go): tracks
+    consecutive results against the probe's thresholds."""
+
+    __slots__ = ("probe", "started", "last_run", "succ", "fail", "result")
+
+    def __init__(self, probe: v1.Probe, now: float):
+        self.probe = probe
+        self.started = now
+        self.last_run = float("-inf")
+        self.succ = 0
+        self.fail = 0
+        # readiness starts False (pod not Ready until the probe passes);
+        # liveness starts True (a container is assumed live until proven
+        # otherwise) — prober/worker.go initialValue
+        self.result: bool = False
+
+    def due(self, now: float) -> bool:
+        if now - self.started < self.probe.initial_delay_seconds:
+            return False
+        return now - self.last_run >= self.probe.period_seconds
+
+    def observe(self, ok: bool, now: float) -> bool:
+        """Record one probe result; returns the (possibly flipped)
+        effective result."""
+        self.last_run = now
+        if ok:
+            self.succ += 1
+            self.fail = 0
+            if self.succ >= self.probe.success_threshold:
+                self.result = True
+        else:
+            self.fail += 1
+            self.succ = 0
+            if self.fail >= self.probe.failure_threshold:
+                self.result = False
+        return self.result
+
+
 class Kubelet:
     """One node's agent. Thread-free: the pool (or a test) drives it via
     handle_pod_event / housekeeping / heartbeat."""
@@ -64,6 +103,9 @@ class Kubelet:
         self.runtime = runtime
         self.host_ip = host_ip  # the node's address (same for all its pods)
         self._known: Dict[str, str] = {}  # pod key -> last posted phase
+        self._specs: Dict[str, v1.Pod] = {}  # pod key -> last seen spec
+        # prober bookkeeping (pkg/kubelet/prober): (key, kind) -> worker
+        self._probes: Dict[tuple, _ProbeWorker] = {}
 
     # -- pod lifecycle (syncPod, kubelet.go:1482) ----------------------------
 
@@ -74,16 +116,29 @@ class Kubelet:
         if ev_type == "DELETED":
             self.runtime.kill_pod(key)
             self._known.pop(key, None)
+            self._forget_probes(key)
             return
         if pod.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
             # terminal: runtime resources are reclaimed, status stands
             self.runtime.kill_pod(key)
             self._known[key] = pod.status.phase
+            self._forget_probes(key)
             return
+        self._specs[key] = pod
         if key not in self._known:
             ip = self.runtime.run_pod(pod)
             self._known[key] = v1.POD_RUNNING
-            self._post_status(pod, v1.POD_RUNNING, ip)
+            # phase and the initial Ready verdict land in ONE status write:
+            # posting them separately opens a window where Running exists
+            # with no Ready condition and pod_is_ready() defaults to True —
+            # endpoints would briefly publish a warming-up pod
+            self._post_status(
+                pod,
+                v1.POD_RUNNING,
+                ip,
+                ready=self._probe_of(pod, "readiness") is None,
+            )
+            self._start_probes(pod, post_ready=False)
 
     def housekeeping(self) -> None:
         """PLEG relist → post phase transitions (pleg/generic.go 1s relist)."""
@@ -96,19 +151,144 @@ class Kubelet:
             except NotFound:
                 self.runtime.kill_pod(key)
                 self._known.pop(key, None)
+                self._forget_probes(key)
                 continue
             self._known[key] = phase
             if phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
                 self.runtime.kill_pod(key)
+                self._forget_probes(key)
                 self._post_status(pod, phase, None)
+        self.run_probes()
 
-    def _post_status(self, pod: v1.Pod, phase: str, ip: Optional[str]) -> None:
+    # -- probes (pkg/kubelet/prober) -----------------------------------------
+
+    @staticmethod
+    def _probe_of(pod: v1.Pod, kind: str):
+        """Effective pod-level probe: the runtime health channel is
+        pod-scoped (one sandbox verdict per kind), so multiple containers'
+        probes collapse to the STRICTEST combination — shortest period,
+        longest warmup, fewest failures tolerated, most successes
+        required. (The reference ANDs per-container results; with a
+        pod-scoped runtime the strictest-config collapse is the closest
+        sound equivalent.)"""
+        attr = "readiness_probe" if kind == "readiness" else "liveness_probe"
+        probes = [getattr(c, attr) for c in pod.spec.containers if getattr(c, attr)]
+        if not probes:
+            return None
+        if len(probes) == 1:
+            return probes[0]
+        return v1.Probe(
+            period_seconds=min(p.period_seconds for p in probes),
+            initial_delay_seconds=max(p.initial_delay_seconds for p in probes),
+            failure_threshold=min(p.failure_threshold for p in probes),
+            success_threshold=max(p.success_threshold for p in probes),
+        )
+
+    def _start_probes(
+        self, pod: v1.Pod, now: Optional[float] = None, post_ready: bool = True
+    ) -> None:
+        now = now if now is not None else time.monotonic()
+        key = pod.metadata.key
+        for kind in ("readiness", "liveness"):
+            p = self._probe_of(pod, kind)
+            if p is not None:
+                w = _ProbeWorker(p, now)
+                if kind == "liveness":
+                    w.result = True
+                self._probes[(key, kind)] = w
+        if post_ready:
+            # restart path: the restarted container warms up again, so a
+            # probe-bearing pod drops out of Ready; probe-less pods are
+            # Ready whenever Running (status_manager)
+            self._post_ready(pod, (key, "readiness") not in self._probes)
+
+    def _forget_probes(self, key: str) -> None:
+        self._specs.pop(key, None)
+        self._probes.pop((key, "readiness"), None)
+        self._probes.pop((key, "liveness"), None)
+
+    def run_probes(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        for (key, kind), w in list(self._probes.items()):
+            if self._known.get(key) != v1.POD_RUNNING or not w.due(now):
+                continue
+            pod = self._specs.get(key)
+            if pod is None:
+                continue
+            before = w.result
+            after = w.observe(self.runtime.probe(key, kind), now)
+            if kind == "readiness":
+                if after != before:
+                    self._post_ready(pod, after)
+            elif before and not after:
+                # liveness remediation: restart the containers in place
+                # (restart policy Always semantics), count it, and reset
+                # both probes — the restarted container warms up again
+                self.runtime.restart_pod(key)
+                self._bump_restart_count(pod)
+                self._start_probes(pod, now)
+
+    def _post_ready(self, pod: v1.Pod, ready: bool) -> None:
+        status = "True" if ready else "False"
+
+        def mutate(p):
+            if p.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
+                return None
+            for c in p.status.conditions:
+                if c.type == v1.COND_POD_READY:
+                    if c.status == status:
+                        return None
+                    c.status = status
+                    c.last_transition_time = time.time()
+                    return p
+            p.status.conditions.append(
+                v1.PodCondition(type=v1.COND_POD_READY, status=status)
+            )
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            pass
+
+    def _bump_restart_count(self, pod: v1.Pod) -> None:
+        names = [c.name or f"c{i}" for i, c in enumerate(pod.spec.containers)]
+
+        def mutate(p):
+            if not p.status.container_statuses:
+                p.status.container_statuses = [
+                    v1.ContainerStatus(name=n, ready=False) for n in names
+                ]
+            for cs in p.status.container_statuses:
+                cs.restart_count += 1
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            pass
+
+    def _post_status(
+        self,
+        pod: v1.Pod,
+        phase: str,
+        ip: Optional[str],
+        ready: Optional[bool] = None,
+    ) -> None:
         def mutate(p):
             if p.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
                 # never regress a terminal phase (a stale watch snapshot
                 # racing a completed pod must not flip it back to Running)
                 return None
-            if p.status.phase == phase and (ip is None or p.status.pod_ip == ip):
+            if (
+                p.status.phase == phase
+                and (ip is None or p.status.pod_ip == ip)
+                and ready is None
+            ):
                 return None
             p.status.phase = phase
             if p.status.start_time is None:
@@ -116,6 +296,16 @@ class Kubelet:
             if ip is not None:
                 p.status.pod_ip = ip
                 p.status.host_ip = self.host_ip or ip
+            if ready is not None:
+                status = "True" if ready else "False"
+                for c in p.status.conditions:
+                    if c.type == v1.COND_POD_READY:
+                        c.status = status
+                        break
+                else:
+                    p.status.conditions.append(
+                        v1.PodCondition(type=v1.COND_POD_READY, status=status)
+                    )
             return p
 
         try:

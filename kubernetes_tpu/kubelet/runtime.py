@@ -19,6 +19,8 @@ from ..api import objects as v1
 # annotations understood by FakeRuntime (test/kubemark scripting)
 ANN_RUN_SECONDS = "kubelet.fake/run-seconds"  # complete after N seconds
 ANN_FAIL = "kubelet.fake/fail"  # terminal phase Failed instead of Succeeded
+ANN_READY_AFTER = "kubelet.fake/ready-after"  # readiness passes after N s
+ANN_UNHEALTHY_AFTER = "kubelet.fake/unhealthy-after"  # liveness fails after N s
 
 
 class PodRuntime:
@@ -37,15 +39,34 @@ class PodRuntime:
         / POD_FAILED."""
         raise NotImplementedError
 
+    def probe(self, pod_key: str, kind: str) -> bool:
+        """Health check backing the kubelet's prober (pkg/probe): kind is
+        'liveness' or 'readiness'. Unknown pods fail both."""
+        return pod_key in self.relist()
+
+    def restart_pod(self, pod_key: str) -> None:
+        """Liveness remediation: restart the pod's containers in place
+        (kill + recreate, same sandbox — kuberuntime's container restart).
+        Default: no-op."""
+
 
 class _FakePod:
-    __slots__ = ("ip", "started", "run_seconds", "fail")
+    __slots__ = ("ip", "started", "run_seconds", "fail", "ready_after", "unhealthy_after")
 
-    def __init__(self, ip: str, run_seconds: Optional[float], fail: bool):
+    def __init__(
+        self,
+        ip: str,
+        run_seconds: Optional[float],
+        fail: bool,
+        ready_after: float = 0.0,
+        unhealthy_after: Optional[float] = None,
+    ):
         self.ip = ip
         self.started = time.monotonic()
         self.run_seconds = run_seconds
         self.fail = fail
+        self.ready_after = ready_after
+        self.unhealthy_after = unhealthy_after
 
 
 class FakeRuntime(PodRuntime):
@@ -60,10 +81,13 @@ class FakeRuntime(PodRuntime):
     def run_pod(self, pod: v1.Pod) -> str:
         ann = pod.metadata.annotations
         run_s = ann.get(ANN_RUN_SECONDS)
+        unh = ann.get(ANN_UNHEALTHY_AFTER)
         fp = _FakePod(
             ip=self._ip_alloc(pod.metadata.uid),
             run_seconds=float(run_s) if run_s is not None else None,
             fail=ann.get(ANN_FAIL, "") not in ("", "false"),
+            ready_after=float(ann.get(ANN_READY_AFTER, "0")),
+            unhealthy_after=float(unh) if unh is not None else None,
         )
         with self._lock:
             self._pods[pod.metadata.key] = fp
@@ -72,6 +96,25 @@ class FakeRuntime(PodRuntime):
     def kill_pod(self, pod_key: str) -> None:
         with self._lock:
             self._pods.pop(pod_key, None)
+
+    def probe(self, pod_key: str, kind: str) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            fp = self._pods.get(pod_key)
+            if fp is None:
+                return False
+            age = now - fp.started
+            if kind == "readiness":
+                return age >= fp.ready_after
+            return fp.unhealthy_after is None or age < fp.unhealthy_after
+
+    def restart_pod(self, pod_key: str) -> None:
+        # container restart resets the clocks: readiness warms up again and
+        # an unhealthy-after script becomes unhealthy again after the delay
+        with self._lock:
+            fp = self._pods.get(pod_key)
+            if fp is not None:
+                fp.started = time.monotonic()
 
     def relist(self) -> Dict[str, str]:
         now = time.monotonic()

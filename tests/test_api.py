@@ -204,3 +204,71 @@ def test_structural_copy_deep_isolation():
     assert pod.spec.node_selector["d"] == "ssd"
     assert pod.spec.volumes[0].persistent_volume_claim == "c"
     assert pod.spec.node_name == ""
+
+
+def test_fast_deepcopy_covers_every_field():
+    """The hand-written structural __deepcopy__ for Pod/Node must track the
+    dataclass field sets — a field it misses silently reverts to default on
+    every store round-trip (found live: probes vanished from containers).
+    Populate every field with a non-default value and diff the wire form."""
+    import copy
+    import dataclasses
+    import typing
+
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.api import serialization
+
+    def populate(cls, depth=0):
+        """Instance with a non-default value for EVERY field (best effort,
+        bounded depth)."""
+        if depth > 6:
+            return cls()
+        hints = typing.get_type_hints(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            tp = hints[f.name]
+            origin = typing.get_origin(tp)
+            args = typing.get_args(tp)
+            if origin is typing.Union:
+                tp = next(a for a in args if a is not type(None))
+                origin = typing.get_origin(tp)
+                args = typing.get_args(tp)
+            try:
+                if tp is str:
+                    kwargs[f.name] = f"x-{f.name}"
+                elif tp is int:
+                    kwargs[f.name] = 7
+                elif tp is float:
+                    kwargs[f.name] = 7.5
+                elif tp is bool:
+                    kwargs[f.name] = True
+                elif origin is list:
+                    item = args[0] if args else str
+                    kwargs[f.name] = [
+                        populate(item, depth + 1)
+                        if dataclasses.is_dataclass(item)
+                        else ("v" if item is str else 3)
+                    ]
+                elif origin is dict:
+                    vt = args[1] if len(args) > 1 else str
+                    kwargs[f.name] = {
+                        "k": "v" if vt is not int else 3
+                    }
+                elif dataclasses.is_dataclass(tp):
+                    kwargs[f.name] = populate(tp, depth + 1)
+            except Exception:
+                continue  # unpopulatable exotic field: skip
+        try:
+            return cls(**kwargs)
+        except Exception:
+            return cls()
+
+    for cls in (v1.Pod, v1.Node):
+        obj = populate(cls)
+        copied = copy.deepcopy(obj)
+        a = serialization.encode(obj)
+        b = serialization.encode(copied)
+        assert a == b, (
+            f"{cls.__name__} fast deepcopy dropped fields: "
+            f"{ {k: a[k] for k in a if b.get(k) != a[k]} }"
+        )

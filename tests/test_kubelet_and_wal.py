@@ -248,3 +248,121 @@ def test_wal_scheduler_end_to_end_restart(tmp_path):
     finally:
         sched2.stop()
         pool2.stop()
+
+
+# ---------------------------------------------------------------------------
+# probes (pkg/kubelet/prober)
+# ---------------------------------------------------------------------------
+
+
+def _probe_pod(name, annotations, readiness=None, liveness=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, annotations=annotations),
+        spec=v1.PodSpec(
+            containers=[
+                v1.Container(
+                    name="c0",
+                    requests={"cpu": "100m"},
+                    readiness_probe=readiness,
+                    liveness_probe=liveness,
+                )
+            ]
+        ),
+    )
+
+
+def test_readiness_probe_gates_ready_condition_and_endpoints():
+    from kubernetes_tpu.controller.endpoints import EndpointsController
+    from kubernetes_tpu.kubelet.runtime import ANN_READY_AFTER
+
+    server = APIServer()
+    pool = NodeAgentPool(server, housekeeping_interval=0.05)
+    pool.add_node("node-0")
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    epc = EndpointsController(server)
+    pool.start()
+    sched.start()
+    epc.start()
+    try:
+        server.create(
+            "services",
+            v1.Service(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.ServiceSpec(selector={"app": "web"}),
+            ),
+        )
+        p = _probe_pod(
+            "warm",
+            {ANN_READY_AFTER: "0.6"},
+            readiness=v1.Probe(period_seconds=0.05, failure_threshold=1),
+        )
+        p.metadata.labels = {"app": "web"}
+        server.create("pods", p)
+        # runs, but NOT Ready during warmup: condition False, endpoints
+        # list it under notReadyAddresses
+        assert wait_until(
+            lambda: server.get("pods", "default", "warm").status.phase
+            == "Running"
+        )
+        pod = server.get("pods", "default", "warm")
+        conds = {c.type: c.status for c in pod.status.conditions}
+        assert conds.get("Ready") == "False"
+
+        def ep_ready_count():
+            try:
+                ep = server.get("endpoints", "default", "web")
+            except Exception:
+                return -1
+            return sum(len(s.addresses) for s in ep.subsets)
+
+        assert ep_ready_count() < 1
+        # after warmup the probe flips Ready and endpoints pick it up
+        assert wait_until(
+            lambda: {
+                c.type: c.status
+                for c in server.get("pods", "default", "warm").status.conditions
+            }.get("Ready")
+            == "True",
+        ), "readiness probe must flip Ready after ready-after elapses"
+        assert wait_until(lambda: ep_ready_count() == 1)
+    finally:
+        epc.stop()
+        sched.stop()
+        pool.stop()
+
+
+def test_liveness_probe_restarts_container():
+    from kubernetes_tpu.kubelet.runtime import ANN_UNHEALTHY_AFTER
+
+    server = APIServer()
+    pool = NodeAgentPool(server, housekeeping_interval=0.05)
+    pool.add_node("node-0")
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    try:
+        p = _probe_pod(
+            "crashy",
+            {ANN_UNHEALTHY_AFTER: "0.3"},
+            liveness=v1.Probe(period_seconds=0.05, failure_threshold=2),
+        )
+        server.create("pods", p)
+        assert wait_until(
+            lambda: server.get("pods", "default", "crashy").status.phase
+            == "Running"
+        )
+        # the runtime goes unhealthy after 0.3s; two consecutive failures
+        # trigger an in-place restart, counted in containerStatuses
+        assert wait_until(
+            lambda: any(
+                cs.restart_count >= 1
+                for cs in server.get(
+                    "pods", "default", "crashy"
+                ).status.container_statuses
+            ),
+        ), "liveness failure must restart the container and count it"
+        # pod stays Running (restart, not kill)
+        assert server.get("pods", "default", "crashy").status.phase == "Running"
+    finally:
+        sched.stop()
+        pool.stop()
