@@ -1483,6 +1483,51 @@ class Subject:
     namespace: str = ""
 
 
+# ---------------------------------------------------------------------------
+# Dynamic admission (staging/src/k8s.io/api/admissionregistration/v1):
+# webhook configurations consumed by the apiserver's webhook admission.
+
+
+@dataclass
+class WebhookClientConfig:
+    url: str = ""  # http(s)://host:port/path — service refs are not modeled
+
+
+@dataclass
+class RuleWithOperations:
+    operations: List[str] = field(default_factory=lambda: ["*"])  # CREATE/UPDATE/DELETE/*
+    resources: List[str] = field(default_factory=lambda: ["*"])
+
+
+@dataclass
+class Webhook:
+    name: str = ""
+    client_config: WebhookClientConfig = field(default_factory=WebhookClientConfig)
+    rules: List[RuleWithOperations] = field(default_factory=list)
+    failure_policy: str = "Fail"  # Fail | Ignore
+    timeout_seconds: float = 10.0
+
+
+@dataclass
+class MutatingWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+    kind: str = "MutatingWebhookConfiguration"
+
+    def deep_copy(self) -> "MutatingWebhookConfiguration":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+    kind: str = "ValidatingWebhookConfiguration"
+
+    def deep_copy(self) -> "ValidatingWebhookConfiguration":
+        return copy.deepcopy(self)
+
+
 @dataclass
 class ClusterRoleBinding:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)

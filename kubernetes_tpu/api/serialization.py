@@ -53,6 +53,8 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "limitranges": v1.LimitRange,
     "clusterroles": v1.ClusterRole,
     "clusterrolebindings": v1.ClusterRoleBinding,
+    "mutatingwebhookconfigurations": v1.MutatingWebhookConfiguration,
+    "validatingwebhookconfigurations": v1.ValidatingWebhookConfiguration,
 }
 
 KIND_TO_RESOURCE = {

@@ -483,6 +483,65 @@ class PriorityAdmission(AdmissionPlugin):
             obj.spec.priority_class_name = default.metadata.name
 
 
+class DefaultStorageClassAdmission(AdmissionPlugin):
+    """PVCs created without a class get the cluster default
+    (plugin/pkg/admission/storage/storageclass/setdefault): the
+    StorageClass annotated storageclass.kubernetes.io/is-default-class."""
+
+    name = "DefaultStorageClass"
+    DEFAULT_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
+
+    def __init__(self, server):
+        self.server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "persistentvolumeclaims":
+            return
+        if obj.spec.storage_class_name is not None:
+            return  # explicit class (or explicit "" = no dynamic provision)
+        for sc in self.server.list("storageclasses")[0]:
+            if (
+                sc.metadata.annotations.get(self.DEFAULT_ANNOTATION, "").lower()
+                == "true"
+            ):
+                obj.spec.storage_class_name = sc.metadata.name
+                return
+
+
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+
+class DefaultTolerationSecondsAdmission(AdmissionPlugin):
+    """Every pod tolerates not-ready/unreachable NoExecute taints for a
+    bounded window (plugin/pkg/admission/defaulttolerationseconds): node
+    failure doesn't instantly evict, but eviction isn't disabled either —
+    the nodelifecycle evictor honors tolerationSeconds."""
+
+    name = "DefaultTolerationSeconds"
+
+    def __init__(self, toleration_seconds: int = 300):
+        self.toleration_seconds = toleration_seconds
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        for key in (TAINT_NOT_READY, TAINT_UNREACHABLE):
+            # Toleration.tolerates covers the wildcard key=""+Exists form:
+            # a tolerate-everything pod must NOT get a bounded override
+            taint = v1.Taint(key, "", v1.TAINT_NO_EXECUTE)
+            if any(t.tolerates(taint) for t in obj.spec.tolerations):
+                continue
+            obj.spec.tolerations.append(
+                v1.Toleration(
+                    key=key,
+                    operator=v1.TOLERATION_OP_EXISTS,
+                    effect=v1.TAINT_NO_EXECUTE,
+                    toleration_seconds=self.toleration_seconds,
+                )
+            )
+
+
 class ServiceAccountAdmission(AdmissionPlugin):
     """Default pod spec.service_account to "default" (the mutating half of
     plugin/pkg/admission/serviceaccount, minus volume injection)."""

@@ -138,8 +138,14 @@ class APIServer:
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
+        # admission runs OUTSIDE the store lock: webhook plugins do HTTP
+        # round trips (and their handlers commonly read back from this
+        # server), which under the lock would stall every API call and
+        # deadlock read-back webhooks. The cost is the reference's own
+        # TOCTOU: two racing creates can both pass quota validation — the
+        # quota controller reconciles, it doesn't serialize
+        self._admit("create", kind, obj)
         with self._lock:
-            self._admit("create", kind, obj)
             store = self._objects.setdefault(kind, {})
             key = self._key(obj)
             if key in store:
@@ -163,8 +169,8 @@ class APIServer:
             return copy.deepcopy(store[key])
 
     def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
+        self._admit("update", kind, obj)  # outside the lock, see create()
         with self._lock:
-            self._admit("update", kind, obj)
             store = self._objects.setdefault(kind, {})
             key = self._key(obj)
             if key not in store:
@@ -224,13 +230,20 @@ class APIServer:
                 continue
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
+        key = f"{namespace}/{name}" if namespace else name
         with self._lock:
-            key = f"{namespace}/{name}" if namespace else name
+            store = self._objects.get(kind, {})
+            if key not in store:
+                raise NotFound(f"{kind} {key} not found")
+            admit_copy = copy.deepcopy(store[key])
+        # outside the lock, see create(); validators get a copy so a
+        # misbehaving plugin can't mutate stored state through the ref
+        self._admit("delete", kind, admit_copy)
+        with self._lock:
             store = self._objects.get(kind, {})
             if key not in store:
                 raise NotFound(f"{kind} {key} not found")
             obj = store[key]
-            self._admit("delete", kind, obj)
             if obj.metadata.finalizers:
                 # graceful deletion (registry store.Delete with pending
                 # finalizers): mark intent, keep the object; finalizer

@@ -61,6 +61,8 @@ def init_cluster(
     from ..apiserver.auth import (
         MASTERS_GROUP,
         AdmissionChain,
+        DefaultStorageClassAdmission,
+        DefaultTolerationSecondsAdmission,
         LimitRangerAdmission,
         NamespaceLifecycleAdmission,
         PriorityAdmission,
@@ -69,6 +71,10 @@ def init_cluster(
         ServiceAccountAdmission,
         TokenAuthenticator,
         make_rule,
+    )
+    from ..apiserver.webhook import (
+        MutatingWebhookAdmission,
+        ValidatingWebhookAdmission,
     )
     from ..apiserver.rest import serve
     from ..client.apiserver import APIServer
@@ -120,12 +126,16 @@ def init_cluster(
             mutating=[
                 ServiceAccountAdmission(),
                 PriorityAdmission(store),
+                DefaultStorageClassAdmission(store),
+                DefaultTolerationSecondsAdmission(),
                 LimitRangerAdmission(store),
+                MutatingWebhookAdmission(store),
             ],
             validating=[
                 NamespaceLifecycleAdmission(store),
                 LimitRangerAdmission(store),
                 QuotaAdmission(store),
+                ValidatingWebhookAdmission(store),
             ],
         )
     )

@@ -21,6 +21,7 @@ from ..kubemark.hollow_node import NODE_LEASE_NS
 logger = logging.getLogger("kubernetes_tpu.controller.nodelifecycle")
 
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 
 
 class NodeLifecycleController:
@@ -71,7 +72,7 @@ class NodeLifecycleController:
                 if now - since >= 0:
                     self._set_ready(name, False)
                 if now - since > self.eviction_timeout:
-                    self._evict_pods(name)
+                    self._evict_pods(name, since, now)
 
     def _node_healthy(self, name: str, now: float) -> bool:
         try:
@@ -117,15 +118,24 @@ class NodeLifecycleController:
         except NotFound:
             pass
 
-    def _evict_pods(self, node_name: str) -> None:
+    def _evict_pods(self, node_name: str, since: float, now: float) -> None:
         pods, _ = self.server.list("pods")
         for pod in pods:
             if pod.spec.node_name != node_name:
                 continue
-            if any(
-                tol.key == TAINT_UNREACHABLE
-                and tol.effect in ("", v1.TAINT_NO_EXECUTE)
-                for tol in pod.spec.tolerations
+            # NoExecute toleration semantics (TaintBasedEvictions) against
+            # the taint this controller actually applies: an unbounded
+            # MATCHING toleration (incl. the wildcard key=""+Exists
+            # DaemonSet form, via Toleration.tolerates) exempts the pod;
+            # bounded tolerationSeconds (e.g. DefaultTolerationSeconds
+            # 300s) only DELAY eviction — the reference's
+            # minTolerationTime: the SHORTEST bound wins
+            taint = v1.Taint(TAINT_UNREACHABLE, "", v1.TAINT_NO_EXECUTE)
+            matching = [t for t in pod.spec.tolerations if t.tolerates(taint)]
+            if any(t.toleration_seconds is None for t in matching):
+                continue
+            if matching and now - since < min(
+                t.toleration_seconds for t in matching
             ):
                 continue
             try:

@@ -1,0 +1,392 @@
+"""Dynamic admission webhooks + the defaulting admission plugins
+(apiserver/pkg/admission/plugin/webhook, plugin/pkg/admission/
+storage/storageclass/setdefault, defaulttolerationseconds)."""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.auth import (
+    AdmissionChain,
+    AdmissionDenied,
+    DefaultStorageClassAdmission,
+    DefaultTolerationSecondsAdmission,
+)
+from kubernetes_tpu.apiserver.webhook import (
+    MutatingWebhookAdmission,
+    ValidatingWebhookAdmission,
+    apply_json_patch,
+)
+from kubernetes_tpu.client import APIServer
+
+
+class _Hook(BaseHTTPRequestHandler):
+    """Scriptable webhook endpoint; behavior set per-path."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        self.server.seen.append(body)
+        if self.path == "/deny":
+            resp = {"allowed": False, "status": {"message": "computer says no"}}
+        elif self.path == "/label":
+            patch = [
+                {"op": "add", "path": "/metadata/labels", "value": {"injected": "yes"}}
+            ]
+            resp = {
+                "allowed": True,
+                "patchType": "JSONPatch",
+                "patch": base64.b64encode(json.dumps(patch).encode()).decode(),
+            }
+        else:
+            resp = {"allowed": True}
+        out = json.dumps({"response": resp}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+@pytest.fixture
+def hook_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    srv.seen = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+
+
+def make_pod(name):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "100m"})]),
+    )
+
+
+def _hook_cfg(kind_cls, url, resources=("pods",), failure_policy="Fail"):
+    return kind_cls(
+        metadata=v1.ObjectMeta(name=f"wh-{url.rsplit('/', 1)[-1]}", namespace=""),
+        webhooks=[
+            v1.Webhook(
+                name="hook.test.io",
+                client_config=v1.WebhookClientConfig(url=url),
+                rules=[
+                    v1.RuleWithOperations(
+                        operations=["CREATE"], resources=list(resources)
+                    )
+                ],
+                failure_policy=failure_policy,
+                timeout_seconds=3.0,
+            )
+        ],
+    )
+
+
+def test_json_patch_minimal():
+    doc = {"metadata": {"name": "x"}, "list": [1, 2]}
+    out = apply_json_patch(
+        doc,
+        [
+            {"op": "add", "path": "/metadata/labels", "value": {"a": "b"}},
+            {"op": "replace", "path": "/list/0", "value": 9},
+            {"op": "remove", "path": "/list/1"},
+            {"op": "add", "path": "/list/-", "value": 7},
+        ],
+    )
+    assert out == {"metadata": {"name": "x", "labels": {"a": "b"}}, "list": [9, 7]}
+
+
+def test_validating_webhook_denies(hook_server):
+    port = hook_server.server_address[1]
+    server = APIServer()
+    server.create(
+        "validatingwebhookconfigurations",
+        _hook_cfg(
+            v1.ValidatingWebhookConfiguration, f"http://127.0.0.1:{port}/deny"
+        ),
+    )
+    server.admit_hooks.append(
+        AdmissionChain(validating=[ValidatingWebhookAdmission(server)])
+    )
+    with pytest.raises(AdmissionDenied, match="computer says no"):
+        server.create("pods", make_pod("rejected"))
+    # non-matching resource sails through (rules say pods only)
+    server.create("configmaps", v1.ConfigMap(metadata=v1.ObjectMeta(name="cm")))
+    assert len(hook_server.seen) == 1  # only the pod was reviewed
+
+
+def test_mutating_webhook_patches_object(hook_server):
+    port = hook_server.server_address[1]
+    server = APIServer()
+    server.create(
+        "mutatingwebhookconfigurations",
+        _hook_cfg(
+            v1.MutatingWebhookConfiguration, f"http://127.0.0.1:{port}/label"
+        ),
+    )
+    server.admit_hooks.append(
+        AdmissionChain(mutating=[MutatingWebhookAdmission(server)])
+    )
+    server.create("pods", make_pod("patched"))
+    pod = server.get("pods", "default", "patched")
+    assert pod.metadata.labels.get("injected") == "yes"
+
+
+def test_webhook_failure_policy(hook_server):
+    dead = "http://127.0.0.1:9/nowhere"  # connection refused
+    server = APIServer()
+    server.create(
+        "validatingwebhookconfigurations",
+        _hook_cfg(v1.ValidatingWebhookConfiguration, dead, failure_policy="Fail"),
+    )
+    server.admit_hooks.append(
+        AdmissionChain(validating=[ValidatingWebhookAdmission(server)])
+    )
+    with pytest.raises(AdmissionDenied, match="unavailable"):
+        server.create("pods", make_pod("blocked"))
+
+    server2 = APIServer()
+    server2.create(
+        "validatingwebhookconfigurations",
+        _hook_cfg(
+            v1.ValidatingWebhookConfiguration, dead, failure_policy="Ignore"
+        ),
+    )
+    server2.admit_hooks.append(
+        AdmissionChain(validating=[ValidatingWebhookAdmission(server2)])
+    )
+    server2.create("pods", make_pod("allowed"))  # fails open
+
+
+def test_default_storage_class_admission():
+    server = APIServer()
+    server.create(
+        "storageclasses",
+        v1.StorageClass(
+            metadata=v1.ObjectMeta(
+                name="standard",
+                namespace="",
+                annotations={
+                    "storageclass.kubernetes.io/is-default-class": "true"
+                },
+            ),
+            provisioner="tpu.csi",
+        ),
+    )
+    server.admit_hooks.append(
+        AdmissionChain(mutating=[DefaultStorageClassAdmission(server)])
+    )
+    server.create(
+        "persistentvolumeclaims",
+        v1.PersistentVolumeClaim(metadata=v1.ObjectMeta(name="unclassed")),
+    )
+    assert (
+        server.get("persistentvolumeclaims", "default", "unclassed")
+        .spec.storage_class_name
+        == "standard"
+    )
+    # explicit "" (no dynamic provisioning) is preserved
+    server.create(
+        "persistentvolumeclaims",
+        v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="manual"),
+            spec=v1.PersistentVolumeClaimSpec(storage_class_name=""),
+        ),
+    )
+    assert (
+        server.get("persistentvolumeclaims", "default", "manual")
+        .spec.storage_class_name
+        == ""
+    )
+
+
+def test_default_toleration_seconds_and_delayed_eviction():
+    """The admission plugin adds bounded not-ready/unreachable tolerations;
+    the nodelifecycle evictor honors tolerationSeconds as a DELAY, not an
+    exemption."""
+    from kubernetes_tpu.controller.nodelifecycle import NodeLifecycleController
+    from kubernetes_tpu.kubelet import NodeAgentPool
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+
+    server = APIServer()
+    server.admit_hooks.append(
+        AdmissionChain(
+            mutating=[DefaultTolerationSecondsAdmission(toleration_seconds=1)]
+        )
+    )
+    pool = NodeAgentPool(server, heartbeat_interval=0.1, housekeeping_interval=0.1)
+    pool.add_node("doomed")
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    nlc = NodeLifecycleController(
+        server,
+        node_monitor_period=0.05,
+        node_monitor_grace_period=0.4,
+        pod_eviction_timeout=0.1,
+    )
+    pool.start()
+    sched.start()
+    nlc.start()
+    try:
+        server.create("pods", make_pod("tolerant"))
+        stored = server.get("pods", "default", "tolerant")
+        assert any(
+            t.key == "node.kubernetes.io/unreachable"
+            and t.toleration_seconds == 1
+            for t in stored.spec.tolerations
+        ), "admission must add the bounded toleration"
+
+        def running():
+            return server.get("pods", "default", "tolerant").status.phase == "Running"
+
+        deadline = time.time() + 15
+        while time.time() < deadline and not running():
+            time.sleep(0.03)
+        assert running()
+        pool.remove_node("doomed")  # heartbeats stop
+        # still present inside the toleration window after not-ready…
+        time.sleep(0.7)
+        assert any(
+            p.metadata.name == "tolerant" for p in server.list("pods")[0]
+        ), "tolerationSeconds must delay eviction"
+        # …gone once the window expires
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if not any(
+                p.metadata.name == "tolerant" for p in server.list("pods")[0]
+            ):
+                break
+            time.sleep(0.05)
+        assert not any(
+            p.metadata.name == "tolerant" for p in server.list("pods")[0]
+        ), "tolerationSeconds must not exempt forever"
+    finally:
+        nlc.stop()
+        sched.stop()
+        pool.stop()
+
+
+def test_malformed_webhook_response_follows_failure_policy():
+    """HTML/garbage bodies are 'webhook unavailable', not a crash: Ignore
+    fails open, Fail fails closed with AdmissionDenied."""
+
+    class _Garbage(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers["Content-Length"]))
+            out = b"<html>gateway error</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Garbage)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+    try:
+        for policy, should_pass in (("Ignore", True), ("Fail", False)):
+            server = APIServer()
+            server.create(
+                "validatingwebhookconfigurations",
+                _hook_cfg(
+                    v1.ValidatingWebhookConfiguration, url, failure_policy=policy
+                ),
+            )
+            server.admit_hooks.append(
+                AdmissionChain(validating=[ValidatingWebhookAdmission(server)])
+            )
+            if should_pass:
+                server.create("pods", make_pod("ok"))
+            else:
+                with pytest.raises(AdmissionDenied, match="unavailable"):
+                    server.create("pods", make_pod("no"))
+    finally:
+        srv.shutdown()
+
+
+def test_webhook_may_read_back_from_the_apiserver():
+    """Admission runs outside the store lock: a webhook whose handler
+    queries the same apiserver must not deadlock (the common pattern —
+    policy engines read cluster state)."""
+    from kubernetes_tpu.apiserver.rest import serve
+
+    srv_http, port, store = serve()
+    try:
+
+        class _ReadBack(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers["Content-Length"]))
+                # read back from the cluster being admitted
+                import urllib.request as _u
+
+                with _u.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/namespaces/default/configmaps",
+                    timeout=5,
+                ) as r:
+                    r.read()
+                out = json.dumps({"response": {"allowed": True}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        hook_srv = ThreadingHTTPServer(("127.0.0.1", 0), _ReadBack)
+        threading.Thread(target=hook_srv.serve_forever, daemon=True).start()
+        try:
+            store.create(
+                "validatingwebhookconfigurations",
+                _hook_cfg(
+                    v1.ValidatingWebhookConfiguration,
+                    f"http://127.0.0.1:{hook_srv.server_address[1]}/rb",
+                ),
+            )
+            store.admit_hooks.append(
+                AdmissionChain(validating=[ValidatingWebhookAdmission(store)])
+            )
+            done = []
+
+            def create():
+                store.create("pods", make_pod("readback"))
+                done.append(True)
+
+            t = threading.Thread(target=create, daemon=True)
+            t.start()
+            t.join(timeout=8)
+            assert done, "create deadlocked on a read-back webhook"
+        finally:
+            hook_srv.shutdown()
+    finally:
+        srv_http.shutdown()
+
+
+def test_wildcard_toleration_exempts_from_eviction():
+    """key=\"\"+Exists (DaemonSet tolerate-all): DefaultTolerationSeconds
+    must not override it with a bounded toleration, and the evictor must
+    treat it as matching the unreachable taint."""
+    adm = DefaultTolerationSecondsAdmission(toleration_seconds=1)
+    pod = make_pod("wild")
+    pod.spec.tolerations.append(
+        v1.Toleration(key="", operator=v1.TOLERATION_OP_EXISTS)
+    )
+    adm.mutate("create", "pods", pod)
+    assert len(pod.spec.tolerations) == 1, (
+        "wildcard toleration already covers both taints; nothing to add"
+    )
+    # evictor sees it as an unbounded matching toleration -> exempt
+    from kubernetes_tpu.controller.nodelifecycle import (
+        TAINT_UNREACHABLE as TK,
+    )
+
+    taint = v1.Taint(TK, "", v1.TAINT_NO_EXECUTE)
+    assert pod.spec.tolerations[0].tolerates(taint)
