@@ -120,9 +120,18 @@ class _WebhookAdmission(AdmissionPlugin):
     def __init__(self, server):
         self.server = server
 
+    # BOTH configuration kinds are exempt from BOTH plugins: if each kind
+    # were only exempt from its own plugin, two broken failurePolicy=Fail
+    # configs could veto each other's deletion and lock the cluster out of
+    # every write forever (upstream exempts the admissionregistration
+    # group for the same reason)
+    EXEMPT_RESOURCES = frozenset(
+        {"mutatingwebhookconfigurations", "validatingwebhookconfigurations"}
+    )
+
     def _dispatch(self, verb: str, resource: str, obj) -> None:
-        if resource == self.config_resource:
-            return  # never ask webhooks about webhook configuration writes
+        if resource in self.EXEMPT_RESOURCES:
+            return
         try:
             configs, _ = self.server.list(self.config_resource)
         except Exception:

@@ -103,13 +103,50 @@ def cmd_get(client: RESTClient, args) -> int:
         else:
             _print_table(resource, [obj])
         return 0
-    objs, _rv = client.list(resource)
-    if args.namespace and resource == "pods":
-        objs = [o for o in objs if o.metadata.namespace == args.namespace]
+    objs, rv = client.list(resource)
+
+    def _matches(o) -> bool:
+        if (
+            not getattr(args, "all_namespaces", False)
+            and args.namespace
+            and resource == "pods"
+            and o.metadata.namespace != args.namespace
+        ):
+            return False
+        for term in (args.selector or "").split(","):
+            if not term:
+                continue
+            if "=" in term:
+                k, _, want = term.partition("=")
+                if o.metadata.labels.get(k) != want:
+                    return False
+            elif term not in o.metadata.labels:  # bare key: existence
+                return False
+        return True
+
+    objs = [o for o in objs if _matches(o)]
     if args.output == "json":
         print(json.dumps([codec.encode(o) for o in objs], indent=2))
     else:
         _print_table(resource, objs)
+    if getattr(args, "watch", False):
+        # stream subsequent changes (kubectl get -w), same filters as the
+        # initial list
+        w = client.watch(resource, from_version=rv)
+        try:
+            while True:
+                ev = w.get(timeout=1.0)
+                if ev is None:
+                    if w.stopped:
+                        print("watch stream closed", file=sys.stderr)
+                        return 1
+                    continue
+                if _matches(ev.object):
+                    print(f"{ev.type:<9} {ev.object.metadata.key}")
+        except KeyboardInterrupt:
+            pass
+        finally:
+            w.stop()
     return 0
 
 
@@ -664,6 +701,9 @@ def main(argv=None) -> int:
     p_get = sub.add_parser("get")
     p_get.add_argument("resource")
     p_get.add_argument("name", nargs="?")
+    p_get.add_argument("-l", "--selector", default="")
+    p_get.add_argument("-A", "--all-namespaces", action="store_true")
+    p_get.add_argument("-w", "--watch", action="store_true")
     p_desc = sub.add_parser("describe")
     p_desc.add_argument("resource")
     p_desc.add_argument("name")

@@ -21,7 +21,6 @@ from ..kubemark.hollow_node import NODE_LEASE_NS
 logger = logging.getLogger("kubernetes_tpu.controller.nodelifecycle")
 
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
-TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 
 
 class NodeLifecycleController:

@@ -267,7 +267,20 @@ class Scheduler:
         self.queue.close()
         self.cache.stop()
         self.informer_factory.stop()
-        self._bind_pool.shutdown(wait=False)
+        # release parked permit-waiters or the drain below would block on
+        # their (up to 30s) wait timeouts
+        for p in self.profiles.values():
+            for wp in p.framework.iterate_waiting_pods():
+                wp.reject("scheduler shutting down")
+        # drain in-flight binds BEFORE flushing recorders: a bind finishing
+        # after the flush would drop its Scheduled event into a buffer
+        # nobody serves
+        self._bind_pool.shutdown(wait=True)
+        for p in self.profiles.values():
+            rec = getattr(p, "recorder", None)
+            if rec is not None and hasattr(rec, "flush"):
+                rec.flush(timeout=2.0)
+                rec.stop()
 
     def wait_for_idle(self, timeout: float = 30.0) -> bool:
         """Test helper: wait until no pending pods remain."""

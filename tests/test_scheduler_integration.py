@@ -76,7 +76,13 @@ def test_end_to_end_basic(sched_env):
         server.create("pods", make_pod(f"p{i}"))
     placed = wait_scheduled(server, [f"p{i}" for i in range(20)])
     assert len(set(placed.values())) == 4  # spread over all nodes
-    ev, _ = server.list("events")
+    # the recorder is async (EventBroadcaster): give the flusher a beat
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        ev, _ = server.list("events")
+        if any(e.reason == "Scheduled" for e in ev):
+            break
+        time.sleep(0.02)
     assert any(e.reason == "Scheduled" for e in ev)
 
 
