@@ -72,48 +72,78 @@ class EventRecorder:
             return
         key = obj.metadata.key if hasattr(obj, "metadata") else str(obj)
         now = time.time()
-        ev = ClusterEvent(
-            metadata=ObjectMeta(
-                name=f"{key.replace('/', '.')}.{reason}", namespace="default"
-            ),
-            involved_kind=getattr(obj, "kind", ""),
-            involved_key=key,
-            type=event_type,
-            reason=reason,
-            action=action,
-            note=note,
-        )
+        drain = False
         with self._cond:
-            if self._stopped:
-                straggler = True  # flusher is gone: write inline below
+            agg = (key, reason)
+            cur = self._pending.get(agg)
+            if cur is not None:
+                cur.count += 1
+                cur.last_timestamp = now
+                cur.note = note
+            elif len(self._pending) >= self._max_buffer:
+                self._dropped += 1  # overload: shed, never block callers
+                return
             else:
-                straggler = False
-                agg = (key, reason)
-                cur = self._pending.get(agg)
-                if cur is not None:
-                    cur.count += 1
-                    cur.last_timestamp = now
-                    cur.note = note
-                elif len(self._pending) >= self._max_buffer:
-                    self._dropped += 1  # overload: shed, never block callers
-                else:
-                    self._pending[agg] = ev
+                # built only on the miss path: the storm case (same
+                # key+reason repeating) must stay allocation-free
+                self._pending[agg] = ClusterEvent(
+                    metadata=ObjectMeta(
+                        name=f"{key.replace('/', '.')}.{reason}",
+                        namespace="default",
+                    ),
+                    involved_kind=getattr(obj, "kind", ""),
+                    involved_key=key,
+                    type=event_type,
+                    reason=reason,
+                    action=action,
+                    note=note,
+                )
+            if self._stopped:
+                # flusher is gone (or finishing): drain inline through the
+                # same swap protocol so stragglers serialize with it and
+                # with each other — no unsynchronized read-modify-write
+                drain = True
+            else:
                 if self._flusher is None:
                     self._flusher = threading.Thread(
                         target=self._flush_loop, daemon=True, name="event-flusher"
                     )
                     self._flusher.start()
                 self._cond.notify()
-        if straggler:
-            self._write(ev)
+        if drain:
+            self._drain()
+
+    def _drain(self) -> None:
+        """Write everything pending using the swap/_inflight protocol
+        (shared with the flusher thread)."""
+        while True:
+            with self._cond:
+                while self._inflight:
+                    self._cond.wait(timeout=1.0)
+                if not self._pending:
+                    return
+                batch = self._pending
+                self._pending = {}
+                self._inflight = True
+            try:
+                for ev in batch.values():
+                    self._write(ev)
+            finally:
+                with self._cond:
+                    self._inflight = False
+                    self._cond.notify_all()
 
     def _flush_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._pending and not self._stopped:
+                # sleep while a straggler drain owns the swap, or while
+                # there is nothing to do and we're not stopping
+                while self._inflight or (
+                    not self._pending and not self._stopped
+                ):
                     self._cond.wait(timeout=1.0)
-                if self._stopped and not self._pending:
-                    return
+                if not self._pending:
+                    return  # stopped and drained
                 batch = self._pending
                 self._pending = {}
                 self._inflight = True

@@ -116,9 +116,13 @@ def cmd_get(client: RESTClient, args) -> int:
         for term in (args.selector or "").split(","):
             if not term:
                 continue
-            if "=" in term:
+            if "!=" in term:
+                k, _, want = term.partition("!=")
+                if o.metadata.labels.get(k) == want:
+                    return False
+            elif "=" in term:
                 k, _, want = term.partition("=")
-                if o.metadata.labels.get(k) != want:
+                if o.metadata.labels.get(k.rstrip("=")) != want:
                     return False
             elif term not in o.metadata.labels:  # bare key: existence
                 return False

@@ -663,23 +663,12 @@ class SnapshotEncoder:
                 ws.append(float(wt.weight))
         return ids, ws
 
-    def add_pod(
-        self,
-        node_name: str,
-        pod: v1.Pod,
-        device_synced: bool = False,
-        prio_band: Optional[int] = None,
-    ) -> None:
-        """device_synced=True: the wave kernel already committed this pod's
-        occupancy (requested/nonzero/sel_counts/eterm_w/ports/prio_req) into
-        the device snapshot it returned (wavelattice finalize), so replaying
-        it here must update the host masters WITHOUT marking the row dirty —
-        a dirty mark would re-upload values the device already holds, and at
-        ~65 ms tunnel RTT per transfer those redundant scatters were the
-        1-2 s encode spikes in the round-2 bench."""
-        row = self._row_by_name.get(node_name)
-        if row is None:
-            raise KeyError(f"unknown node {node_name}")
+    def pod_proto(self, pod: v1.Pod) -> tuple:
+        """Shared encoding of everything add_pod derives from the SPEC
+        (requests, carried terms, ports, label match vector): pods of one
+        scheduling template produce identical protos, so a bulk bind
+        computes this once per template instead of once per pod. Valid
+        only at the current vocab state — add_pod revalidates."""
         from ..api.objects import compute_pod_resource_request, pod_host_ports
 
         req = self.encode_resources(compute_pod_resource_request(pod), ceil=True)
@@ -691,7 +680,38 @@ class SnapshotEncoder:
         req[RES_PODS] = 1
         nz[RES_PODS] = 1
         eids, ews = self._pod_eterms(pod)
-        pids = [self.intern_port(proto, port) for (_, proto, port) in pod_host_ports(pod)]
+        pids = [
+            self.intern_port(proto, port)
+            for (_, proto, port) in pod_host_ports(pod)
+        ]
+        mv = self._match_vec(pod.metadata.namespace, pod.metadata.labels)
+        return (req, nz, eids, ews, pids, mv, len(self.sel_vocab))
+
+    def add_pod(
+        self,
+        node_name: str,
+        pod: v1.Pod,
+        device_synced: bool = False,
+        prio_band: Optional[int] = None,
+        proto: Optional[tuple] = None,
+    ) -> None:
+        """device_synced=True: the wave kernel already committed this pod's
+        occupancy (requested/nonzero/sel_counts/eterm_w/ports/prio_req) into
+        the device snapshot it returned (wavelattice finalize), so replaying
+        it here must update the host masters WITHOUT marking the row dirty —
+        a dirty mark would re-upload values the device already holds, and at
+        ~65 ms tunnel RTT per transfer those redundant scatters were the
+        1-2 s encode spikes in the round-2 bench.
+
+        proto: a pod_proto() result from a template sibling — reused
+        (arrays treated as immutable) unless the vocab grew since."""
+        row = self._row_by_name.get(node_name)
+        if row is None:
+            raise KeyError(f"unknown node {node_name}")
+        if proto is not None and proto[6] == len(self.sel_vocab):
+            req, nz, eids, ews, pids, mv, _ = proto
+        else:
+            req, nz, eids, ews, pids, mv, _ = self.pod_proto(pod)
         # device_synced replay must land in the band the kernel committed
         # prio_req under (captured at encode time); recomputing could pick a
         # different band after a relabel, silently diverging host vs device
@@ -705,15 +725,15 @@ class SnapshotEncoder:
             eterm_ws=ews,
             port_ids=pids,
             match_cache_len=len(self.sel_vocab),
-            match_vec=self._match_vec(pod.metadata.namespace, pod.metadata.labels),
+            match_vec=mv,
             prio_band=band,
         )
         self._pods[row][pod.metadata.key] = entry
         self.m_req[row, : len(req)] += req
         self.m_nonzero[row, : len(nz)] += nz
         self.m_prio_req[row, band, : len(req)] += req
-        for i, mv in enumerate(entry.match_vec):
-            if mv:
+        for i, m in enumerate(entry.match_vec):
+            if m:
                 self.m_sel_counts[row, i] += 1
         for tid, w in zip(eids, ews):
             self.m_eterm_w[row, tid] += w

@@ -115,6 +115,7 @@ class SchedulerCache:
         pod: v1.Pod,
         device_synced: bool = False,
         prio_band: Optional[int] = None,
+        proto: Optional[tuple] = None,
     ) -> None:
         node = pod.spec.node_name
         ni = self._nodes.get(node)
@@ -126,7 +127,8 @@ class SchedulerCache:
         self._bump(ni)
         self._pod_to_node[pod.metadata.key] = node
         self.encoder.add_pod(
-            node, pod, device_synced=device_synced, prio_band=prio_band
+            node, pod, device_synced=device_synced, prio_band=prio_band,
+            proto=proto,
         )
 
     def _remove_pod_internal(self, key: str, node: str) -> None:
@@ -145,12 +147,15 @@ class SchedulerCache:
         node_name: str,
         device_synced: bool = False,
         prio_band: Optional[int] = None,
+        proto: Optional[tuple] = None,
     ) -> None:
         """device_synced=True: the placement came from the wave kernel, whose
         finalize already committed the pod's occupancy into the device
         snapshot — replay host-side only (ops/encoding.add_pod). prio_band
         pins the priority band the kernel committed prio_req under (a band
-        relabel between encode and replay would otherwise diverge)."""
+        relabel between encode and replay would otherwise diverge).
+        proto: encoder.pod_proto() from a template sibling (bulk binds
+        compute the spec-derived encoding once per template)."""
         key = pod.metadata.key
         with self.lock:
             if key in self._assumed or key in self._pod_to_node:
@@ -158,7 +163,10 @@ class SchedulerCache:
             assumed = pod.deep_copy()
             assumed.spec.node_name = node_name
             self._add_pod_internal(
-                assumed, device_synced=device_synced, prio_band=prio_band
+                assumed,
+                device_synced=device_synced,
+                prio_band=prio_band,
+                proto=proto,
             )
             self._assumed[key] = _AssumedInfo(assumed, node_name, None)
 

@@ -267,6 +267,11 @@ class Scheduler:
         self.queue.close()
         self.cache.stop()
         self.informer_factory.stop()
+        # join the scheduling loop FIRST: a cycle still running could park
+        # new permit-waiters after the reject sweep below, or submit binds
+        # into a shut-down pool
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=10.0)
         # release parked permit-waiters or the drain below would block on
         # their (up to 30s) wait timeouts
         for p in self.profiles.values():
@@ -608,7 +613,8 @@ class Scheduler:
         algo_dur = time.monotonic() - t_start
         metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
 
-        to_bind: List = []  # (pi, node_name, prio_band)
+        to_bind: List = []  # (pi, node_name, prio_band, proto)
+        protos: dict = {}  # template -> shared encoder proto
         fallback_pis: List[QueuedPodInfo] = []
         failed: List = []  # (pi, tpl_index)
         for i, pi in enumerate(pis):
@@ -620,7 +626,23 @@ class Scheduler:
                 if node_name is None:
                     failed.append((pi, i))
                     continue
-                to_bind.append((pi, node_name, int(eb.pod_band_np[i])))
+                t = int(eb.pod_tpl_np[i])
+                proto = protos.get(t)
+                if proto is None:
+                    # one spec-derived encoding per template, shared by
+                    # every sibling in the batch (same fingerprint =>
+                    # identical proto). Under the cache lock: the encoder's
+                    # vocabs are mutated by informer threads through locked
+                    # cache methods, and an intern between _match_vec and
+                    # the proto's vocab-length stamp would smuggle a short
+                    # match_vec past add_pod's staleness guard
+                    with self.cache.lock:
+                        proto = protos[t] = self.cache.encoder.pod_proto(
+                            pi.pod
+                        )
+                to_bind.append(
+                    (pi, node_name, int(eb.pod_band_np[i]), proto)
+                )
             elif deferred[i]:
                 self.queue.readd(pi)
             else:
@@ -690,16 +712,17 @@ class Scheduler:
     def _assume_and_bind_bulk(
         self, to_bind: List, t_start: float, device_synced: bool = False
     ) -> None:
-        """Assume + bind a whole wave of placements ((pi, node, band)
-        triples). When the profile has no permit/prebind/postbind plugins
-        and the binder is the default, the binds collapse into one batch API
-        call (the in-cycle fast path — async per-pod binding remains for
-        plugin-bearing profiles, matching the reference's goroutine-per-bind
-        at scheduler.go:666)."""
+        """Assume + bind a whole wave of placements ((pi, node, band,
+        proto) tuples; proto may be None for host-path placements). When
+        the profile has no permit/prebind/postbind plugins and the binder
+        is the default, the binds collapse into one batch API call (the
+        in-cycle fast path — async per-pod binding remains for
+        plugin-bearing profiles, matching the reference's
+        goroutine-per-bind at scheduler.go:666)."""
         if not to_bind:
             return
         simple: List = []
-        for pi, node_name, band in to_bind:
+        for pi, node_name, band, proto in to_bind:
             pod = pi.pod
             prof = self.profiles.for_pod(pod)
             fw = prof.framework
@@ -714,7 +737,11 @@ class Scheduler:
             )
             try:
                 self.cache.assume_pod(
-                    pod, node_name, device_synced=device_synced, prio_band=band
+                    pod,
+                    node_name,
+                    device_synced=device_synced,
+                    prio_band=band,
+                    proto=proto,
                 )
             except ValueError as e:
                 if device_synced:
@@ -788,7 +815,18 @@ class Scheduler:
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves, message=st.message)
             return
-        self._bind_pool.submit(self._bind_async, pi, node_name, state, t_start)
+        try:
+            self._bind_pool.submit(
+                self._bind_async, pi, node_name, state, t_start
+            )
+        except RuntimeError:
+            # pool shut down mid-cycle (stop racing a final batch): unwind
+            # like a failed bind so the reservation doesn't leak
+            self.cache.forget_pod(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(
+                pi, self.queue.moves, message="scheduler shutting down"
+            )
 
     # -- host fallback path ---------------------------------------------------
 
@@ -878,7 +916,18 @@ class Scheduler:
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves, message=st.message)
             return
-        self._bind_pool.submit(self._bind_async, pi, node_name, state, t_start)
+        try:
+            self._bind_pool.submit(
+                self._bind_async, pi, node_name, state, t_start
+            )
+        except RuntimeError:
+            # pool shut down mid-cycle (stop racing a final batch): unwind
+            # like a failed bind so the reservation doesn't leak
+            self.cache.forget_pod(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(
+                pi, self.queue.moves, message="scheduler shutting down"
+            )
 
     def _bind_async(self, pi: QueuedPodInfo, node_name: str, state, t_start) -> None:
         """binding cycle (async goroutine at scheduler.go:666)."""
