@@ -176,6 +176,17 @@ def main() -> int:
             detail={
                 "platform": platform,
                 "device_readback_rtt_ms": tunnel_rtt_ms,
+                # the steady-state pod-p99 floor on THIS deployment: every
+                # cycle needs >=1 device->host readback (bind consumes the
+                # chosen nodes host-side), so p99 < 10 ms is unreachable
+                # while the backend sits behind a ~65-85 ms tunnel; on
+                # locally-attached TPU the same readback is sub-ms and the
+                # target applies
+                "latency_floor_note": (
+                    f"pod p99 >= 1 readback RTT ({tunnel_rtt_ms} ms measured) "
+                    "on the tunneled backend; <10 ms requires local PCIe/ICI "
+                    "attachment"
+                ),
                 "workload": res.workload,
                 "num_nodes": res.num_nodes,
                 "scheduled": res.scheduled,

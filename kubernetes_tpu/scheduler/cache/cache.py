@@ -13,6 +13,7 @@ confirmed by the informer's scheduled-pod Add, expired by a janitor loop.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -21,6 +22,8 @@ from typing import Dict, List, Optional
 from ...api import objects as v1
 from ...ops.encoding import EncodingConfig, SnapshotEncoder
 from .nodeinfo import NodeInfo, Snapshot
+
+logger = logging.getLogger("kubernetes_tpu.scheduler.cache")
 
 
 @dataclass
@@ -169,6 +172,67 @@ class SchedulerCache:
                 proto=proto,
             )
             self._assumed[key] = _AssumedInfo(assumed, node_name, None)
+
+    def assume_pods_bulk(self, items: list) -> list:
+        """Assume a whole wave of device-committed placements under ONE
+        lock acquisition, with vectorized encoder scatters. items =
+        [(pod, node_name, band, proto)]; returns a per-item error-message
+        list (None = assumed). Entries that fail the duplicate/unknown-
+        node checks are skipped without affecting the rest."""
+        errors: list = [None] * len(items)
+        enc_items: list = []
+        with self.lock:
+            for i, (pod, node_name, band, proto) in enumerate(items):
+                key = pod.metadata.key
+                if key in self._assumed or key in self._pod_to_node:
+                    errors[i] = f"pod {key} already assumed/added"
+                    continue
+                assumed = pod.deep_copy()
+                assumed.spec.node_name = node_name
+                ni = self._nodes.get(node_name)
+                if ni is None:
+                    # unknown node: track mapping only (matches add path)
+                    self._pod_to_node[key] = node_name
+                    self._assumed[key] = _AssumedInfo(assumed, node_name, None)
+                    continue
+                ni.add_pod(assumed)
+                self._bump(ni)
+                self._pod_to_node[key] = node_name
+                self._assumed[key] = _AssumedInfo(assumed, node_name, None)
+                enc_items.append(
+                    (
+                        node_name,
+                        assumed,
+                        # same fallback as add_pod: an unpinned band is
+                        # derived from the pod's priority, never 0
+                        band
+                        if band is not None
+                        else self.encoder._band_of(assumed.priority),
+                        proto,
+                    )
+                )
+            if enc_items:
+                try:
+                    self.encoder.add_pods_bulk(enc_items)
+                except Exception:
+                    # bulk pass 1 raises BEFORE any master write, so the
+                    # per-pod path can safely redo the whole wave — the
+                    # NodeInfo/_assumed state above is already correct
+                    logger.exception(
+                        "bulk encoder scatter failed; per-pod fallback"
+                    )
+                    for node_name, assumed, band, proto in enc_items:
+                        try:
+                            self.encoder.add_pod(
+                                node_name,
+                                assumed,
+                                device_synced=True,
+                                prio_band=band,
+                                proto=proto,
+                            )
+                        except KeyError:
+                            pass  # node unknown to the encoder: row-less
+        return errors
 
     def finish_binding(self, pod: v1.Pod) -> None:
         """Arms the expiry TTL (cache.go FinishBinding)."""

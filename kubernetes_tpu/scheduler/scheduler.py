@@ -721,12 +721,42 @@ class Scheduler:
         goroutine-per-bind at scheduler.go:666)."""
         if not to_bind:
             return
+        # ONE lock acquisition + vectorized encoder scatters for the whole
+        # wave (device_synced path); the host fallback path still assumes
+        # per pod through the same cache method semantics
+        if device_synced:
+            errors = self.cache.assume_pods_bulk(
+                [(pi.pod, node_name, band, proto)
+                 for pi, node_name, band, proto in to_bind]
+            )
+        else:
+            errors = []
+            for pi, node_name, band, proto in to_bind:
+                try:
+                    self.cache.assume_pod(
+                        pi.pod,
+                        node_name,
+                        device_synced=False,
+                        prio_band=band,
+                        proto=proto,
+                    )
+                    errors.append(None)
+                except ValueError as e:
+                    errors.append(str(e))
         simple: List = []
-        for pi, node_name, band, proto in to_bind:
+        for (pi, node_name, band, proto), err in zip(to_bind, errors):
             pod = pi.pod
+            if err is not None:
+                if device_synced:
+                    # the kernel already committed this placement on-device;
+                    # with no host replay the row must be re-uploaded
+                    self.cache.encoder.mark_row_dirty(node_name)
+                self._handle_failure(
+                    pi, self.queue.moves, message=err, error=True
+                )
+                continue
             prof = self.profiles.for_pod(pod)
-            fw = prof.framework
-            ps = fw.plugin_set
+            ps = prof.framework.plugin_set
             plain = (
                 self.cfg.sync_batch_bind
                 and not ps.reserve
@@ -735,23 +765,6 @@ class Scheduler:
                 and not ps.post_bind
                 and ps.bind == ["DefaultBinder"]
             )
-            try:
-                self.cache.assume_pod(
-                    pod,
-                    node_name,
-                    device_synced=device_synced,
-                    prio_band=band,
-                    proto=proto,
-                )
-            except ValueError as e:
-                if device_synced:
-                    # the kernel already committed this placement on-device;
-                    # with no host replay the row must be re-uploaded
-                    self.cache.encoder.mark_row_dirty(node_name)
-                self._handle_failure(
-                    pi, self.queue.moves, message=str(e), error=True
-                )
-                continue
             self.queue.delete_nominated_if_exists(pod)
             if plain:
                 simple.append((pi, node_name, prof))

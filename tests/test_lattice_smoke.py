@@ -282,3 +282,54 @@ def test_batch_padding_invalid_rows():
     res = kern(enc.flush(), eb.batch, jnp.asarray(DEFAULT_WEIGHTS), jax.random.PRNGKey(0))
     assert int(res.chosen[0]) == 0
     assert all(int(res.chosen[i]) == -1 for i in range(1, 4))
+
+
+def test_add_pods_bulk_matches_sequential():
+    """The vectorized bulk-assume scatter must leave the host masters
+    byte-identical to per-pod add_pod."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.encoding import SnapshotEncoder
+
+    def build(n_pods, bulk):
+        enc = SnapshotEncoder()
+        for i in range(8):
+            enc.add_node(make_node(f"n{i}"))
+        pods = []
+        for i in range(n_pods):
+            p = make_pod(
+                f"p{i}",
+                cpu="250m" if i % 2 else "100m",
+                labels={"app": "a" if i % 3 else "b"},
+            )
+            pods.append(p)
+        # intern a predicate so match vectors are non-trivial
+        from kubernetes_tpu.api.selectors import LabelSelector
+
+        enc.intern_predicate(
+            frozenset({"default"}), LabelSelector.make({"app": "a"})
+        )
+        items = []
+        for i, p in enumerate(pods):
+            p.spec.node_name = f"n{i % 8}"
+            proto = enc.pod_proto(p) if i % 2 else None  # mixed proto/None
+            items.append((f"n{i % 8}", p, i % 3, proto))
+        if bulk:
+            enc.add_pods_bulk(items)
+        else:
+            for node, p, band, proto in items:
+                enc.add_pod(node, p, device_synced=True, prio_band=band, proto=proto)
+        return enc
+
+    a = build(24, bulk=False)
+    b = build(24, bulk=True)
+    for field in (
+        "m_req", "m_nonzero", "m_prio_req", "m_sel_counts",
+        "m_eterm_w", "m_port_counts",
+    ):
+        np.testing.assert_array_equal(
+            getattr(a, field), getattr(b, field), err_msg=field
+        )
+    assert set(
+        (r, k) for r, d in a._pods.items() for k in d
+    ) == set((r, k) for r, d in b._pods.items() for k in d)
