@@ -29,6 +29,12 @@ class FakeCache:
         if self.assume_func:
             self.assume_func(pod, node_name)
 
+    def assume_pods_bulk(self, items) -> list:
+        """Mirror SchedulerCache's wave-bind entry point."""
+        for pod, node_name, _band, _proto in items:
+            self.assume_pod(pod, node_name)
+        return [None] * len(items)
+
     def finish_binding(self, pod) -> None:
         pass
 

@@ -319,11 +319,19 @@ def test_fuzz_device_mask_matches_host_filters(seed):
             )
 
     # ---- device/host occupancy convergence -------------------------------
-    for i, pod in enumerate(pods):
-        if eb.fallback[i] or not placed[i]:
-            continue
-        nm = enc.row_names[int(chosen[i])]
-        enc.add_pod(nm, pod, device_synced=True, prio_band=int(eb.pod_band_np[i]))
+    # even seeds replay through the vectorized bulk path (the production
+    # wave-bind route), odd seeds per-pod — both must converge with the
+    # device's own commits
+    replay = [
+        (enc.row_names[int(chosen[i])], pod, int(eb.pod_band_np[i]))
+        for i, pod in enumerate(pods)
+        if not eb.fallback[i] and placed[i]
+    ]
+    if seed % 2 == 0:
+        enc.add_pods_bulk([(nm, pod, band, None) for nm, pod, band in replay])
+    else:
+        for nm, pod, band in replay:
+            enc.add_pod(nm, pod, device_synced=True, prio_band=band)
     np.testing.assert_array_equal(enc.m_req, new_snap_h.requested)
     np.testing.assert_array_equal(enc.m_sel_counts, new_snap_h.sel_counts)
     np.testing.assert_array_equal(enc.m_port_counts, new_snap_h.port_counts)
