@@ -115,8 +115,29 @@ def make_wave_kernel(
     m_cand: int = 128,
     n_waves: int = 8,
     hard_pod_affinity_weight: float = 1.0,
+    use_pallas_fit: bool = False,
 ):
-    """Build the wave kernel (unjitted) for the given static capacities."""
+    """Build the wave kernel (unjitted) for the given static capacities.
+
+    use_pallas_fit routes the resource-fit mask (Stage A's fits0 and each
+    wave's fits_w — the kernel's hottest recomputation) through the fused
+    Pallas kernel in ops/pallas_ops.py instead of the XLA [TPL, N, R]
+    broadcast; interpret mode on non-TPU backends keeps it testable."""
+    if use_pallas_fit:
+        from .pallas_ops import fit_mask as _pallas_fit_mask
+
+        _interpret = jax.devices()[0].platform != "tpu"
+
+        def _fit(req, free):
+            return _pallas_fit_mask(req, free, interpret=_interpret)
+
+    else:
+
+        def _fit(req, free):
+            return jnp.all(
+                (req[:, None, :] == 0) | (req[:, None, :] <= free[None]),
+                axis=-1,
+            )
 
     def kernel(snap: DeviceSnapshot, tb: TemplateBatch, pt: PairTable, weights, rng):
         tpl: PodBatch = tb.tpl
@@ -146,10 +167,7 @@ def make_wave_kernel(
         )(tpl)  # each [TPL, N]
 
         free0 = snap.allocatable - snap.requested  # [N, R]
-        fits0 = jnp.all(
-            (tpl.req[:, None, :] == 0) | (tpl.req[:, None, :] <= free0[None]),
-            axis=-1,
-        )  # [TPL, N]
+        fits0 = _fit(tpl.req, free0)  # [TPL, N]
         ports0 = jnp.any(
             tpl.port_mask[:, None, :] & (snap.port_counts[None] > 0), axis=-1
         )  # [TPL, N]
@@ -395,11 +413,7 @@ def make_wave_kernel(
         def wave(_, state):
             placed, chosen, req_d, port_d, dom_d = state
             free_d = free0 - req_d  # [N, R]
-            fits_w = jnp.all(
-                (tpl.req[:, None, :] == 0)
-                | (tpl.req[:, None, :] <= free_d[None]),
-                axis=-1,
-            )
+            fits_w = _fit(tpl.req, free_d)
             ports_w = jnp.any(
                 tpl.port_mask[:, None, :]
                 & ((snap.port_counts + port_d)[None] > 0),
@@ -590,8 +604,11 @@ def make_wave_kernel_jit(
     m_cand: int = 128,
     n_waves: int = 8,
     hard_pod_affinity_weight: float = 1.0,
+    use_pallas_fit: bool = False,
 ):
     return jax.jit(
-        make_wave_kernel(v_cap, m_cand, n_waves, hard_pod_affinity_weight),
+        make_wave_kernel(
+            v_cap, m_cand, n_waves, hard_pod_affinity_weight, use_pallas_fit
+        ),
         donate_argnums=(0,),
     )

@@ -73,6 +73,7 @@ def make_sharded_wave_kernel(
     n_waves: int,
     hard_pod_affinity_weight: float,
     mesh: Mesh,
+    use_pallas_fit: bool = False,
 ):
     """The PRODUCTION wave kernel (ops/wavelattice.py) jitted with the
     snapshot sharded over the mesh's node axis.
@@ -92,7 +93,9 @@ def make_sharded_wave_kernel(
     multi-chip analogue of the reference's 16-way node fan-out
     (generic_scheduler.go:490) with ICI collectives instead of goroutines.
     """
-    base = make_wave_kernel(v_cap, m_cand, n_waves, hard_pod_affinity_weight)
+    base = make_wave_kernel(
+        v_cap, m_cand, n_waves, hard_pod_affinity_weight, use_pallas_fit
+    )
     rep = replicated(mesh)
     snap_sh = snapshot_shardings(mesh)
     in_shardings = (

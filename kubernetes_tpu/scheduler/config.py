@@ -49,6 +49,10 @@ class KubeSchedulerConfiguration:
     assume_ttl_seconds: float = 30.0
     # wave kernel (ops/wavelattice.py): vectorized bulk pass + W commit waves
     use_wave: bool = True  # False => serial scan lattice (oracle-exact)
+    # route the wave kernel's resource-fit mask (fits0 + per-wave fits_w)
+    # through the fused Pallas kernel (ops/pallas_ops.py) instead of the
+    # XLA broadcast; off by default pending on-hardware measurement
+    use_pallas_fit: bool = False
     wave_m_cand: int = 512  # top-M candidate nodes per template (>= batch/2 so a
     # zone-concentrated burst has enough distinct targets)
     wave_n_waves: int = 32  # conflict-resolution waves for batches with hard

@@ -541,6 +541,7 @@ class Scheduler:
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self._mesh,
+                self.cfg.use_pallas_fit,
             )
         else:
             kern = make_wave_kernel_jit(
@@ -548,6 +549,7 @@ class Scheduler:
                 self.cfg.wave_m_cand,
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
+                self.cfg.use_pallas_fit,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:
