@@ -1484,6 +1484,78 @@ class Subject:
 
 
 # ---------------------------------------------------------------------------
+# Networking (staging/src/k8s.io/api/networking/v1): served types whose
+# enforcement lives out of tree (ingress controllers, CNI plugins) — type
+# parity so workloads can declare them and controllers/GC can own them.
+
+
+@dataclass
+class IngressBackend:
+    service_name: str = ""
+    service_port: int = 0
+
+
+@dataclass
+class IngressPath:
+    path: str = "/"
+    path_type: str = "Prefix"  # Prefix | Exact
+    backend: IngressBackend = field(default_factory=IngressBackend)
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    paths: List[IngressPath] = field(default_factory=list)
+
+
+@dataclass
+class IngressSpec:
+    ingress_class_name: Optional[str] = None
+    default_backend: Optional[IngressBackend] = None
+    rules: List[IngressRule] = field(default_factory=list)
+
+
+@dataclass
+class Ingress:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: IngressSpec = field(default_factory=IngressSpec)
+    kind: str = "Ingress"
+
+    def deep_copy(self) -> "Ingress":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class NetworkPolicyPeer:
+    pod_selector: Optional[LabelSelector] = None
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class NetworkPolicyRule:
+    ports: List[Tuple[str, int]] = field(default_factory=list)  # (proto, port)
+    peers: List[NetworkPolicyPeer] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicySpec:
+    pod_selector: Optional[LabelSelector] = None  # None/empty = all pods
+    policy_types: List[str] = field(default_factory=lambda: ["Ingress"])
+    ingress: List[NetworkPolicyRule] = field(default_factory=list)
+    egress: List[NetworkPolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NetworkPolicySpec = field(default_factory=NetworkPolicySpec)
+    kind: str = "NetworkPolicy"
+
+    def deep_copy(self) -> "NetworkPolicy":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
 # Dynamic admission (staging/src/k8s.io/api/admissionregistration/v1):
 # webhook configurations consumed by the apiserver's webhook admission.
 

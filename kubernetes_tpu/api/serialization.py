@@ -55,6 +55,8 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "clusterrolebindings": v1.ClusterRoleBinding,
     "mutatingwebhookconfigurations": v1.MutatingWebhookConfiguration,
     "validatingwebhookconfigurations": v1.ValidatingWebhookConfiguration,
+    "ingresses": v1.Ingress,
+    "networkpolicies": v1.NetworkPolicy,
 }
 
 KIND_TO_RESOURCE = {

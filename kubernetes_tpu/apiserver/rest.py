@@ -50,6 +50,10 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
+    def send_response(self, code, message=None):
+        self._last_code = code  # recorded for the audit event
+        super().send_response(code, message)
+
     # -- helpers -------------------------------------------------------------
 
     @property
@@ -327,6 +331,54 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         return q.get("watch", ["0"])[-1] in ("1", "true")
 
+    def _audited(self, handler):
+        """WithAudit (config.go:668): one ResponseComplete event per
+        request, recorded after the handler writes its code. Wraps the
+        WHOLE chain so limiter 429s and authn 401s are audited too — the
+        rejections are when the trail matters most."""
+        aud = getattr(self.server, "audit", None)
+        if aud is None:
+            return handler()
+        self._last_code = 0  # keep-alive reuses the handler: never carry a
+        # previous request's code into this event
+        try:
+            return handler()
+        finally:
+            try:
+                # identity WITHOUT response-writing: the memoized APF user
+                # if present, else a silent header resolve (a failed authn
+                # already wrote its 401; never write from a finally)
+                if self._request_user is not None:
+                    user = self._request_user[0]
+                elif self.server.authenticator is not None:
+                    user = self.server.authenticator.authenticate_header(
+                        self.headers.get("Authorization", "")
+                    )
+                else:
+                    user = None
+                resource, ns, name, _q = self._parse()
+                if resource is not None:
+                    if self._is_long_running():
+                        verb = "watch"  # logged when the stream ends
+                    else:
+                        verb = {
+                            "GET": "get" if name else "list",
+                            "POST": "create",
+                            "PUT": "update",
+                            "DELETE": "delete",
+                        }.get(self.command, self.command.lower())
+                    aud.log(
+                        user.name if user else None,
+                        user.groups if user else (),
+                        verb,
+                        resource,
+                        ns or "",
+                        name or "",
+                        getattr(self, "_last_code", 0),
+                    )
+            except Exception:
+                pass  # auditing must never break request handling
+
     def _limited(self, handler):
         """WithPriorityAndFairness when a FlowController is configured,
         else WithMaxInFlightLimit, else unlimited (insecure dev port).
@@ -368,16 +420,16 @@ class _Handler(BaseHTTPRequestHandler):
             sem.release()
 
     def do_GET(self):
-        return self._limited(self._handle_GET)
+        return self._audited(lambda: self._limited(self._handle_GET))
 
     def do_POST(self):
-        return self._limited(self._handle_POST)
+        return self._audited(lambda: self._limited(self._handle_POST))
 
     def do_PUT(self):
-        return self._limited(self._handle_PUT)
+        return self._audited(lambda: self._limited(self._handle_PUT))
 
     def do_DELETE(self):
-        return self._limited(self._handle_DELETE)
+        return self._audited(lambda: self._limited(self._handle_DELETE))
 
     def _handle_GET(self):
         u = urlparse(self.path)
@@ -598,11 +650,13 @@ class APIServerHTTP(ThreadingHTTPServer):
         authorizer=None,
         max_in_flight: int = 400,
         priority_and_fairness: bool = True,
+        audit=None,  # apiserver.audit.AuditLogger, or None
     ):
         super().__init__(addr, _Handler)
         self.store = store
         self.authenticator = authenticator  # None = insecure port semantics
         self.authorizer = authorizer
+        self.audit = audit
         # WithPriorityAndFairness over the same total budget; falls back to
         # WithMaxInFlightLimit (config.go:662-666) when disabled. 0/None
         # max_in_flight disables both
@@ -631,6 +685,7 @@ def serve(
     authorizer=None,
     max_in_flight: int = 400,
     priority_and_fairness: bool = True,
+    audit=None,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store).
     max_in_flight=0 disables the in-flight limiter."""
@@ -642,6 +697,7 @@ def serve(
         authorizer,
         max_in_flight=max_in_flight,
         priority_and_fairness=priority_and_fairness,
+        audit=audit,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

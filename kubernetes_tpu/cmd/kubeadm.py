@@ -52,6 +52,9 @@ class ClusterHandle:
         self.controller_manager.stop()
         self.scheduler.stop()
         self.http_server.shutdown()
+        audit = getattr(self.http_server, "audit", None)
+        if audit is not None:
+            audit.stop()  # drain + close the audit writer
 
 
 def init_cluster(
@@ -139,8 +142,14 @@ def init_cluster(
             ],
         )
     )
+    from ..apiserver.audit import AuditLogger
+
     http_server, port, _ = serve(
-        store=store, port=port, authenticator=authn, authorizer=authz
+        store=store,
+        port=port,
+        authenticator=authn,
+        authorizer=authz,
+        audit=AuditLogger(path=os.path.join(data_dir, "audit.jsonl")),
     )
     logger.info("[control-plane] apiserver on :%d (WAL at %s)", port, data_dir)
 

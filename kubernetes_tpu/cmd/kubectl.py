@@ -606,6 +606,37 @@ def cmd_explain(client: RESTClient, args) -> int:
     return 0
 
 
+def cmd_certificate(client: RESTClient, args) -> int:
+    """kubectl certificate approve|deny <name> (cmd/certificates): sets the
+    condition the signer/cleaner controllers act on."""
+    from ..api import objects as v1
+
+    cond = "Approved" if args.subverb == "approve" else "Denied"
+
+    def mutate(csr):
+        if any(c.type == cond and c.status == "True" for c in csr.status.conditions):
+            return None
+        csr.status.conditions.append(
+            v1.PodCondition(type=cond, status="True", reason="KubectlCertificate")
+        )
+        return csr
+
+    _update_with_retry(
+        client, "certificatesigningrequests", mutate, "", args.name
+    )
+    past = {"approve": "approved", "deny": "denied"}[args.subverb]
+    print(f"certificatesigningrequest.certificates.k8s.io/{args.name} {past}")
+    return 0
+
+
+def cmd_api_resources(client: RESTClient, args) -> int:
+    """kubectl api-resources: the served resource catalogue."""
+    print(f"{'NAME':<36} {'KIND'}")
+    for res, cls in sorted(codec.RESOURCE_KINDS.items()):
+        print(f"{res:<36} {cls.__name__}")
+    return 0
+
+
 def cmd_drain(client: RESTClient, args) -> int:
     """kubectl drain: cordon, then EVICT every non-daemon pod off the node
     through the PDB-respecting eviction subresource, retrying 429s until
@@ -762,6 +793,10 @@ def main(argv=None) -> int:
     p_wait.add_argument("--timeout", type=float, default=30.0)
     p_explain = sub.add_parser("explain")
     p_explain.add_argument("resource")  # resource[.field.path]
+    p_cert = sub.add_parser("certificate")
+    p_cert.add_argument("subverb", choices=["approve", "deny"])
+    p_cert.add_argument("name")
+    sub.add_parser("api-resources")
     p_drain = sub.add_parser("drain")
     p_drain.add_argument("name")
     p_drain.add_argument("--timeout", type=float, default=60.0)
@@ -824,6 +859,10 @@ def main(argv=None) -> int:
             return cmd_wait(client, args)
         if args.verb == "explain":
             return cmd_explain(client, args)
+        if args.verb == "certificate":
+            return cmd_certificate(client, args)
+        if args.verb == "api-resources":
+            return cmd_api_resources(client, args)
         if args.verb == "drain":
             return cmd_drain(client, args)
         if args.verb == "auth":

@@ -12,6 +12,15 @@ Layout: resources ride the SUBLANE axis (R padded to 8) and nodes the LANE
 axis (tiles of 128), per the TPU tiling table in the pallas guide; the
 template axis is a small VMEM-resident broadcast.
 
+Two entry points:
+  * `fit_mask` — the mask alone, in the snapshot's natural [N, R] layout;
+    THIS is what the wave kernel calls (config `use_pallas_fit`).
+  * `fit_mask_least_alloc` — the mask fused with a least-allocated-style
+    score in one pass; standalone (oracle-tested, not yet wired: the wave
+    kernel's score stage normalizes cpu/mem fractions differently and its
+    fusion is the next integration step once the mask path is timed on
+    hardware).
+
 `fit_mask_least_alloc(req, free, alloc)`:
     req   [TPL, R] i32   per-template requests
     free  [R, N]  i32    allocatable - requested, transposed

@@ -2,6 +2,7 @@
 apiserver/pkg/server/config.go:660 + plugin/pkg/admission/resourcequota)."""
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -373,5 +374,54 @@ def test_priority_and_fairness_over_http():
             gd._sem.release()
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/v1/pods") as r:
             assert r.status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_audit_log_records_requests(tmp_path):
+    """WithAudit (apiserver/pkg/audit): one ResponseComplete JSON line per
+    non-watch request with user, verb, resource, and code."""
+    from kubernetes_tpu.apiserver.audit import AuditLogger
+    from kubernetes_tpu.apiserver.rest import serve
+
+    path = str(tmp_path / "audit.jsonl")
+    aud = AuditLogger(path=path)
+    authn = TokenAuthenticator(allow_anonymous=True)
+    authn.add_token("tok", "alice", groups=("devs",))
+    srv, port, store = serve(authenticator=authn, audit=aud)
+    try:
+        _req(port, "/api/v1/pods", token="tok")
+        _req(
+            port,
+            "/api/v1/namespaces/default/pods",
+            method="POST",
+            body={"kind": "Pod", "metadata": {"name": "a1"}},
+            token="tok",
+        )
+        _req(port, "/api/v1/namespaces/default/pods/missing")  # anonymous 404
+        deadline = time.time() + 5
+        while time.time() < deadline and len(aud.ring) < 3:
+            time.sleep(0.02)
+        evs = list(aud.ring)
+        assert len(evs) >= 3
+        get_ev = next(e for e in evs if e["verb"] == "list")
+        assert get_ev["user"] == "alice" and get_ev["resource"] == "pods"
+        post_ev = next(e for e in evs if e["verb"] == "create")
+        assert post_ev["code"] in (200, 201) and post_ev["name"] == ""
+        miss = next(e for e in evs if e["name"] == "missing")
+        assert miss["code"] == 404 and miss["user"] == "system:anonymous"
+        aud.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                lines = open(path).read().strip().splitlines()
+                if len(lines) >= 3:
+                    break
+            except FileNotFoundError:
+                pass
+            time.sleep(0.05)
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) >= 3
+        assert json.loads(lines[0])["stage"] == "ResponseComplete"
     finally:
         srv.shutdown()

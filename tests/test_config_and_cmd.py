@@ -432,3 +432,66 @@ def test_kubectl_rollout_history_and_undo(capsys):
     finally:
         ctrl.stop()
         srv.shutdown()
+
+
+def test_kubectl_certificate_and_api_resources(capsys):
+    """kubectl certificate approve drives the signer; api-resources lists
+    the catalogue; networking types round-trip the wire codec."""
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.controller.certificates import CSRSigningController
+
+    srv, port, store = serve()
+    signer = CSRSigningController(store)
+    signer.start()
+    try:
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        store.create(
+            "certificatesigningrequests",
+            v1.CertificateSigningRequest(
+                metadata=v1.ObjectMeta(name="user-csr", namespace=""),
+                spec=v1.CertificateSigningRequestSpec(
+                    request="payload", username="alice", signer_name="custom"
+                ),
+            ),
+        )
+        assert kubectl.main(base + ["certificate", "approve", "user-csr"]) == 0
+        capsys.readouterr()
+        assert wait_until(
+            lambda: bool(
+                store.get("certificatesigningrequests", "", "user-csr")
+                .status.certificate
+            )
+        ), "approval via kubectl must flow into signing"
+
+        assert kubectl.main(base + ["api-resources"]) == 0
+        out = capsys.readouterr().out
+        for res in ("pods", "ingresses", "networkpolicies", "clusterroles"):
+            assert res in out
+
+        # networking types round-trip through the REST wire form
+        from kubernetes_tpu.api import serialization
+
+        ing = v1.Ingress(
+            metadata=v1.ObjectMeta(name="web"),
+            spec=v1.IngressSpec(
+                rules=[
+                    v1.IngressRule(
+                        host="x.test",
+                        paths=[
+                            v1.IngressPath(
+                                backend=v1.IngressBackend("svc", 80)
+                            )
+                        ],
+                    )
+                ]
+            ),
+        )
+        store.create("ingresses", ing)
+        got = store.get("ingresses", "default", "web")
+        enc = serialization.encode(got)
+        back = serialization.decode("ingresses", enc)
+        assert back.spec.rules[0].paths[0].backend.service_name == "svc"
+    finally:
+        signer.stop()
+        srv.shutdown()
