@@ -53,6 +53,11 @@ class KubeSchedulerConfiguration:
     # through the fused Pallas kernel (ops/pallas_ops.py) instead of the
     # XLA broadcast; off by default pending on-hardware measurement
     use_pallas_fit: bool = False
+    # debug: cross-check every device placement against the HOST filter
+    # chain per cycle (SURVEY §5's per-cycle verify mode — the live
+    # analogue of the offline differential fuzz). Costs a host snapshot +
+    # plugin run per placement; off outside debugging
+    verify_cycles: bool = False
     wave_m_cand: int = 512  # top-M candidate nodes per template (>= batch/2 so a
     # zone-concentrated burst has enough distinct targets)
     wave_n_waves: int = 32  # conflict-resolution waves for batches with hard

@@ -88,9 +88,14 @@ class _InFlightBatch:
     """A wave batch whose kernel is dispatched but whose results haven't
     been read back yet (pipeline depth 1)."""
 
-    __slots__ = ("pis", "eb", "row_names", "res", "moves0", "trace", "t_start")
+    __slots__ = (
+        "pis", "eb", "row_names", "res", "moves0", "trace", "t_start",
+        "snapshot",
+    )
 
-    def __init__(self, pis, eb, row_names, res, moves0, trace, t_start):
+    def __init__(
+        self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None
+    ):
         self.pis = pis
         self.eb = eb
         self.row_names = row_names
@@ -98,6 +103,10 @@ class _InFlightBatch:
         self.moves0 = moves0
         self.trace = trace
         self.t_start = t_start
+        # host snapshot captured AT LAUNCH (verify_cycles only): the state
+        # the device encoding was built from — verifying against resolve-
+        # time state would report informer churn as device/host mismatches
+        self.snapshot = snapshot
 
 
 _SCORE_NAME_TO_COMPONENT = {
@@ -562,8 +571,11 @@ class Scheduler:
         trace.step("launch")
         with self.cache.lock:
             self.cache.encoder.set_device_snapshot(new_snap)
+        verify_snap = (
+            self.cache.update_snapshot() if self.cfg.verify_cycles else None
+        )
         prev, self._pending = self._pending, _InFlightBatch(
-            pis, eb, row_names, res, moves0, trace, t_start
+            pis, eb, row_names, res, moves0, trace, t_start, verify_snap
         )
         if prev is not None:
             self._resolve_batch(prev)
@@ -650,6 +662,14 @@ class Scheduler:
             else:
                 failed.append((pi, i))
 
+        if self.cfg.verify_cycles and to_bind:
+            try:
+                self._verify_placements(to_bind, p.snapshot)
+            except Exception:
+                # a diagnostic must never affect scheduling: an exception
+                # here would requeue a fully successful batch while the
+                # device snapshot keeps its commits
+                logger.exception("verify_cycles cross-check failed")
         self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
         trace.step("assume+bind")
         if fallback_pis or failed:
@@ -688,6 +708,53 @@ class Scheduler:
                     ],
                 )
         trace.log_if_long(0.1)
+
+    # pre-batch-sound plugins: anti-monotone (or invariant) under in-batch
+    # commits, so a device placement MUST pass them on the pre-batch host
+    # snapshot. Inter-pod terms are excluded — batch-mates legitimately
+    # CREATE affinity feasibility (carveout chains)
+    _VERIFY_PLUGINS = (
+        "NodeUnschedulable",
+        "NodeName",
+        "NodePorts",
+        "NodeAffinity",
+        "TaintToleration",
+        "NodeResourcesFit",
+    )
+
+    def _verify_placements(self, to_bind: List, snapshot) -> None:
+        """Per-cycle device-vs-host cross-check (SURVEY §5): run the host
+        filter chain's pre-batch-sound subset for every placement the
+        kernel committed, against the snapshot captured AT LAUNCH (the
+        state the device encoding saw); a FAIL verdict means the device
+        encoding and the host plugins disagree — counted and logged, never
+        acted on (the live analogue of tests/test_fuzz_differential.py).
+        Debug mode: the launch-time snapshot clone is the cost."""
+        if snapshot is None:
+            return
+        for pi, node_name, _band, _proto in to_bind:
+            ni = snapshot.node_info_map.get(node_name)
+            if ni is None:
+                continue
+            prof = self.profiles.for_pod(pi.pod)
+            fw = prof.framework
+            state = CycleState()
+            for name in self._VERIFY_PLUGINS:
+                if not fw.has_filter_plugin(name):
+                    continue
+                st = fw.plugin(name).filter(state, pi.pod, ni)
+                if not is_success(st):
+                    metrics.inc(
+                        "scheduler_verify_mismatch_total", {"plugin": name}
+                    )
+                    logger.error(
+                        "verify_cycles: device placed %s on %s but host "
+                        "plugin %s says %s",
+                        pi.pod.metadata.key,
+                        node_name,
+                        name,
+                        st.message or st.code,
+                    )
 
     def _preempt_whatif_tpl(self, eb, failed: List, pod_tpl: np.ndarray):
         """[TPL, N] optimistic preemption mask for the batch's templates
