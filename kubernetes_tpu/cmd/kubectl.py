@@ -174,6 +174,32 @@ def _print_table(resource: str, objs) -> None:
         print(f"{'TYPE':<8} {'REASON':<20} {'OBJECT':<40} {'NOTE'}")
         for e in objs:
             print(f"{e.type:<8} {e.reason:<20} {e.involved_key:<40} {e.note[:60]}")
+    elif resource in ("deployments", "replicasets", "statefulsets"):
+        print(f"{'NAMESPACE':<12} {'NAME':<32} {'DESIRED':<8} {'READY':<6} {'UPDATED'}")
+        for o in objs:
+            st = o.status
+            print(
+                f"{o.metadata.namespace:<12} {o.metadata.name:<32} "
+                f"{o.spec.replicas:<8} {getattr(st, 'ready_replicas', 0):<6} "
+                f"{getattr(st, 'updated_replicas', '-')}"
+            )
+    elif resource == "jobs":
+        print(f"{'NAMESPACE':<12} {'NAME':<32} {'COMPLETIONS':<12} {'ACTIVE':<7} {'FAILED'}")
+        for o in objs:
+            want = o.spec.completions if o.spec.completions is not None else 1
+            print(
+                f"{o.metadata.namespace:<12} {o.metadata.name:<32} "
+                f"{o.status.succeeded}/{want:<10} {o.status.active:<7} "
+                f"{o.status.failed}"
+            )
+    elif resource == "services":
+        print(f"{'NAMESPACE':<12} {'NAME':<32} {'TYPE':<12} {'CLUSTER-IP':<16} {'PORTS'}")
+        for o in objs:
+            ports = ",".join(f"{p[1]}/{p[0]}" for p in o.spec.ports) or "<none>"
+            print(
+                f"{o.metadata.namespace:<12} {o.metadata.name:<32} "
+                f"{o.spec.type:<12} {o.spec.cluster_ip or '<none>':<16} {ports}"
+            )
     else:
         print("NAME")
         for o in objs:
@@ -195,6 +221,30 @@ def cmd_describe(client: RESTClient, args) -> int:
             print("\nEvents:")
             for e in related:
                 print(f"  {e.type} {e.reason}: {e.note} (x{e.count})")
+    elif resource == "nodes":
+        # describe node: allocated-resources summary (kubectl's
+        # "Allocated resources" section)
+        from ..api.objects import compute_pod_resource_request
+        from ..api.resources import cpu_to_millis, parse_quantity
+
+        pods, _ = client.list("pods")
+        # terminal pods keep spec.nodeName until GC but hold no resources
+        # (kubectl filters them from Allocated resources the same way)
+        mine = [
+            p
+            for p in pods
+            if p.spec.node_name == obj.metadata.name
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        reqs = [compute_pod_resource_request(p) for p in mine]
+        cpu_m = sum(r.get("cpu", 0) for r in reqs)  # already millicores
+        mem_b = sum(r.get("memory", 0) for r in reqs)  # already bytes
+        cpu_alloc = cpu_to_millis(obj.status.allocatable.get("cpu", 0)) or 1
+        mem_alloc = parse_quantity(obj.status.allocatable.get("memory", 0)) or 1
+        print("\nAllocated resources:")
+        print(f"  pods:   {len(mine)}")
+        print(f"  cpu:    {cpu_m}m ({100 * cpu_m / cpu_alloc:.0f}%)")
+        print(f"  memory: {mem_b} ({100 * mem_b / mem_alloc:.0f}%)")
     return 0
 
 

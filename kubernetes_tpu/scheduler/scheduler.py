@@ -538,6 +538,15 @@ class Scheduler:
                     snap = self.cache.encoder.flush()
                     enc_cfg = self.cache.encoder.cfg
                     row_names = list(self.cache.encoder.row_names)
+                    # verify_cycles: the host view the device encoding was
+                    # built from — cloned under the SAME lock as the flush,
+                    # or informer churn in between would read as phantom
+                    # device/host mismatches
+                    verify_snap = (
+                        self.cache.update_snapshot()
+                        if self.cfg.verify_cycles
+                        else None
+                    )
                     break
             self._resolve_pending()
         trace.step("flush")
@@ -571,9 +580,6 @@ class Scheduler:
         trace.step("launch")
         with self.cache.lock:
             self.cache.encoder.set_device_snapshot(new_snap)
-        verify_snap = (
-            self.cache.update_snapshot() if self.cfg.verify_cycles else None
-        )
         prev, self._pending = self._pending, _InFlightBatch(
             pis, eb, row_names, res, moves0, trace, t_start, verify_snap
         )

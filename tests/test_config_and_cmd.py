@@ -495,3 +495,45 @@ def test_kubectl_certificate_and_api_resources(capsys):
     finally:
         signer.stop()
         srv.shutdown()
+
+
+def test_kubectl_workload_tables_and_describe_node(capsys):
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    srv, port, store = serve()
+    try:
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        store.create("nodes", make_node("big"))
+        p = make_pod("on-big")
+        p.spec.node_name = "big"
+        store.create("pods", p)
+        store.create(
+            "deployments",
+            v1.Deployment(
+                metadata=v1.ObjectMeta(name="api"),
+                spec=v1.DeploymentSpec(replicas=3, selector={"app": "api"}),
+                status=v1.DeploymentStatus(ready_replicas=2, updated_replicas=3),
+            ),
+        )
+        store.create(
+            "services",
+            v1.Service(
+                metadata=v1.ObjectMeta(name="svc"),
+                spec=v1.ServiceSpec(
+                    selector={"app": "api"}, ports=[("TCP", 80)],
+                    cluster_ip="10.96.0.9",
+                ),
+            ),
+        )
+        assert kubectl.main(base + ["get", "deployments"]) == 0
+        out = capsys.readouterr().out
+        assert "DESIRED" in out and " 3 " in out.replace("3", " 3 ", 1)
+        assert kubectl.main(base + ["get", "services"]) == 0
+        out = capsys.readouterr().out
+        assert "10.96.0.9" in out and "80/TCP" in out
+        assert kubectl.main(base + ["describe", "nodes", "big"]) == 0
+        out = capsys.readouterr().out
+        assert "Allocated resources" in out and "cpu:    100m" in out
+    finally:
+        srv.shutdown()

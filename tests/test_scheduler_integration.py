@@ -216,3 +216,28 @@ def test_verify_cycles_mode_clean_run():
         assert not mismatches, mismatches
     finally:
         sched.stop()
+
+
+def test_debug_flags_matrix_schedules():
+    """Soak the debug-flag interactions: Pallas fit mask (interpret mode on
+    CPU) + per-cycle verify together must schedule cleanly with zero
+    mismatches."""
+    from kubernetes_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    server = APIServer()
+    cfg = KubeSchedulerConfiguration(
+        use_device=True, use_pallas_fit=True, verify_cycles=True
+    )
+    sched = Scheduler(server, cfg)
+    sched.start()
+    try:
+        for i in range(4):
+            server.create("nodes", make_node(f"m{i}"))
+        for i in range(16):
+            server.create("pods", make_pod(f"x{i}", cpu="250m"))
+        wait_scheduled(server, [f"x{i}" for i in range(16)])
+        dump = metrics.dump()
+        assert not {k: v for k, v in dump.items() if "verify_mismatch" in k}
+    finally:
+        sched.stop()
