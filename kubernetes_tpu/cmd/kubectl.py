@@ -67,6 +67,23 @@ ALIASES = {
 }
 
 
+CLUSTER_SCOPED = {
+    "nodes",
+    "persistentvolumes",
+    "storageclasses",
+    "csinodes",
+    "namespaces",
+    "priorityclasses",
+    "customresourcedefinitions",
+    "apiservices",
+    "clusterroles",
+    "clusterrolebindings",
+    "mutatingwebhookconfigurations",
+    "validatingwebhookconfigurations",
+    "certificatesigningrequests",
+}
+
+
 def _resource(arg: str) -> str:
     return ALIASES.get(arg, arg)
 
@@ -109,7 +126,7 @@ def cmd_get(client: RESTClient, args) -> int:
         if (
             not getattr(args, "all_namespaces", False)
             and args.namespace
-            and resource == "pods"
+            and resource not in CLUSTER_SCOPED
             and o.metadata.namespace != args.namespace
         ):
             return False

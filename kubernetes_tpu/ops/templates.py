@@ -48,16 +48,11 @@ def _own_selector_matches(pod: v1.Pod) -> Tuple:
     return tuple(out)
 
 
-def _label_effect_key(encoder: SnapshotEncoder, pod: v1.Pod) -> Tuple:
-    """Labels as the ENCODING sees them: which interned predicates (selector
-    vocab + existing-pod term vocab) match, plus the pod's own-term
-    self-matches. Two pods whose labels differ only in ways no predicate
-    observes — e.g. 300 gangs distinguished solely by a group-name label —
-    collapse to one template instead of 300 (each extra template count is
-    another XLA variant; a 15k-pod gang burst compiled per batch without
-    this). Vocab lengths are part of the key so growth never aliases masks
-    across vocab versions."""
-    ns, labels = pod.metadata.namespace, pod.metadata.labels
+def _label_masks(encoder: SnapshotEncoder, ns: str, labels) -> Tuple:
+    """(len_sel, len_eterm, sel_mask, eterm_mask): which interned
+    predicates match these labels, stamped with the vocab lengths so
+    growth never aliases masks across vocab versions. THE single source
+    for both the direct and the memoized fingerprint paths."""
     sel_mask = 0
     for i, pred in enumerate(encoder.sel_vocab.items):
         if pred.matches(ns, labels):
@@ -66,13 +61,21 @@ def _label_effect_key(encoder: SnapshotEncoder, pod: v1.Pod) -> Tuple:
     for i, et in enumerate(encoder.eterm_vocab.items):
         if et.predicate.matches(ns, labels):
             et_mask |= 1 << i
+    return (len(encoder.sel_vocab), len(encoder.eterm_vocab), sel_mask, et_mask)
+
+
+def _label_effect_key(encoder: SnapshotEncoder, pod: v1.Pod) -> Tuple:
+    """Labels as the ENCODING sees them: which interned predicates (selector
+    vocab + existing-pod term vocab) match, plus the pod's own-term
+    self-matches. Two pods whose labels differ only in ways no predicate
+    observes — e.g. 300 gangs distinguished solely by a group-name label —
+    collapse to one template instead of 300 (each extra template count is
+    another XLA variant; a 15k-pod gang burst compiled per batch without
+    this)."""
     return (
-        "enc",
-        len(encoder.sel_vocab),
-        len(encoder.eterm_vocab),
-        sel_mask,
-        et_mask,
-        _own_selector_matches(pod),
+        ("enc",)
+        + _label_masks(encoder, pod.metadata.namespace, pod.metadata.labels)
+        + (_own_selector_matches(pod),)
     )
 
 
@@ -182,6 +185,8 @@ class TemplateCache:
         self._fallback: List[bool] = []
         self._tpl_batch_np: Optional[PodBatch] = None
         self._vocab_sig = self._sig()
+        self._label_memo: Dict[Tuple, Tuple] = {}
+        self._label_memo_sig = (0, 0)
 
     def _sig(self) -> Tuple:
         e = self.encoder
@@ -195,6 +200,37 @@ class TemplateCache:
             len(e.avoid_vocab),
             len(e.res_vocab),
             e.cfg,
+        )
+
+    def _fingerprint(self, pod: v1.Pod) -> Tuple:
+        """pod_fingerprint with the label-effect masks memoized by
+        (namespace, labels): a burst's pods repeat a handful of label sets
+        thousands of times, and the per-pod vocab scans in
+        _label_effect_key dominated tpl-encode."""
+        key = (
+            pod.metadata.namespace,
+            tuple(sorted(pod.metadata.labels.items())),
+        )
+        memo = self._label_memo
+        eff = memo.get(key)
+        if eff is None:
+            if len(memo) > 4096:
+                memo.clear()  # unbounded label diversity: cap the memo
+            eff = memo[key] = _label_masks(
+                self.encoder, pod.metadata.namespace, pod.metadata.labels
+            )
+        fp = pod_fingerprint(pod, None)
+        # splice the memoized effect key in place of the raw-labels slot
+        # (index 1 — see pod_fingerprint's tuple layout)
+        return (
+            fp[0],
+            ("enc",) + eff + (_own_selector_matches(pod),),
+        ) + fp[2:]
+
+    def _memo_valid(self) -> bool:
+        return self._label_memo_sig == (
+            len(self.encoder.sel_vocab),
+            len(self.encoder.eterm_vocab),
         )
 
     def encode(
@@ -211,7 +247,14 @@ class TemplateCache:
         # nothing new, so this converges in <= 2 extra passes.
         for _ in range(4):
             sig0 = self._sig()
-            fps = [pod_fingerprint(p, self.encoder) for p in pods]
+            if not self._memo_valid():
+                # vocab grew: every memoized mask is stale
+                self._label_memo.clear()
+                self._label_memo_sig = (
+                    len(self.encoder.sel_vocab),
+                    len(self.encoder.eterm_vocab),
+                )
+            fps = [self._fingerprint(p) for p in pods]
             changed = False
             for pod, fp in zip(pods, fps):
                 if fp not in self._rows:

@@ -280,3 +280,43 @@ def test_template_split_when_predicate_interned_same_batch():
     )
     # and the template match bits must reflect each pod's actual labels
     assert bool(cache.match_eterm_differs(tw, tx)) if hasattr(cache, "match_eterm_differs") else True
+
+
+def test_memoized_fingerprint_matches_direct():
+    """TemplateCache's memoized fingerprint must equal pod_fingerprint
+    (pod, encoder) exactly — incl. after vocab growth invalidates masks."""
+    from kubernetes_tpu.api.objects import Container, ObjectMeta, Pod, PodSpec
+    from kubernetes_tpu.ops.templates import pod_fingerprint
+
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("n0"))
+    cache = TemplateCache(enc)
+    cache._label_memo_sig = (len(enc.sel_vocab), len(enc.eterm_vocab))
+
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector.make({"app": "a"}),
+                    topology_key="zone",
+                ),
+            )
+        )
+    )
+    pods = [
+        Pod(metadata=ObjectMeta(name="x", labels={"app": "a"}),
+            spec=PodSpec(containers=[Container(requests={"cpu": "1"})])),
+        Pod(metadata=ObjectMeta(name="y", labels={"app": "b", "extra": "1"}),
+            spec=PodSpec(containers=[Container(requests={"cpu": "2"})])),
+        make_pod("z", labels={"app": "a"}, affinity=anti),
+    ]
+    for p in pods:
+        assert cache._fingerprint(p) == pod_fingerprint(p, enc), p.metadata.name
+    # grow the vocab (intern a predicate), memo must invalidate
+    enc.intern_predicate(
+        frozenset({"default"}), LabelSelector.make({"app": "b"})
+    )
+    cache._label_memo.clear()
+    cache._label_memo_sig = (len(enc.sel_vocab), len(enc.eterm_vocab))
+    for p in pods:
+        assert cache._fingerprint(p) == pod_fingerprint(p, enc), p.metadata.name
