@@ -66,9 +66,14 @@ class HollowCluster(NodeAgentPool):
         num_nodes: int = 0,
         name_prefix: str = "hollow-node",
         heartbeat_interval: float = 10.0,
+        housekeeping_interval: float = 1.0,
         node_template=make_hollow_node,
     ):
-        super().__init__(server, heartbeat_interval=heartbeat_interval)
+        super().__init__(
+            server,
+            heartbeat_interval=heartbeat_interval,
+            housekeeping_interval=housekeeping_interval,
+        )
         self.nodes: Dict[str, HollowNode] = {}
         self._template = node_template
         for i in range(num_nodes):

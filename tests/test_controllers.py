@@ -241,3 +241,55 @@ def test_controller_manager_runs_all():
         }
     finally:
         mgr.stop()
+
+
+def test_hollow_cluster_scale_smoke():
+    """Kubemark-style scale smoke (SURVEY §4: hollow nodes let a big
+    control plane run on one box): 200 hollow kubelets, 1000 pods,
+    everything Running with status/IP posted by the shared kubelet path."""
+    server = APIServer()
+    hollow = HollowCluster(
+        server,
+        num_nodes=200,
+        heartbeat_interval=2.0,
+        housekeeping_interval=0.5,
+    )
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    hollow.start()
+    sched.start()
+    try:
+        from kubernetes_tpu.api.objects import Pod
+
+        for i in range(1000):
+            server.create(
+                "pods",
+                Pod(
+                    metadata=ObjectMeta(name=f"scale-{i}"),
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": "10m"})]
+                    ),
+                ),
+            )
+        deadline = time.time() + 120
+        running = 0
+        while time.time() < deadline:
+            running = server.count(
+                "pods", lambda p: p.status.phase == "Running"
+            )
+            if running >= 1000:
+                break
+            time.sleep(0.25)
+        assert running >= 1000, f"only {running}/1000 pods Running"
+        # spread across the fleet, and every Running pod has a sandbox IP
+        nodes_used = {
+            p.spec.node_name for p in server.list("pods")[0] if p.spec.node_name
+        }
+        assert len(nodes_used) == 200
+        assert all(
+            p.status.pod_ip
+            for p in server.list("pods")[0]
+            if p.status.phase == "Running"
+        )
+    finally:
+        sched.stop()
+        hollow.stop()
