@@ -47,8 +47,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
             ok = self.server.health_check()
             self._respond(200 if ok else 500, b"ok" if ok else b"unhealthy")
         elif self.path == "/metrics":
-            body = json.dumps(metrics.dump(), indent=1).encode()
-            self._respond(200, body, "application/json")
+            # content negotiation: Prometheus exposition text by default
+            # (what the reference's legacyregistry serves); JSON on request
+            if "application/json" in (self.headers.get("Accept") or ""):
+                body = json.dumps(metrics.dump(), indent=1).encode()
+                self._respond(200, body, "application/json")
+            else:
+                self._respond(
+                    200,
+                    metrics.render_prometheus().encode(),
+                    "text/plain; version=0.0.4",
+                )
         else:
             self._respond(404, b"not found")
 

@@ -66,7 +66,7 @@ class HollowCluster(NodeAgentPool):
         num_nodes: int = 0,
         name_prefix: str = "hollow-node",
         heartbeat_interval: float = 10.0,
-        housekeeping_interval: float = 1.0,
+        housekeeping_interval: float = 0.5,  # NodeAgentPool's default
         node_template=make_hollow_node,
     ):
         super().__init__(

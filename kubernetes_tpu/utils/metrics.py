@@ -91,6 +91,59 @@ class Metrics:
             self._gauges.clear()
             self._hists.clear()
 
+    def render_prometheus(self) -> str:
+        """Prometheus exposition text format (the wire form the reference's
+        legacyregistry serves on /metrics): counters and gauges as-is,
+        histograms as _count/_sum plus p50/p90/p99 quantile gauges (this
+        registry keeps a sample reservoir, not fixed buckets)."""
+
+        def esc(v) -> str:
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        def fmt_labels(labels) -> str:
+            if not labels:
+                return ""
+            inner = ",".join(
+                f'{k}="{esc(v)}"' for k, v in sorted(dict(labels).items())
+            )
+            return "{" + inner + "}"
+
+        lines = []
+        # the whole render holds the lock (like dump()): histograms are
+        # shared mutable objects, and a concurrent observe() between the
+        # quantile/_sum/_count reads would emit a torn summary
+        with self._lock:
+            seen_types = set()
+            for (name, labels), v in sorted(self._counters.items()):
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} counter")
+                    seen_types.add(name)
+                lines.append(f"{name}{fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} gauge")
+                    seen_types.add(name)
+                lines.append(f"{name}{fmt_labels(labels)} {v}")
+            for (name, labels), h in sorted(self._hists.items()):
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} summary")
+                    seen_types.add(name)
+                base = dict(labels) if labels else {}
+                s = sorted(h._samples)  # one sort serves all quantiles
+                for q in (0.5, 0.9, 0.99):
+                    ql = dict(base)
+                    ql["quantile"] = f"{q:g}"
+                    val = s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
+                    lines.append(f"{name}{fmt_labels(ql)} {val}")
+                lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
+                lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+        return "\n".join(lines) + "\n"
+
     def dump(self) -> dict:
         with self._lock:
             out = {}

@@ -205,9 +205,20 @@ def test_cmd_run_serves_healthz_and_metrics():
             f"http://127.0.0.1:{port}/healthz", timeout=5
         ).read()
         assert body == b"ok"
+        # default: Prometheus exposition text (legacyregistry wire form)
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "# TYPE schedule_attempts_total counter" in text
+        assert 'schedule_attempts_total{result="scheduled"}' in text
+        # JSON via content negotiation
         m = json.loads(
             urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics", timeout=5
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/metrics",
+                    headers={"Accept": "application/json"},
+                ),
+                timeout=5,
             ).read()
         )
         assert any("schedule_attempts_total" in k for k in m)
@@ -216,12 +227,10 @@ def test_cmd_run_serves_healthz_and_metrics():
             f"http://127.0.0.1:{port}/metrics", method="DELETE"
         )
         urllib.request.urlopen(req, timeout=5)
-        m2 = json.loads(
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics", timeout=5
-            ).read()
-        )
-        assert not any("schedule_attempts_total" in k for k in m2)
+        text2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "schedule_attempts_total" not in text2
     finally:
         sched.stop()
 
