@@ -266,6 +266,9 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     priority: Optional[int] = None
     priority_class_name: str = ""
+    # PreemptLowerPriority | Never; None = inherit the class's policy
+    # (filled by the Priority admission plugin, like spec.priority)
+    preemption_policy: Optional[str] = None
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
     overhead: Dict[str, Quantity] = field(default_factory=dict)
@@ -465,6 +468,7 @@ def _copy_pod_spec(s: PodSpec) -> PodSpec:
         scheduler_name=s.scheduler_name,
         priority=s.priority,
         priority_class_name=s.priority_class_name,
+        preemption_policy=s.preemption_policy,
         containers=[_copy_container(c) for c in s.containers],
         init_containers=[_copy_container(c) for c in s.init_containers],
         overhead=dict(s.overhead),

@@ -476,11 +476,23 @@ class PriorityAdmission(AdmissionPlugin):
                     f"no PriorityClass {obj.spec.priority_class_name!r}"
                 )
             obj.spec.priority = pc.value
+            if obj.spec.preemption_policy is None:
+                obj.spec.preemption_policy = pc.preemption_policy
+            elif obj.spec.preemption_policy != pc.preemption_policy:
+                # admission.go rejects the mismatch: a pod must not claim a
+                # class's priority while discarding its preemption policy
+                raise AdmissionDenied(
+                    f"pod preemptionPolicy {obj.spec.preemption_policy!r} "
+                    f"conflicts with PriorityClass "
+                    f"{pc.metadata.name!r} policy {pc.preemption_policy!r}"
+                )
             return
         default = next((c for c in classes if c.global_default), None)
         if default is not None and obj.spec.priority is None:
             obj.spec.priority = default.value
             obj.spec.priority_class_name = default.metadata.name
+            if obj.spec.preemption_policy is None:
+                obj.spec.preemption_policy = default.preemption_policy
 
 
 class DefaultStorageClassAdmission(AdmissionPlugin):

@@ -190,9 +190,12 @@ class Preemptor:
 
 
 def pod_eligible_to_preempt_others(pod: v1.Pod, snapshot: Snapshot) -> bool:
-    """podEligibleToPreemptOthers (:840): a pod that already nominated a node
-    where a lower-priority victim is terminating waits instead of preempting
-    again."""
+    """podEligibleToPreemptOthers (:840): a preemptionPolicy of Never
+    (from the pod's PriorityClass via admission) disqualifies outright;
+    a pod that already nominated a node where a lower-priority victim is
+    terminating waits instead of preempting again."""
+    if pod.spec.preemption_policy == "Never":
+        return False
     nominated = pod.status.nominated_node_name
     if nominated:
         ni = snapshot.get(nominated)

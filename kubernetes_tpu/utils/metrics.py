@@ -38,11 +38,14 @@ class Histogram:
             self._samples.append(v)
 
     def quantile(self, q: float) -> float:
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs) -> List[float]:
+        """Several quantiles from ONE sort of the reservoir."""
         if not self._samples:
-            return 0.0
+            return [0.0] * len(qs)
         s = sorted(self._samples)
-        idx = min(int(q * len(s)), len(s) - 1)
-        return s[idx]
+        return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]
 
     @property
     def avg(self) -> float:
@@ -134,11 +137,10 @@ class Metrics:
                     lines.append(f"# TYPE {name} summary")
                     seen_types.add(name)
                 base = dict(labels) if labels else {}
-                s = sorted(h._samples)  # one sort serves all quantiles
-                for q in (0.5, 0.9, 0.99):
+                vals = h.quantiles((0.5, 0.9, 0.99))  # one sort
+                for q, val in zip((0.5, 0.9, 0.99), vals):
                     ql = dict(base)
                     ql["quantile"] = f"{q:g}"
-                    val = s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
                     lines.append(f"{name}{fmt_labels(ql)} {val}")
                 lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
                 lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")

@@ -204,3 +204,50 @@ def test_device_whatif_mask_is_optimistic_superset():
                 assert mask[pi, ni], (
                     f"what-if mask excluded viable node {name} for {pod.metadata.name}"
                 )
+
+
+def test_preemption_policy_never_blocks_preemption():
+    """A PriorityClass with preemptionPolicy=Never yields high priority
+    WITHOUT the right to evict (admission.go + podEligibleToPreemptOthers):
+    the pod queues ahead but never takes victims."""
+    from kubernetes_tpu.apiserver.auth import AdmissionChain, PriorityAdmission
+
+    server = APIServer()
+    server.create(
+        "priorityclasses",
+        v1.PriorityClass(
+            metadata=v1.ObjectMeta(name="polite-high", namespace=""),
+            value=100000,
+            preemption_policy="Never",
+        ),
+    )
+    server.admit_hooks.append(
+        AdmissionChain(mutating=[PriorityAdmission(server)])
+    )
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    server.create("nodes", make_node("only", cpu="2"))
+    sched.start()
+    try:
+        low = make_pod("low", cpu="1500m")
+        low.spec.priority = 0
+        server.create("pods", low)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if server.get("pods", "default", "low").spec.node_name:
+                break
+            time.sleep(0.03)
+        assert server.get("pods", "default", "low").spec.node_name == "only"
+
+        polite = make_pod("polite", cpu="1500m")
+        polite.spec.priority_class_name = "polite-high"
+        server.create("pods", polite)
+        stored = server.get("pods", "default", "polite")
+        assert stored.spec.priority == 100000
+        assert stored.spec.preemption_policy == "Never"
+        time.sleep(2.0)
+        # the victim survives and the polite pod stays pending
+        names = {p.metadata.name for p in server.list("pods")[0]}
+        assert "low" in names, "Never-policy pod must not evict"
+        assert server.get("pods", "default", "polite").spec.node_name == ""
+    finally:
+        sched.stop()
