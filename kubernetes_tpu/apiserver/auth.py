@@ -493,6 +493,15 @@ class PriorityAdmission(AdmissionPlugin):
             obj.spec.priority_class_name = default.metadata.name
             if obj.spec.preemption_policy is None:
                 obj.spec.preemption_policy = default.preemption_policy
+            elif obj.spec.preemption_policy != default.preemption_policy:
+                # same mismatch rule as the named-class branch: the
+                # resolved class's policy binds
+                raise AdmissionDenied(
+                    f"pod preemptionPolicy {obj.spec.preemption_policy!r} "
+                    f"conflicts with default PriorityClass "
+                    f"{default.metadata.name!r} policy "
+                    f"{default.preemption_policy!r}"
+                )
 
 
 class DefaultStorageClassAdmission(AdmissionPlugin):

@@ -118,7 +118,7 @@ def cmd_get(client: RESTClient, args) -> int:
         if args.output == "json":
             print(json.dumps(codec.encode(obj), indent=2))
         else:
-            _print_table(resource, [obj])
+            _print_table(resource, [obj], wide=args.output == "wide")
         return 0
     objs, rv = client.list(resource)
 
@@ -149,7 +149,7 @@ def cmd_get(client: RESTClient, args) -> int:
     if args.output == "json":
         print(json.dumps([codec.encode(o) for o in objs], indent=2))
     else:
-        _print_table(resource, objs)
+        _print_table(resource, objs, wide=args.output == "wide")
     if getattr(args, "watch", False):
         # stream subsequent changes (kubectl get -w), same filters as the
         # initial list
@@ -171,14 +171,38 @@ def cmd_get(client: RESTClient, args) -> int:
     return 0
 
 
-def _print_table(resource: str, objs) -> None:
+def _print_table(resource: str, objs, wide: bool = False) -> None:
     if resource == "pods":
-        print(f"{'NAMESPACE':<12} {'NAME':<40} {'NODE':<24} {'PHASE':<10}")
-        for p in objs:
+        if wide:
             print(
+                f"{'NAMESPACE':<12} {'NAME':<40} {'NODE':<24} {'PHASE':<10} "
+                f"{'READY':<6} {'IP':<16} {'RESTARTS'}"
+            )
+        else:
+            print(f"{'NAMESPACE':<12} {'NAME':<40} {'NODE':<24} {'PHASE':<10}")
+        for p in objs:
+            line = (
                 f"{p.metadata.namespace:<12} {p.metadata.name:<40} "
                 f"{p.spec.node_name or '<none>':<24} {p.status.phase:<10}"
             )
+            if wide:
+                from ..api.objects import COND_POD_READY
+
+                ready = next(
+                    (
+                        c.status
+                        for c in p.status.conditions
+                        if c.type == COND_POD_READY
+                    ),
+                    "-",
+                )
+                restarts = sum(
+                    cs.restart_count for cs in p.status.container_statuses
+                )
+                line += (
+                    f" {ready:<6} {p.status.pod_ip or '<none>':<16} {restarts}"
+                )
+            print(line)
     elif resource == "nodes":
         print(f"{'NAME':<28} {'UNSCHEDULABLE':<14} {'TAINTS':<5} {'CPU':<8}")
         for n in objs:
@@ -797,7 +821,7 @@ def main(argv=None) -> int:
         help="bearer token for secured clusters",
     )
     parser.add_argument("-n", "--namespace", default="default")
-    parser.add_argument("-o", "--output", default="table", choices=["table", "json"])
+    parser.add_argument("-o", "--output", default="table", choices=["table", "json", "wide"])
     sub = parser.add_subparsers(dest="verb", required=True)
 
     p_get = sub.add_parser("get")

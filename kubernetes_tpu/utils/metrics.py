@@ -154,12 +154,13 @@ class Metrics:
             for (name, labels), v in self._gauges.items():
                 out[f"{name}{dict(labels)}"] = v
             for (name, labels), h in self._hists.items():
+                p50, p90, p99 = h.quantiles((0.50, 0.90, 0.99))
                 out[f"{name}{dict(labels)}"] = {
                     "count": h.n,
                     "avg": h.avg,
-                    "p50": h.quantile(0.50),
-                    "p90": h.quantile(0.90),
-                    "p99": h.quantile(0.99),
+                    "p50": p50,
+                    "p90": p90,
+                    "p99": p99,
                 }
             return out
 
