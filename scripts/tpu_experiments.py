@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""The queued TPU measurement sequence (PERFORMANCE.md 'next experiments').
+
+Run when the tunneled TPU is reachable (probe first — see the tunnel-wedge
+notes in PERFORMANCE.md):
+
+    python scripts/tpu_experiments.py [probe|traces|batchsize|gang|pallas|all]
+
+Each step prints one JSON line; stderr carries the per-batch stage traces
+(tpl-encode / pair-table / flush / launch / kernel / assume+bind).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """Tunnel-safe liveness probe in a subprocess (a wedged backend hangs
+    in-process jax calls forever)."""
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "jax.jit(lambda v: v+1)(jnp.ones((8,8))).block_until_ready();"
+                "print(jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s if timeout_s > 0 else None,
+        )
+        ok = r.returncode == 0 and "tpu" in r.stdout
+    except subprocess.TimeoutExpired:
+        ok = False  # wedged tunnel: the tiny jit hung past the timeout
+    print(json.dumps({"step": "probe", "tpu_alive": ok}))
+    return ok
+
+
+def _run(
+    cfg_name: str,
+    sched_config=None,
+    timeout_s: float = 600.0,
+    presize_nodes=None,
+):
+    from kubernetes_tpu.perf.harness import run_benchmark
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+
+    return run_benchmark(
+        WORKLOADS[cfg_name], sched_config=sched_config, quiet=True,
+        timeout_s=timeout_s, presize_nodes=presize_nodes,
+    )
+
+
+def _warm(sched_config=None) -> None:
+    """Compile the measured run's kernel variants out-of-window: the warm
+    workload presized to 5k nodes produces the same v_cap/n_cap shapes
+    (bench.py's warmup protocol)."""
+    _run(
+        "SchedulingPodAffinity/500",
+        sched_config=sched_config,
+        presize_nodes=5000,
+    )
+
+
+def _result_line(step: str, r, extra=None) -> None:
+    out = {
+        "step": step,
+        "scheduled": r.scheduled,
+        "unscheduled": r.unscheduled,
+        "duration_s": round(r.duration_s, 2),
+        "pods_per_s": round(r.throughput_pods_per_s, 1),
+        "encode_total_s": round(r.encode_total_s, 2),
+        "kernel_total_s": round(r.kernel_total_s, 2),
+        "n_batches": r.n_batches,
+    }
+    if extra:
+        out.update(extra)
+    print(json.dumps(out), flush=True)
+
+
+def traces() -> None:
+    """Baseline 5k suite with granular stage traces on stderr."""
+    _warm()
+    r = _run("SchedulingPodAffinity/5000")
+    _result_line("traces-baseline-1024", r)
+
+
+def batchsize() -> None:
+    """device_batch_size 4096 vs the 1024 default (PERFORMANCE.md step 1)."""
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    # 1024 is the default config: traces() already measured it — only the
+    # 4096 arm runs here (each 5k suite is minutes of tunnel time)
+    sc = KubeSchedulerConfiguration(device_batch_size=4096)
+    _warm(sched_config=sc)
+    r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+    _result_line("batchsize-4096", r, {"device_batch_size": 4096})
+
+
+def gang() -> None:
+    """Gang/5000 post template-collapse (expected: minutes -> seconds)."""
+    from kubernetes_tpu.scheduler.config import (
+        KubeSchedulerConfiguration,
+        ProfileConfig,
+    )
+    from kubernetes_tpu.scheduler.framework.registry import (
+        coscheduling_plugin_set,
+    )
+
+    gcfg = KubeSchedulerConfiguration(
+        profiles=[ProfileConfig(plugin_set=coscheduling_plugin_set())]
+    )
+    r = _run("Gang/5000", sched_config=gcfg, timeout_s=600.0)
+    _result_line("gang-5000", r)
+
+
+def pallas() -> None:
+    """use_pallas_fit A/B on the 5k suite (PERFORMANCE.md step 2)."""
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    # False is the default config: compare against traces()'s baseline
+    sc = KubeSchedulerConfiguration(use_pallas_fit=True)
+    _warm(sched_config=sc)
+    r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+    _result_line("pallas-True", r, {"use_pallas_fit": True})
+
+
+STEPS = {
+    "probe": probe,
+    "traces": traces,
+    "batchsize": batchsize,
+    "gang": gang,
+    "pallas": pallas,
+}
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:]) or ["all"]
+    unknown = [a for a in args if a != "all" and a not in STEPS]
+    if unknown:
+        print(
+            f"unknown step(s) {unknown}; valid: {sorted(STEPS)} or 'all'",
+            file=sys.stderr,
+        )
+        return 2
+    failed = 0
+    if args[0] == "all":
+        if not probe():
+            print(json.dumps({"error": "tpu unreachable; aborting"}))
+            return 1
+        for step in ("traces", "batchsize", "gang", "pallas"):
+            t0 = time.time()
+            try:
+                STEPS[step]()
+            except Exception as e:  # keep later steps runnable
+                failed += 1
+                print(
+                    json.dumps(
+                        {"step": step, "error": str(e),
+                         "elapsed_s": round(time.time() - t0, 1)}
+                    ),
+                    flush=True,
+                )
+        return 1 if failed else 0
+    for name in args:
+        try:
+            ok = STEPS[name]()
+        except Exception as e:
+            failed += 1
+            print(json.dumps({"step": name, "error": str(e)}), flush=True)
+            continue
+        if ok is False:  # probe() returns a liveness verdict
+            failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
