@@ -1,0 +1,29 @@
+# Build entry points (reference Makefile -> hack/make-rules/*):
+#   make test             unit + integration suite (8-device CPU mesh)
+#   make bench            headline benchmark (TPU if reachable, else CPU)
+#   make bench-cpu        CPU-backend benchmark (no tunnel dependency)
+#   make tpu-experiments  queued on-hardware measurement sequence
+#   make dryrun           multi-chip dryrun (virtual 8-device CPU mesh)
+#   make verify           test + dryrun (the pre-commit gate)
+
+PY ?= python
+
+.PHONY: test bench bench-cpu tpu-experiments dryrun verify
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+bench-cpu:
+	BENCH_FORCE_CPU=1 $(PY) bench.py
+
+tpu-experiments:
+	$(PY) scripts/tpu_experiments.py all
+
+dryrun:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+verify: test dryrun
