@@ -123,3 +123,33 @@ def test_wave_kernel_pallas_fit_parity():
     placed_b, chosen_b = run(True)
     np.testing.assert_array_equal(placed_a, placed_b)
     np.testing.assert_array_equal(chosen_a, chosen_b)
+
+
+def test_sharded_wave_kernel_with_pallas_fit():
+    """use_pallas_fit composes with the sharded mesh path: GSPMD
+    partitions around the (interpret-mode on CPU) pallas call and
+    placements match the unsharded kernel's count."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.encoding import SnapshotEncoder
+    from kubernetes_tpu.ops.lattice import DEFAULT_WEIGHTS
+    from kubernetes_tpu.ops.templates import TemplateCache, build_pair_table
+    from kubernetes_tpu.parallel.mesh import make_mesh
+    from kubernetes_tpu.parallel.sharded import make_sharded_wave_kernel
+    from test_lattice_smoke import make_node, make_pod
+
+    enc = SnapshotEncoder()
+    for i in range(16):
+        enc.add_node(make_node(f"n{i}", cpu="8"))
+    cache = TemplateCache(enc)
+    pods = [make_pod(f"p{i}", cpu="500m") for i in range(12)]
+    eb = cache.encode(pods, pad_to=16)
+    pt, _ = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+    snap = enc.flush()
+    mesh = make_mesh()
+    kern = make_sharded_wave_kernel(enc.cfg.v_cap, 64, 4, 1.0, mesh, True)
+    _, res = kern(
+        snap, eb.batch, pt, jnp.asarray(DEFAULT_WEIGHTS), jax.random.PRNGKey(0)
+    )
+    enc.invalidate_device()
+    assert int(np.asarray(jax.device_get(res.placed)).sum()) == 12
