@@ -44,6 +44,16 @@ class KubeSchedulerConfiguration:
     device_batch_size: int = 1024
     device_batch_window: float = 0.01  # linger to let bursts accumulate (tunnel
     # RTT dwarfs 10ms; fuller batches amortize it)
+    # wave-pipeline depth: up to depth-1 launched batches stay in flight and
+    # resolve in ONE combined device->host readback (the donated snapshot
+    # chains batches on-device, so the tunnel RTT is paid once per depth-1
+    # batches instead of once per batch). 1 = fully synchronous, 2 = the old
+    # depth-1 pipeline. Sustained-load readbacks/batch = 1/(depth-1).
+    # 0 = auto: the scheduler measures the device->host readback RTT at
+    # start and picks 6 when the readback is expensive (remote/tunneled
+    # device) or 2 when it is sub-ms (local device / CPU, where deep
+    # pipelining only adds latency and host/device CPU contention).
+    pipeline_depth: int = 0
     encoding: EncodingConfig = field(default_factory=EncodingConfig)
     bind_workers: int = 16
     assume_ttl_seconds: float = 30.0
@@ -80,5 +90,7 @@ class KubeSchedulerConfiguration:
             raise ValueError("duplicate profile schedulerName")
         if self.device_batch_size < 1:
             raise ValueError("device_batch_size must be >= 1")
+        if self.pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 1, or 0 for auto")
         if self.leader_election is not None:
             self.leader_election.validate()

@@ -198,12 +198,17 @@ class Scheduler:
         self._rng_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(0)
         self._mesh = None  # set by start() when >1 device is visible
-        # depth-1 pipeline: the launched-but-unresolved wave batch. Results
-        # are read back AFTER the next batch's kernel is dispatched, so the
-        # ~65 ms tunnel readback RTT overlaps the next batch's device time
-        # (the TPU-shaped analogue of the reference's async binding
-        # goroutine overlapping the next scheduleOne, scheduler.go:666).
-        self._pending: Optional[_InFlightBatch] = None
+        # wave pipeline: launched-but-unresolved batches, oldest first. The
+        # donated snapshot chains batches on-device, so up to
+        # cfg.pipeline_depth-1 batches stay in flight and resolve with ONE
+        # combined device->host readback — the ~65 ms tunnel RTT is paid
+        # once per depth-1 batches, and the newest batch's device time still
+        # overlaps the readback + host bind work (the TPU-shaped analogue
+        # of the reference's async binding goroutine overlapping the next
+        # scheduleOne, scheduler.go:666, taken to its batch conclusion).
+        self._pending: List[_InFlightBatch] = []
+        # resolved by start() when cfg.pipeline_depth == 0 (auto)
+        self._pipeline_depth = self.cfg.pipeline_depth or 2
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table, n_waves)
@@ -264,12 +269,36 @@ class Scheduler:
                 self.cache.encoder.set_sharding(
                     snapshot_shardings(self._mesh), replicated(self._mesh)
                 )
+        if self.cfg.pipeline_depth == 0 and self.cfg.use_device:
+            self._pipeline_depth = self._auto_pipeline_depth()
         self.queue.run()
         self.cache.start_janitor()
         self._sched_thread = threading.Thread(
             target=self._scheduling_loop, daemon=True, name="scheduler"
         )
         self._sched_thread.start()
+
+    def _auto_pipeline_depth(self) -> int:
+        """Pick the wave-pipeline depth from the measured device->host
+        readback RTT: a tunneled/remote device (tens of ms per sync) wants
+        the deep pipeline so one readback amortizes over many batches; a
+        local device or the CPU backend (sub-ms) wants the shallow one —
+        deep pipelining there only adds pod latency and, on CPU, host vs
+        device compute contention."""
+        try:
+            d = jax.device_put(np.zeros(16, np.float32))
+            jax.device_get(d + 1)  # warmup: first d2h shifts tunnel regime
+            rtts = []
+            for _ in range(3):
+                r = d + 1
+                t0 = time.monotonic()
+                jax.device_get(r)
+                rtts.append(time.monotonic() - t0)
+            rtt_ms = sorted(rtts)[1] * 1e3
+        except Exception:
+            logger.exception("pipeline-depth RTT probe failed; using depth 2")
+            return 2
+        return 6 if rtt_ms > 5.0 else 2
 
     def stop(self) -> None:
         self._stop.set()
@@ -302,12 +331,12 @@ class Scheduler:
         while time.time() < deadline:
             if (
                 len(self.queue) == 0
-                and self._pending is None
+                and not self._pending
                 and not self.cache.encoder.has_pending_updates
             ):
                 return True
             time.sleep(0.01)
-        return len(self.queue) == 0 and self._pending is None
+        return len(self.queue) == 0 and not self._pending
 
     # -- the loop ------------------------------------------------------------
 
@@ -317,7 +346,7 @@ class Scheduler:
             # arrivals — resolving the in-flight results (binding its pods)
             # is the more urgent work, and any poll delay here would be
             # charged to those pods' latency
-            inflight = self._pending is not None
+            inflight = bool(self._pending)
             pis = self.queue.pop_batch(
                 self.cfg.device_batch_size,
                 timeout=0.0 if inflight else 0.2,
@@ -523,7 +552,7 @@ class Scheduler:
         # cheap pre-check so the common drain case pays one encode, not two
         # (the locked re-check below remains authoritative: encode itself
         # can intern predicates and dirty rows)
-        if self._pending is not None and self.cache.encoder.has_pending_updates:
+        if self._pending and self.cache.encoder.has_pending_updates:
             self._resolve_pending()
         while True:
             with self.cache.lock, _stage_timer("encode"):
@@ -532,7 +561,7 @@ class Scheduler:
                 ptab, n_waves = self._pair_table(eb)
                 trace.step("pair-table")
                 if (
-                    self._pending is None
+                    not self._pending
                     or not self.cache.encoder.has_pending_updates
                 ):
                     snap = self.cache.encoder.flush()
@@ -580,55 +609,82 @@ class Scheduler:
         trace.step("launch")
         with self.cache.lock:
             self.cache.encoder.set_device_snapshot(new_snap)
-        prev, self._pending = self._pending, _InFlightBatch(
-            pis, eb, row_names, res, moves0, trace, t_start, verify_snap
+        self._pending.append(
+            _InFlightBatch(
+                pis, eb, row_names, res, moves0, trace, t_start, verify_snap
+            )
         )
-        if prev is not None:
-            self._resolve_batch(prev)
+        metrics.inc("scheduler_wave_batches_total")
+        if len(self._pending) >= self._pipeline_depth:
+            # pipeline full: ONE combined readback resolves every batch but
+            # the newest, which stays in flight so its device time overlaps
+            # the readback + the host-side bind work below
+            keep = 0 if self._pipeline_depth == 1 else 1
+            self._resolve_oldest(len(self._pending) - keep)
 
     def _resolve_pending(self) -> None:
-        p, self._pending = self._pending, None
-        if p is not None:
-            self._resolve_batch(p)
+        self._resolve_oldest(len(self._pending))
 
-    def _resolve_batch(self, p: "_InFlightBatch") -> None:
-        """Resolve one in-flight batch; never raises. An exception mid-way
-        would otherwise be misattributed by the loop's handler to the batch
-        currently in self._pending (requeueing pods that are about to bind)
-        while dropping this batch's unprocessed tail."""
-        try:
-            self._resolve_batch_inner(p)
-        except Exception:
-            logger.exception("resolving wave batch failed")
-            moves = self.queue.moves
-            for pi in p.pis:
-                key = pi.pod.metadata.key
-                if self.cache.has_pod(key):
-                    continue  # already assumed/bound before the exception
-                self.queue.add_unschedulable_if_not_present(pi, moves)
-
-    def _resolve_batch_inner(self, p: "_InFlightBatch") -> None:
-        """Read back one in-flight batch's results and act on them."""
-        pis, eb, row_names, res = p.pis, p.eb, p.row_names, p.res
-        moves0, trace, t_start = p.moves0, p.trace, p.t_start
+    def _resolve_oldest(self, k: int) -> None:
+        """Resolve the k oldest in-flight batches with ONE combined
+        device->host readback; never raises. Placements of ALL k batches
+        are replayed into the host cache (and bound) before any batch's
+        failure handling runs — the fallback/preemption paths read the host
+        cache, and an unreplayed sibling batch would let them grant the
+        same capacity twice."""
+        if k <= 0:
+            return
+        batches, self._pending = self._pending[:k], self._pending[k:]
         with _stage_timer("kernel"):
-            # ONE pytree readback: each separate np.asarray is a full tunnel
-            # round trip (~65 ms); the round-2 "330 ms kernel" was mostly
-            # sequential readbacks. resolvable_tpl stays on device — it is
-            # only fetched on the (rare) failure path below.
             try:
-                chosen, placed, deferred = jax.device_get(
-                    (res.chosen, res.placed, res.deferred)
+                fetched = jax.device_get(
+                    [(b.res.chosen, b.res.placed, b.res.deferred) for b in batches]
                 )
+                metrics.inc("scheduler_wave_readbacks_total")
             except Exception:
-                # device/tunnel error: the kernel's on-device commits are
+                # device/tunnel error: the kernels' on-device commits are
                 # unknowable — rebuild HBM from the host masters and retry
                 self.cache.encoder.invalidate_device()
+                logger.exception(
+                    "wave pipeline readback failed (%d batches)", len(batches)
+                )
                 moves = self.queue.moves
-                for pi in pis:
-                    self.queue.add_unschedulable_if_not_present(pi, moves)
-                logger.exception("wave batch readback failed")
+                for b in batches:
+                    for pi in b.pis:
+                        if not self.cache.has_pod(pi.pod.metadata.key):
+                            self.queue.add_unschedulable_if_not_present(pi, moves)
                 return
+        tails = []
+        for b, arrays in zip(batches, fetched):
+            try:
+                tails.append(self._commit_batch(b, arrays))
+            except Exception:
+                logger.exception("committing wave batch failed")
+                tails.append(None)
+                moves = self.queue.moves
+                for pi in b.pis:
+                    if not self.cache.has_pod(pi.pod.metadata.key):
+                        self.queue.add_unschedulable_if_not_present(pi, moves)
+        for b, tail in zip(batches, tails):
+            if tail is None:
+                continue
+            try:
+                self._finish_batch(b, tail[0], tail[1])
+            except Exception:
+                logger.exception("resolving wave batch failures failed")
+                moves = self.queue.moves
+                for pi in tail[0]:
+                    if not self.cache.has_pod(pi.pod.metadata.key):
+                        self.queue.add_unschedulable_if_not_present(pi, moves)
+                for pi, _i in tail[1]:
+                    self.queue.add_unschedulable_if_not_present(pi, moves)
+
+    def _commit_batch(self, p: "_InFlightBatch", arrays) -> tuple:
+        """Act on one read-back batch's placements: assume + bind, re-add
+        deferred pods. Returns (fallback_pis, failed) for _finish_batch."""
+        pis, eb, row_names = p.pis, p.eb, p.row_names
+        chosen, placed, deferred = arrays
+        trace, t_start = p.trace, p.t_start
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
         metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
@@ -678,6 +734,14 @@ class Scheduler:
                 logger.exception("verify_cycles cross-check failed")
         self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
         trace.step("assume+bind")
+        return fallback_pis, failed
+
+    def _finish_batch(
+        self, p: "_InFlightBatch", fallback_pis: List, failed: List
+    ) -> None:
+        """Host fallback + failure/preemption handling for one committed
+        batch (runs after EVERY sibling batch's placements are replayed)."""
+        eb, row_names, res, moves0 = p.eb, p.row_names, p.res, p.moves0
         if fallback_pis or failed:
             # the host paths below read the host cache; a NEWER in-flight
             # batch holds device-committed placements the cache can't see
@@ -713,7 +777,7 @@ class Scheduler:
                         row_names[r] for r in rows if row_names[r]
                     ],
                 )
-        trace.log_if_long(0.1)
+        p.trace.log_if_long(0.1)
 
     # pre-batch-sound plugins: anti-monotone (or invariant) under in-batch
     # commits, so a device placement MUST pass them on the pre-batch host
@@ -774,10 +838,10 @@ class Scheduler:
                 t = int(pod_tpl[i])
                 prios[t] = max(prios[t], int(pod_prio[i]))
             with self.cache.lock:
-                # _resolve_batch_inner drains the pipeline before the failed
+                # _finish_batch drains the pipeline before the failed
                 # block, so no newer batch can be in flight here and flush's
                 # scatter cannot erase un-replayed device commits
-                assert self._pending is None
+                assert not self._pending
                 snap = self.cache.encoder.flush()
             return np.asarray(preempt_whatif(snap, eb.batch.tpl, prios))
         except Exception:

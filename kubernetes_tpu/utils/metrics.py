@@ -47,6 +47,15 @@ class Histogram:
         s = sorted(self._samples)
         return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]
 
+    def quantiles_since(self, n0: int, qs) -> List[float]:
+        """Quantiles over samples observed AFTER the first n0 — lets a
+        measurement window exclude warmup/compile-laden samples the same
+        way callers baseline `total`/`n` (bench stage breakdown)."""
+        s = sorted(self._samples[n0:])
+        if not s:
+            return [0.0] * len(qs)
+        return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]
+
     @property
     def avg(self) -> float:
         return self.total / self.n if self.n else 0.0
