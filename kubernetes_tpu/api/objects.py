@@ -13,6 +13,7 @@ constructors; conversion layers are unnecessary (single internal version).
 from __future__ import annotations
 
 import copy
+import dataclasses
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -408,6 +409,39 @@ class Pod:
     def priority(self) -> int:
         """pod priority with default 0 (podutil.GetPodPriority)."""
         return self.spec.priority if self.spec.priority is not None else 0
+
+
+def assume_copy(pod: Pod, node_name: str) -> Pod:
+    """Shell copy for the scheduler cache's assume protocol: a fresh Pod +
+    PodSpec shell with node_name set, SHARING metadata, status, and every
+    spec innard (containers, volumes, tolerations, affinity — all treated
+    as read-only once queued; informer updates arrive as new objects and
+    the confirmation swaps in the API server's own copy, cache.add_pod).
+    ~10x cheaper than deep_copy on the bulk assume path, which the host
+    bind stage's pods/s ceiling is made of. dataclasses.replace keeps the
+    shell complete as PodSpec grows fields."""
+    return Pod(
+        metadata=pod.metadata,
+        spec=dataclasses.replace(pod.spec, node_name=node_name),
+        status=pod.status,
+        kind=pod.kind,
+    )
+
+
+def event_copy(pod: Pod) -> Pod:
+    """Watch-event snapshot of a stored pod: fresh Pod/meta/spec/status
+    SHELLS so the event is isolated from the store's in-place shell
+    mutators (bind_pods' node_name set, _bump's resource_version, delete's
+    deletion_timestamp), while sharing every list/dict innard — the store
+    replaces objects wholesale on update and never mutates innards in
+    place. This is the batch-bind hot path's copy (one per MODIFIED
+    event); cold paths keep full deep_copy."""
+    return Pod(
+        metadata=dataclasses.replace(pod.metadata),
+        spec=dataclasses.replace(pod.spec),
+        status=dataclasses.replace(pod.status),
+        kind=pod.kind,
+    )
 
 
 def _copy_meta(m: ObjectMeta) -> ObjectMeta:

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 
 from ...api import objects as v1
 from ...ops.encoding import EncodingConfig, SnapshotEncoder
-from .nodeinfo import NodeInfo, Snapshot
+from .nodeinfo import NodeInfo, Snapshot, _has_affinity
 
 logger = logging.getLogger("kubernetes_tpu.scheduler.cache")
 
@@ -181,21 +181,35 @@ class SchedulerCache:
         node checks are skipped without affecting the rest."""
         errors: list = [None] * len(items)
         enc_items: list = []
+        # template siblings share a proto object; the spec-derived host
+        # aggregates (requests, ports, affinity) are identical per template
+        # (fingerprint pins them, ops/templates.py:82) — compute them once
+        tmpl_pre: dict = {}
         with self.lock:
             for i, (pod, node_name, band, proto) in enumerate(items):
                 key = pod.metadata.key
                 if key in self._assumed or key in self._pod_to_node:
                     errors[i] = f"pod {key} already assumed/added"
                     continue
-                assumed = pod.deep_copy()
-                assumed.spec.node_name = node_name
+                assumed = v1.assume_copy(pod, node_name)
                 ni = self._nodes.get(node_name)
                 if ni is None:
                     # unknown node: track mapping only (matches add path)
                     self._pod_to_node[key] = node_name
                     self._assumed[key] = _AssumedInfo(assumed, node_name, None)
                     continue
-                ni.add_pod(assumed)
+                pre_key = id(proto) if proto is not None else None
+                pre = tmpl_pre.get(pre_key) if pre_key is not None else None
+                if pre is None:
+                    pre = (
+                        v1.compute_pod_resource_request(pod),
+                        v1.compute_pod_resource_request(pod, non_zero=True),
+                        v1.pod_host_ports(pod),
+                        _has_affinity(pod),
+                    )
+                    if pre_key is not None:
+                        tmpl_pre[pre_key] = pre
+                ni.add_pod_precomputed(assumed, *pre)
                 self._bump(ni)
                 self._pod_to_node[key] = node_name
                 self._assumed[key] = _AssumedInfo(assumed, node_name, None)

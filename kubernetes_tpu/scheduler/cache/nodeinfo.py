@@ -34,12 +34,31 @@ class NodeInfo:
         self.allocatable = node.allocatable()
 
     def add_pod(self, pod: v1.Pod) -> None:
-        self.requested.add(compute_pod_resource_request(pod))
-        self.non_zero_requested.add(compute_pod_resource_request(pod, non_zero=True))
+        self.add_pod_precomputed(
+            pod,
+            compute_pod_resource_request(pod),
+            compute_pod_resource_request(pod, non_zero=True),
+            pod_host_ports(pod),
+            _has_affinity(pod),
+        )
+
+    def add_pod_precomputed(
+        self,
+        pod: v1.Pod,
+        req: ResourceList,
+        non_zero_req: ResourceList,
+        host_ports,
+        has_affinity: bool,
+    ) -> None:
+        """add_pod with the spec-derived aggregates precomputed: template
+        siblings in a bulk assume share one computation (the fingerprint
+        pins requests/ports/affinity per template, ops/templates.py:82)."""
+        self.requested.add(req)
+        self.non_zero_requested.add(non_zero_req)
         self.pods.append(pod)
-        if _has_affinity(pod):
+        if has_affinity:
             self.pods_with_affinity.append(pod)
-        for hp in pod_host_ports(pod):
+        for hp in host_ports:
             self.used_ports[hp] = self.used_ports.get(hp, 0) + 1
 
     def remove_pod(self, pod_key: str) -> Optional[v1.Pod]:
