@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
@@ -306,13 +307,22 @@ class AdmissionChain:
 
 class QuotaAdmission(AdmissionPlugin):
     """Deny pod creates that would exceed any ResourceQuota hard limit in
-    the namespace (plugin/pkg/admission/resourcequota). Usage is recomputed
-    live (not from quota status) so the gate can't be raced stale."""
+    the namespace (plugin/pkg/admission/resourcequota). Check-and-reserve:
+    usage is recomputed live and racing creates serialize through the
+    plugin's own mutex, with an in-flight reservation ledger covering the
+    window between a create passing admission and its pod appearing in the
+    store — mirroring the reference's transactional quota reservation
+    (racing creates cannot both pass a quota with room for one).
+    Reservations clear as soon as the pod is visible, or after a short TTL
+    when the create failed downstream of admission."""
 
     name = "ResourceQuota"
 
-    def __init__(self, server):
+    def __init__(self, server, reserve_ttl_s: float = 5.0):
         self.server = server
+        self._lock = threading.Lock()
+        self._ttl = reserve_ttl_s
+        self._reserved: dict = {}  # ns -> {pod_key: (delta, deadline)}
 
     def validate(self, verb: str, resource: str, obj) -> None:
         if verb != "create" or resource != "pods":
@@ -323,7 +333,6 @@ class QuotaAdmission(AdmissionPlugin):
             return
         from ..controller.resourcequota import compute_namespace_usage
 
-        usage = compute_namespace_usage(self.server, ns)
         req = v1.compute_pod_resource_request(obj)
         delta = {
             "pods": 1,
@@ -332,21 +341,46 @@ class QuotaAdmission(AdmissionPlugin):
             "requests.memory": int(req.get(MEMORY, 0)),
             "memory": int(req.get(MEMORY, 0)),
         }
-        for q in quotas:
-            for res_name, hard in q.spec.hard.items():
-                # hard limits are k8s quantities ("2", "500m", "4Gi"); usage
-                # is millicores/bytes/counts — parse with the same units
-                if "cpu" in res_name:
-                    limit = cpu_to_millis(hard)
-                else:
-                    limit = to_int_value(hard)
-                want = usage.get(res_name, 0) + delta.get(res_name, 0)
-                if want > limit:
-                    raise AdmissionDenied(
-                        f"exceeded quota {q.metadata.name}: requested "
-                        f"{res_name}={delta.get(res_name, 0)}, used "
-                        f"{usage.get(res_name, 0)}, limited {hard}"
-                    )
+        with self._lock:
+            # purge BEFORE computing usage: the other order can drop a
+            # reservation whose pod landed between the usage read and the
+            # purge, leaving it counted nowhere (review r4). This order can
+            # only double-count (reservation kept + pod already in usage) —
+            # a transient fail-closed, never an over-admission.
+            now = time.monotonic()
+            res = self._reserved.setdefault(ns, {})
+            for key in list(res):
+                _d, deadline = res[key]
+                # the pod landed (usage counts it now) or the create died
+                # downstream of admission (TTL): drop the reservation
+                if deadline < now or self._pod_exists(key):
+                    del res[key]
+            usage = compute_namespace_usage(self.server, ns)
+            for d, _deadline in res.values():
+                for rn, v in d.items():
+                    usage[rn] = usage.get(rn, 0) + v
+            for q in quotas:
+                for res_name, hard in q.spec.hard.items():
+                    # hard limits are k8s quantities ("2", "500m", "4Gi");
+                    # usage is millicores/bytes/counts — same-unit parse
+                    if "cpu" in res_name:
+                        limit = cpu_to_millis(hard)
+                    else:
+                        limit = to_int_value(hard)
+                    want = usage.get(res_name, 0) + delta.get(res_name, 0)
+                    if want > limit:
+                        raise AdmissionDenied(
+                            f"exceeded quota {q.metadata.name}: requested "
+                            f"{res_name}={delta.get(res_name, 0)}, used "
+                            f"{usage.get(res_name, 0)}, limited {hard}"
+                        )
+            res[obj.metadata.key] = (delta, now + self._ttl)
+
+    def _pod_exists(self, key: str) -> bool:
+        try:
+            return self.server.exists("pods", key)
+        except Exception:
+            return False
 
 
 class NamespaceLifecycleAdmission(AdmissionPlugin):

@@ -13,11 +13,14 @@ BETWEEN levels is exact).
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
 from ..utils.metrics import metrics
+
+logger = logging.getLogger("kubernetes_tpu.apiserver.flowcontrol")
 
 
 @dataclass
@@ -114,6 +117,7 @@ class FlowController:
     ):
         self.levels = {l.name: l for l in (levels or default_levels())}
         self.schemas = list(schemas or default_schemas())
+        self._warned_schemas: set = set()
         self.queue_wait_s = queue_wait_s
         total_shares = sum(l.shares for l in self.levels.values() if not l.exempt)
         for l in self.levels.values():
@@ -125,7 +129,22 @@ class FlowController:
                 lv = self.levels.get(s.priority_level)
                 if lv is not None:
                     return lv
-        return next(iter(self.levels.values()))
+                if s.name not in self._warned_schemas:
+                    # once per schema: this fires on EVERY matching request
+                    self._warned_schemas.add(s.name)
+                    logger.warning(
+                        "FlowSchema %s references unknown priority level %s",
+                        s.name,
+                        s.priority_level,
+                    )
+        # fail-CLOSED fallback: global-default (or any throttled level),
+        # never the dict's first entry — with default_levels() that is
+        # 'exempt', which would silently unlimit misconfigured traffic
+        lv = self.levels.get("global-default")
+        if lv is not None:
+            return lv
+        non_exempt = [l for l in self.levels.values() if not l.exempt]
+        return non_exempt[0] if non_exempt else next(iter(self.levels.values()))
 
     def begin(self, user, resource: str, verb: str) -> PriorityLevel:
         lv = self.classify(user, resource, verb)

@@ -345,6 +345,11 @@ class _Handler(BaseHTTPRequestHandler):
             return handler()
         finally:
             try:
+                # _limited's APF path memoizes the authenticated user for
+                # exactly this finally (it must outlive _limited's own
+                # finally, which releases the flow-control slot; the memo
+                # is cleared below — keep-alive connections reuse the
+                # handler across requests)
                 # identity WITHOUT response-writing: the memoized APF user
                 # if present, else a silent header resolve (a failed authn
                 # already wrote its 401; never write from a finally)
@@ -378,6 +383,8 @@ class _Handler(BaseHTTPRequestHandler):
                     )
             except Exception:
                 pass  # auditing must never break request handling
+            finally:
+                self._request_user = None
 
     def _limited(self, handler):
         """WithPriorityAndFairness when a FlowController is configured,
@@ -398,14 +405,18 @@ class _Handler(BaseHTTPRequestHandler):
                 lv = fc.begin(user, resource or "", self.command.lower())
             except RequestRejected as e:
                 return self._status_error(429, "TooManyRequests", str(e))
-            # the handler's _authorize re-resolves the identity; cache the
-            # classification's result for this one request (cleared below:
-            # keep-alive connections reuse the handler across requests)
+            # memo the classification's identity for this one request: the
+            # handler's _authorize and _audited's event reuse it instead of
+            # re-resolving the token. Cleared by _audited's outer finally
+            # (keep-alive connections reuse the handler across requests);
+            # when no audit is configured there is no outer finally, so
+            # clear here
             self._request_user = (user, True)
             try:
                 return handler()
             finally:
-                self._request_user = None
+                if getattr(self.server, "audit", None) is None:
+                    self._request_user = None
                 fc.end(lv)
         sem = self.server.inflight
         if sem is None:

@@ -165,14 +165,29 @@ class _WebhookAdmission(AdmissionPlugin):
                             serialization.encode(obj), patch
                         )
                         new_obj = serialization.decode(resource, doc)
-                        # graft the mutated state onto the live object the
-                        # admission chain carries forward
-                        obj.__dict__.update(new_obj.__dict__)
                     except Exception as e:
                         raise AdmissionDenied(
                             f"webhook {hook.name!r} returned an unusable "
                             f"patch: {e}"
                         ) from None
+                    # immutable-metadata guard (the reference re-validates
+                    # object meta after mutation): a patch renaming the
+                    # object would silently change its store identity (the
+                    # key is derived AFTER admission), and a patched
+                    # resourceVersion would subvert the conflict check
+                    old_m, new_m = obj.metadata, new_obj.metadata
+                    for f in ("name", "namespace", "uid", "resource_version"):
+                        if getattr(new_m, f) != getattr(old_m, f):
+                            raise AdmissionDenied(
+                                f"webhook {hook.name!r} patch mutates "
+                                f"immutable metadata.{f}"
+                            )
+                    # status is not admittable content either: keep ours
+                    if hasattr(obj, "status"):
+                        new_obj.status = obj.status
+                    # graft the mutated state onto the live object the
+                    # admission chain carries forward
+                    obj.__dict__.update(new_obj.__dict__)
 
 
 class MutatingWebhookAdmission(_WebhookAdmission):

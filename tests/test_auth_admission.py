@@ -425,3 +425,72 @@ def test_audit_log_records_requests(tmp_path):
         assert json.loads(lines[0])["stage"] == "ResponseComplete"
     finally:
         srv.shutdown()
+
+
+def test_quota_check_and_reserve_serializes_racing_creates():
+    """ADVICE r3: two concurrent creates must not both pass a quota with
+    room for one. The reservation ledger covers the window between a
+    create passing admission and its pod appearing in the store."""
+    import threading
+
+    store = APIServer()
+    store.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="q", namespace="default"),
+            spec=v1.ResourceQuotaSpec(hard={"pods": 1}),
+        ),
+    )
+    # short TTL: the loser's reservation may survive its denial (it checked
+    # before the winner's insert); the post-delete create below retries past
+    # that window rather than depending on thread interleaving
+    qa = QuotaAdmission(store, reserve_ttl_s=0.4)
+    store.admit_hooks.append(AdmissionChain(validating=[qa]))
+
+    results = []
+    barrier = threading.Barrier(2)
+
+    def create(name):
+        barrier.wait()
+        try:
+            store.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=name),
+                    spec=v1.PodSpec(containers=[v1.Container()]),
+                ),
+            )
+            results.append((name, None))
+        except AdmissionDenied as e:
+            results.append((name, str(e)))
+
+    ts = [threading.Thread(target=create, args=(f"r{i}",)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    created = [r for r in results if r[1] is None]
+    denied = [r for r in results if r[1] is not None]
+    assert len(created) == 1 and len(denied) == 1, results
+    assert "exceeded quota" in denied[0][1]
+
+    # the reservation clears once the pod is visible (or its TTL lapses):
+    # a delete frees the quota and a later create succeeds
+    store.delete("pods", "default", created[0][0])
+    import time as _time
+
+    deadline = _time.monotonic() + 3.0
+    while True:
+        try:
+            store.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name="after"),
+                    spec=v1.PodSpec(containers=[v1.Container()]),
+                ),
+            )
+            break
+        except AdmissionDenied:
+            if _time.monotonic() > deadline:
+                raise
+            _time.sleep(0.05)

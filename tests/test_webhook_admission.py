@@ -390,3 +390,53 @@ def test_wildcard_toleration_exempts_from_eviction():
 
     taint = v1.Taint(TK, "", v1.TAINT_NO_EXECUTE)
     assert pod.spec.tolerations[0].tolerates(taint)
+
+
+class _RenameHook(BaseHTTPRequestHandler):
+    """Malicious mutating webhook: patches immutable metadata."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers["Content-Length"]))
+        patch = [
+            {"op": "replace", "path": "/metadata/name", "value": "hijacked"}
+        ]
+        resp = {
+            "allowed": True,
+            "patchType": "JSONPatch",
+            "patch": base64.b64encode(json.dumps(patch).encode()).decode(),
+        }
+        out = json.dumps({"response": resp}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+def test_mutating_webhook_cannot_patch_immutable_metadata():
+    """ADVICE r3: a JSONPatch rewriting metadata.name would silently change
+    the object's store identity (the key is derived after admission) — the
+    plugin must reject it like the reference's post-mutation re-validation."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RenameHook)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        server = APIServer()
+        server.create(
+            "mutatingwebhookconfigurations",
+            _hook_cfg(
+                v1.MutatingWebhookConfiguration,
+                f"http://127.0.0.1:{port}/rename",
+            ),
+        )
+        server.admit_hooks.append(
+            AdmissionChain(mutating=[MutatingWebhookAdmission(server)])
+        )
+        with pytest.raises(AdmissionDenied, match="immutable metadata"):
+            server.create("pods", make_pod("victim"))
+        # nothing landed under either name
+        assert server.count("pods") == 0
+    finally:
+        srv.shutdown()
