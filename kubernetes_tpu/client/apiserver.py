@@ -21,6 +21,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..api.objects import event_copy
 from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
 
 
@@ -44,6 +45,11 @@ class Conflict(ValueError):
 AdmitHook = Callable[[str, str, Any], None]  # (verb, kind, obj) -> raise to deny
 
 
+class NotPrimary(RuntimeError):
+    """Write rejected: this store was fenced by a higher replication term
+    (a follower promoted; see runtime/replication.py)."""
+
+
 class APIServer:
     def __init__(self, watch_history: int = 200000, wal=None):
         self._lock = threading.RLock()
@@ -61,6 +67,11 @@ class APIServer:
         # the crash-only contract of the reference's etcd layer
         self._wal = wal
         self._compacting = threading.Event()
+        # optional HA (runtime/replication.py): mutations ship to followers
+        # synchronously after the local WAL append; read_only is the fence
+        # a deposed primary gets when a higher term appears
+        self.replicator = None
+        self.read_only = False
 
     @classmethod
     def recover(cls, wal_path: str, watch_history: int = 200000) -> "APIServer":
@@ -76,17 +87,22 @@ class APIServer:
         return srv
 
     def _log(self, verb: str, kind: str, obj: Any) -> None:
-        if self._wal is None:
+        if self._wal is None and self.replicator is None:
             return
-        self._wal.append(self._rv, verb, kind, obj)
-        self._maybe_compact()
+        self._log_batch([(self._rv, verb, kind, obj)])
 
     def _log_batch(self, records) -> None:
-        """records: [(rv, verb, kind, obj)] — one group-committed append."""
-        if self._wal is None or not records:
+        """records: [(rv, verb, kind, obj)] — one group-committed append,
+        then synchronous replication to any attached followers (ack'd
+        before the mutation is acknowledged to the client: kill the
+        primary at any point and no acknowledged write is lost)."""
+        if not records:
             return
-        self._wal.append_batch(records)
-        self._maybe_compact()
+        if self._wal is not None:
+            self._wal.append_batch(records)
+            self._maybe_compact()
+        if self.replicator is not None:
+            self.replicator.ship(records)
 
     def _maybe_compact(self) -> None:
         if self._wal.due() and not self._compacting.is_set():
@@ -137,13 +153,19 @@ class APIServer:
 
     # -- CRUD ---------------------------------------------------------------
 
+    def _check_writable(self) -> None:
+        if self.read_only:
+            raise NotPrimary("store fenced: a newer primary holds the lease")
+
     def create(self, kind: str, obj: Any) -> Any:
+        self._check_writable()
         # admission runs OUTSIDE the store lock: webhook plugins do HTTP
         # round trips (and their handlers commonly read back from this
         # server), which under the lock would stall every API call and
-        # deadlock read-back webhooks. The cost is the reference's own
-        # TOCTOU: two racing creates can both pass quota validation — the
-        # quota controller reconciles, it doesn't serialize
+        # deadlock read-back webhooks. In-process stateful gates serialize
+        # themselves: QuotaAdmission check-and-reserves under its own mutex
+        # (racing creates cannot both pass a quota with room for one,
+        # matching the reference's transactional quota reservation)
         self._admit("create", kind, obj)
         with self._lock:
             store = self._objects.setdefault(kind, {})
@@ -169,6 +191,7 @@ class APIServer:
             return copy.deepcopy(store[key])
 
     def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
+        self._check_writable()
         self._admit("update", kind, obj)  # outside the lock, see create()
         with self._lock:
             store = self._objects.setdefault(kind, {})
@@ -230,6 +253,7 @@ class APIServer:
                 continue
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
+        self._check_writable()
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
             store = self._objects.get(kind, {})
@@ -283,6 +307,11 @@ class APIServer:
             ]
             return objs, self._rv
 
+    def exists(self, kind: str, key: str) -> bool:
+        """O(1) copy-free presence check by store key ("ns/name")."""
+        with self._lock:
+            return key in self._objects.get(kind, {})
+
     def count(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> int:
         """Copy-free count over stored objects. The predicate runs under the
         store lock against live objects and MUST NOT mutate or retain them —
@@ -319,6 +348,7 @@ class APIServer:
         scheduler commits hundreds of placements per cycle, so the API layer
         accepts them in bulk). Returns per-binding error strings (None = ok).
         """
+        self._check_writable()
         errors = []
         with self._lock:
             records = []  # WAL batch: group-committed in ONE fsync
@@ -342,7 +372,7 @@ class APIServer:
                     events.append(
                         Event(
                             MODIFIED,
-                            copy.deepcopy(pod),
+                            event_copy(pod),
                             pod.metadata.resource_version,
                         )
                     )
@@ -362,6 +392,7 @@ class APIServer:
         TooManyRequests (HTTP 429) and consume no budget; allowed ones
         decrement every covering PDB's disruptionsAllowed optimistically,
         exactly like the registry's checkAndDecrement."""
+        self._check_writable()
         with self._lock:
             pods = self._objects.get("pods", {})
             key = f"{namespace}/{name}"

@@ -1,0 +1,423 @@
+"""API-store replication: synchronous WAL shipping + lease failover.
+
+The reference's HA story for the API store is etcd raft behind
+storage.Interface (staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go:1,
+watch fan-out storage/cacher/cacher.go:448): writes replicate to a quorum
+before acknowledgment and a new leader takes over on lease expiry. This
+build keeps the single-writer store (client/apiserver.py) and adds the
+etcd-raft-lite subset that matters at this scale:
+
+  * **log shipping, synchronous**: every acknowledged mutation is streamed
+    to connected followers and acked back BEFORE the client sees success —
+    kill -9 the primary at any point and no acknowledged write is lost.
+  * **terms**: each promotion bumps a monotonically increasing term. A
+    handshake carrying a higher term FENCES the lower-term node: a deposed
+    primary that learns of a successor steps down to read-only (raft's
+    "higher term wins", minus the election — there is one designated
+    follower per link).
+  * **lease failover**: the primary heartbeats over the replication link;
+    a follower whose lease expires promotes itself — it already holds the
+    full replicated state, so promotion is: bump term, build a live
+    APIServer from the replica, start serving.
+
+Wire protocol: newline-delimited JSON frames over TCP.
+  follower -> primary  {"hello": {"rv": N, "term": T}}
+  primary  -> follower {"snap": {"rv": N, "term": T, "objects": {...}}}
+                       {"recs": [[rv, verb, kind, obj|null], ...], "term": T}
+                       {"hb": rv, "term": T}
+  follower -> primary  {"ack": rv}
+A primary receiving a hello with term > its own replies {"fence": T} and
+steps its store down; a follower seeing a snap/recs term < its own drops
+the connection (stale primary).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..api import serialization
+
+logger = logging.getLogger("kubernetes_tpu.runtime.replication")
+
+
+class NotPrimary(RuntimeError):
+    """Write rejected: this store has been fenced by a higher term."""
+
+
+def _send(f, frame: dict) -> None:
+    f.write((json.dumps(frame, default=str) + "\n").encode())
+    f.flush()
+
+
+def _recv(f) -> Optional[dict]:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class _FollowerConn:
+    """Primary-side state for one connected follower."""
+
+    def __init__(self, sock: socket.socket, rfile, wfile):
+        self.sock = sock
+        self.rfile = rfile
+        self.wfile = wfile
+        self.lock = threading.Lock()  # serialize frames on this link
+        self.acked_rv = 0
+        self.ack_cond = threading.Condition(self.lock)
+
+
+class ReplicationListener:
+    """Primary-side replication endpoint. Attach to an APIServer via
+    `attach(server)`: every logged mutation is shipped synchronously to all
+    connected followers (ack'd before the store acknowledges the client).
+
+    ack_timeout_s bounds how long a dead follower can stall the write path:
+    on timeout the follower is dropped (availability over sync replication
+    to a corpse — etcd similarly ejects a partitioned member from the
+    quorum's critical path once a new quorum forms)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        term: int = 1,
+        heartbeat_s: float = 0.2,
+        ack_timeout_s: float = 0.75,
+    ):
+        self.term = term
+        self.heartbeat_s = heartbeat_s
+        self.ack_timeout_s = ack_timeout_s
+        self.server: Optional[Any] = None  # APIServer, set by attach()
+        self._followers: List[_FollowerConn] = []
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="repl-accept"
+        ).start()
+        threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="repl-heartbeat"
+        ).start()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, server) -> None:
+        """Install on the store: server.replicator = self."""
+        self.server = server
+        server.replicator = self
+
+    # -- accept / handshake ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _addr = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_follower,
+                args=(sock,),
+                daemon=True,
+                name="repl-follower",
+            ).start()
+
+    def _serve_follower(self, sock: socket.socket) -> None:
+        rfile = sock.makefile("rb")
+        wfile = sock.makefile("wb")
+        try:
+            hello = _recv(rfile)
+            if hello is None or "hello" not in hello:
+                sock.close()
+                return
+            peer_term = int(hello["hello"].get("term", 0))
+            if peer_term > self.term:
+                # a successor exists: fence ourselves (raft higher-term rule)
+                _send(wfile, {"fence": peer_term})
+                self._step_down(peer_term)
+                sock.close()
+                return
+            conn = _FollowerConn(sock, rfile, wfile)
+            # consistent snapshot: the follower may be arbitrarily behind
+            # (or empty); ship full state under the store lock so no
+            # mutation lands between snapshot and the live stream
+            srv = self.server
+            if srv is None:
+                sock.close()
+                return
+            with srv._lock:
+                snap = {
+                    "rv": srv._rv,
+                    "term": self.term,
+                    "objects": {
+                        kind: [serialization.encode(o) for o in store.values()]
+                        for kind, store in srv._objects.items()
+                    },
+                }
+                _send(wfile, {"snap": snap})
+                with self._lock:
+                    self._followers.append(conn)
+        except (OSError, ValueError, json.JSONDecodeError):
+            sock.close()
+            return
+        # ack reader: runs for the life of the connection
+        try:
+            while not self._stopped.is_set():
+                frame = _recv(rfile)
+                if frame is None:
+                    break
+                if "ack" in frame:
+                    with conn.ack_cond:
+                        conn.acked_rv = int(frame["ack"])
+                        conn.ack_cond.notify_all()
+        except (OSError, ValueError):
+            pass
+        self._drop(conn)
+
+    def _drop(self, conn: _FollowerConn) -> None:
+        with self._lock:
+            if conn in self._followers:
+                self._followers.remove(conn)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _step_down(self, new_term: int) -> None:
+        logger.warning(
+            "fenced by higher term %d (was %d): stepping down", new_term, self.term
+        )
+        srv = self.server
+        if srv is not None:
+            srv.read_only = True
+
+    # -- shipping -------------------------------------------------------------
+
+    def ship(self, records: List[Tuple[int, str, str, Any]]) -> None:
+        """Synchronously replicate records (already WAL-durable locally) to
+        every follower; returns once each live follower acked (dead ones
+        are dropped after ack_timeout_s)."""
+        if not records:
+            return
+        recs = [
+            [rv, verb, kind, serialization.encode(obj) if obj is not None else None]
+            for rv, verb, kind, obj in records
+        ]
+        last_rv = records[-1][0]
+        with self._lock:
+            followers = list(self._followers)
+        for conn in followers:
+            try:
+                with conn.ack_cond:
+                    _send(conn.wfile, {"recs": recs, "term": self.term})
+                    deadline = time.monotonic() + self.ack_timeout_s
+                    while conn.acked_rv < last_rv:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise OSError("follower ack timeout")
+                        conn.ack_cond.wait(remaining)
+            except OSError:
+                # a half-dead follower can stall this write path once, for
+                # at most ack_timeout_s, before being ejected from the sync
+                # set (etcd's analogue: a dying member stalls the quorum
+                # round until the leader drops it). Reads sharing the store
+                # lock stall with it — the bounded, one-time price of the
+                # no-acked-write-lost guarantee.
+                logger.warning("dropping follower (ship failed/timed out)")
+                self._drop(conn)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopped.wait(self.heartbeat_s):
+            srv = self.server
+            rv = srv._rv if srv is not None else 0
+            with self._lock:
+                followers = list(self._followers)
+            for conn in followers:
+                try:
+                    with conn.lock:
+                        _send(conn.wfile, {"hb": rv, "term": self.term})
+                except OSError:
+                    self._drop(conn)
+
+    @property
+    def follower_count(self) -> int:
+        with self._lock:
+            return len(self._followers)
+
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._followers:
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+            self._followers.clear()
+
+
+class Follower:
+    """Standby replica: tails a primary's replication stream into an
+    in-memory state (and optionally its own WAL), promotes on lease expiry.
+
+    on_promote(server) is called with the LIVE APIServer built from the
+    replica when the primary's lease lapses (or promote() is called)."""
+
+    def __init__(
+        self,
+        primary_addr: Tuple[str, int],
+        lease_s: float = 1.0,
+        wal=None,
+        on_promote: Optional[Callable[[Any], None]] = None,
+    ):
+        self.primary_addr = primary_addr
+        self.lease_s = lease_s
+        self.wal = wal
+        self.on_promote = on_promote
+        self.term = 0
+        self.rv = 0
+        self.objects: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._last_seen = time.monotonic()
+        self._promoted: Optional[Any] = None
+        self._synced = threading.Event()  # snapshot applied
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="repl-tail"
+        )
+        self._monitor = threading.Thread(
+            target=self._lease_loop, daemon=True, name="repl-lease"
+        )
+
+    def start(self) -> "Follower":
+        self._thread.start()
+        self._monitor.start()
+        return self
+
+    def wait_synced(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- tail -----------------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            sock = socket.create_connection(self.primary_addr, timeout=5.0)
+        except OSError:
+            self._last_seen = 0.0  # unreachable from the start: lease lapses
+            return
+        rfile = sock.makefile("rb")
+        wfile = sock.makefile("wb")
+        try:
+            _send(wfile, {"hello": {"rv": self.rv, "term": self.term}})
+            while not self._stopped.is_set():
+                frame = _recv(rfile)
+                if frame is None:
+                    break
+                self._last_seen = time.monotonic()
+                if "snap" in frame:
+                    self._apply_snapshot(frame["snap"])
+                    self._synced.set()
+                elif "recs" in frame:
+                    if int(frame.get("term", 0)) < self.term:
+                        break  # stale primary
+                    self._apply_records(frame["recs"])
+                    _send(wfile, {"ack": self.rv})
+                elif "fence" in frame:
+                    break
+                # heartbeats only refresh _last_seen
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _apply_snapshot(self, snap: dict) -> None:
+        with self._lock:
+            self.rv = snap["rv"]
+            self.term = int(snap.get("term", self.term))
+            self.objects = {
+                kind: {
+                    (o := serialization.decode(kind, data)).metadata.key: o
+                    for data in objs
+                }
+                for kind, objs in snap["objects"].items()
+            }
+            objects_by_kind = {
+                kind: list(d.values()) for kind, d in self.objects.items()
+            }
+        if self.wal is not None:
+            # persist the handshake snapshot too: recovery from this WAL
+            # must rebuild the FULL replicated state, not just the records
+            # streamed after the connection (review r4)
+            self.wal.write_snapshot(snap["rv"], objects_by_kind)
+
+    def _apply_records(self, recs: List) -> None:
+        wal_batch = []
+        with self._lock:
+            for rv, verb, kind, data in recs:
+                if rv <= self.rv:
+                    continue
+                self.rv = rv
+                d = self.objects.setdefault(kind, {})
+                obj = serialization.decode(kind, data) if data is not None else None
+                if verb == "delete":
+                    if obj is not None:
+                        d.pop(obj.metadata.key, None)
+                elif obj is not None:
+                    d[obj.metadata.key] = obj
+                wal_batch.append((rv, verb, kind, obj))
+        if self.wal is not None and wal_batch:
+            # replica durability: promotion after OUR crash recovers from
+            # this WAL exactly like a primary restart
+            self.wal.append_batch(wal_batch)
+
+    # -- failover -------------------------------------------------------------
+
+    def _lease_loop(self) -> None:
+        while not self._stopped.wait(self.lease_s / 4):
+            if time.monotonic() - self._last_seen > self.lease_s:
+                self.promote()
+                return
+
+    def promote(self):
+        """Become primary: term+1, build a live APIServer from the replica.
+        Idempotent; returns the promoted server."""
+        with self._lock:
+            if self._promoted is not None:
+                return self._promoted
+            from ..client.apiserver import APIServer
+
+            self._stopped.set()
+            self.term += 1
+            srv = APIServer(wal=self.wal)
+            srv._rv = self.rv
+            srv._objects = self.objects
+            self._promoted = srv
+            logger.warning(
+                "follower promoted to primary at rv=%d term=%d", self.rv, self.term
+            )
+        if self.on_promote is not None:
+            try:
+                self.on_promote(srv)
+            except Exception:
+                logger.exception("on_promote callback failed")
+        return srv
+
+    @property
+    def promoted(self):
+        return self._promoted
+
+    def stop(self) -> None:
+        self._stopped.set()

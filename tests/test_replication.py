@@ -1,0 +1,172 @@
+"""Replicated API store: sync log shipping, lease failover, term fencing
+(runtime/replication.py; reference: etcd raft behind storage.Interface,
+staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go:1)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer, NotPrimary
+from kubernetes_tpu.runtime.replication import Follower, ReplicationListener
+
+
+def _pod(name, node=""):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            node_name=node, containers=[v1.Container(requests={"cpu": "100m"})]
+        ),
+    )
+
+
+def _mk_pair(lease_s=0.6):
+    primary = APIServer()
+    listener = ReplicationListener(heartbeat_s=0.1)
+    listener.attach(primary)
+    follower = Follower(listener.address, lease_s=lease_s).start()
+    assert follower.wait_synced(5.0)
+    return primary, listener, follower
+
+
+def test_follower_receives_snapshot_and_live_stream():
+    primary = APIServer()
+    primary.create("pods", _pod("pre-existing"))
+    listener = ReplicationListener(heartbeat_s=0.1)
+    listener.attach(primary)
+    follower = Follower(listener.address, lease_s=30.0).start()
+    assert follower.wait_synced(5.0)
+    assert "pre-existing" in {
+        k.split("/")[-1] for k in follower.objects.get("pods", {})
+    }
+    primary.create("pods", _pod("live"))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if any(k.endswith("/live") for k in follower.objects.get("pods", {})):
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("live record never replicated")
+    listener.close()
+    follower.stop()
+
+
+def test_chaos_kill_primary_mid_burst_no_acked_write_lost():
+    """The VERDICT r3 'done' bar: kill the primary mid-burst, the follower
+    promotes, and every write the client saw acknowledged is present on
+    the promoted server."""
+    primary, listener, follower = _mk_pair(lease_s=0.5)
+    acked = []
+    dead = threading.Event()
+
+    def writer():
+        i = 0
+        while not dead.is_set() and i < 500:
+            name = f"burst-{i}"
+            try:
+                primary.create("pods", _pod(name))
+            except Exception:
+                break  # primary died mid-call: write was NOT acknowledged
+            acked.append(name)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.15)  # mid-burst…
+    listener.close()  # kill -9 the primary's replication + service
+    dead.set()
+    t.join()
+    assert len(acked) > 10, "burst never got going"
+
+    # lease lapses -> promotion (automatic via the monitor thread)
+    deadline = time.monotonic() + 5.0
+    while follower.promoted is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    promoted = follower.promoted
+    assert promoted is not None, "follower never promoted"
+    have = set(promoted._objects.get("pods", {}))
+    missing = [n for n in acked if f"default/{n}" not in have]
+    assert not missing, f"acknowledged writes lost: {missing[:5]}…"
+
+
+def test_higher_term_fences_old_primary():
+    primary, listener, follower = _mk_pair(lease_s=30.0)
+    # a successor (term 2) introduces itself: the old primary must fence
+    sock = socket.create_connection(listener.address, timeout=5.0)
+    f = sock.makefile("rwb")
+    f.write((json.dumps({"hello": {"rv": 0, "term": 2}}) + "\n").encode())
+    f.flush()
+    resp = json.loads(f.readline())
+    assert resp == {"fence": 2}
+    deadline = time.monotonic() + 2.0
+    while not primary.read_only and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(NotPrimary):
+        primary.create("pods", _pod("rejected"))
+    sock.close()
+    listener.close()
+    follower.stop()
+
+
+def test_promoted_server_serves_scheduler_relist_and_converges():
+    """After failover the scheduler re-lists against the promoted server
+    and schedules new work (SURVEY §5 failure recovery)."""
+    primary, listener, follower = _mk_pair(lease_s=30.0)
+    for i in range(3):
+        primary.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=f"n{i}", namespace=""),
+                status=v1.NodeStatus(
+                    capacity={"cpu": "4", "memory": "8Gi", "pods": "110"}
+                ),
+            ),
+        )
+    primary.create("pods", _pod("before-failover"))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and follower.rv < primary._rv:
+        time.sleep(0.01)
+    listener.close()
+    promoted = follower.promote()
+    assert promoted._rv == follower.rv
+
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    sched = Scheduler(promoted, KubeSchedulerConfiguration(use_mesh=False))
+    sched.start()
+    try:
+        promoted.create("pods", _pod("after-failover"))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            scheduled = promoted.count(
+                "pods", lambda p: bool(p.spec.node_name)
+            )
+            if scheduled >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("scheduler did not converge on the promoted server")
+    finally:
+        sched.stop()
+        follower.stop()
+
+
+def test_replication_survives_follower_death():
+    """A dead follower must not stall the primary's write path (it is
+    dropped after ack_timeout, etcd-style ejection from the critical path)."""
+    primary = APIServer()
+    listener = ReplicationListener(heartbeat_s=0.1, ack_timeout_s=0.3)
+    listener.attach(primary)
+    follower = Follower(listener.address, lease_s=30.0).start()
+    assert follower.wait_synced(5.0)
+    follower.stop()  # stops acking (socket stays half-open briefly)
+    t0 = time.monotonic()
+    for i in range(3):
+        primary.create("pods", _pod(f"alone-{i}"))
+    assert time.monotonic() - t0 < 5.0
+    assert primary.count("pods") == 3
+    listener.close()
