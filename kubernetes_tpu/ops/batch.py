@@ -189,6 +189,7 @@ def encode_pod_batch(
     # silently allocate stale-shaped arrays (caught by the differential fuzz)
     c = enc.cfg
     S, T = c.s_cap, c.t_cap
+    svc_mask = enc.service_sid_mask()
     b = {
         "valid": np.zeros(P, np.bool_),
         "req": np.zeros((P, c.r_cap), np.int32),
@@ -230,6 +231,7 @@ def encode_pod_batch(
         "ppref_key": np.full((P, c.pod_pref_max), -1, np.int32),
         "ppref_w": np.zeros((P, c.pod_pref_max), np.float32),
         "match_sel": np.zeros((P, S), np.bool_),
+        "match_svc": np.zeros((P, S), np.bool_),
         "match_eterm": np.zeros((P, T), np.bool_),
         "eterm_add": np.zeros((P, T), np.float32),
         "port_mask": np.zeros((P, c.pv_cap), np.bool_),
@@ -371,6 +373,7 @@ def encode_pod_batch(
         b["match_sel"][i, : len(enc.sel_vocab)] = enc._match_vec(
             ns, pod.metadata.labels
         )
+        b["match_svc"][i] = b["match_sel"][i] & svc_mask
         for t_i, et in enumerate(enc.eterm_vocab.items):
             b["match_eterm"][i, t_i] = et.predicate.matches(ns, pod.metadata.labels)
         for tid, w in zip(d["eterm_ids"], d["eterm_ws"]):

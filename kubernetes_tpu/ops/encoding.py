@@ -289,6 +289,9 @@ class PodBatch(NamedTuple):
     ppref_w: Any  # [P, W] float32 signed weight (negative = anti)
     # cross-match tensors
     match_sel: Any  # [P, S] bool pod matches interned predicate s
+    match_svc: Any  # [P, S] bool — match_sel restricted to SERVICE-derived
+    # predicates (encoder.service_sids): the SelectorSpread score's count
+    # columns (same-service pods via snap.sel_counts)
     match_eterm: Any  # [P, T] bool pod matches eterm t's predicate
     eterm_add: Any  # [P, T] float32 pod's own term contributions if placed
     port_mask: Any  # [P, PV] bool host ports the pod occupies
@@ -336,6 +339,10 @@ class SnapshotEncoder:
         self.port_vocab = Vocab()  # (proto, port) -> pid
         self.image_vocab = Vocab()
         self.avoid_vocab = Vocab()  # controller-ref "kind/name" -> aid
+        # sids interned FROM SERVICE selectors (register_service_predicate):
+        # the SelectorSpread device score counts same-service pods through
+        # exactly these sel_counts columns and no others
+        self.service_sids: set = set()
 
         self.row_names: List[Optional[str]] = []
         self._row_by_name: Dict[str, int] = {}
@@ -464,6 +471,25 @@ class SnapshotEncoder:
                 self._dirty_rows.add(row)
         self.generation += 1
         return sid
+
+    def register_service_predicate(self, namespace: str, selector: LabelSelector) -> int:
+        """Intern a Service's selector as a pod predicate and mark its sid
+        service-derived (the DefaultPodTopologySpread device score reads
+        sel_counts through service sids only). Idempotent; called from the
+        scheduler's service event handlers so a new Service grows the vocab
+        and thereby invalidates cached templates (their fingerprints embed
+        vocab lengths)."""
+        sid = self.intern_predicate(frozenset({namespace}), selector)
+        self.service_sids.add(sid)
+        return sid
+
+    def service_sid_mask(self) -> np.ndarray:
+        """[s_cap] bool — which predicate columns are service-derived."""
+        mask = np.zeros(self.cfg.s_cap, np.bool_)
+        for sid in self.service_sids:
+            if sid < mask.shape[0]:
+                mask[sid] = True
+        return mask
 
     def intern_eterm(self, pred: PodPredicate, topo_key: str, kind: int) -> int:
         key_id = self.intern_key(topo_key)

@@ -71,7 +71,8 @@ SC_IMAGE = 6
 SC_PREFER_AVOID = 7
 SC_TOPO_SPREAD = 8
 SC_INTERPOD = 9
-NUM_SCORE_COMPONENTS = 10
+SC_SELECTOR_SPREAD = 10  # DefaultPodTopologySpread (same-service pod count)
+NUM_SCORE_COMPONENTS = 11
 
 # Default profile weights: all 1 except NodePreferAvoidPods=10000
 # (algorithmprovider/registry.go:61-131).
@@ -432,6 +433,19 @@ def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
         ip_max = jnp.max(jnp.where(feasible, jnp.abs(ip), 0.0))
         ip_norm = jnp.where(ip_max > 0, ip / ip_max * 100.0, 0.0)
 
+        # DefaultPodTopologySpread: same-service pods per node via the
+        # service-derived sel_counts columns; MAX over matching services
+        # matches the host's any()-dedup when services don't overlap (the
+        # common case — overlapping services score each pod once there too)
+        svc_cnt = jnp.max(
+            jnp.where(
+                bp.match_svc[None, :],
+                (snap.sel_counts + sel_x).astype(jnp.float32),
+                0.0,
+            ),
+            axis=1,
+        )  # [N]
+
         comps = jnp.stack(
             [
                 least,
@@ -444,6 +458,7 @@ def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
                 avoid,
                 norm_invert(spread_penalty),
                 ip_norm,
+                norm_invert(svc_cnt),
             ]
         )  # [K, N]
         total_score = jnp.sum(comps * weights[:, None], axis=0)

@@ -180,6 +180,10 @@ class TemplateCache:
     def __init__(self, encoder: SnapshotEncoder, max_templates: int = 64):
         self.encoder = encoder
         self.max_templates = max_templates
+        # bumped by the scheduler when template-relevant state changes
+        # WITHOUT growing a vocab (service delete/retarget: the match_svc
+        # masks must rebuild even though fingerprints alone can't see it)
+        self.extra_sig = 0
         self._rows: Dict[Tuple, int] = {}
         self._exemplars: List[v1.Pod] = []
         self._fallback: List[bool] = []
@@ -200,6 +204,7 @@ class TemplateCache:
             len(e.avoid_vocab),
             len(e.res_vocab),
             e.cfg,
+            self.extra_sig,
         )
 
     def _fingerprint(self, pod: v1.Pod) -> Tuple:

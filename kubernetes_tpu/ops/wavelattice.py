@@ -317,6 +317,21 @@ def make_wave_kernel(
         )
         ip_norm = jnp.where(ip_mx > 0, ip / ip_mx * 100.0, 0.0)
 
+        # DefaultPodTopologySpread: same-service pods per node through the
+        # service-derived sel_counts columns (templates sharing a service
+        # share the mask); MAX over matching services mirrors the host's
+        # any()-dedup for non-overlapping services. Stage-A counts like the
+        # other pair scores — staleness within the batch window is the
+        # kernel's documented score model.
+        svc_cnt = jnp.max(
+            jnp.where(
+                tpl.match_svc[:, None, :],
+                snap.sel_counts[None].astype(jnp.float32),
+                0.0,
+            ),
+            axis=-1,
+        )  # [TPL, N]
+
         comps = jnp.stack(
             [
                 least,
@@ -329,6 +344,7 @@ def make_wave_kernel(
                 avoid,
                 norm_invert(spread_pen0, feasible0),
                 ip_norm,
+                norm_invert(svc_cnt, feasible0),
             ]
         )  # [K, TPL, N]
         total_score = jnp.einsum("k,ktn->tn", weights, comps)

@@ -43,6 +43,12 @@ class SchedulerCache:
         self.lock = threading.RLock()
         self._nodes: Dict[str, NodeInfo] = {}
         self._pod_to_node: Dict[str, str] = {}
+        # pods scheduled to nodes the cache hasn't seen yet (informer start
+        # races the node list; WAL recovery replays pods first): parked
+        # here and replayed into the NodeInfo + encoder when the node
+        # arrives — the reference's implicit-NodeInfo reconcile
+        # (internal/cache/cache.go AddPod on an unknown node)
+        self._orphans: Dict[str, Dict[str, v1.Pod]] = {}
         self._assumed: Dict[str, _AssumedInfo] = {}
         self._ttl = ttl_seconds
         self.encoder = encoder or SnapshotEncoder(encoding_config)
@@ -63,6 +69,10 @@ class SchedulerCache:
                 ni.set_node(node)
             self._bump(ni)
             self.encoder.add_node(node)
+            # replay pods that arrived before their node did
+            for pod in self._orphans.pop(name, {}).values():
+                ni.add_pod(pod)
+                self.encoder.add_pod(name, pod)
 
     def update_node(self, node: v1.Node) -> None:
         self.add_node(node)
@@ -90,6 +100,9 @@ class SchedulerCache:
                         ni.remove_pod(key)
                         ni.add_pod(pod)
                         self._bump(ni)
+                    else:
+                        # node vanished mid-bind: park for a possible re-add
+                        self._orphans.setdefault(a.node_name, {})[key] = pod
                     self._pod_to_node[key] = pod.spec.node_name
                     return
                 # scheduled somewhere else than assumed: undo and re-add
@@ -123,8 +136,10 @@ class SchedulerCache:
         node = pod.spec.node_name
         ni = self._nodes.get(node)
         if ni is None:
-            # pod on unknown node: track mapping only (reference logs this)
+            # pod on a node the cache hasn't seen: park it for add_node's
+            # replay (update_node races and recovery both hit this)
             self._pod_to_node[pod.metadata.key] = node
+            self._orphans.setdefault(node, {})[pod.metadata.key] = pod
             return
         ni.add_pod(pod)
         self._bump(ni)
@@ -140,6 +155,11 @@ class SchedulerCache:
             if ni.remove_pod(key) is not None:
                 self._bump(ni)
                 self.encoder.remove_pod(node, key)
+        orphans = self._orphans.get(node)
+        if orphans is not None:
+            orphans.pop(key, None)
+            if not orphans:
+                del self._orphans[node]
         self._pod_to_node.pop(key, None)
 
     # -- assume protocol -----------------------------------------------------

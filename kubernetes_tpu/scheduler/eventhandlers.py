@@ -52,6 +52,20 @@ def add_all_event_handlers(sched: "Scheduler") -> None:
         on_delete=lambda n: _on_node_delete(sched, n),
     )
 
+    # -- services -> SelectorSpread's device columns -------------------------
+    # A Service's selector is interned as a service-derived predicate so the
+    # kernel's DefaultPodTopologySpread score can count same-service pods
+    # through sel_counts; interning grows the vocab, which invalidates
+    # cached templates (their fingerprints embed vocab lengths). Deletes
+    # can't shrink the vocab — bump the template cache's external sig so
+    # match_svc masks rebuild without the dead service.
+    services = sched.informer_factory.informer("services")
+    services.add_handler(
+        on_add=lambda s: _on_service_add(sched, s),
+        on_update=lambda old, new: _on_service_update(sched, old, new),
+        on_delete=lambda s: _on_service_delete(sched, s),
+    )
+
 
 def _on_scheduled_add(sched, pod):
     sched.cache.add_pod(pod)
@@ -109,3 +123,57 @@ def _on_node_update(sched, old, new):
 def _on_node_delete(sched, node):
     sched.cache.remove_node(node.metadata.name)
     sched.queue.move_all_to_active_or_backoff(qevents.NODE_DELETE)
+
+
+def _register_service(sched, svc) -> bool:
+    sel = getattr(svc.spec, "selector", None)
+    if not sel:
+        return False
+    from ..api.selectors import selector_from_match_labels
+
+    with sched.cache.lock:
+        enc = sched.cache.encoder
+        before = len(enc.service_sids)
+        enc.register_service_predicate(
+            svc.metadata.namespace, selector_from_match_labels(sel)
+        )
+        return len(enc.service_sids) != before
+
+
+def _rebuild_service_sids(sched) -> None:
+    """Recompute the service-derived sid set from the LIVE services (the
+    vocab can't shrink, but a deleted/retargeted service must drop out of
+    the match_svc masks)."""
+    from ..api.selectors import selector_from_match_labels
+
+    try:
+        services, _ = sched.server.list("services")
+    except Exception:
+        services = []
+    with sched.cache.lock:
+        enc = sched.cache.encoder
+        enc.service_sids.clear()
+        for s in services:
+            sel = getattr(s.spec, "selector", None)
+            if sel:
+                enc.register_service_predicate(
+                    s.metadata.namespace, selector_from_match_labels(sel)
+                )
+    sched._tpl_cache.extra_sig += 1  # cached match_svc masks are stale
+
+
+def _on_service_add(sched, svc):
+    if _register_service(sched, svc):
+        sched._tpl_cache.extra_sig += 1
+    sched.queue.move_all_to_active_or_backoff(qevents.SERVICE_ADD)
+
+
+def _on_service_update(sched, old, new):
+    if getattr(old.spec, "selector", None) != getattr(new.spec, "selector", None):
+        _rebuild_service_sids(sched)
+    sched.queue.move_all_to_active_or_backoff(qevents.SERVICE_UPDATE)
+
+
+def _on_service_delete(sched, svc):
+    _rebuild_service_sids(sched)
+    sched.queue.move_all_to_active_or_backoff(qevents.SERVICE_DELETE)

@@ -52,6 +52,7 @@ from ..ops.lattice import (
     SC_NODE_AFFINITY,
     SC_PREFER_AVOID,
     SC_REQ_TO_CAP,
+    SC_SELECTOR_SPREAD,
     SC_TAINT,
     SC_TOPO_SPREAD,
     make_schedule_batch,
@@ -120,7 +121,7 @@ _SCORE_NAME_TO_COMPONENT = {
     "NodePreferAvoidPods": SC_PREFER_AVOID,
     "PodTopologySpread": SC_TOPO_SPREAD,
     "InterPodAffinity": SC_INTERPOD,
-    # DefaultPodTopologySpread has no device component; host path only.
+    "DefaultPodTopologySpread": SC_SELECTOR_SPREAD,
 }
 
 

@@ -337,3 +337,102 @@ def test_fuzz_device_mask_matches_host_filters(seed):
     np.testing.assert_array_equal(enc.m_port_counts, new_snap_h.port_counts)
     np.testing.assert_array_equal(enc.m_prio_req, new_snap_h.prio_req)
     np.testing.assert_allclose(enc.m_eterm_w, new_snap_h.eterm_w, rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24])
+def test_fuzz_selector_spread_device_picks_min_service_count(seed):
+    """Score-differential for the device DefaultPodTopologySpread: with the
+    spread component as the ONLY weighted score, every kernel placement
+    must land on a node whose batch-start same-service pod count is
+    minimal among that pod's feasible nodes (the host plugin's invert-by-
+    max normalization picks exactly those). Services are one-per-app
+    (non-overlapping), where the kernel's max-dedup equals the host's
+    any()-dedup. Capacities are generous so in-batch fills never force a
+    pod off the min-count tier."""
+    from kubernetes_tpu.api.selectors import selector_from_match_labels
+    from kubernetes_tpu.ops.lattice import (
+        NUM_SCORE_COMPONENTS,
+        SC_SELECTOR_SPREAD,
+    )
+
+    rng = random.Random(seed)
+    n_nodes = rng.randrange(6, 14)
+    enc = SnapshotEncoder()
+    nodes = []
+    infos = {}
+    for i in range(n_nodes):
+        n = Node(
+            metadata=ObjectMeta(name=f"n{i}", namespace=""),
+            status=NodeStatus(
+                capacity={"cpu": "64", "memory": "256Gi", "pods": "200"}
+            ),
+        )
+        nodes.append(n)
+        enc.add_node(n)
+        infos[n.metadata.name] = NodeInfo(n)
+    for app in APPS:
+        enc.register_service_predicate(
+            "default", selector_from_match_labels({"app": app})
+        )
+    # existing pods: plain app labels only (no affinity noise)
+    for j in range(n_nodes * 2):
+        node = rng.choice(nodes)
+        p = Pod(
+            metadata=ObjectMeta(
+                name=f"pre-{j}", labels={"app": rng.choice(APPS)}
+            ),
+            spec=PodSpec(
+                node_name=node.metadata.name,
+                containers=[Container(requests={"cpu": "100m"})],
+            ),
+        )
+        enc.add_pod(node.metadata.name, p)
+        infos[node.metadata.name].add_pod(p)
+
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}", labels={"app": rng.choice(APPS)}),
+            spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+        )
+        for i in range(rng.randrange(3, 8))
+    ]
+
+    tc = TemplateCache(enc)
+    P = 1
+    while P < len(pods):
+        P *= 2
+    eb = tc.encode(pods, pad_to=P)
+    ptab, _ = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+    snap = enc.flush()
+    weights = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
+    weights[SC_SELECTOR_SPREAD] = 1.0
+    kern = make_wave_kernel_jit(enc.cfg.v_cap, 64, 8)
+    _new_snap, res = kern(snap, eb.batch, ptab, weights, jax.random.PRNGKey(seed))
+    chosen, placed, feasible_tpl = jax.device_get(
+        (res.chosen, res.placed, res.feasible_tpl)
+    )
+    enc.invalidate_device()
+
+    def svc_count(app, node_name):
+        return sum(
+            1
+            for p in infos[node_name].pods
+            if p.metadata.labels.get("app") == app
+        )
+
+    pod_tpl = eb.pod_tpl_np
+    for i, pod in enumerate(pods):
+        assert placed[i], (seed, pod.metadata.name)
+        t = int(pod_tpl[i])
+        app = pod.metadata.labels["app"]
+        feas_nodes = [
+            enc.row_names[r]
+            for r in np.nonzero(feasible_tpl[t])[0]
+            if enc.row_names[r]
+        ]
+        min_cnt = min(svc_count(app, nm) for nm in feas_nodes)
+        got = enc.row_names[int(chosen[i])]
+        assert svc_count(app, got) == min_cnt, (
+            f"seed={seed} pod={pod.metadata.name} app={app}: placed on {got} "
+            f"(count {svc_count(app, got)}), min feasible count {min_cnt}"
+        )
