@@ -250,6 +250,16 @@ class ContainerStatus:
     state: str = "running"  # waiting | running | terminated
 
 
+@dataclass(frozen=True)
+class SecurityContext:
+    """Container security context subset the admission gates act on
+    (reference core/v1 SecurityContext)."""
+
+    privileged: bool = False
+    run_as_user: Optional[int] = None
+    run_as_non_root: bool = False
+
+
 @dataclass
 class Container:
     name: str = ""
@@ -259,6 +269,8 @@ class Container:
     ports: List[ContainerPort] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    image_pull_policy: str = ""  # "" = kubelet default (IfNotPresent)
+    security_context: Optional[SecurityContext] = None
 
 
 @dataclass
@@ -284,6 +296,9 @@ class PodSpec:
     termination_grace_period_seconds: int = 30
     volumes: List["Volume"] = field(default_factory=list)
     service_account_name: str = ""
+    # bounded-duration pods (Jobs set this); the quota "Terminating" scope
+    # selects on its presence (reference core/v1 ActiveDeadlineSeconds)
+    active_deadline_seconds: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -475,6 +490,8 @@ def _copy_container(c: Container) -> Container:
         ],
         liveness_probe=c.liveness_probe,  # Probe is treated as immutable
         readiness_probe=c.readiness_probe,
+        image_pull_policy=c.image_pull_policy,
+        security_context=c.security_context,  # frozen
     )
 
 
@@ -515,6 +532,7 @@ def _copy_pod_spec(s: PodSpec) -> PodSpec:
         termination_grace_period_seconds=s.termination_grace_period_seconds,
         volumes=[_copy_volume(v) for v in s.volumes],
         service_account_name=s.service_account_name,
+        active_deadline_seconds=s.active_deadline_seconds,
     )
 
 
@@ -620,6 +638,11 @@ class NodeStatus:
     images: List[ContainerImage] = field(default_factory=list)
     addresses: List[Tuple[str, str]] = field(default_factory=list)
     node_info: Dict[str, str] = field(default_factory=dict)
+    # kubelet volume manager reporting (reference VolumesInUse/
+    # VolumesAttached): the safe-detach contract between node and the
+    # attach-detach controller
+    volumes_in_use: List[str] = field(default_factory=list)
+    volumes_attached: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -658,6 +681,8 @@ class Node:
                 ],
                 addresses=list(self.status.addresses),
                 node_info=dict(self.status.node_info),
+                volumes_in_use=list(self.status.volumes_in_use),
+                volumes_attached=list(self.status.volumes_attached),
             ),
             kind=self.kind,
         )
@@ -1220,7 +1245,30 @@ class CronJob:
 @dataclass
 class ResourceQuotaSpec:
     hard: Dict[str, Quantity] = field(default_factory=dict)
+    # quota scopes (reference ResourceQuotaScope): BestEffort,
+    # NotBestEffort, Terminating, NotTerminating — a quota with scopes
+    # tracks/limits only pods matching ALL of them
     scopes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PodSecurityPolicySpec:
+    """Subset of policy/v1beta1 PSPSpec the validation gate acts on
+    (reference plugin/pkg/admission/security/podsecuritypolicy)."""
+
+    privileged: bool = False  # allow privileged containers
+    host_network: bool = False  # allow hostNetwork pods
+    run_as_user_rule: str = "RunAsAny"  # RunAsAny | MustRunAsNonRoot
+
+
+@dataclass
+class PodSecurityPolicy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSecurityPolicySpec = field(default_factory=PodSecurityPolicySpec)
+    kind: str = "PodSecurityPolicy"
+
+    def deep_copy(self) -> "PodSecurityPolicy":
+        return copy.deepcopy(self)
 
 
 @dataclass

@@ -57,6 +57,7 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "validatingwebhookconfigurations": v1.ValidatingWebhookConfiguration,
     "ingresses": v1.Ingress,
     "networkpolicies": v1.NetworkPolicy,
+    "podsecuritypolicies": v1.PodSecurityPolicy,
 }
 
 KIND_TO_RESOURCE = {

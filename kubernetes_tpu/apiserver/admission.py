@@ -1,0 +1,176 @@
+"""Admission breadth: NodeRestriction, AlwaysPullImages, PodSecurityPolicy,
+and quota scope selection.
+
+Reference: plugin/pkg/admission/noderestriction/admission.go (node
+identities may mutate only their own Node and pods bound to them),
+…/alwayspullimages (force PullAlways so a scheduled-together pod can't read
+a private image from the node cache), …/security/podsecuritypolicy
+(validate pod security posture against cluster policies), and the quota
+evaluator's scope matching (pkg/quota/v1/evaluator/core/pods.go
+podMatchesScopeFunc).
+
+The requesting identity reaches in-process admission through a contextvar
+the REST layer sets after authentication — the moral equivalent of
+admission.Attributes.GetUserInfo(). In-process callers (controllers,
+tests) have no identity set and are unrestricted, like loopback clients
+with cluster-admin.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+from ..api import objects as v1
+from .auth import AdmissionDenied, AdmissionPlugin
+
+# set by the REST layer per request (None = loopback/in-process client)
+request_user: contextvars.ContextVar = contextvars.ContextVar(
+    "request_user", default=None
+)
+
+NODE_USER_PREFIX = "system:node:"
+NODES_GROUP = "system:nodes"
+
+
+class NodeRestrictionAdmission(AdmissionPlugin):
+    """A node identity (user system:node:<name>, group system:nodes) may
+    mutate only its OWN Node object and pods BOUND to it (the mirror-pod /
+    status-update surface). Everything else is denied — a compromised
+    kubelet cannot reach across the cluster."""
+
+    name = "NodeRestriction"
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        user = request_user.get()
+        if user is None or NODES_GROUP not in getattr(user, "groups", ()):
+            return
+        if not user.name.startswith(NODE_USER_PREFIX):
+            raise AdmissionDenied(
+                f"user in {NODES_GROUP} without a node identity: {user.name}"
+            )
+        node_name = user.name[len(NODE_USER_PREFIX):]
+        if resource == "nodes":
+            if obj is not None and obj.metadata.name != node_name:
+                raise AdmissionDenied(
+                    f"node {node_name!r} cannot modify node "
+                    f"{obj.metadata.name!r}"
+                )
+            return
+        if resource == "pods":
+            bound = getattr(obj.spec, "node_name", "") if obj is not None else ""
+            if bound != node_name:
+                raise AdmissionDenied(
+                    f"node {node_name!r} can only {verb} pods bound to "
+                    f"itself (pod bound to {bound or 'nothing'})"
+                )
+            return
+        if resource == "leases":
+            # node heartbeat leases: only its own
+            if obj is not None and obj.metadata.name != node_name:
+                raise AdmissionDenied(
+                    f"node {node_name!r} cannot renew lease "
+                    f"{obj.metadata.name!r}"
+                )
+            return
+        raise AdmissionDenied(
+            f"node identity may not {verb} {resource} objects"
+        )
+
+
+class AlwaysPullImagesAdmission(AdmissionPlugin):
+    """Force imagePullPolicy=Always on every container at create: without
+    it, any pod scheduled onto a node can run a private image already
+    pulled there without presenting credentials."""
+
+    name = "AlwaysPullImages"
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+
+class PodSecurityPolicyAdmission(AdmissionPlugin):
+    """Validate pod security posture against the cluster's
+    PodSecurityPolicy objects: the pod is admitted iff SOME policy allows
+    every requested capability (privileged, hostNetwork, run-as-user).
+    No policies installed = the gate is open (the plugin disabled state;
+    the reference denies, but requires explicit enablement — here
+    installing the first policy arms the gate)."""
+
+    name = "PodSecurityPolicy"
+
+    def __init__(self, server):
+        self.server = server
+
+    @staticmethod
+    def _pod_wants(pod) -> dict:
+        privileged = any(
+            c.security_context is not None and c.security_context.privileged
+            for c in list(pod.spec.containers) + list(pod.spec.init_containers)
+        )
+        runs_as_root = any(
+            c.security_context is not None
+            and c.security_context.run_as_user == 0
+            for c in list(pod.spec.containers) + list(pod.spec.init_containers)
+        )
+        return {
+            "privileged": privileged,
+            "host_network": pod.spec.host_network,
+            "runs_as_root": runs_as_root,
+        }
+
+    @staticmethod
+    def _allows(psp: "v1.PodSecurityPolicy", wants: dict) -> Optional[str]:
+        s = psp.spec
+        if wants["privileged"] and not s.privileged:
+            return "privileged containers are not allowed"
+        if wants["host_network"] and not s.host_network:
+            return "hostNetwork is not allowed"
+        if s.run_as_user_rule == "MustRunAsNonRoot" and wants["runs_as_root"]:
+            return "running as root (runAsUser=0) is not allowed"
+        return None
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        try:
+            policies, _ = self.server.list("podsecuritypolicies")
+        except Exception:
+            return
+        if not policies:
+            return
+        wants = self._pod_wants(obj)
+        reasons = []
+        for psp in sorted(policies, key=lambda p: p.metadata.name):
+            why = self._allows(psp, wants)
+            if why is None:
+                return  # some policy admits the pod
+            reasons.append(f"{psp.metadata.name}: {why}")
+        raise AdmissionDenied(
+            "unable to validate against any pod security policy: "
+            + "; ".join(reasons)
+        )
+
+
+def pod_matches_scopes(pod, scopes) -> bool:
+    """Quota scope selection (podMatchesScopeFunc): a scoped quota tracks
+    and limits only matching pods. BestEffort = no container requests or
+    limits at all; Terminating = activeDeadlineSeconds set."""
+    for scope in scopes:
+        best_effort = not any(
+            c.requests or c.limits
+            for c in list(pod.spec.containers) + list(pod.spec.init_containers)
+        )
+        terminating = pod.spec.active_deadline_seconds is not None
+        if scope == "BestEffort" and not best_effort:
+            return False
+        if scope == "NotBestEffort" and best_effort:
+            return False
+        if scope == "Terminating" and not terminating:
+            return False
+        if scope == "NotTerminating" and terminating:
+            return False
+    return True

@@ -341,6 +341,8 @@ class QuotaAdmission(AdmissionPlugin):
             "requests.memory": int(req.get(MEMORY, 0)),
             "memory": int(req.get(MEMORY, 0)),
         }
+        from .admission import pod_matches_scopes
+
         with self._lock:
             # purge BEFORE computing usage: the other order can drop a
             # reservation whose pod landed between the usage read and the
@@ -350,16 +352,31 @@ class QuotaAdmission(AdmissionPlugin):
             now = time.monotonic()
             res = self._reserved.setdefault(ns, {})
             for key in list(res):
-                _d, deadline = res[key]
+                _d, deadline, _rpod = res[key]
                 # the pod landed (usage counts it now) or the create died
                 # downstream of admission (TTL): drop the reservation
                 if deadline < now or self._pod_exists(key):
                     del res[key]
-            usage = compute_namespace_usage(self.server, ns)
-            for d, _deadline in res.values():
-                for rn, v in d.items():
-                    usage[rn] = usage.get(rn, 0) + v
+            usage_by_scopes: dict = {}  # scopes tuple -> usage incl. reserved
+            matched_any = False
             for q in quotas:
+                scopes = tuple(q.spec.scopes)
+                # a scoped quota constrains only matching pods
+                if scopes and not pod_matches_scopes(obj, scopes):
+                    continue
+                matched_any = True
+                usage = usage_by_scopes.get(scopes)
+                if usage is None:
+                    usage = compute_namespace_usage(self.server, ns, scopes)
+                    for entry in res.values():
+                        d, _deadline, rpod = entry
+                        # a reservation counts toward this quota only if
+                        # ITS pod matches the quota's scopes too
+                        if scopes and not pod_matches_scopes(rpod, scopes):
+                            continue
+                        for rn, v in d.items():
+                            usage[rn] = usage.get(rn, 0) + v
+                    usage_by_scopes[scopes] = usage
                 for res_name, hard in q.spec.hard.items():
                     # hard limits are k8s quantities ("2", "500m", "4Gi");
                     # usage is millicores/bytes/counts — same-unit parse
@@ -374,7 +391,8 @@ class QuotaAdmission(AdmissionPlugin):
                             f"{res_name}={delta.get(res_name, 0)}, used "
                             f"{usage.get(res_name, 0)}, limited {hard}"
                         )
-            res[obj.metadata.key] = (delta, now + self._ttl)
+            if matched_any:
+                res[obj.metadata.key] = (delta, now + self._ttl, obj)
 
     def _pod_exists(self, key: str) -> bool:
         try:

@@ -206,11 +206,18 @@ class _Handler(BaseHTTPRequestHandler):
     def _authenticate(self):
         """(user, ok): resolve the request identity. ok=False means a 401
         was already written. user is None only on the insecure port (no
-        authenticator configured)."""
+        authenticator configured). The resolved identity is published to
+        in-process admission via the admission.request_user contextvar
+        (admission.Attributes.GetUserInfo() equivalent — NodeRestriction
+        reads it)."""
+        from .admission import request_user as _admission_user
+
         if self._request_user is not None:
+            _admission_user.set(self._request_user[0])
             return self._request_user
         authn = self.server.authenticator
         if authn is None:
+            _admission_user.set(None)
             return None, True
         from .auth import ANONYMOUS, UserInfo
 
@@ -220,6 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._status_error(401, "Unauthorized", "authentication required")
                 return None, False
             user = UserInfo(ANONYMOUS, ("system:unauthenticated",))
+        _admission_user.set(user)
         return user, True
 
     def _authorize(

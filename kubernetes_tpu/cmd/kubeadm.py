@@ -75,6 +75,10 @@ def init_cluster(
         TokenAuthenticator,
         make_rule,
     )
+    from ..apiserver.admission import (
+        NodeRestrictionAdmission,
+        PodSecurityPolicyAdmission,
+    )
     from ..apiserver.webhook import (
         MutatingWebhookAdmission,
         ValidatingWebhookAdmission,
@@ -136,6 +140,8 @@ def init_cluster(
             ],
             validating=[
                 NamespaceLifecycleAdmission(store),
+                NodeRestrictionAdmission(),
+                PodSecurityPolicyAdmission(store),
                 LimitRangerAdmission(store),
                 QuotaAdmission(store),
                 ValidatingWebhookAdmission(store),
@@ -189,6 +195,21 @@ def init_cluster(
         ),
     )
     logger.info("[bootstrap-token] join token stored")
+
+    # -- phase upload-config: kubeadm-config (upgrade's source of truth) -----
+    from .. import __version__ as _cluster_version
+
+    store.create(
+        "configmaps",
+        v1.ConfigMap(
+            metadata=v1.ObjectMeta(name="kubeadm-config", namespace="kube-system"),
+            data={
+                "ClusterConfiguration": json.dumps(
+                    {"kubernetesVersion": _cluster_version}
+                )
+            },
+        ),
+    )
 
     # -- phase upload-config/addons: public discovery document ---------------
     # cluster-info in kube-public carries ONLY the server location (no
@@ -291,6 +312,83 @@ def join_node(
     return pool
 
 
+def upgrade_plan(server) -> dict:
+    """`kubeadm upgrade plan` (cmd/kubeadm/app/cmd/upgrade/plan.go):
+    compare the cluster's recorded version (kubeadm-config) with the
+    version this binary ships."""
+    from .. import __version__ as target
+
+    try:
+        cm = server.get("configmaps", "kube-system", "kubeadm-config")
+        current = json.loads(cm.data.get("ClusterConfiguration", "{}")).get(
+            "kubernetesVersion", "unknown"
+        )
+    except Exception:
+        current = "unknown"
+    return {
+        "current": current,
+        "target": target,
+        "upgrade_available": current != target,
+    }
+
+
+def upgrade_apply(server, target: Optional[str] = None) -> dict:
+    """`kubeadm upgrade apply` (…/upgrade/apply.go): refuse downgrades and
+    migrate the stored cluster configuration to the new version — the
+    config-migration half of the reference's apply (component manifests
+    don't exist in an in-process control plane). Idempotent."""
+    from .. import __version__ as binary_version
+
+    target = target or binary_version
+    plan = upgrade_plan(server)
+    current = plan["current"]
+
+    def _key(vs: str):
+        try:
+            return tuple(int(x) for x in vs.lstrip("v").split("-")[0].split("."))
+        except ValueError:
+            return ()
+
+    if _key(target) < _key(current):
+        raise ValueError(
+            f"downgrade {current} -> {target} is not supported "
+            "(upgrade/apply.go version skew policy)"
+        )
+
+    def mutate(cm):
+        cfg = json.loads(cm.data.get("ClusterConfiguration", "{}"))
+        if cfg.get("kubernetesVersion") == target:
+            return None
+        cfg["kubernetesVersion"] = target
+        cm.data["ClusterConfiguration"] = json.dumps(cfg)
+        return cm
+
+    from ..client.apiserver import NotFound
+
+    try:
+        server.guaranteed_update(
+            "configmaps", "kube-system", "kubeadm-config", mutate
+        )
+    except NotFound:
+        from ..api import objects as v1
+
+        server.create(
+            "configmaps",
+            v1.ConfigMap(
+                metadata=v1.ObjectMeta(
+                    name="kubeadm-config", namespace="kube-system"
+                ),
+                data={
+                    "ClusterConfiguration": json.dumps(
+                        {"kubernetesVersion": target}
+                    )
+                },
+            ),
+        )
+    logger.info("[upgrade] cluster %s -> %s", current, target)
+    return {"from": current, "to": target}
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kubeadm-tpu")
     sub = parser.add_subparsers(dest="verb", required=True)
@@ -301,6 +399,11 @@ def main(argv=None) -> int:
     p_join.add_argument("server")
     p_join.add_argument("--token", required=True)
     p_join.add_argument("--node-name", default="node-joined")
+    p_up = sub.add_parser("upgrade")
+    p_up.add_argument("phase", choices=["plan", "apply"])
+    p_up.add_argument("server")
+    p_up.add_argument("--token", required=True)
+    p_up.add_argument("--version", default=None)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -323,6 +426,15 @@ def main(argv=None) -> int:
             threading.Event().wait()
         except KeyboardInterrupt:
             pool.stop()
+        return 0
+    if args.verb == "upgrade":
+        from ..apiserver.client import AuthRESTClient
+
+        client = AuthRESTClient(args.server, token=args.token)
+        if args.phase == "plan":
+            print(json.dumps(upgrade_plan(client), indent=2))
+        else:
+            print(json.dumps(upgrade_apply(client, args.version), indent=2))
         return 0
     return 1
 

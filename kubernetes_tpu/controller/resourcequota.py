@@ -29,15 +29,21 @@ Q_CPU = "cpu"  # alias of requests.cpu (v1 compatibility)
 Q_MEM = "memory"
 
 
-def compute_namespace_usage(server, namespace: str) -> Dict[str, int]:
-    """Usage for one namespace. Terminal pods don't count (the reference
-    quota evaluator skips Succeeded/Failed pods)."""
+def compute_namespace_usage(
+    server, namespace: str, scopes=()
+) -> Dict[str, int]:
+    """Usage for one namespace, restricted to pods matching `scopes`
+    (reference quota scope selection, evaluator/core/pods.go). Terminal
+    pods don't count (the evaluator skips Succeeded/Failed pods)."""
+    from ..apiserver.admission import pod_matches_scopes
+
     pods, _ = server.list("pods", namespace=namespace)
     live = [
         p
         for p in pods
         if p.metadata.deletion_timestamp is None
         and p.status.phase not in (v1.POD_SUCCEEDED, v1.POD_FAILED)
+        and (not scopes or pod_matches_scopes(p, scopes))
     ]
     cpu = mem = 0
     for p in live:
@@ -86,7 +92,7 @@ class ResourceQuotaController(WorkqueueController):
             quota = self.server.get("resourcequotas", ns, name)
         except NotFound:
             return
-        usage = compute_namespace_usage(self.server, ns)
+        usage = compute_namespace_usage(self.server, ns, quota.spec.scopes)
         used = {r: usage.get(r, 0) for r in quota.spec.hard}
 
         def mutate(cur):

@@ -1,0 +1,198 @@
+"""Admission breadth: NodeRestriction, AlwaysPullImages, PodSecurityPolicy,
+quota scopes (apiserver/admission.py; reference plugin/pkg/admission/)."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.admission import (
+    AlwaysPullImagesAdmission,
+    NodeRestrictionAdmission,
+    PodSecurityPolicyAdmission,
+    pod_matches_scopes,
+    request_user,
+)
+from kubernetes_tpu.apiserver.auth import (
+    AdmissionChain,
+    AdmissionDenied,
+    QuotaAdmission,
+    UserInfo,
+)
+from kubernetes_tpu.client.apiserver import APIServer
+
+
+def _pod(name, node="", containers=None, host_network=False, adl=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            node_name=node,
+            containers=containers or [v1.Container(requests={"cpu": "100m"})],
+            host_network=host_network,
+            active_deadline_seconds=adl,
+        ),
+    )
+
+
+class _Ctx:
+    """Set/restore the admission identity contextvar."""
+
+    def __init__(self, user):
+        self.user = user
+
+    def __enter__(self):
+        self.tok = request_user.set(self.user)
+
+    def __exit__(self, *a):
+        request_user.reset(self.tok)
+
+
+def test_node_restriction_scopes_node_identity():
+    plugin = NodeRestrictionAdmission()
+    kubelet = UserInfo("system:node:n1", ("system:nodes",))
+    # own node: allowed
+    with _Ctx(kubelet):
+        plugin.validate(
+            "update", "nodes", v1.Node(metadata=v1.ObjectMeta(name="n1", namespace=""))
+        )
+        # another node: denied
+        with pytest.raises(AdmissionDenied, match="cannot modify node"):
+            plugin.validate(
+                "update",
+                "nodes",
+                v1.Node(metadata=v1.ObjectMeta(name="n2", namespace="")),
+            )
+        # pod bound to itself: allowed; elsewhere: denied
+        plugin.validate("update", "pods", _pod("p", node="n1"))
+        with pytest.raises(AdmissionDenied, match="bound to"):
+            plugin.validate("update", "pods", _pod("p", node="n2"))
+        # unrelated resources: denied outright
+        with pytest.raises(AdmissionDenied, match="may not"):
+            plugin.validate("create", "secrets", None)
+    # non-node users unrestricted
+    with _Ctx(UserInfo("alice", ("system:authenticated",))):
+        plugin.validate("update", "nodes", v1.Node(metadata=v1.ObjectMeta(name="n2")))
+    # loopback (no identity): unrestricted
+    plugin.validate("update", "nodes", v1.Node(metadata=v1.ObjectMeta(name="n2")))
+
+
+def test_always_pull_images_forces_policy():
+    store = APIServer()
+    store.admit_hooks.append(
+        AdmissionChain(mutating=[AlwaysPullImagesAdmission()])
+    )
+    store.create(
+        "pods",
+        _pod(
+            "p",
+            containers=[
+                v1.Container(name="c1", image="private/img"),
+                v1.Container(name="c2", image="other", image_pull_policy="Never"),
+            ],
+        ),
+    )
+    p = store.get("pods", "default", "p")
+    assert all(c.image_pull_policy == "Always" for c in p.spec.containers)
+
+
+def test_pod_security_policy_gates_capabilities():
+    store = APIServer()
+    plugin = PodSecurityPolicyAdmission(store)
+    priv_pod = _pod(
+        "priv",
+        containers=[
+            v1.Container(
+                security_context=v1.SecurityContext(privileged=True)
+            )
+        ],
+    )
+    # no policies installed: gate open
+    plugin.validate("create", "pods", priv_pod)
+    # a restricted policy arms the gate
+    store.create(
+        "podsecuritypolicies",
+        v1.PodSecurityPolicy(
+            metadata=v1.ObjectMeta(name="restricted", namespace=""),
+            spec=v1.PodSecurityPolicySpec(
+                privileged=False, run_as_user_rule="MustRunAsNonRoot"
+            ),
+        ),
+    )
+    with pytest.raises(AdmissionDenied, match="privileged"):
+        plugin.validate("create", "pods", priv_pod)
+    with pytest.raises(AdmissionDenied, match="root"):
+        plugin.validate(
+            "create",
+            "pods",
+            _pod(
+                "root",
+                containers=[
+                    v1.Container(
+                        security_context=v1.SecurityContext(run_as_user=0)
+                    )
+                ],
+            ),
+        )
+    with pytest.raises(AdmissionDenied, match="hostNetwork"):
+        plugin.validate("create", "pods", _pod("hn", host_network=True))
+    # plain pod passes; a privileged POLICY added later re-admits priv pods
+    plugin.validate("create", "pods", _pod("plain"))
+    store.create(
+        "podsecuritypolicies",
+        v1.PodSecurityPolicy(
+            metadata=v1.ObjectMeta(name="privileged", namespace=""),
+            spec=v1.PodSecurityPolicySpec(
+                privileged=True, host_network=True
+            ),
+        ),
+    )
+    plugin.validate("create", "pods", priv_pod)
+
+
+def test_quota_scopes_select_pods():
+    be = _pod("be", containers=[v1.Container()])
+    burst = _pod("burst")
+    term = _pod("term", adl=60)
+    assert pod_matches_scopes(be, ["BestEffort"])
+    assert not pod_matches_scopes(burst, ["BestEffort"])
+    assert pod_matches_scopes(burst, ["NotBestEffort"])
+    assert pod_matches_scopes(term, ["Terminating"])
+    assert not pod_matches_scopes(term, ["NotTerminating"])
+    assert pod_matches_scopes(term, ["Terminating", "NotBestEffort"])
+
+
+def test_scoped_quota_only_limits_matching_pods():
+    store = APIServer()
+    store.create(
+        "resourcequotas",
+        v1.ResourceQuota(
+            metadata=v1.ObjectMeta(name="be-quota"),
+            spec=v1.ResourceQuotaSpec(hard={"pods": 1}, scopes=["BestEffort"]),
+        ),
+    )
+    store.admit_hooks.append(
+        AdmissionChain(validating=[QuotaAdmission(store)])
+    )
+    # burstable pods bypass the BestEffort-scoped quota entirely
+    store.create("pods", _pod("burst-1"))
+    store.create("pods", _pod("burst-2"))
+    # the first best-effort pod fits, the second trips the scope's limit
+    store.create("pods", _pod("be-1", containers=[v1.Container()]))
+    with pytest.raises(AdmissionDenied, match="exceeded quota"):
+        store.create("pods", _pod("be-2", containers=[v1.Container()]))
+
+
+def test_scoped_quota_status_tracks_matching_usage_only():
+    from kubernetes_tpu.controller.resourcequota import (
+        compute_namespace_usage,
+    )
+
+    store = APIServer()
+    store.create("pods", _pod("burst-1"))
+    store.create("pods", _pod("be-1", containers=[v1.Container()]))
+    assert compute_namespace_usage(store, "default")["pods"] == 2
+    assert (
+        compute_namespace_usage(store, "default", ["BestEffort"])["pods"] == 1
+    )
+    assert (
+        compute_namespace_usage(store, "default", ["NotBestEffort"])["pods"]
+        == 1
+    )

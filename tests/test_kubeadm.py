@@ -73,3 +73,37 @@ def test_init_join_and_schedule(tmp_path):
         if pool is not None:
             pool.stop()
         handle.stop()
+
+
+def test_upgrade_plan_and_apply(tmp_path):
+    """kubeadm upgrade: plan reads the recorded cluster version, apply
+    migrates it (refusing downgrades) — cmd/kubeadm/app/cmd/upgrade/."""
+    import json as _json
+
+    import pytest as _pytest
+
+    from kubernetes_tpu import __version__
+    from kubernetes_tpu.cmd.kubeadm import (
+        init_cluster,
+        upgrade_apply,
+        upgrade_plan,
+    )
+
+    handle = init_cluster(str(tmp_path / "kubeadm"), controllers=[])
+    try:
+        plan = upgrade_plan(handle.store)
+        assert plan["current"] == __version__
+        assert plan["upgrade_available"] is False
+        # apply to a newer version migrates the stored config
+        res = upgrade_apply(handle.store, "v9.9.9")
+        assert res == {"from": __version__, "to": "v9.9.9"}
+        cm = handle.store.get("configmaps", "kube-system", "kubeadm-config")
+        cfg = _json.loads(cm.data["ClusterConfiguration"])
+        assert cfg["kubernetesVersion"] == "v9.9.9"
+        # downgrades are refused
+        with _pytest.raises(ValueError, match="downgrade"):
+            upgrade_apply(handle.store, "v0.0.1")
+        # idempotent re-apply
+        assert upgrade_apply(handle.store, "v9.9.9")["to"] == "v9.9.9"
+    finally:
+        handle.stop()
