@@ -45,7 +45,9 @@ def _pod_pv_names(server, pod: v1.Pod) -> Set[str]:
 class AttachDetachController(WorkqueueController):
     name = "attachdetach"
     primary_kind = "pods"
-    secondary_kinds = ("persistentvolumeclaims",)
+    # nodes: a volumes_in_use drop (kubelet unmounted) must retry the
+    # delayed safe detach
+    secondary_kinds = ("persistentvolumeclaims", "nodes")
 
     def primary_key_of(self, obj) -> str:
         # sync() rebuilds the whole desired-state-of-world; a constant key
@@ -94,6 +96,17 @@ class AttachDetachController(WorkqueueController):
                 pass
         for (pv_name, node_name), a in have.items():
             if (pv_name, node_name) not in wanted:
+                # safe detach: never while the node still reports the
+                # volume in use (volumes_in_use, the kubelet volume
+                # manager's mount bookkeeping — reconciler.go's
+                # "operation not permitted while mounted" contract)
+                if pv_name in self._volumes_in_use(node_name):
+                    logger.info(
+                        "delaying detach of %s from %s: still in use",
+                        pv_name,
+                        node_name,
+                    )
+                    continue
                 try:
                     self.server.delete(
                         "volumeattachments",
@@ -102,6 +115,16 @@ class AttachDetachController(WorkqueueController):
                     )
                 except NotFound:
                     pass
+
+    def _volumes_in_use(self, node_name: str) -> Set[str]:
+        try:
+            node = self.server.get("nodes", "", node_name)
+        except NotFound:
+            try:
+                node = self.server.get("nodes", "default", node_name)
+            except NotFound:
+                return set()
+        return set(node.status.volumes_in_use)
 
     def _attacher_of(self, pv_name: str) -> str:
         try:

@@ -97,11 +97,21 @@ class Kubelet:
         node_name: str,
         runtime: PodRuntime,
         host_ip: Optional[str] = None,
+        device_manager=None,
     ):
         self.server = server
         self.node_name = node_name
         self.runtime = runtime
         self.host_ip = host_ip  # the node's address (same for all its pods)
+        # optional device-plugin manager (devicemanager.DeviceManager):
+        # allocates plugin devices at pod admission, frees on termination,
+        # and surfaces extended-resource capacity into NodeStatus
+        self.device_manager = device_manager
+        self._device_generation = -1
+        # optional volume manager (volumemanager.VolumeManager): PVC pods
+        # wait for attach+mount before the sandbox starts
+        self.volume_manager = None
+        self._wait_volumes: Dict[str, v1.Pod] = {}  # parked on mounts
         self._known: Dict[str, str] = {}  # pod key -> last posted phase
         self._specs: Dict[str, v1.Pod] = {}  # pod key -> last seen spec
         # prober bookkeeping (pkg/kubelet/prober): (key, kind) -> worker
@@ -117,15 +127,41 @@ class Kubelet:
             self.runtime.kill_pod(key)
             self._known.pop(key, None)
             self._forget_probes(key)
+            self._wait_volumes.pop(key, None)
+            if self.device_manager is not None:
+                self.device_manager.free_pod(key)
+            if self.volume_manager is not None:
+                self.volume_manager.forget_pod(key)
             return
         if pod.status.phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
             # terminal: runtime resources are reclaimed, status stands
             self.runtime.kill_pod(key)
             self._known[key] = pod.status.phase
             self._forget_probes(key)
+            if self.device_manager is not None:
+                self.device_manager.free_pod(key)
             return
         self._specs[key] = pod
         if key not in self._known:
+            if self.device_manager is not None:
+                # device admission BEFORE the sandbox starts (the manager's
+                # Allocate ordering in kubelet admission, manager.go)
+                try:
+                    self.device_manager.allocate_pod(pod)
+                except Exception as e:
+                    self._post_admission_failure(pod, str(e))
+                    self._known[key] = v1.POD_FAILED
+                    return
+            if self.volume_manager is not None:
+                # WaitForAttachAndMount: a PVC pod parks until its volumes
+                # are set up; housekeeping reconciles + retries
+                self.volume_manager.note_pod(pod)
+                if not self.volume_manager.mounts_ready(pod):
+                    self.volume_manager.reconcile()
+                if not self.volume_manager.mounts_ready(pod):
+                    self._wait_volumes[key] = pod
+                    return
+                self._wait_volumes.pop(key, None)
             ip = self.runtime.run_pod(pod)
             self._known[key] = v1.POD_RUNNING
             # phase and the initial Ready verdict land in ONE status write:
@@ -157,7 +193,16 @@ class Kubelet:
             if phase in (v1.POD_SUCCEEDED, v1.POD_FAILED):
                 self.runtime.kill_pod(key)
                 self._forget_probes(key)
+                if self.device_manager is not None:
+                    self.device_manager.free_pod(key)
                 self._post_status(pod, phase, None)
+        self.sync_device_capacity()
+        if self.volume_manager is not None:
+            self.volume_manager.reconcile()
+            for key, pod in list(self._wait_volumes.items()):
+                if self.volume_manager.mounts_ready(pod):
+                    del self._wait_volumes[key]
+                    self.handle_pod_event("ADDED", pod)
         self.run_probes()
 
     # -- probes (pkg/kubelet/prober) -----------------------------------------
@@ -329,6 +374,49 @@ class Kubelet:
                 "leases", NODE_LEASE_NS, self.node_name, renew
             )
         except (NotFound, Conflict):
+            pass
+
+    def _post_admission_failure(self, pod: v1.Pod, message: str) -> None:
+        """UnexpectedAdmissionError (the reference's device-admission
+        failure phase): the pod fails on this node; a controller replaces
+        it and the scheduler tries elsewhere."""
+
+        def mutate(p):
+            p.status.phase = v1.POD_FAILED
+            p.status.reason = "UnexpectedAdmissionError"
+            p.status.message = message
+            return p
+
+        try:
+            self.server.guaranteed_update(
+                "pods", pod.metadata.namespace, pod.metadata.name, mutate
+            )
+        except NotFound:
+            pass
+
+    def sync_device_capacity(self) -> None:
+        """Surface plugin resources into NodeStatus capacity/allocatable
+        (manager.go GetCapacity -> node status setters). Cheap no-op until
+        the manager's device set actually changes."""
+        dm = self.device_manager
+        if dm is None or dm.generation == self._device_generation:
+            return
+        gen = dm.generation
+        caps = dm.capacities()
+
+        def mutate(node):
+            changed = False
+            for res, cnt in caps.items():
+                if node.status.capacity.get(res) != cnt:
+                    node.status.capacity[res] = cnt
+                    node.status.allocatable[res] = cnt
+                    changed = True
+            return node if changed else None
+
+        try:
+            self.server.guaranteed_update("nodes", "", self.node_name, mutate)
+            self._device_generation = gen
+        except NotFound:
             pass
 
     def post_ready_condition(self, now: Optional[float] = None) -> None:

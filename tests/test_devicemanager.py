@@ -1,0 +1,188 @@
+"""Kubelet device-plugin manager (kubelet/devicemanager.py; reference
+pkg/kubelet/cm/devicemanager/manager.go + topology_hints.go)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.kubelet.devicemanager import (
+    Device,
+    DeviceManager,
+    DevicePluginStub,
+)
+from kubernetes_tpu.kubelet.kubelet import Kubelet, make_node_object
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+RES = "tpu.dev/chip"
+
+
+def _mk(tmp_path, policy="best-effort", devices=None, checkpoint=None):
+    dm = DeviceManager(
+        str(tmp_path / "kubelet_device.sock"),
+        checkpoint_path=str(tmp_path / (checkpoint or "device_state.json")),
+        policy=policy,
+    )
+    dm.start()
+    stub = DevicePluginStub(
+        dm.socket_path,
+        RES,
+        devices
+        or [
+            Device("d0", topology=0),
+            Device("d1", topology=0),
+            Device("d2", topology=1),
+            Device("d3", topology=1),
+        ],
+    )
+    stub.start()
+    deadline = time.monotonic() + 5.0
+    while RES not in dm.capacities() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return dm, stub
+
+
+def _pod(name, n_chips):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={RES: str(n_chips)})]),
+    )
+
+
+def test_register_capacity_and_health_updates(tmp_path):
+    dm, stub = _mk(tmp_path)
+    try:
+        assert dm.capacities() == {RES: 4}
+        # ListAndWatch push: one chip goes unhealthy
+        stub.update_devices(
+            [
+                Device("d0", topology=0),
+                Device("d1", healthy=False, topology=0),
+                Device("d2", topology=1),
+                Device("d3", topology=1),
+            ]
+        )
+        deadline = time.monotonic() + 5.0
+        while dm.capacities()[RES] != 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert dm.capacities() == {RES: 3}
+    finally:
+        stub.stop()
+        dm.stop()
+
+
+def test_allocation_prefers_topology_alignment(tmp_path):
+    dm, stub = _mk(tmp_path)
+    try:
+        got = dm.allocate_pod(_pod("p1", 2))
+        ids = got[RES]
+        assert len(ids) == 2
+        # both devices from ONE locality domain
+        doms = {0 if i in ("d0", "d1") else 1 for i in ids}
+        assert len(doms) == 1, ids
+        # the plugin observed the Allocate call with exactly these ids
+        deadline = time.monotonic() + 5.0
+        while not stub.allocated and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(stub.allocated[0]) == sorted(ids)
+        # second pod gets the OTHER domain, still aligned
+        got2 = dm.allocate_pod(_pod("p2", 2))
+        assert not set(got2[RES]) & set(ids)
+    finally:
+        stub.stop()
+        dm.stop()
+
+
+def test_restricted_policy_rejects_unaligned(tmp_path):
+    dm, stub = _mk(tmp_path, policy="restricted")
+    try:
+        # 3 chips cannot come from a single 2-chip domain
+        with pytest.raises(RuntimeError, match="restricted"):
+            dm.allocate_pod(_pod("big", 3))
+        # best-effort on the same shape proceeds unaligned
+    finally:
+        stub.stop()
+        dm.stop()
+    dm2, stub2 = _mk(tmp_path, policy="best-effort", checkpoint="s2.json")
+    try:
+        got = dm2.allocate_pod(_pod("big", 3))
+        assert len(got[RES]) == 3
+    finally:
+        stub2.stop()
+        dm2.stop()
+
+
+def test_checkpoint_restore_preserves_allocations(tmp_path):
+    dm, stub = _mk(tmp_path)
+    try:
+        got = dm.allocate_pod(_pod("p1", 2))
+    finally:
+        stub.stop()
+        dm.stop()
+    # kubelet restart: a fresh manager restores the allocation from disk
+    dm2 = DeviceManager(
+        str(tmp_path / "kubelet_device2.sock"),
+        checkpoint_path=str(tmp_path / "device_state.json"),
+    )
+    dm2.start()
+    stub2 = DevicePluginStub(dm2.socket_path, RES, [
+        Device("d0", topology=0), Device("d1", topology=0),
+        Device("d2", topology=1), Device("d3", topology=1),
+    ])
+    stub2.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while RES not in dm2.capacities() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert dm2.allocations("default/p1") == got
+        # restored in-use devices are NOT re-granted
+        got2 = dm2.allocate_pod(_pod("p2", 2))
+        assert not set(got2[RES]) & set(got[RES])
+    finally:
+        stub2.stop()
+        dm2.stop()
+
+
+def test_kubelet_surfaces_capacity_and_admits_device_pods(tmp_path):
+    """End to end: plugin capacity reaches NodeStatus (where the scheduler's
+    NodeResourcesFit sees it as an extended resource), an admitted pod gets
+    devices, an oversized pod fails with UnexpectedAdmissionError."""
+    server = APIServer()
+    server.create("nodes", make_node_object("n0"))
+    dm, stub = _mk(tmp_path)
+    from kubernetes_tpu.kubemark.hollow_node import _fake_pod_ip
+
+    kl = Kubelet(server, "n0", FakeRuntime(_fake_pod_ip), device_manager=dm)
+    try:
+        kl.sync_device_capacity()
+        node = server.get("nodes", "", "n0")
+        assert node.status.capacity[RES] == 4
+        assert node.status.allocatable[RES] == 4
+
+        ok = _pod("uses-chips", 2)
+        ok.spec.node_name = "n0"
+        server.create("pods", ok)
+        kl.handle_pod_event("ADDED", server.get("pods", "default", "uses-chips"))
+        assert len(dm.allocations("default/uses-chips")[RES]) == 2
+        assert (
+            server.get("pods", "default", "uses-chips").status.phase
+            == v1.POD_RUNNING
+        )
+
+        big = _pod("too-big", 5)
+        big.spec.node_name = "n0"
+        server.create("pods", big)
+        kl.handle_pod_event("ADDED", server.get("pods", "default", "too-big"))
+        p = server.get("pods", "default", "too-big")
+        assert p.status.phase == v1.POD_FAILED
+        assert p.status.reason == "UnexpectedAdmissionError"
+
+        # deletion frees the devices
+        kl.handle_pod_event(
+            "DELETED", server.get("pods", "default", "uses-chips")
+        )
+        assert dm.allocations("default/uses-chips") == {}
+    finally:
+        stub.stop()
+        dm.stop()

@@ -1,0 +1,114 @@
+"""Kubelet volume manager: attach → mount → pod start ordering, in-use
+reporting, safe detach (kubelet/volumemanager.py; reference
+pkg/kubelet/volumemanager/volume_manager.go)."""
+
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.controller.attachdetach import AttachDetachController
+from kubernetes_tpu.kubelet.kubelet import Kubelet, make_node_object
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
+from kubernetes_tpu.kubemark.hollow_node import _fake_pod_ip
+
+
+def _pv(name):
+    return v1.PersistentVolume(
+        metadata=v1.ObjectMeta(name=name, namespace=""),
+        spec=v1.PersistentVolumeSpec(
+            capacity={"storage": "10Gi"},
+            gce_persistent_disk=v1.GCEPersistentDiskVolumeSource(pd_name=name),
+        ),
+    )
+
+
+def _pvc(name, pv):
+    return v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PersistentVolumeClaimSpec(volume_name=pv),
+        status=v1.PersistentVolumeClaimStatus(phase="Bound"),
+    )
+
+
+def _pod(name, pvc, node="n0"):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            node_name=node,
+            containers=[v1.Container(requests={"cpu": "100m"})],
+            volumes=[v1.Volume(name="data", persistent_volume_claim=pvc)],
+        ),
+    )
+
+
+def _setup():
+    server = APIServer()
+    server.create("nodes", make_node_object("n0"))
+    server.create("persistentvolumes", _pv("pv1"))
+    server.create("persistentvolumeclaims", _pvc("claim1", "pv1"))
+    vm = VolumeManager(server, "n0")
+    kl = Kubelet(server, "n0", FakeRuntime(_fake_pod_ip))
+    kl.volume_manager = vm
+    return server, vm, kl
+
+
+def test_pod_waits_for_attach_then_mounts_and_starts():
+    server, vm, kl = _setup()
+    server.create("pods", _pod("p1", "claim1"))
+    pod = server.get("pods", "default", "p1")
+    # no VolumeAttachment yet: the pod parks, not started
+    kl.handle_pod_event("ADDED", pod)
+    assert server.get("pods", "default", "p1").status.phase == "Pending"
+    assert "default/p1" in kl._wait_volumes
+    # volumes_in_use already reports intent (desired state)
+    assert server.get("nodes", "", "n0").status.volumes_in_use == ["pv1"]
+
+    # the attach-detach controller attaches (pod is scheduled to n0)
+    ad = AttachDetachController(server)
+    ad.sync("reconcile")
+    vas, _ = server.list("volumeattachments")
+    assert len(vas) == 1 and vas[0].spec.pv_name == "pv1"
+
+    # housekeeping reconciles the mount and starts the parked pod
+    kl.housekeeping()
+    assert server.get("pods", "default", "p1").status.phase == v1.POD_RUNNING
+    assert vm.mounted_for("default/p1") == ["pv1"]
+    assert not kl._wait_volumes
+
+
+def test_safe_detach_waits_for_unmount():
+    server, vm, kl = _setup()
+    server.create("pods", _pod("p1", "claim1"))
+    ad = AttachDetachController(server)
+    ad.sync("reconcile")
+    kl.handle_pod_event("ADDED", server.get("pods", "default", "p1"))
+    kl.housekeeping()
+    assert vm.mounted_for("default/p1") == ["pv1"]
+
+    # pod deleted, but the kubelet hasn't torn down yet: detach must wait
+    server.delete("pods", "default", "p1")
+    ad.sync("reconcile")
+    vas, _ = server.list("volumeattachments")
+    assert len(vas) == 1, "detached while still mounted"
+
+    # kubelet tears down -> volumes_in_use clears -> detach proceeds
+    kl.handle_pod_event("DELETED", _pod("p1", "claim1"))
+    kl.housekeeping()
+    assert server.get("nodes", "", "n0").status.volumes_in_use == []
+    ad.sync("reconcile")
+    vas, _ = server.list("volumeattachments")
+    assert vas == []
+
+
+def test_non_pvc_pods_unaffected():
+    server, vm, kl = _setup()
+    plain = v1.Pod(
+        metadata=v1.ObjectMeta(name="plain"),
+        spec=v1.PodSpec(node_name="n0", containers=[v1.Container()]),
+    )
+    server.create("pods", plain)
+    kl.handle_pod_event("ADDED", server.get("pods", "default", "plain"))
+    assert (
+        server.get("pods", "default", "plain").status.phase == v1.POD_RUNNING
+    )
