@@ -1,22 +1,32 @@
 """kube-proxy-lite: the per-node service VIP dataplane.
 
 Reference shape: pkg/proxy/iptables/proxier.go — the proxier watches
-Services + Endpoints, and `syncProxyRules` (proxier.go:775) rebuilds the
-node's full NAT table on every sync: one chain per service port
-(KUBE-SVC-*), one per endpoint (KUBE-SEP-*) with statistical round-robin,
-and ClientIP session affinity via `recent` match. Changes are accumulated
-in change-tracker maps and applied atomically by iptables-restore.
-
-This build has no netfilter to program; the dataplane is a process-local
-routing table the (hollow) pod runtime queries to reach a VIP:
+Services + EndpointSlices, change trackers accumulate deltas, and
+`syncProxyRules` (proxier.go:775) rebuilds NAT chains applied atomically by
+iptables-restore. This build has no netfilter to program; the dataplane is
+a process-local routing table the (hollow) pod runtime queries to reach a
+VIP:
 
     table: (cluster_ip | "ns/name", port_name_or_number) -> [backends]
-    resolve(vip, port, client_key) -> one backend (RR or ClientIP-hash)
+    resolve(vip, port, client_key) -> one backend
 
-The sync loop mirrors syncProxyRules' structure: event handlers only mark
-pending changes; a single sync rebuilds the whole table from the informer
-caches and swaps it atomically (readers never see a partial table); a
-min-sync interval coalesces event bursts the way the proxier's
+Parity points:
+  * **EndpointSlice-driven** (pkg/proxy/endpointslicecache.go): backends
+    come from discovery slices (`kubernetes.io/service-name` label, ready
+    endpoints only), merged across a service's slices; the legacy
+    Endpoints object is the fallback for services with no slices — the
+    same dual-source arrangement as the EndpointSliceProxying gate era.
+  * **Change tracking**: event handlers record which SERVICES changed
+    (service events directly, slice events via their service label); the
+    sync recomputes only those services unless a full rebuild is due —
+    the ServiceChangeTracker/EndpointChangeTracker split.
+  * **Two modes**: "iptables" resolves statistically (round-robin, the
+    `--mode random` chain equivalent) and "ipvs" adds real virtual-server
+    scheduling — least-connection with live connection tracking
+    (pkg/proxy/ipvs/proxier.go's rr/lc schedulers).
+  * ClientIP session affinity via a stable hash in both modes.
+
+A min-sync interval coalesces event bursts the way the proxier's
 BoundedFrequencyRunner does.
 """
 
@@ -26,7 +36,7 @@ import itertools
 import logging
 import threading
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import objects as v1
 from ..client.informers import SharedInformerFactory
@@ -34,11 +44,77 @@ from ..client.informers import SharedInformerFactory
 logger = logging.getLogger("kubernetes_tpu.proxy")
 
 AFFINITY_ANNOTATION = "service.kubernetes.io/session-affinity"  # "ClientIP"
+SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+
+
+class EndpointSliceCache:
+    """Applied-slice state per service (pkg/proxy/endpointslicecache.go):
+    slices keyed by (namespace, slice name); backends_for merges a
+    service's slices into per-port backend lists (ready endpoints only)."""
+
+    def __init__(self):
+        self._slices: Dict[Tuple[str, str], v1.EndpointSlice] = {}
+
+    @staticmethod
+    def _svc_key(es: v1.EndpointSlice) -> Optional[str]:
+        svc = es.metadata.labels.get(SERVICE_NAME_LABEL)
+        return f"{es.metadata.namespace}/{svc}" if svc else None
+
+    def update(self, es: v1.EndpointSlice) -> set:
+        """Apply one slice; returns every service key affected — including
+        the PREVIOUS owner when the service-name label changed or vanished
+        (its table rows would otherwise serve the removed endpoints
+        forever)."""
+        slot = (es.metadata.namespace, es.metadata.name)
+        old = self._slices.pop(slot, None)
+        keys = set()
+        if old is not None:
+            old_key = self._svc_key(old)
+            if old_key:
+                keys.add(old_key)
+        new_key = self._svc_key(es)
+        if new_key:
+            self._slices[slot] = es
+            keys.add(new_key)
+        return keys
+
+    def remove(self, es: v1.EndpointSlice) -> set:
+        old = self._slices.pop((es.metadata.namespace, es.metadata.name), None)
+        key = self._svc_key(old if old is not None else es)
+        return {key} if key else set()
+
+    def has_slices(self, svc_key: str) -> bool:
+        ns, _, name = svc_key.partition("/")
+        return any(
+            k[0] == ns and s.metadata.labels.get(SERVICE_NAME_LABEL) == name
+            for k, s in self._slices.items()
+        )
+
+    def backends_for(self, svc_key: str) -> Dict[object, List[Tuple[str, int]]]:
+        ns, _, name = svc_key.partition("/")
+        out: Dict[object, List[Tuple[str, int]]] = {}
+        for (sns, _sname), es in sorted(self._slices.items()):
+            if sns != ns or es.metadata.labels.get(SERVICE_NAME_LABEL) != name:
+                continue
+            for pname, pnum in es.ports or [("", 0)]:
+                lst: List[Tuple[str, int]] = []
+                for ep in es.endpoints:
+                    if not ep.ready:
+                        continue  # unready endpoints are not routed
+                    addr = (ep.addresses[0] if ep.addresses else "") or ep.target_pod
+                    if addr:
+                        lst.append((addr, pnum))
+                for port_id in {pname, pnum} - {""}:
+                    out.setdefault(port_id, []).extend(lst)
+        return out
 
 
 class Proxier:
     """One per node (NodeAgentPool shares one per process — the table is
-    node-independent in this build since there is no real network)."""
+    node-independent in this build since there is no real network).
+
+    mode: "iptables" (statistical round-robin) or "ipvs" (virtual-server
+    scheduling; scheduler "rr" or "lc" least-connection)."""
 
     def __init__(
         self,
@@ -46,9 +122,17 @@ class Proxier:
         node_name: str = "",
         min_sync_period: float = 0.05,
         informer_factory: Optional[SharedInformerFactory] = None,
+        mode: str = "iptables",
+        ipvs_scheduler: str = "lc",
     ):
+        if mode not in ("iptables", "ipvs"):
+            raise ValueError(f"unknown proxy mode {mode!r}")
+        if ipvs_scheduler not in ("rr", "lc"):
+            raise ValueError(f"unknown ipvs scheduler {ipvs_scheduler!r}")
         self.server = server
         self.node_name = node_name
+        self.mode = mode
+        self.ipvs_scheduler = ipvs_scheduler
         self.min_sync = min_sync_period
         self._own_informers = informer_factory is None
         self.informers = informer_factory or SharedInformerFactory(server)
@@ -56,22 +140,75 @@ class Proxier:
         self._table: Dict[Tuple[str, object], List[Tuple[str, int]]] = {}
         self._affinity: Dict[str, str] = {}  # every vip key -> affinity mode
         self._rr: Dict[Tuple[str, object], int] = {}  # per-(vip, port) RR
+        self._conns: Dict[Tuple[str, int], int] = {}  # ipvs lc: active conns
+        self._slice_cache = EndpointSliceCache()
+        # change trackers: service keys needing recompute; None entry = full
+        self._pending: Set[str] = set()
+        self._full = True
+        # vip -> service key (so per-service recompute can drop stale vips)
+        self._vips_of: Dict[str, List[str]] = {}
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.syncs = 0  # sync counter (tests/metrics)
+        self.slice_routed = 0  # services routed via EndpointSlices (tests)
+        self.legacy_routed = 0  # services routed via the Endpoints fallback
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
         svc_inf = self.informers.informer("services")
+        eps_inf = self.informers.informer("endpointslices")
         ep_inf = self.informers.informer("endpoints")
-        mark = lambda *_a, **_k: self._dirty.set()  # noqa: E731
-        svc_inf.add_handler(on_add=mark, on_update=mark, on_delete=mark)
-        ep_inf.add_handler(on_add=mark, on_update=mark, on_delete=mark)
+
+        def svc_changed(*objs):
+            with self._lock:
+                for o in objs:
+                    if o is not None:
+                        self._pending.add(o.metadata.key)
+            self._dirty.set()
+
+        def slice_changed(remove, *objs):
+            with self._lock:
+                for o in objs:
+                    if o is None:
+                        continue
+                    keys = (
+                        self._slice_cache.remove(o)
+                        if remove
+                        else self._slice_cache.update(o)
+                    )
+                    self._pending.update(keys)
+            self._dirty.set()
+
+        def ep_changed(*objs):
+            # legacy Endpoints: only matters for services with no slices
+            with self._lock:
+                for o in objs:
+                    if o is not None:
+                        self._pending.add(o.metadata.key)
+            self._dirty.set()
+
+        svc_inf.add_handler(
+            on_add=lambda s: svc_changed(s),
+            on_update=lambda o, n: svc_changed(o, n),
+            on_delete=lambda s: svc_changed(s),
+        )
+        eps_inf.add_handler(
+            on_add=lambda s: slice_changed(False, s),
+            on_update=lambda o, n: slice_changed(False, n),
+            on_delete=lambda s: slice_changed(True, s),
+        )
+        ep_inf.add_handler(
+            on_add=lambda e: ep_changed(e),
+            on_update=lambda o, n: ep_changed(n),
+            on_delete=lambda e: ep_changed(e),
+        )
         if self._own_informers:
             self.informers.start()
             self.informers.wait_for_cache_sync()
+        with self._lock:
+            self._full = True
         self._dirty.set()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"proxier-{self.node_name}"
@@ -97,43 +234,84 @@ class Proxier:
             # BoundedFrequencyRunner: coalesce event bursts
             self._stop.wait(self.min_sync)
 
-    # -- the sync (syncProxyRules-shaped: full rebuild, atomic swap) --------
+    # -- the sync (syncProxyRules-shaped; change-tracked) --------------------
 
     def sync_proxy_rules(self) -> None:
+        with self._lock:
+            full, self._full = self._full, False
+            pending, self._pending = self._pending, set()
         services, _ = self.server.list("services")
-        table: Dict[Tuple[str, object], List[Tuple[str, int]]] = {}
-        affinity: Dict[str, str] = {}
-        for svc in services:
+        by_key = {s.metadata.key: s for s in services}
+        targets = by_key if full else {
+            k: by_key.get(k) for k in pending
+        }
+        new_entries: Dict[str, Dict[Tuple[str, object], List]] = {}
+        new_affinity: Dict[str, str] = {}
+        new_vips: Dict[str, List[str]] = {}
+        for key, svc in targets.items():
+            if svc is None:
+                new_vips[key] = []  # deleted service: drop its vips
+                continue
+            backends_by_port = self._backends_for(svc)
+            vips = self._vips(svc)
+            new_vips[key] = vips
+            entries: Dict[Tuple[str, object], List] = {}
             mode = svc.metadata.annotations.get(AFFINITY_ANNOTATION, "")
-            try:
-                eps = self.server.get(
-                    "endpoints", svc.metadata.namespace, svc.metadata.name
-                )
-            except Exception:
-                eps = None
-            backends_by_port: Dict[object, List[Tuple[str, int]]] = {}
-            if eps is not None:
-                for subset in eps.subsets:
-                    for pname, pnum in subset.ports or [("", 0)]:
-                        # route by number AND name: kube-proxy keys rules by
-                        # service port number; names are aliases
-                        lst: List[Tuple[str, int]] = []
-                        for addr in subset.addresses:
-                            lst.append((addr.ip or addr.target_pod, pnum))
-                        for port_id in {pname, pnum} - {""}:
-                            backends_by_port.setdefault(port_id, []).extend(lst)
-            for vip_key in self._vips(svc):
-                affinity[vip_key] = mode
+            for vip_key in vips:
+                new_affinity[vip_key] = mode
                 for port_id, backends in backends_by_port.items():
-                    table[(vip_key, port_id)] = backends
+                    entries[(vip_key, port_id)] = backends
                 if not backends_by_port:
                     # service with no endpoints: present but empty (the
                     # proxier emits a REJECT rule; resolve returns None)
-                    table[(vip_key, None)] = []
+                    entries[(vip_key, None)] = []
+            new_entries[key] = entries
         with self._lock:
-            self._table = table
-            self._affinity = affinity
+            if full:
+                self._table = {}
+                self._affinity = {}
+                self._vips_of = {}
+            for key in new_vips:
+                # drop the service's previous vip rows, then re-add
+                for vip in self._vips_of.get(key, ()):
+                    self._affinity.pop(vip, None)
+                    for tk in [t for t in self._table if t[0] == vip]:
+                        del self._table[tk]
+                self._vips_of[key] = new_vips[key]
+            for key, entries in new_entries.items():
+                self._table.update(entries)
+            self._affinity.update(new_affinity)
             self.syncs += 1
+
+    def _backends_for(self, svc: v1.Service) -> Dict[object, List[Tuple[str, int]]]:
+        """EndpointSlices first; the legacy Endpoints object only for
+        services with no slices at all (the dual-source fallback)."""
+        key = svc.metadata.key
+        with self._lock:
+            has_slices = self._slice_cache.has_slices(key)
+            if has_slices:
+                self.slice_routed += 1
+                return self._slice_cache.backends_for(key)
+        try:
+            eps = self.server.get(
+                "endpoints", svc.metadata.namespace, svc.metadata.name
+            )
+        except Exception:
+            return {}
+        backends_by_port: Dict[object, List[Tuple[str, int]]] = {}
+        for subset in eps.subsets:
+            for pname, pnum in subset.ports or [("", 0)]:
+                lst: List[Tuple[str, int]] = []
+                for addr in subset.addresses:
+                    lst.append((addr.ip or addr.target_pod, pnum))
+                # route by number AND name: kube-proxy keys rules by
+                # service port number; names are aliases
+                for port_id in {pname, pnum} - {""}:
+                    backends_by_port.setdefault(port_id, []).extend(lst)
+        if backends_by_port:
+            with self._lock:
+                self.legacy_routed += 1
+        return backends_by_port
 
     @staticmethod
     def _vips(svc: v1.Service) -> List[str]:
@@ -147,9 +325,11 @@ class Proxier:
     def resolve(
         self, vip: str, port: object = None, client_key: str = ""
     ) -> Optional[Tuple[str, int]]:
-        """One backend for vip:port — round-robin, or a stable ClientIP hash
-        when the service requests session affinity (proxier.go `recent`
-        match equivalent)."""
+        """One backend for vip:port. iptables mode: round-robin (the
+        statistical chain). ipvs mode: the configured scheduler — "lc"
+        picks the backend with the fewest tracked connections (pair with
+        release() when the connection ends). ClientIP affinity overrides
+        both with a stable hash."""
         with self._lock:
             backends = self._table.get((vip, port))
             if backends is None and port is None:
@@ -165,11 +345,28 @@ class Proxier:
                 return None
             if self._affinity.get(vip, "") == "ClientIP" and client_key:
                 i = zlib.crc32(client_key.encode()) % len(backends)
+            elif self.mode == "ipvs" and self.ipvs_scheduler == "lc":
+                i = min(
+                    range(len(backends)),
+                    key=lambda j: (self._conns.get(backends[j], 0), j),
+                )
             else:
                 n = self._rr.get((vip, port), 0)
                 self._rr[(vip, port)] = n + 1
                 i = n % len(backends)
-            return backends[i]
+            chosen = backends[i]
+            if self.mode == "ipvs":
+                self._conns[chosen] = self._conns.get(chosen, 0) + 1
+            return chosen
+
+    def release(self, backend: Tuple[str, int]) -> None:
+        """ipvs connection tracking: the connection to `backend` ended."""
+        with self._lock:
+            c = self._conns.get(backend, 0) - 1
+            if c <= 0:
+                self._conns.pop(backend, None)
+            else:
+                self._conns[backend] = c
 
     def endpoints_of(self, vip: str, port: object = None) -> List[Tuple[str, int]]:
         with self._lock:

@@ -197,3 +197,144 @@ def test_end_to_end_service_flow_through_controllers_and_kubelet():
     finally:
         cm.stop()
         cluster.stop()
+
+
+def _slice(name, svc_name, ips, port=("http", 80), ready=True):
+    from kubernetes_tpu.api.objects import Endpoint, EndpointSlice
+
+    return EndpointSlice(
+        metadata=ObjectMeta(
+            name=name, labels={"kubernetes.io/service-name": svc_name}
+        ),
+        endpoints=[Endpoint(addresses=[ip], ready=ready) for ip in ips],
+        ports=[port],
+    )
+
+
+def test_endpointslice_driven_routing_preferred_over_endpoints():
+    """Slices win when present (reference EndpointSliceProxying); unready
+    endpoints are not routed; multiple slices merge."""
+    server = APIServer()
+    server.create("services", _svc("web", cluster_ip="10.96.0.9"))
+    # a STALE legacy Endpoints object that must be ignored
+    server.create("endpoints", _eps("web", ["10.9.9.9"]))
+    server.create("endpointslices", _slice("web-0", "web", ["10.0.0.1"]))
+    server.create("endpointslices", _slice("web-1", "web", ["10.0.0.2"]))
+    server.create(
+        "endpointslices",
+        _slice("web-2", "web", ["10.0.0.3"], ready=False),
+    )
+    prox = Proxier(server)
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        eps = set(prox.endpoints_of("10.96.0.9", 80))
+        assert eps == {("10.0.0.1", 80), ("10.0.0.2", 80)}, eps
+        assert prox.slice_routed > 0
+    finally:
+        prox.stop()
+
+
+def test_endpoints_fallback_when_no_slices():
+    server = APIServer()
+    server.create("services", _svc("old", cluster_ip="10.96.0.10"))
+    server.create("endpoints", _eps("old", ["10.1.0.1"]))
+    prox = Proxier(server)
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        assert prox.endpoints_of("10.96.0.10", 80) == [("10.1.0.1", 80)]
+        assert prox.legacy_routed > 0
+    finally:
+        prox.stop()
+
+
+def test_slice_change_tracker_resync():
+    """A slice update re-routes only that service (change-tracked sync)."""
+    server = APIServer()
+    server.create("services", _svc("web", cluster_ip="10.96.0.11"))
+    server.create("endpointslices", _slice("web-0", "web", ["10.0.0.1"]))
+    prox = Proxier(server)
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        assert prox.endpoints_of("10.96.0.11", 80) == [("10.0.0.1", 80)]
+        es = server.get("endpointslices", "default", "web-0")
+        from kubernetes_tpu.api.objects import Endpoint
+
+        es.endpoints = [Endpoint(addresses=["10.0.0.7"], ready=True)]
+        server.update("endpointslices", es)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if prox.endpoints_of("10.96.0.11", 80) == [("10.0.0.7", 80)]:
+                break
+            time.sleep(0.02)
+        assert prox.endpoints_of("10.96.0.11", 80) == [("10.0.0.7", 80)]
+        # slice deletion falls back to REJECT (no legacy Endpoints here)
+        server.delete("endpointslices", "default", "web-0")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if prox.resolve("10.96.0.11", 80) is None:
+                break
+            time.sleep(0.02)
+        assert prox.resolve("10.96.0.11", 80) is None
+    finally:
+        prox.stop()
+
+
+def test_ipvs_mode_least_connection_scheduling():
+    """Second proxy mode: ipvs lc tracks live connections and steers new
+    ones to the least-loaded backend."""
+    server = APIServer()
+    server.create("services", _svc("db", cluster_ip="10.96.0.12"))
+    server.create(
+        "endpointslices", _slice("db-0", "db", ["10.0.1.1", "10.0.1.2"])
+    )
+    prox = Proxier(server, mode="ipvs", ipvs_scheduler="lc")
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        b1 = prox.resolve("10.96.0.12", 80)
+        b2 = prox.resolve("10.96.0.12", 80)
+        assert {b1, b2} == {("10.0.1.1", 80), ("10.0.1.2", 80)}
+        # b1's connection ends; the third connection goes back to b1
+        prox.release(b1)
+        b3 = prox.resolve("10.96.0.12", 80)
+        assert b3 == b1
+        # now both have 1 conn; a burst splits evenly
+        prox.release(b2)
+        got = [prox.resolve("10.96.0.12", 80) for _ in range(4)]
+        assert got.count(("10.0.1.1", 80)) + got.count(("10.0.1.2", 80)) == 4
+        assert abs(got.count(("10.0.1.1", 80)) - got.count(("10.0.1.2", 80))) <= 2
+    finally:
+        prox.stop()
+
+
+def test_slice_label_change_clears_old_service_routing():
+    """Review r4: a slice re-labeled to another service (or unlabeled)
+    must not leave the previous service routing to stale backends."""
+    server = APIServer()
+    server.create("services", _svc("web", cluster_ip="10.96.0.13"))
+    server.create("services", _svc("web2", cluster_ip="10.96.0.14"))
+    server.create("endpointslices", _slice("sl-0", "web", ["10.0.2.1"]))
+    prox = Proxier(server)
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        assert prox.endpoints_of("10.96.0.13", 80) == [("10.0.2.1", 80)]
+        # retarget the slice to web2
+        es = server.get("endpointslices", "default", "sl-0")
+        es.metadata.labels["kubernetes.io/service-name"] = "web2"
+        server.update("endpointslices", es)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (
+                prox.resolve("10.96.0.13", 80) is None
+                and prox.endpoints_of("10.96.0.14", 80) == [("10.0.2.1", 80)]
+            ):
+                break
+            time.sleep(0.02)
+        assert prox.resolve("10.96.0.13", 80) is None, "stale routing kept"
+        assert prox.endpoints_of("10.96.0.14", 80) == [("10.0.2.1", 80)]
+    finally:
+        prox.stop()
