@@ -289,8 +289,188 @@ def cmd_describe(client: RESTClient, args) -> int:
     return 0
 
 
+def _normalized(resource: str, obj) -> dict:
+    """Server-populated fields stripped so a diff shows only user intent
+    (kubectl diff's dry-run comparison ignores the same machinery fields)."""
+    doc = codec.encode(obj)
+    meta = doc.get("metadata", {})
+    for f in (
+        "resource_version",
+        "resourceVersion",
+        "uid",
+        "generation",
+        "creation_timestamp",
+        "creationTimestamp",
+    ):
+        meta.pop(f, None)
+    doc.pop("status", None)
+    return doc
+
+
+def _file_or_kustomize_objects(args) -> List[tuple]:
+    if getattr(args, "kustomize", None):
+        return _kustomize_build(args.kustomize)
+    if getattr(args, "filename", None):
+        return _load_objects(args.filename)
+    raise SystemExit("error: must specify -f FILE or -k DIRECTORY")
+
+
+def cmd_diff(client: RESTClient, args) -> int:
+    """kubectl diff (staging/src/k8s.io/kubectl/pkg/cmd/diff/diff.go):
+    unified diff of each file object against its live counterpart; exit 1
+    when any object differs (the reference's exit-code contract)."""
+    import difflib
+
+    objs = _file_or_kustomize_objects(args)
+    changed = 0
+    for resource, obj in objs:
+        try:
+            live = client.get(resource, obj.metadata.namespace, obj.metadata.name)
+            live_doc = _normalized(resource, live)
+        except NotFound:
+            live_doc = None
+        want_doc = _normalized(resource, obj)
+        if live_doc == want_doc:
+            continue
+        changed += 1
+        a = (
+            json.dumps(live_doc, indent=2, sort_keys=True, default=str).splitlines()
+            if live_doc is not None
+            else []
+        )
+        b = json.dumps(want_doc, indent=2, sort_keys=True, default=str).splitlines()
+        name = f"{resource}/{obj.metadata.namespace}/{obj.metadata.name}"
+        for line in difflib.unified_diff(
+            a, b, fromfile=f"LIVE {name}", tofile=f"MERGED {name}", lineterm=""
+        ):
+            print(line)
+    return 1 if changed else 0
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    """Strategic-merge-lite: dicts merge recursively, everything else
+    (lists included) replaces — the subset kustomize patchesStrategicMerge
+    users rely on for spec tweaks."""
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _kustomize_build(directory: str) -> List[tuple]:
+    """kustomize-lite (…/cmd/kustomize; sigs.k8s.io/kustomize subset):
+    kustomization.{json,yaml} with resources, namePrefix/nameSuffix,
+    namespace, commonLabels, patchesStrategicMerge, and images overrides.
+    `resources` entries may be files or nested kustomization dirs (bases).
+    """
+    for fname in ("kustomization.json", "kustomization.yaml", "kustomization.yml"):
+        path = os.path.join(directory, fname)
+        if os.path.exists(path):
+            break
+    else:
+        raise SystemExit(f"no kustomization file in {directory}")
+    with open(path) as f:
+        text = f.read()
+    try:
+        kz = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+
+            kz = yaml.safe_load(text)
+        except ImportError:
+            raise SystemExit("kustomization is not JSON and PyYAML is unavailable")
+
+    docs: List[tuple] = []
+    for res in kz.get("resources", []):
+        rpath = os.path.join(directory, res)
+        if os.path.isdir(rpath):
+            docs.extend(_kustomize_build(rpath))  # base overlay
+        else:
+            docs.extend(_load_objects(rpath))
+
+    patches = []
+    for p in kz.get("patchesStrategicMerge", []):
+        with open(os.path.join(directory, p)) as f:
+            ptext = f.read()
+        try:
+            patches.append(json.loads(ptext))
+        except json.JSONDecodeError:
+            import yaml  # type: ignore
+
+            patches.extend(d for d in yaml.safe_load_all(ptext) if d)
+
+    images = {im["name"]: im for im in kz.get("images", [])}
+    out: List[tuple] = []
+    for resource, obj in docs:
+        doc = codec.encode(obj)
+        for patch in patches:
+            pm = patch.get("metadata", {})
+            if pm.get("name") == doc.get("metadata", {}).get("name") and patch.get(
+                "kind", doc.get("kind")
+            ) == doc.get("kind"):
+                doc = _deep_merge(doc, patch)
+        meta = doc.setdefault("metadata", {})
+        if kz.get("namePrefix") or kz.get("nameSuffix"):
+            meta["name"] = (
+                kz.get("namePrefix", "") + meta.get("name", "") + kz.get("nameSuffix", "")
+            )
+        if kz.get("namespace"):
+            meta["namespace"] = kz["namespace"]
+        for k, v in kz.get("commonLabels", {}).items():
+            meta.setdefault("labels", {})[k] = v
+            # commonLabels also propagate to selectors + pod templates the
+            # way kustomize wires them through workload kinds
+            spec = doc.get("spec", {})
+            if isinstance(spec.get("selector"), dict):
+                sel = spec["selector"]
+                tgt = sel.setdefault("match_labels", sel) if "match_labels" in sel else sel
+                if isinstance(tgt, dict):
+                    tgt[k] = v
+            tpl = spec.get("template") if isinstance(spec, dict) else None
+            if isinstance(tpl, dict):
+                tpl.setdefault("metadata", {}).setdefault("labels", {})[k] = v
+        for c in doc.get("spec", {}).get("containers", []) or []:
+            base, sep, suffix = _split_image_ref(c.get("image", ""))
+            im = images.get(base)
+            if im:
+                new_base = im.get("newName", base)
+                if im.get("newTag"):
+                    c["image"] = f"{new_base}:{im['newTag']}"
+                elif im.get("digest"):
+                    c["image"] = f"{new_base}@{im['digest']}"
+                else:
+                    c["image"] = new_base + sep + suffix
+        out.append(codec.decode_any(doc))
+    return out
+
+
+def _split_image_ref(ref: str):
+    """(name, sep, tag_or_digest) for an image reference — the tag ':' is
+    only a separator AFTER the last '/', so registry ports
+    (localhost:5000/app) survive, and '@sha256:…' digests split on '@'
+    (kustomize image transformer semantics)."""
+    if "@" in ref:
+        name, _, digest = ref.partition("@")
+        return name, "@", digest
+    slash = ref.rfind("/")
+    colon = ref.rfind(":")
+    if colon > slash:
+        return ref[:colon], ":", ref[colon + 1:]
+    return ref, "", ""
+
+
+def cmd_kustomize(client: RESTClient, args) -> int:
+    rendered = [codec.encode(obj) for _res, obj in _kustomize_build(args.directory)]
+    print(json.dumps(rendered, indent=2, default=str))
+    return 0
+
+
 def cmd_apply(client: RESTClient, args) -> int:
-    for resource, obj in _load_objects(args.filename):
+    for resource, obj in _file_or_kustomize_objects(args):
         try:
             client.create(resource, obj)
             print(f"{resource}/{obj.metadata.name} created")
@@ -834,7 +1014,13 @@ def main(argv=None) -> int:
     p_desc.add_argument("resource")
     p_desc.add_argument("name")
     p_apply = sub.add_parser("apply")
-    p_apply.add_argument("-f", "--filename", required=True)
+    p_apply.add_argument("-f", "--filename")
+    p_apply.add_argument("-k", "--kustomize")
+    p_diff = sub.add_parser("diff")
+    p_diff.add_argument("-f", "--filename")
+    p_diff.add_argument("-k", "--kustomize")
+    p_kust = sub.add_parser("kustomize")
+    p_kust.add_argument("directory")
     p_create = sub.add_parser("create")
     p_create.add_argument("-f", "--filename", required=True)
     p_del = sub.add_parser("delete")
@@ -908,6 +1094,10 @@ def main(argv=None) -> int:
             return cmd_get(client, args)
         if args.verb == "describe":
             return cmd_describe(client, args)
+        if args.verb == "diff":
+            return cmd_diff(client, args)
+        if args.verb == "kustomize":
+            return cmd_kustomize(client, args)
         if args.verb == "apply":
             return cmd_apply(client, args)
         if args.verb == "create":

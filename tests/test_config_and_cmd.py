@@ -546,3 +546,115 @@ def test_kubectl_workload_tables_and_describe_node(capsys):
         assert "Allocated resources" in out and "cpu:    100m" in out
     finally:
         srv.shutdown()
+
+
+def test_kubectl_diff_and_kustomize(tmp_path, capsys):
+    """kubectl diff (exit-code contract + unified diff) and kustomize-lite
+    rendering with prefix/labels/patches/images (reference
+    kubectl/pkg/cmd/diff, cmd/kustomize)."""
+    import json as _json
+
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    srv, port, store = serve()
+    try:
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        # live object
+        store.create("pods", make_pod("web"))
+        # local file: same pod with a changed image
+        f = tmp_path / "pod.json"
+        doc = {
+            "kind": "Pod",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx:2"}]},
+        }
+        f.write_text(_json.dumps(doc))
+        rc = kubectl.main(base + ["diff", "-f", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 1  # differs
+        assert "nginx:2" in out and "LIVE pods/default/web" in out
+        # no difference -> exit 0, no output
+        live = store.get("pods", "default", "web")
+        from kubernetes_tpu.api import serialization as codec
+
+        f2 = tmp_path / "same.json"
+        f2.write_text(_json.dumps(codec.encode(live), default=str))
+        assert kubectl.main(base + ["diff", "-f", str(f2)]) == 0
+
+        # kustomize: base dir + overlay semantics
+        kdir = tmp_path / "kz"
+        kdir.mkdir()
+        (kdir / "dep.json").write_text(
+            _json.dumps(
+                {
+                    "kind": "Pod",
+                    "metadata": {"name": "app", "labels": {"app": "x"}},
+                    "spec": {
+                        "containers": [{"name": "c", "image": "busybox"}]
+                    },
+                }
+            )
+        )
+        (kdir / "patch.json").write_text(
+            _json.dumps(
+                {
+                    "kind": "Pod",
+                    "metadata": {"name": "app"},
+                    "spec": {"priority": 10},
+                }
+            )
+        )
+        (kdir / "kustomization.json").write_text(
+            _json.dumps(
+                {
+                    "resources": ["dep.json"],
+                    "namePrefix": "prod-",
+                    "namespace": "prod",
+                    "commonLabels": {"env": "prod"},
+                    "patchesStrategicMerge": ["patch.json"],
+                    "images": [{"name": "busybox", "newTag": "1.36"}],
+                }
+            )
+        )
+        assert kubectl.main(base + ["kustomize", str(kdir)]) == 0
+        rendered = _json.loads(capsys.readouterr().out)
+        assert rendered[0]["metadata"]["name"] == "prod-app"
+        assert rendered[0]["metadata"]["namespace"] == "prod"
+        assert rendered[0]["metadata"]["labels"]["env"] == "prod"
+        assert rendered[0]["spec"]["priority"] == 10
+        assert rendered[0]["spec"]["containers"][0]["image"] == "busybox:1.36"
+
+        # apply -k creates the rendered objects
+        assert kubectl.main(base + ["apply", "-k", str(kdir)]) == 0
+        assert store.get("pods", "prod", "prod-app").spec.priority == 10
+        # diff -k now clean
+        assert kubectl.main(base + ["diff", "-k", str(kdir)]) == 0
+    finally:
+        srv.shutdown()
+
+
+def test_kustomize_image_ref_parsing():
+    """Review r4: registry ports and digests survive the images override."""
+    from kubernetes_tpu.cmd.kubectl import _split_image_ref
+
+    assert _split_image_ref("localhost:5000/app:1") == (
+        "localhost:5000/app", ":", "1",
+    )
+    assert _split_image_ref("app@sha256:abc") == ("app", "@", "sha256:abc")
+    assert _split_image_ref("busybox") == ("busybox", "", "")
+    assert _split_image_ref("busybox:1.36") == ("busybox", ":", "1.36")
+
+
+def test_kubectl_apply_without_file_errors_cleanly(capsys):
+    import pytest as _pytest
+
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+
+    srv, port, store = serve()
+    try:
+        with _pytest.raises(SystemExit, match="-f FILE or -k"):
+            kubectl.main(["--server", f"http://127.0.0.1:{port}", "apply"])
+    finally:
+        srv.shutdown()
