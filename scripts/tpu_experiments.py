@@ -13,12 +13,28 @@ Each step prints one JSON line; stderr carries the per-batch stage traces
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 REPO = __file__.rsplit("/", 2)[0]
 sys.path.insert(0, REPO)
+
+# every result line is ALSO appended here as it lands (VERDICT r3 #1:
+# checkpoint continuously — a tunnel wedge at round end must not erase
+# the arms that already ran)
+RESULTS_PATH = os.path.join(REPO, "experiments", "tpu_experiments.jsonl")
+
+
+def _emit(obj: dict) -> None:
+    line = json.dumps(obj)
+    print(line, flush=True)
+    os.makedirs(os.path.dirname(RESULTS_PATH), exist_ok=True)
+    with open(RESULTS_PATH, "a", encoding="utf-8") as f:
+        f.write(line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def probe(timeout_s: float = 60.0) -> bool:
@@ -40,7 +56,7 @@ def probe(timeout_s: float = 60.0) -> bool:
         ok = r.returncode == 0 and "tpu" in r.stdout
     except subprocess.TimeoutExpired:
         ok = False  # wedged tunnel: the tiny jit hung past the timeout
-    print(json.dumps({"step": "probe", "tpu_alive": ok}))
+    _emit({"step": "probe", "tpu_alive": ok, "ts": time.time()})
     return ok
 
 
@@ -80,10 +96,14 @@ def _result_line(step: str, r, extra=None) -> None:
         "encode_total_s": round(r.encode_total_s, 2),
         "kernel_total_s": round(r.kernel_total_s, 2),
         "n_batches": r.n_batches,
+        "n_readbacks": r.n_readbacks,
+        "readbacks_per_batch": round(r.readbacks_per_batch, 3),
+        "kernel_cycle_p99_ms": round(r.kernel_cycle_p99_ms, 1),
+        "ts": time.time(),
     }
     if extra:
         out.update(extra)
-    print(json.dumps(out), flush=True)
+    _emit(out)
 
 
 def traces() -> None:
@@ -103,6 +123,21 @@ def batchsize() -> None:
     _warm(sched_config=sc)
     r = _run("SchedulingPodAffinity/5000", sched_config=sc)
     _result_line("batchsize-4096", r, {"device_batch_size": 4096})
+
+
+def pipeline() -> None:
+    """pipeline_depth A/B on the tunnel (VERDICT r3 #2): depth 2 (the old
+    depth-1 pipeline, 1 readback/batch) vs the auto-selected deep pipeline
+    (1 readback per depth-1 batches)."""
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    for depth in (2, 0):  # 0 = auto (RTT-probed; deep on the tunnel)
+        sc = KubeSchedulerConfiguration(pipeline_depth=depth)
+        _warm(sched_config=sc)
+        r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+        _result_line(
+            f"pipeline-depth-{depth or 'auto'}", r, {"pipeline_depth": depth}
+        )
 
 
 def gang() -> None:
@@ -137,6 +172,7 @@ STEPS = {
     "probe": probe,
     "traces": traces,
     "batchsize": batchsize,
+    "pipeline": pipeline,
     "gang": gang,
     "pallas": pallas,
 }
@@ -156,18 +192,15 @@ def main(argv=None) -> int:
         if not probe():
             print(json.dumps({"error": "tpu unreachable; aborting"}))
             return 1
-        for step in ("traces", "batchsize", "gang", "pallas"):
+        for step in ("traces", "batchsize", "pipeline", "gang", "pallas"):
             t0 = time.time()
             try:
                 STEPS[step]()
             except Exception as e:  # keep later steps runnable
                 failed += 1
-                print(
-                    json.dumps(
-                        {"step": step, "error": str(e),
-                         "elapsed_s": round(time.time() - t0, 1)}
-                    ),
-                    flush=True,
+                _emit(
+                    {"step": step, "error": str(e),
+                     "elapsed_s": round(time.time() - t0, 1)}
                 )
         return 1 if failed else 0
     for name in args:
