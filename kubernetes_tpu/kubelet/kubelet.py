@@ -111,6 +111,8 @@ class Kubelet:
         # optional volume manager (volumemanager.VolumeManager): PVC pods
         # wait for attach+mount before the sandbox starts
         self.volume_manager = None
+        # optional node-pressure eviction manager (eviction.EvictionManager)
+        self.eviction_manager = None
         self._wait_volumes: Dict[str, v1.Pod] = {}  # parked on mounts
         self._known: Dict[str, str] = {}  # pod key -> last posted phase
         self._specs: Dict[str, v1.Pod] = {}  # pod key -> last seen spec
@@ -197,6 +199,11 @@ class Kubelet:
                     self.device_manager.free_pod(key)
                 self._post_status(pod, phase, None)
         self.sync_device_capacity()
+        if self.eviction_manager is not None:
+            try:
+                self.eviction_manager.synchronize()
+            except Exception:
+                logger.exception("eviction manager pass failed")
         if self.volume_manager is not None:
             self.volume_manager.reconcile()
             for key, pod in list(self._wait_volumes.items()):
@@ -499,6 +506,11 @@ class NodeAgentPool:
         )
         with self._lock:
             self.kubelets[name] = kl
+        # surface the node's logs to the apiserver (kubectl logs hop);
+        # remote clients (joined pools) have no provider registry
+        providers = getattr(self.server, "log_providers", None)
+        if providers is not None:
+            providers[name] = kl.runtime.logs
         return kl
 
     def remove_node(self, name: str) -> None:
@@ -506,6 +518,9 @@ class NodeAgentPool:
         nodelifecycle to notice the missed heartbeats)."""
         with self._lock:
             self.kubelets.pop(name, None)
+        providers = getattr(self.server, "log_providers", None)
+        if providers is not None:
+            providers.pop(name, None)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -1,0 +1,95 @@
+"""Kubelet node-pressure eviction + QoS classes (kubelet/eviction.py;
+reference pkg/kubelet/eviction/eviction_manager.go, helper/qos)."""
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.kubelet.eviction import (
+    MEMORY_PRESSURE_TAINT,
+    QOS_BEST_EFFORT,
+    QOS_BURSTABLE,
+    QOS_GUARANTEED,
+    EvictionManager,
+    qos_class,
+)
+from kubernetes_tpu.kubelet.kubelet import make_node_object
+
+
+def _pod(name, mem=None, lim=None, prio=0, node="n0"):
+    reqs = {"memory": mem} if mem else {}
+    lims = {"memory": lim, "cpu": "1"} if lim else {}
+    if lim and mem:
+        reqs["cpu"] = "1"
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            node_name=node,
+            priority=prio,
+            containers=[v1.Container(requests=reqs, limits=lims)],
+        ),
+        status=v1.PodStatus(phase=v1.POD_RUNNING),
+    )
+
+
+def test_qos_classes():
+    assert qos_class(_pod("be")) == QOS_BEST_EFFORT
+    assert qos_class(_pod("burst", mem="1Gi")) == QOS_BURSTABLE
+    assert qos_class(_pod("guar", mem="1Gi", lim="1Gi")) == QOS_GUARANTEED
+
+
+def test_eviction_ranks_best_effort_first_and_taints_node():
+    server = APIServer()
+    server.create("nodes", make_node_object("n0", memory="1Gi"))
+    server.create("pods", _pod("guar", mem="512Mi", lim="512Mi", prio=100))
+    server.create("pods", _pod("burst", mem="400Mi"))
+    be = _pod("be")
+    server.create("pods", be)
+    # give the BestEffort pod synthetic usage so pressure exists
+    usage = {
+        "default/guar": 512 << 20,
+        "default/burst": 400 << 20,
+        "default/be": 200 << 20,
+    }
+    em = EvictionManager(
+        server,
+        "n0",
+        memory_threshold_bytes=64 << 20,
+        usage_fn=lambda p: usage.get(p.metadata.key, 0),
+    )
+    evicted = em.synchronize()
+    assert evicted == ["default/be"], evicted  # BestEffort goes first
+    p = server.get("pods", "default", "be")
+    assert p.status.phase == v1.POD_FAILED and p.status.reason == "Evicted"
+    node = server.get("nodes", "", "n0")
+    assert any(
+        c.type == "MemoryPressure" and c.status == "True"
+        for c in node.status.conditions
+    )
+    assert any(t.key == MEMORY_PRESSURE_TAINT for t in node.spec.taints)
+
+    # pressure clears once usage drops below threshold: condition + taint go
+    usage["default/be"] = 0
+    usage["default/burst"] = 0
+    assert em.synchronize() == []
+    node = server.get("nodes", "", "n0")
+    assert any(
+        c.type == "MemoryPressure" and c.status == "False"
+        for c in node.status.conditions
+    )
+    assert not any(t.key == MEMORY_PRESSURE_TAINT for t in node.spec.taints)
+
+
+def test_guaranteed_within_requests_evicted_last():
+    server = APIServer()
+    server.create("nodes", make_node_object("n0", memory="1Gi"))
+    server.create("pods", _pod("guar", mem="900Mi", lim="900Mi", prio=1000))
+    server.create("pods", _pod("burst-over", mem="64Mi"))
+    usage = {"default/guar": 900 << 20, "default/burst-over": 120 << 20}
+    em = EvictionManager(
+        server,
+        "n0",
+        memory_threshold_bytes=64 << 20,
+        usage_fn=lambda p: usage.get(p.metadata.key, 0),
+    )
+    # burst-over exceeds its request: it is the victim, not the bigger
+    # guaranteed pod living within its requests
+    assert em.synchronize() == ["default/burst-over"]
