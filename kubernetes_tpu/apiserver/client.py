@@ -40,6 +40,22 @@ class RESTClient:
             path += f"/{name}"
         return self.base + path
 
+    def get_text(self, resource: str, namespace: str, name: str) -> str:
+        """Plain-text GET of a subresource (pods/{name}/log): same URL
+        scheme, headers, timeout, and HTTP error mapping as the JSON
+        path (get_raw is the JSON variant for aggregated API paths)."""
+        req = urllib.request.Request(
+            self._url(resource, namespace, name), headers=self._headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode() or str(e)
+            if e.code == 404:
+                raise NotFound(msg) from None
+            raise RuntimeError(msg) from None
+
     def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(

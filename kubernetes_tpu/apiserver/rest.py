@@ -477,6 +477,28 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorize(verb, resource, ns, name or ""):
             return
         try:
+            if resource == "pods" and name and name.endswith("/log"):
+                # pods/{name}/log subresource -> node's log provider (the
+                # kubelet hop of kubectl logs); plain text like the
+                # reference's log REST handler
+                tail = query.get("tailLines")
+                try:
+                    tail_n = int(tail) if tail is not None else None
+                except ValueError:
+                    return self._status_error(
+                        400, "BadRequest", f"invalid tailLines {tail!r}"
+                    )
+                text = self.store.pod_logs(
+                    ns or "", name[: -len("/log")], tail_n
+                )
+                body = text.encode()
+                self.send_response(200)
+                self._last_code = 200
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if name:
                 obj = self.store.get(resource, ns or "", name)
                 return self._json(200, codec.encode(obj))

@@ -72,6 +72,10 @@ class APIServer:
         # a deposed primary gets when a higher term appears
         self.replicator = None
         self.read_only = False
+        # node name -> callable(pod_key, tail_lines) -> str: the kubelet's
+        # log surface (kubectl logs flows apiserver -> kubelet -> runtime
+        # GetContainerLogs in the reference; node agent pools register here)
+        self.log_providers: Dict[str, Callable] = {}
 
     @classmethod
     def recover(cls, wal_path: str, watch_history: int = 200000) -> "APIServer":
@@ -306,6 +310,20 @@ class APIServer:
                 if namespace is None or o.metadata.namespace == namespace
             ]
             return objs, self._rv
+
+    def pod_logs(
+        self, namespace: str, name: str, tail_lines: Optional[int] = None
+    ) -> str:
+        """pods/{name}/log subresource: route to the pod's node's
+        registered log provider (the kubelet-proxy hop of kubectl logs)."""
+        pod = self.get("pods", namespace, name)
+        node = pod.spec.node_name
+        if not node:
+            raise NotFound(f"pod {namespace}/{name} is not scheduled")
+        provider = self.log_providers.get(node)
+        if provider is None:
+            raise NotFound(f"no log provider for node {node}")
+        return provider(f"{namespace}/{name}", tail_lines)
 
     def exists(self, kind: str, key: str) -> bool:
         """O(1) copy-free presence check by store key ("ns/name")."""

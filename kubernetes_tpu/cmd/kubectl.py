@@ -511,6 +511,20 @@ def _update_node(client: RESTClient, name: str, mutate) -> None:
         client.guaranteed_update("nodes", "default", name, mutate)
 
 
+def cmd_logs(client: RESTClient, args) -> int:
+    """kubectl logs: GET pods/{name}/log (kubectl/pkg/cmd/logs; served by
+    the apiserver's log subresource routing to the pod's kubelet)."""
+    sub = f"{args.name}/log"
+    if args.tail is not None:
+        sub += f"?tailLines={args.tail}"
+    try:
+        sys.stdout.write(client.get_text("pods", args.namespace, sub))
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_cordon(client: RESTClient, args, unschedulable=True) -> int:
     def mutate(n):
         n.spec.unschedulable = unschedulable
@@ -1021,6 +1035,9 @@ def main(argv=None) -> int:
     p_diff.add_argument("-k", "--kustomize")
     p_kust = sub.add_parser("kustomize")
     p_kust.add_argument("directory")
+    p_logs = sub.add_parser("logs")
+    p_logs.add_argument("name")
+    p_logs.add_argument("--tail", type=int, default=None)
     p_create = sub.add_parser("create")
     p_create.add_argument("-f", "--filename", required=True)
     p_del = sub.add_parser("delete")
@@ -1098,6 +1115,8 @@ def main(argv=None) -> int:
             return cmd_diff(client, args)
         if args.verb == "kustomize":
             return cmd_kustomize(client, args)
+        if args.verb == "logs":
+            return cmd_logs(client, args)
         if args.verb == "apply":
             return cmd_apply(client, args)
         if args.verb == "create":

@@ -49,6 +49,11 @@ class PodRuntime:
         (kill + recreate, same sandbox — kuberuntime's container restart).
         Default: no-op."""
 
+    def logs(self, pod_key: str, tail_lines: Optional[int] = None) -> str:
+        """Container log text (the GetContainerLogs surface kubectl logs
+        reaches through the kubelet). Default: empty."""
+        return ""
+
 
 class _FakePod:
     __slots__ = ("ip", "started", "run_seconds", "fail", "ready_after", "unhealthy_after")
@@ -129,3 +134,26 @@ class FakeRuntime(PodRuntime):
                 else:
                     out[key] = v1.POD_RUNNING
         return out
+
+    def logs(self, pod_key: str, tail_lines: Optional[int] = None) -> str:
+        """Synthesized container log (the hollow runtime's stand-in for
+        real container output): lifecycle lines with timestamps."""
+        now = time.monotonic()
+        with self._lock:
+            fp = self._pods.get(pod_key)
+            if fp is None:
+                return ""
+            age = now - fp.started
+            lines = [
+                f"[fake-runtime] pod {pod_key} sandbox started (ip {fp.ip})",
+                f"[fake-runtime] uptime {age:.1f}s",
+            ]
+            if fp.run_seconds is not None:
+                outcome = "fail" if fp.fail else "succeed"
+                lines.append(
+                    f"[fake-runtime] scripted to {outcome} after "
+                    f"{fp.run_seconds:.1f}s"
+                )
+        if tail_lines is not None:
+            lines = lines[-tail_lines:] if tail_lines > 0 else []
+        return "\n".join(lines) + "\n" if lines else ""

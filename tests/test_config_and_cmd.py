@@ -658,3 +658,48 @@ def test_kubectl_apply_without_file_errors_cleanly(capsys):
             kubectl.main(["--server", f"http://127.0.0.1:{port}", "apply"])
     finally:
         srv.shutdown()
+
+
+def test_kubectl_logs_via_log_subresource(capsys):
+    """kubectl logs flows apiserver -> node log provider -> runtime
+    (reference: kubectl -> apiserver -> kubelet GetContainerLogs)."""
+    import time as _time
+
+    from kubernetes_tpu.api import objects as _v1
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
+
+    srv, port, store = serve()
+    pool = NodeAgentPool(server=store, housekeeping_interval=0.05)
+    try:
+        pool.add_node("n0")
+        pool.start()
+        pod = _v1.Pod(
+            metadata=_v1.ObjectMeta(name="logged"),
+            spec=_v1.PodSpec(
+                node_name="n0", containers=[_v1.Container(name="c")]
+            ),
+        )
+        store.create("pods", pod)
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if (
+                store.get("pods", "default", "logged").status.phase
+                == _v1.POD_RUNNING
+            ):
+                break
+            _time.sleep(0.05)
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        assert kubectl.main(base + ["logs", "logged"]) == 0
+        out = capsys.readouterr().out
+        assert "sandbox started" in out
+        # tail limits the line count
+        assert kubectl.main(base + ["logs", "logged", "--tail", "1"]) == 0
+        out = capsys.readouterr().out
+        assert len(out.strip().splitlines()) == 1
+        # unscheduled/unknown pod is a clean 404
+        assert kubectl.main(base + ["logs", "nope"]) == 1
+    finally:
+        pool.stop()
+        srv.shutdown()
