@@ -254,8 +254,14 @@ def main() -> int:
                 with open(path, encoding="utf-8") as f:
                     best = json.load(f)
             if best is None or out["value"] >= best.get("value", 0):
-                with open(path, "w", encoding="utf-8") as f:
+                # atomic publish: a crash mid-write must not destroy the
+                # previously checkpointed artifact
+                tmp = path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
                     json.dump(out, f, indent=1)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
     except Exception:
         traceback.print_exc()
     return 1 if "error" in out else 0

@@ -172,3 +172,67 @@ def test_kill_kubelet_node_evicts_and_reschedules(tmp_path):
         rs.stop()
         sched.stop()
         cluster.stop()
+
+
+def test_upgrade_apply_mid_burst_does_not_disrupt(tmp_path):
+    """The chaosmonkey upgrade-suite shape (test/e2e/chaosmonkey): run an
+    upgrade WHILE a scheduling burst is in flight — every pod still lands
+    and the cluster version migrates."""
+    from kubernetes_tpu.cmd.kubeadm import init_cluster, upgrade_apply
+
+    handle = init_cluster(str(tmp_path / "up"), controllers=[])
+    try:
+        store = handle.store
+        for i in range(10):
+            store.create(
+                "nodes",
+                v1.Node(
+                    metadata=v1.ObjectMeta(name=f"n{i}", namespace=""),
+                    status=v1.NodeStatus(
+                        capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+                        allocatable={
+                            "cpu": "16", "memory": "64Gi", "pods": "110"
+                        },
+                    ),
+                ),
+            )
+        n_pods = 120
+        for i in range(n_pods // 2):
+            store.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"a{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        # the disruption, mid-burst
+        res = upgrade_apply(store, "v9.9.9")
+        assert res["to"] == "v9.9.9"
+        for i in range(n_pods // 2):
+            store.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"b{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            bound = store.count("pods", lambda p: bool(p.spec.node_name))
+            if bound >= n_pods:
+                break
+            time.sleep(0.1)
+        assert bound >= n_pods, f"only {bound}/{n_pods} scheduled across upgrade"
+        import json as _json
+
+        cm = store.get("configmaps", "kube-system", "kubeadm-config")
+        assert (
+            _json.loads(cm.data["ClusterConfiguration"])["kubernetesVersion"]
+            == "v9.9.9"
+        )
+    finally:
+        handle.stop()
