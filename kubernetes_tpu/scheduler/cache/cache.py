@@ -112,6 +112,18 @@ class SchedulerCache:
     def update_pod(self, pod: v1.Pod) -> None:
         key = pod.metadata.key
         with self.lock:
+            if key in self._assumed and pod.spec.node_name:
+                # bind confirmation arriving as an UPDATE event (the usual
+                # shape: unscheduled -> scheduled MODIFIED): route through
+                # add_pod's confirmation branch instead of remove+re-add —
+                # the re-add would dirty the node row and force a full-row
+                # re-upload at the next flush for state the device already
+                # holds (the kernel committed it). Only for updates that
+                # CARRY a node: an unscheduled-shaped update of an assumed
+                # pod must not consume the assume (add_pod's mismatch
+                # branch would free the node and strand the pod)
+                self.add_pod(pod)
+                return
             old_node = self._pod_to_node.get(key)
             if old_node is not None:
                 self._remove_pod_internal(key, old_node)

@@ -128,7 +128,13 @@ class PriorityQueue:
 
     # -- pops ---------------------------------------------------------------
 
-    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+    def pop(
+        self, timeout: Optional[float] = None, on_pop=None
+    ) -> Optional[QueuedPodInfo]:
+        """on_pop: invoked UNDER the queue lock before the first item is
+        removed — the scheduler marks itself busy there, so no observer
+        can ever see "queue empty and scheduler not busy" between a pop
+        and the popped batch entering the in-flight pipeline."""
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
             while len(self._active) == 0 and not self._stop.is_set():
@@ -138,18 +144,24 @@ class PriorityQueue:
                 self._cond.wait(rem if rem is None or rem < 0.1 else 0.1)
             if self._stop.is_set():
                 return None
+            if on_pop is not None:
+                on_pop()
             pi = self._active.pop()
             if pi is not None:
                 pi.attempts += 1
             return pi
 
     def pop_batch(
-        self, max_n: int, timeout: Optional[float] = None, window: float = 0.0
+        self,
+        max_n: int,
+        timeout: Optional[float] = None,
+        window: float = 0.0,
+        on_first=None,
     ) -> List[QueuedPodInfo]:
         """Pop up to max_n pods: block for the first, then drain without
         blocking (optionally lingering up to `window` seconds to let a burst
         accumulate — the gang/batch former)."""
-        first = self.pop(timeout)
+        first = self.pop(timeout, on_pop=on_first)
         if first is None:
             return []
         out = [first]

@@ -210,6 +210,7 @@ class Scheduler:
         self._pending: List[_InFlightBatch] = []
         # resolved by start() when cfg.pipeline_depth == 0 (auto)
         self._pipeline_depth = self.cfg.pipeline_depth or 2
+        self._busy = False  # scheduling loop mid-batch (wait_for_idle)
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table, n_waves)
@@ -327,19 +328,33 @@ class Scheduler:
                 rec.stop()
 
     def wait_for_idle(self, timeout: float = 30.0) -> bool:
-        """Test helper: wait until no pending pods remain."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if (
+        """Test helper: wait until no pending pods remain. Requires the
+        idle condition to hold across two samples so the scheduling loop's
+        pop->launch gap (queue drained, batch not yet in flight) can't be
+        mistaken for quiescence."""
+
+        def idle() -> bool:
+            return (
                 len(self.queue) == 0
                 and not self._pending
+                and not self._busy
                 and not self.cache.encoder.has_pending_updates
-            ):
-                return True
+            )
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if idle():
+                time.sleep(0.02)
+                if idle():
+                    return True
+                continue
             time.sleep(0.01)
-        return len(self.queue) == 0 and not self._pending
+        return len(self.queue) == 0 and not self._pending and not self._busy
 
     # -- the loop ------------------------------------------------------------
+
+    def _mark_busy(self) -> None:
+        self._busy = True
 
     def _scheduling_loop(self) -> None:
         while not self._stop.is_set():
@@ -348,13 +363,29 @@ class Scheduler:
             # is the more urgent work, and any poll delay here would be
             # charged to those pods' latency
             inflight = bool(self._pending)
+            # on_first marks the loop busy UNDER the queue lock before the
+            # first pod leaves the queue, so wait_for_idle can never
+            # observe "queue empty, nothing in flight" while a popped
+            # batch is still on its way into the pipeline
             pis = self.queue.pop_batch(
                 self.cfg.device_batch_size,
                 timeout=0.0 if inflight else 0.2,
                 window=0.0 if inflight else self.cfg.device_batch_window,
+                on_first=self._mark_busy,
             )
             if not pis:
-                self._resolve_pending()
+                if self._pending:
+                    # stay busy across the drain: _resolve_oldest detaches
+                    # the in-flight batches before the readback, so without
+                    # this an observer would see "queue empty, nothing
+                    # pending" while placements are still being replayed
+                    self._busy = True
+                    try:
+                        self._resolve_pending()
+                    finally:
+                        self._busy = False
+                else:
+                    self._busy = False
                 continue
             try:
                 self.schedule_pod_batch(pis)
@@ -363,6 +394,8 @@ class Scheduler:
                 moves = self.queue.moves
                 for pi in pis:
                     self.queue.add_unschedulable_if_not_present(pi, moves)
+            finally:
+                self._busy = False
 
     def schedule_pod_batch(self, pis: List[QueuedPodInfo]) -> None:
         trace = Trace("schedule_batch", pods=len(pis))

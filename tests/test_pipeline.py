@@ -45,3 +45,71 @@ def test_depth2_matches_legacy_depth1_pipeline():
 def test_synchronous_depth1_still_schedules_all():
     res = _run(depth=1, pods=256)
     assert res.unscheduled == 0
+
+
+def test_deep_pipeline_device_host_convergence():
+    """After a deep-pipelined burst fully resolves, the donated on-device
+    snapshot must EQUAL a host-master re-encode (the device/host
+    convergence invariant the per-batch replay maintains; any divergence
+    means a batch's commits were erased or double-applied)."""
+    import jax
+    import numpy as np
+
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+
+    server = APIServer()
+    for i in range(20):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=f"n{i}", namespace=""),
+                status=v1.NodeStatus(
+                    capacity={"cpu": "32", "memory": "128Gi", "pods": "200"}
+                ),
+            ),
+        )
+    scfg = KubeSchedulerConfiguration(
+        pipeline_depth=6,
+        device_batch_size=32,
+        device_batch_window=0.02,
+        use_mesh=False,
+    )
+    sched = Scheduler(server, scfg)
+    sched.start()
+    try:
+        for i in range(300):
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(
+                        name=f"p{i}", labels={"app": f"a{i % 3}"}
+                    ),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        import time as _time
+
+        deadline = _time.monotonic() + 60.0
+        while _time.monotonic() < deadline:
+            if server.count("pods", lambda p: bool(p.spec.node_name)) == 300:
+                break
+            _time.sleep(0.05)
+        assert server.count("pods", lambda p: bool(p.spec.node_name)) == 300
+        assert sched.wait_for_idle(30.0)
+        with sched.cache.lock:
+            enc = sched.cache.encoder
+            dev = jax.device_get(enc.flush())
+            masters = enc._masters()
+        for fld in ("requested", "sel_counts", "port_counts", "prio_req"):
+            d = np.asarray(getattr(dev, fld))
+            h = np.asarray(getattr(masters, fld))
+            assert np.array_equal(d, h), (
+                f"device/host diverged on {fld}: "
+                f"{np.abs(d.astype(np.int64) - h.astype(np.int64)).max()}"
+            )
+    finally:
+        sched.stop()
