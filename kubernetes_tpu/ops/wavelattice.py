@@ -267,12 +267,20 @@ def make_wave_kernel(
         feas_cnt_tpl = jnp.sum(feasible0.astype(jnp.int32), axis=1)  # [TPL]
 
         # ---- scores [TPL, N] ----
-        nz_used = (
-            snap.nonzero_req[None] + tpl.nonzero_req[:, None, :]
-        ).astype(jnp.float32)  # [TPL, N, R]
-        alloc = jnp.maximum(snap.allocatable.astype(jnp.float32), 1.0)[None]
-        frac = jnp.clip(nz_used / alloc, 0.0, 1.0)
-        cpu_f, mem_f = frac[..., RES_CPU], frac[..., RES_MEM]
+        # resource scores only read the cpu/mem columns: compute the two
+        # [TPL, N] fraction planes directly instead of materializing the
+        # [TPL, N, R] nz_used broadcast (R× less HBM traffic in Stage A)
+        def _frac(col):
+            a = jnp.maximum(
+                snap.allocatable[:, col].astype(jnp.float32), 1.0
+            )[None]
+            u = (
+                snap.nonzero_req[:, col][None]
+                + tpl.nonzero_req[:, col][:, None]
+            ).astype(jnp.float32)
+            return jnp.clip(u / a, 0.0, 1.0)
+
+        cpu_f, mem_f = _frac(RES_CPU), _frac(RES_MEM)
         least = ((1.0 - cpu_f) + (1.0 - mem_f)) * 50.0
         most = (cpu_f + mem_f) * 50.0
         balanced = (1.0 - jnp.abs(cpu_f - mem_f)) * 100.0
