@@ -113,6 +113,11 @@ class Kubelet:
         self.volume_manager = None
         # optional node-pressure eviction manager (eviction.EvictionManager)
         self.eviction_manager = None
+        # optional container manager (cm.ContainerManager): reserved-
+        # resource accounting — allocatable = capacity - reservations is
+        # posted to NodeStatus so the scheduler packs against it
+        self.container_manager = None
+        self._allocatable_synced = False
         self._wait_volumes: Dict[str, v1.Pod] = {}  # parked on mounts
         self._known: Dict[str, str] = {}  # pod key -> last posted phase
         self._specs: Dict[str, v1.Pod] = {}  # pod key -> last seen spec
@@ -199,6 +204,7 @@ class Kubelet:
                     self.device_manager.free_pod(key)
                 self._post_status(pod, phase, None)
         self.sync_device_capacity()
+        self.sync_node_allocatable()
         if self.eviction_manager is not None:
             try:
                 self.eviction_manager.synchronize()
@@ -423,6 +429,27 @@ class Kubelet:
         try:
             self.server.guaranteed_update("nodes", "", self.node_name, mutate)
             self._device_generation = gen
+        except NotFound:
+            pass
+
+    def sync_node_allocatable(self) -> None:
+        """Post allocatable = capacity - reservations (container_manager's
+        Node Allocatable math; cm/container_manager_linux.go) once — the
+        reservations are static for the kubelet's lifetime."""
+        cm = self.container_manager
+        if cm is None or self._allocatable_synced:
+            return
+
+        def mutate(node):
+            alloc = cm.node_allocatable(node.status.capacity)
+            if node.status.allocatable == alloc:
+                return None
+            node.status.allocatable = alloc
+            return node
+
+        try:
+            self.server.guaranteed_update("nodes", "", self.node_name, mutate)
+            self._allocatable_synced = True
         except NotFound:
             pass
 

@@ -93,3 +93,50 @@ def test_guaranteed_within_requests_evicted_last():
     # burst-over exceeds its request: it is the victim, not the bigger
     # guaranteed pod living within its requests
     assert em.synchronize() == ["default/burst-over"]
+
+
+def test_container_manager_allocatable_and_cgroups():
+    """pkg/kubelet/cm: allocatable = capacity - reservations; pod cgroup
+    paths follow the /kubepods/{qos}/pod{uid} layout."""
+    from kubernetes_tpu.kubelet.cm import ContainerManager
+
+    cm = ContainerManager(
+        system_reserved={"cpu": "500m", "memory": "512Mi"},
+        kube_reserved={"cpu": "1", "memory": "1Gi"},
+        eviction_hard_memory="100Mi",
+    )
+    alloc = cm.node_allocatable({"cpu": "8", "memory": "16Gi", "pods": "110"})
+    assert alloc["cpu"] == "6500m"
+    assert alloc["memory"] == str((16 << 30) - (512 << 20) - (1 << 30) - (100 << 20))
+    assert alloc["pods"] == "110"
+
+    guar = _pod("g", mem="1Gi", lim="1Gi")
+    guar.metadata.uid = "u1"
+    assert cm.pod_cgroup(guar) == "/kubepods/podu1"
+    burst = _pod("b", mem="1Gi")
+    burst.metadata.uid = "u2"
+    assert cm.pod_cgroup(burst) == "/kubepods/burstable/podu2"
+    be = _pod("e")
+    be.metadata.uid = "u3"
+    assert cm.pod_cgroup(be) == "/kubepods/besteffort/podu3"
+
+
+def test_kubelet_posts_reserved_allocatable():
+    """The kubelet posts allocatable = capacity - reservations to the
+    node status (the Node Allocatable KEP), so the scheduler packs
+    against reserved-aware capacity."""
+    from kubernetes_tpu.api.resources import cpu_to_millis
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.kubelet.cm import ContainerManager
+    from kubernetes_tpu.kubelet.kubelet import Kubelet, make_node_object
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+    from kubernetes_tpu.kubemark.hollow_node import _fake_pod_ip
+
+    server = APIServer()
+    server.create("nodes", make_node_object("n0", cpu="8"))
+    kl = Kubelet(server, "n0", FakeRuntime(_fake_pod_ip))
+    kl.container_manager = ContainerManager(kube_reserved={"cpu": "1"})
+    kl.sync_node_allocatable()
+    node = server.get("nodes", "", "n0")
+    assert cpu_to_millis(node.status.allocatable["cpu"]) == 7000
+    assert cpu_to_millis(node.status.capacity["cpu"]) == 8000
