@@ -1,0 +1,157 @@
+"""Versioned scheme: GVK registry + hub-and-spoke conversion.
+
+Reference: staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go — types
+register under (group, version, kind); conversion goes external-version ⇄
+internal hub, so N versions need N converters, not N². This build keeps
+ONE internal Python type per kind (the deliberate single-internal-version
+choice, SURVEY §1 L2) and performs conversion at the WIRE-DICT level: an
+external document is reshaped to the internal wire form before the codec's
+from_dict, and an internal object reshapes on the way out when a target
+version is requested.
+
+The worked multi-version case is discovery.k8s.io EndpointSlice:
+v1beta1 (the internal shape: endpoint.ready bool, topology map) and v1
+(endpoint.conditions.ready, nodeName field, zone) — the same field moves
+the reference's v1beta1→v1 graduation made.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import serialization as codec
+
+Converter = Callable[[dict], dict]  # wire dict -> wire dict
+
+
+class Scheme:
+    """GVK registry + converters (runtime.Scheme-lite)."""
+
+    def __init__(self):
+        # (group, version, kind) -> resource name
+        self._gvk: Dict[Tuple[str, str, str], str] = {}
+        # (group, version, kind) -> (to_internal, from_internal)
+        self._convert: Dict[Tuple[str, str, str], Tuple[Converter, Converter]] = {}
+        # group -> ordered versions, most preferred first
+        self._versions: Dict[str, list] = {}
+
+    def add_known_type(
+        self,
+        group: str,
+        version: str,
+        kind: str,
+        resource: str,
+        to_internal: Optional[Converter] = None,
+        from_internal: Optional[Converter] = None,
+    ) -> None:
+        key = (group, version, kind)
+        self._gvk[key] = resource
+        ident = lambda d: d  # noqa: E731
+        self._convert[key] = (to_internal or ident, from_internal or ident)
+        self._versions.setdefault(group, [])
+        if version not in self._versions[group]:
+            self._versions[group].append(version)
+
+    def prioritized_versions(self, group: str) -> list:
+        return list(self._versions.get(group, []))
+
+    @staticmethod
+    def parse_api_version(api_version: str) -> Tuple[str, str]:
+        """"discovery.k8s.io/v1" -> (group, version); "v1" -> ("", "v1")."""
+        if "/" in api_version:
+            g, _, v = api_version.partition("/")
+            return g, v
+        return "", api_version
+
+    def recognizes(self, api_version: str, kind: str) -> bool:
+        g, v = self.parse_api_version(api_version)
+        return (g, v, kind) in self._gvk
+
+    def decode(self, data: dict) -> Tuple[str, Any]:
+        """External wire document -> (resource, internal typed object)."""
+        api_version = data.get("apiVersion", "")
+        kind = data.get("kind", "")
+        g, v = self.parse_api_version(api_version)
+        key = (g, v, kind)
+        if key not in self._gvk:
+            raise KeyError(f"no kind registered for {api_version}/{kind}")
+        resource = self._gvk[key]
+        to_internal, _ = self._convert[key]
+        return resource, codec.decode(resource, to_internal(dict(data)))
+
+    def encode(self, obj: Any, api_version: Optional[str] = None) -> dict:
+        """Internal object -> wire document at `api_version` (default: the
+        object's own/internal form)."""
+        doc = codec.encode(obj)
+        if api_version is None:
+            return doc
+        g, v = self.parse_api_version(api_version)
+        kind = doc.get("kind", type(obj).__name__)
+        key = (g, v, kind)
+        if key not in self._convert:
+            raise KeyError(f"no conversion to {api_version} for {kind}")
+        _, from_internal = self._convert[key]
+        out = from_internal(doc)
+        out["apiVersion"] = api_version
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the default scheme: every served resource at its internal version, plus
+# the EndpointSlice v1beta1/v1 pair as the worked conversion example
+# ---------------------------------------------------------------------------
+
+
+def _slice_v1_to_internal(doc: dict) -> dict:
+    """discovery.k8s.io/v1 -> internal (v1beta1-shaped): conditions.ready
+    flattens to ready, nodeName stays (internal carries it)."""
+    out = dict(doc)
+    eps = []
+    for ep in doc.get("endpoints", []) or []:
+        ep = dict(ep)
+        conds = ep.pop("conditions", None)
+        if conds is not None and "ready" not in ep:
+            # nil-means-ready (v1 conditions.ready is *bool; nil endpoints
+            # must be treated as serving for backward compatibility)
+            r = conds.get("ready")
+            ep["ready"] = True if r is None else bool(r)
+        ep.pop("zone", None)  # internal has no zone field (topology-lite)
+        eps.append(ep)
+    out["endpoints"] = eps
+    return out
+
+
+def _slice_internal_to_v1(doc: dict) -> dict:
+    """internal -> discovery.k8s.io/v1: ready nests under conditions."""
+    out = dict(doc)
+    eps = []
+    for ep in doc.get("endpoints", []) or []:
+        ep = dict(ep)
+        ready = ep.pop("ready", True)
+        ep["conditions"] = {"ready": bool(ready)}
+        eps.append(ep)
+    out["endpoints"] = eps
+    return out
+
+
+def default_scheme() -> Scheme:
+    s = Scheme()
+    # core group: internal == v1 wire form (identity conversions)
+    for resource, cls in codec.RESOURCE_KINDS.items():
+        s.add_known_type("", "v1", cls.__name__, resource)
+    # the multi-version pair (v1 preferred, v1beta1 served)
+    s.add_known_type(
+        "discovery.k8s.io",
+        "v1",
+        "EndpointSlice",
+        "endpointslices",
+        to_internal=_slice_v1_to_internal,
+        from_internal=_slice_internal_to_v1,
+    )
+    s.add_known_type(
+        "discovery.k8s.io", "v1beta1", "EndpointSlice", "endpointslices"
+    )
+    return s
+
+
+scheme = default_scheme()

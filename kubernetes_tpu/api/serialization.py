@@ -183,8 +183,17 @@ def decode(resource: str, data: dict, allow_unstructured: bool = True) -> Any:
 
 
 def decode_any(data: dict) -> Any:
-    """JSON body with a `kind` field → (resource, typed object)."""
+    """JSON body with a `kind` field → (resource, typed object). Documents
+    at a registered NON-internal version (e.g. discovery.k8s.io/v1
+    EndpointSlice) convert through the scheme's to-internal hop first
+    (api/scheme.py)."""
     kind = data.get("kind", "")
+    api_version = data.get("apiVersion", "")
+    if api_version and "/" in api_version:
+        from .scheme import scheme
+
+        if scheme.recognizes(api_version, kind):
+            return scheme.decode(data)
     resource = KIND_TO_RESOURCE.get(kind)
     if resource is None:
         raise KeyError(f"unknown kind {kind!r}")

@@ -1,0 +1,90 @@
+"""Versioned scheme + conversion (api/scheme.py; reference
+staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go)."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api import serialization as codec
+from kubernetes_tpu.api.scheme import Scheme, default_scheme, scheme
+
+
+def test_gvk_registry_and_version_priority():
+    s = default_scheme()
+    assert s.recognizes("v1", "Pod")
+    assert s.recognizes("discovery.k8s.io/v1", "EndpointSlice")
+    assert s.recognizes("discovery.k8s.io/v1beta1", "EndpointSlice")
+    assert not s.recognizes("discovery.k8s.io/v1", "Pod")
+    assert s.prioritized_versions("discovery.k8s.io") == ["v1", "v1beta1"]
+    assert Scheme.parse_api_version("discovery.k8s.io/v1") == (
+        "discovery.k8s.io",
+        "v1",
+    )
+    assert Scheme.parse_api_version("v1") == ("", "v1")
+
+
+def test_endpointslice_v1_decodes_through_conversion():
+    """A v1 document (conditions.ready) converts to the internal shape
+    (flat ready) — the reference's v1beta1->v1 graduation, inverted."""
+    doc = {
+        "apiVersion": "discovery.k8s.io/v1",
+        "kind": "EndpointSlice",
+        "metadata": {
+            "name": "web-0",
+            "labels": {"kubernetes.io/service-name": "web"},
+        },
+        "endpoints": [
+            {"addresses": ["10.0.0.1"], "conditions": {"ready": True}},
+            {"addresses": ["10.0.0.2"], "conditions": {"ready": False},
+             "zone": "za"},
+        ],
+        "ports": [["http", 80]],
+    }
+    resource, obj = codec.decode_any(doc)
+    assert resource == "endpointslices"
+    assert isinstance(obj, v1.EndpointSlice)
+    assert obj.endpoints[0].ready is True
+    assert obj.endpoints[1].ready is False
+
+
+def test_encode_to_v1_nests_conditions():
+    es = v1.EndpointSlice(
+        metadata=v1.ObjectMeta(name="s"),
+        endpoints=[
+            v1.Endpoint(addresses=["10.0.0.1"], ready=True),
+            v1.Endpoint(addresses=["10.0.0.2"], ready=False),
+        ],
+        ports=[("http", 80)],
+    )
+    out = scheme.encode(es, "discovery.k8s.io/v1")
+    assert out["apiVersion"] == "discovery.k8s.io/v1"
+    assert out["endpoints"][0]["conditions"] == {"ready": True}
+    assert out["endpoints"][1]["conditions"] == {"ready": False}
+    assert "ready" not in out["endpoints"][0]
+    # round trip: v1 wire -> internal -> equal semantic content
+    _res, back = scheme.decode(out | {"kind": "EndpointSlice"})
+    assert [e.ready for e in back.endpoints] == [True, False]
+
+
+def test_unknown_target_version_raises():
+    with pytest.raises(KeyError, match="no conversion"):
+        scheme.encode(
+            v1.EndpointSlice(metadata=v1.ObjectMeta(name="s")),
+            "discovery.k8s.io/v2",
+        )
+
+
+def test_nil_conditions_ready_means_ready():
+    """v1 conditions.ready is *bool: an explicit null must read as ready
+    (the reference's nil-means-serving backward compatibility)."""
+    doc = {
+        "apiVersion": "discovery.k8s.io/v1",
+        "kind": "EndpointSlice",
+        "metadata": {"name": "s"},
+        "endpoints": [
+            {"addresses": ["10.0.0.1"], "conditions": {"ready": None}},
+            {"addresses": ["10.0.0.2"], "conditions": {}},
+        ],
+        "ports": [["http", 80]],
+    }
+    _res, obj = codec.decode_any(doc)
+    assert [e.ready for e in obj.endpoints] == [True, True]
