@@ -16,10 +16,14 @@ Two entry points:
   * `fit_mask` — the mask alone, in the snapshot's natural [N, R] layout;
     THIS is what the wave kernel calls (config `use_pallas_fit`).
   * `fit_mask_least_alloc` — the mask fused with a least-allocated-style
-    score in one pass; standalone (oracle-tested, not yet wired: the wave
-    kernel's score stage normalizes cpu/mem fractions differently and its
-    fusion is the next integration step once the mask path is timed on
-    hardware).
+    score in one pass; standalone and oracle-tested, but NOT wired into
+    the wave kernel, deliberately: round 4 removed the score stage's only
+    [TPL, N, R] intermediate (wavelattice now computes the cpu/mem
+    fraction planes directly as [TPL, N] ops), so there is nothing heavy
+    left for a fused score to save — the mask (`fit_mask`, re-evaluated
+    every wave) remains the one op worth a Pallas pass. Kept as the
+    template for future fused score work (e.g. extended-resource-heavy
+    clusters where R grows past the pad).
 
 `fit_mask_least_alloc(req, free, alloc)`:
     req   [TPL, R] i32   per-template requests

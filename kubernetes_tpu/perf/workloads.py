@@ -183,6 +183,44 @@ def build_workload(
         init = [_basic_pod(f"init-{i}") for i in range(cfg.num_init_pods)]
         return nodes, init, lambda i: _basic_pod(f"pod-{i}", affinity=aff)
 
+    if cfg.name == "SchedulingSecrets":
+        # pods mounting secret volumes (performance-config.yaml
+        # SchedulingSecrets): volumes ride the encode path but gate
+        # nothing — isolates the spec-size cost from scheduling logic
+        from ..api.objects import Volume
+
+        def secret_factory(i: int) -> Pod:
+            p = _basic_pod(f"pod-{i}")
+            p.spec.volumes = [
+                Volume(name=f"s{j}", secret=f"sec-{j}") for j in range(2)
+            ]
+            return p
+
+        init = [_basic_pod(f"init-{i}") for i in range(cfg.num_init_pods)]
+        return nodes, init, secret_factory
+
+    if cfg.name == "SchedulingInTreePVs":
+        # direct in-tree volumes (performance-config.yaml in-tree PV
+        # variant): these pods are flagged for the HOST fallback path
+        # (volume plugins — GCEPDLimits etc. — are host-side post-filters),
+        # so this workload measures the fallback lane at bench scale
+        from ..api.objects import GCEPersistentDiskVolumeSource, Volume
+
+        def pv_factory(i: int) -> Pod:
+            p = _basic_pod(f"pod-{i}")
+            p.spec.volumes = [
+                Volume(
+                    name="data",
+                    gce_persistent_disk=GCEPersistentDiskVolumeSource(
+                        pd_name=f"disk-{i}"
+                    ),
+                )
+            ]
+            return p
+
+        init = [_basic_pod(f"init-{i}") for i in range(cfg.num_init_pods)]
+        return nodes, init, pv_factory
+
     if cfg.name == "Gang":
         # gang burst: groups of 50 identical pods (PodGroup-style), all
         # pending at once (BASELINE.md: 15k pending pods on 5k nodes);
@@ -225,5 +263,8 @@ WORKLOADS: Dict[str, WorkloadConfig] = {
     "SchedulingNodeAffinity/5000": WorkloadConfig(
         "SchedulingNodeAffinity", 5000, 1000, 5000
     ),
+    "SchedulingSecrets/500": WorkloadConfig("SchedulingSecrets", 500, 100, 1000),
+    "SchedulingSecrets/5000": WorkloadConfig("SchedulingSecrets", 5000, 1000, 5000),
+    "SchedulingInTreePVs/500": WorkloadConfig("SchedulingInTreePVs", 500, 100, 400),
     "Gang/5000": WorkloadConfig("Gang", 5000, 0, 15000),
 }

@@ -29,3 +29,22 @@ def test_density_3k_pods_100_nodes_min_throughput():
         f"density throughput {res.throughput_pods_per_s:.1f} pods/s "
         f"below the {THRESHOLD:.0f} pods/s floor"
     )
+
+
+def test_secrets_and_intree_pv_workloads_schedule():
+    """The remaining performance-config variants: secret-volume pods ride
+    the device path; in-tree-PV pods take the host fallback lane — both
+    must fully schedule."""
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+
+    r = run_benchmark(
+        WorkloadConfig("SchedulingSecrets", 50, 0, 200), quiet=True,
+        timeout_s=240,
+    )
+    assert r.unscheduled == 0
+    r = run_benchmark(
+        WorkloadConfig("SchedulingInTreePVs", 50, 0, 100), quiet=True,
+        timeout_s=240,
+    )
+    assert r.unscheduled == 0
+    assert "SchedulingSecrets/5000" in WORKLOADS
