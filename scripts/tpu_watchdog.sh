@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tunnel watchdog: probe until the TPU answers, then immediately run the
+# queued experiment arms (each checkpoints to experiments/*.jsonl) and a
+# bench pass (self-checkpoints BENCH_r04_tpu.json). One TPU process at a
+# time — the probe runs in a subprocess with a hard timeout because a
+# wedged backend hangs every jit forever (PERFORMANCE.md).
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/watchdog.log
+mkdir -p experiments
+echo "$(date -u +%FT%TZ) watchdog start" >> "$LOG"
+while true; do
+  if timeout 75 python -c "import jax, jax.numpy as jnp; jax.jit(lambda v: v+1)(jnp.ones((8,8))).block_until_ready(); import sys; sys.exit(0 if jax.devices()[0].platform=='tpu' else 1)" >> "$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments" >> "$LOG"
+    timeout 3600 python scripts/tpu_experiments.py all >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) experiments rc=$? - running bench" >> "$LOG"
+    timeout 1800 python bench.py >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) bench rc=$? - watchdog done" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) tunnel still wedged" >> "$LOG"
+  sleep 240
+done
