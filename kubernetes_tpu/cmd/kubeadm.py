@@ -41,26 +41,38 @@ class ClusterHandle:
     bootstrap_token: str
     data_dir: str
     _joined: List[object] = field(default_factory=list)
+    replication: object = None  # ReplicationListener when HA is enabled
 
     @property
     def server_url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def replication_address(self):
+        return self.replication.address if self.replication else None
 
     def stop(self) -> None:
         for pool in self._joined:
             pool.stop()
         self.controller_manager.stop()
         self.scheduler.stop()
+        if self.replication is not None:
+            self.replication.close()
         self.http_server.shutdown()
         audit = getattr(self.http_server, "audit", None)
         if audit is not None:
             audit.stop()  # drain + close the audit writer
 
 
-def init_cluster(
-    data_dir: str, port: int = 0, controllers: Optional[List[str]] = None
-) -> ClusterHandle:
-    """Run every init phase; returns the live control plane."""
+def assemble_security(store, admin_token=None, bootstrap_token=None):
+    """The apiserver's trust + admission assembly, shared by init and the
+    standby's promotion (a failover must NOT downgrade the cluster to an
+    unauthenticated, admission-free API server). Returns (authn, authz)
+    and installs the admit-hook chain on the store."""
+    from ..apiserver.admission import (
+        NodeRestrictionAdmission,
+        PodSecurityPolicyAdmission,
+    )
     from ..apiserver.auth import (
         MASTERS_GROUP,
         AdmissionChain,
@@ -75,46 +87,34 @@ def init_cluster(
         TokenAuthenticator,
         make_rule,
     )
-    from ..apiserver.admission import (
-        NodeRestrictionAdmission,
-        PodSecurityPolicyAdmission,
-    )
     from ..apiserver.webhook import (
         MutatingWebhookAdmission,
         ValidatingWebhookAdmission,
     )
-    from ..apiserver.rest import serve
-    from ..client.apiserver import APIServer
-    from ..controller.manager import ControllerManager
-    from ..runtime.wal import WriteAheadLog
-    from ..scheduler import KubeSchedulerConfiguration, Scheduler
+    from ..proxy import ClusterIPAllocator
 
-    os.makedirs(data_dir, exist_ok=True)
-
-    # -- phase certs: trust material (bearer tokens stand in for x509) ------
-    admin_token = secrets.token_urlsafe(24)
-    # bootstrap token in the reference's <id>.<secret> form
-    # (cluster-bootstrap/token/util): the id is public (names the JWS
-    # signature key on cluster-info), the secret half proves possession
-    token_id = secrets.token_hex(3)
-    token_secret = secrets.token_urlsafe(16)
-    bootstrap_token = f"{token_id}.{token_secret}"
-    logger.info("[certs] generated admin + bootstrap tokens")
-
-    # -- phase etcd/control-plane: durable store + REST facade --------------
-    store = APIServer(wal=WriteAheadLog(os.path.join(data_dir, "cluster")))
     authn = TokenAuthenticator(server=store, allow_anonymous=False)
-    authn.add_token(admin_token, "kubernetes-admin", groups=(MASTERS_GROUP,))
-    authn.add_token(
-        bootstrap_token, "system:bootstrap", groups=("system:bootstrappers",)
-    )
+    if admin_token:
+        authn.add_token(
+            admin_token, "kubernetes-admin", groups=(MASTERS_GROUP,)
+        )
+    if bootstrap_token:
+        authn.add_token(
+            bootstrap_token, "system:bootstrap", groups=("system:bootstrappers",)
+        )
     # server-backed: ClusterRole/ClusterRoleBinding objects created via the
     # API feed authorization alongside the programmatic bootstrap policy
     authz = RBACAuthorizer(server=store)
     # bootstrappers run node agents: register + heartbeat, sync pods, and
     # feed the node-side service dataplane (the system:node role shape)
-    authz.bind("system:bootstrappers", make_rule(["create", "update", "get"], ["nodes", "leases"]))
-    authz.bind("system:bootstrappers", make_rule(["get", "list", "watch", "update"], ["pods"]))
+    authz.bind(
+        "system:bootstrappers",
+        make_rule(["create", "update", "get"], ["nodes", "leases"]),
+    )
+    authz.bind(
+        "system:bootstrappers",
+        make_rule(["get", "list", "watch", "update"], ["pods"]),
+    )
     authz.bind(
         "system:bootstrappers",
         make_rule(["get", "list", "watch"], ["services", "endpoints"]),
@@ -123,8 +123,6 @@ def init_cluster(
     authz.bind(
         "system:bootstrappers", make_rule(["get"], ["configmaps"], ["kube-public"])
     )
-    from ..proxy import ClusterIPAllocator
-
     store.admit_hooks.append(ClusterIPAllocator())
     # mutators first, then validators (admission/chain.go ordering); the
     # plugin set mirrors the reference's default enabled admission list
@@ -148,6 +146,49 @@ def init_cluster(
             ],
         )
     )
+    return authn, authz
+
+
+def init_cluster(
+    data_dir: str,
+    port: int = 0,
+    controllers: Optional[List[str]] = None,
+    replication: bool = False,
+) -> ClusterHandle:
+    """Run every init phase; returns the live control plane. With
+    replication=True the store also serves a replication endpoint
+    (runtime/replication.py) so standby control planes can tail it —
+    handle.replication_address is what `kubeadm standby` dials."""
+    from ..apiserver.rest import serve
+    from ..client.apiserver import APIServer
+    from ..controller.manager import ControllerManager
+    from ..runtime.wal import WriteAheadLog
+    from ..scheduler import KubeSchedulerConfiguration, Scheduler
+
+    os.makedirs(data_dir, exist_ok=True)
+
+    # -- phase certs: trust material (bearer tokens stand in for x509) ------
+    admin_token = secrets.token_urlsafe(24)
+    # bootstrap token in the reference's <id>.<secret> form
+    # (cluster-bootstrap/token/util): the id is public (names the JWS
+    # signature key on cluster-info), the secret half proves possession
+    token_id = secrets.token_hex(3)
+    token_secret = secrets.token_urlsafe(16)
+    bootstrap_token = f"{token_id}.{token_secret}"
+    logger.info("[certs] generated admin + bootstrap tokens")
+
+    # -- phase etcd/control-plane: durable store + REST facade --------------
+    store = APIServer(wal=WriteAheadLog(os.path.join(data_dir, "cluster")))
+    repl = None
+    if replication:
+        from ..runtime.replication import ReplicationListener
+
+        repl = ReplicationListener()
+        repl.attach(store)
+        logger.info(
+            "[etcd] replication endpoint on %s:%d", *repl.address
+        )
+    authn, authz = assemble_security(store, admin_token, bootstrap_token)
     from ..apiserver.audit import AuditLogger
 
     http_server, port, _ = serve(
@@ -233,7 +274,115 @@ def init_cluster(
         admin_token=admin_token,
         bootstrap_token=bootstrap_token,
         data_dir=data_dir,
+        replication=repl,
     )
+
+
+def standby_cluster(
+    primary_addr,
+    data_dir: str,
+    lease_s: float = 1.0,
+    port: int = 0,
+    controllers: Optional[List[str]] = None,
+    admin_token: Optional[str] = None,
+    insecure: bool = False,
+):
+    """`kubeadm standby`: a warm control plane behind a replica store.
+
+    Tails the primary's replication stream (full state + live records,
+    persisted to its own WAL); when the primary's lease lapses — or
+    promote() is called — the replica becomes a LIVE control plane with
+    the SAME trust + admission assembly as init (failover must not
+    downgrade security; pass the cluster's admin token, or insecure=True
+    for the dev port), fences the old primary best-effort (higher-term
+    hello — a merely-stalled primary steps down read-only instead of
+    splitting the brain), and the scheduler re-lists from the replicated
+    state. Returns a StandbyHandle with .wait_promoted()/.promote()."""
+    from ..runtime.replication import Follower
+    from ..runtime.wal import WriteAheadLog
+
+    if admin_token is None and not insecure:
+        raise ValueError(
+            "standby_cluster needs the cluster admin token (or insecure=True):"
+            " a promoted control plane must keep authenticating"
+        )
+    os.makedirs(data_dir, exist_ok=True)
+
+    class StandbyHandle:
+        def __init__(self):
+            self.follower = None
+            self.cluster: Optional[ClusterHandle] = None
+            self.promote_error: Optional[BaseException] = None
+            self._promoted = threading.Event()
+
+        def wait_promoted(self, timeout: float = 30.0) -> bool:
+            return self._promoted.wait(timeout)
+
+        def promote(self) -> ClusterHandle:
+            self.follower.promote()
+            if not self.wait_promoted():
+                raise RuntimeError("standby promotion timed out")
+            if self.cluster is None:
+                raise RuntimeError(
+                    f"standby promotion failed: {self.promote_error}"
+                )
+            return self.cluster
+
+        def stop(self):
+            self.follower.stop()
+            if self.cluster is not None:
+                self.cluster.stop()
+
+    handle = StandbyHandle()
+
+    def on_promote(server):
+        try:
+            from ..apiserver.audit import AuditLogger
+            from ..apiserver.rest import serve
+            from ..controller.manager import ControllerManager
+            from ..scheduler import KubeSchedulerConfiguration, Scheduler
+
+            if insecure:
+                authn = authz = None
+            else:
+                authn, authz = assemble_security(server, admin_token)
+            http_server, bound_port, _ = serve(
+                store=server,
+                port=port,
+                authenticator=authn,
+                authorizer=authz,
+                audit=AuditLogger(path=os.path.join(data_dir, "audit.jsonl")),
+            )
+            sched = Scheduler(server, KubeSchedulerConfiguration())
+            sched.start()
+            cmgr = ControllerManager(server, controllers=controllers)
+            cmgr.start()
+            handle.cluster = ClusterHandle(
+                store=server,
+                http_server=http_server,
+                port=bound_port,
+                scheduler=sched,
+                controller_manager=cmgr,
+                admin_token=admin_token or "",
+                bootstrap_token="",
+                data_dir=data_dir,
+            )
+            logger.warning(
+                "[standby] promoted: control plane serving on :%d", bound_port
+            )
+        except BaseException as e:  # surfaced by StandbyHandle.promote
+            handle.promote_error = e
+            raise
+        finally:
+            handle._promoted.set()
+
+    handle.follower = Follower(
+        primary_addr,
+        lease_s=lease_s,
+        wal=WriteAheadLog(os.path.join(data_dir, "cluster")),
+        on_promote=on_promote,
+    ).start()
+    return handle
 
 
 def discover_cluster_info(
@@ -395,10 +544,21 @@ def main(argv=None) -> int:
     p_init = sub.add_parser("init")
     p_init.add_argument("--data-dir", default="./kubeadm-data")
     p_init.add_argument("--port", type=int, default=18080)
+    p_init.add_argument("--with-replication", action="store_true")
     p_join = sub.add_parser("join")
     p_join.add_argument("server")
     p_join.add_argument("--token", required=True)
     p_join.add_argument("--node-name", default="node-joined")
+    p_standby = sub.add_parser("standby")
+    p_standby.add_argument("primary")  # host:port of the replication endpoint
+    p_standby.add_argument("--data-dir", default="./kubeadm-standby")
+    p_standby.add_argument("--lease-seconds", type=float, default=2.0)
+    p_standby.add_argument(
+        "--token",
+        default="",
+        help="cluster admin token the promoted plane authenticates with "
+        "(omitting it serves the promoted plane on the insecure port)",
+    )
     p_up = sub.add_parser("upgrade")
     p_up.add_argument("phase", choices=["plan", "apply"])
     p_up.add_argument("server")
@@ -408,13 +568,18 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
 
     if args.verb == "init":
-        handle = init_cluster(args.data_dir, args.port)
+        handle = init_cluster(
+            args.data_dir, args.port, replication=args.with_replication
+        )
         print(
             "cluster initialized.\n"
             f"  admin conf: {os.path.join(args.data_dir, ADMIN_CONF)}\n"
             "join nodes with:\n"
             f"  kubeadm-tpu join {handle.server_url} --token {handle.bootstrap_token}"
         )
+        if handle.replication_address:
+            host, rport = handle.replication_address
+            print(f"standby control planes: kubeadm-tpu standby {host}:{rport}")
         try:
             threading.Event().wait()
         except KeyboardInterrupt:
@@ -426,6 +591,23 @@ def main(argv=None) -> int:
             threading.Event().wait()
         except KeyboardInterrupt:
             pool.stop()
+        return 0
+    if args.verb == "standby":
+        host, _, port_s = args.primary.rpartition(":")
+        if not host or not port_s.isdigit():
+            parser.error(f"standby target must be HOST:PORT, got {args.primary!r}")
+        handle = standby_cluster(
+            (host, int(port_s)),
+            args.data_dir,
+            lease_s=args.lease_seconds,
+            admin_token=args.token or None,
+            insecure=not args.token,
+        )
+        print(f"standby tailing {args.primary}; promotes on lease expiry")
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            handle.stop()
         return 0
     if args.verb == "upgrade":
         from ..apiserver.client import AuthRESTClient

@@ -408,6 +408,19 @@ class Follower:
             logger.warning(
                 "follower promoted to primary at rv=%d term=%d", self.rv, self.term
             )
+        # best-effort fence of the old primary: it may be merely STALLED
+        # (lease lapsed without dying) — a hello at our higher term makes
+        # it step down read-only instead of splitting the brain. A dead
+        # primary simply refuses the connection.
+        try:
+            sock = socket.create_connection(self.primary_addr, timeout=1.0)
+            try:
+                wfile = sock.makefile("wb")
+                _send(wfile, {"hello": {"rv": self.rv, "term": self.term}})
+            finally:
+                sock.close()
+        except OSError:
+            pass
         if self.on_promote is not None:
             try:
                 self.on_promote(srv)

@@ -9,15 +9,27 @@ cd "$(dirname "$0")/.."
 LOG=experiments/watchdog.log
 mkdir -p experiments
 echo "$(date -u +%FT%TZ) watchdog start" >> "$LOG"
-while true; do
+# a re-wedge mid-run must not end the watchdog: every arm checkpoints, so
+# retrying from the probe is cheap — only a fully successful pass breaks
+# (attempts bounded so a half-alive tunnel can't churn forever)
+ATTEMPTS=0
+while [ "$ATTEMPTS" -lt 12 ]; do
   if timeout 75 python -c "import jax, jax.numpy as jnp; jax.jit(lambda v: v+1)(jnp.ones((8,8))).block_until_ready(); import sys; sys.exit(0 if jax.devices()[0].platform=='tpu' else 1)" >> "$LOG" 2>&1; then
-    echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments" >> "$LOG"
+    ATTEMPTS=$((ATTEMPTS + 1))
+    echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments (attempt $ATTEMPTS)" >> "$LOG"
     timeout 3600 python scripts/tpu_experiments.py all >> "$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) experiments rc=$? - running bench" >> "$LOG"
+    EXP_RC=$?
+    echo "$(date -u +%FT%TZ) experiments rc=$EXP_RC - running bench" >> "$LOG"
     timeout 1800 python bench.py >> "$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) bench rc=$? - watchdog done" >> "$LOG"
-    break
+    BENCH_RC=$?
+    echo "$(date -u +%FT%TZ) bench rc=$BENCH_RC" >> "$LOG"
+    if [ "$EXP_RC" -eq 0 ] && [ "$BENCH_RC" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) full pass complete - watchdog done" >> "$LOG"
+      break
+    fi
+    echo "$(date -u +%FT%TZ) incomplete pass (tunnel re-wedge?) - re-probing" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) tunnel still wedged" >> "$LOG"
   fi
-  echo "$(date -u +%FT%TZ) tunnel still wedged" >> "$LOG"
   sleep 240
 done
