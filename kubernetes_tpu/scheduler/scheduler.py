@@ -210,6 +210,12 @@ class Scheduler:
         self._pending: List[_InFlightBatch] = []
         # resolved by start() when cfg.pipeline_depth == 0 (auto)
         self._pipeline_depth = self.cfg.pipeline_depth or 2
+        # auto batch size: TPU backends take the big batch (template-shaped
+        # kernel: near-free on device, divides the fixed sync cost), CPU
+        # keeps the small one (its kernel compute scales with the batch)
+        self._batch_size = self.cfg.device_batch_size or (
+            4096 if jax.default_backend() == "tpu" else 1024
+        )
         self._busy = False  # scheduling loop mid-batch (wait_for_idle)
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
@@ -368,7 +374,7 @@ class Scheduler:
             # observe "queue empty, nothing in flight" while a popped
             # batch is still on its way into the pipeline
             pis = self.queue.pop_batch(
-                self.cfg.device_batch_size,
+                self._batch_size,
                 timeout=0.0 if inflight else 0.2,
                 window=0.0 if inflight else self.cfg.device_batch_window,
                 on_first=self._mark_busy,
@@ -574,8 +580,8 @@ class Scheduler:
         # two padded-batch buckets: ragged tails use a small lattice, bursts
         # the full one. Exactly two jit variants per wave count — each extra
         # bucket is another multi-second XLA compile on first use
-        small = min(256, self.cfg.device_batch_size)
-        pad = small if len(pis) <= small else self.cfg.device_batch_size
+        small = min(256, self._batch_size)
+        pad = small if len(pis) <= small else self._batch_size
         # encode → drain-check → flush must be ATOMIC under the cache lock:
         # a dirty-row scatter uploads full rows from the host masters, which
         # must already include the in-flight batch's replayed placements or

@@ -107,22 +107,30 @@ def _result_line(step: str, r, extra=None) -> None:
 
 
 def traces() -> None:
-    """Baseline 5k suite with granular stage traces on stderr."""
-    _warm()
-    r = _run("SchedulingPodAffinity/5000")
-    _result_line("traces-baseline-1024", r)
+    """Baseline 5k suite with granular stage traces on stderr. PINNED to
+    batch 1024: the auto default resolves to 4096 on TPU, and the
+    batchsize arms need a real 1024 baseline to compare against."""
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    sc = KubeSchedulerConfiguration(device_batch_size=1024)
+    _warm(sched_config=sc)
+    r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+    _result_line("traces-baseline-1024", r, {"device_batch_size": 1024})
 
 
 def batchsize() -> None:
-    """device_batch_size 4096 vs the 1024 default (PERFORMANCE.md step 1)."""
+    """device_batch_size 4096 and 8192 vs the 1024 default (PERFORMANCE.md
+    step 1): the kernel is template-shaped, so batch growth is near-free on
+    device and divides the per-cycle fixed cost."""
     from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
 
-    # 1024 is the default config: traces() already measured it — only the
-    # 4096 arm runs here (each 5k suite is minutes of tunnel time)
-    sc = KubeSchedulerConfiguration(device_batch_size=4096)
-    _warm(sched_config=sc)
-    r = _run("SchedulingPodAffinity/5000", sched_config=sc)
-    _result_line("batchsize-4096", r, {"device_batch_size": 4096})
+    # traces() pins the 1024 baseline; the auto default already resolves
+    # to 4096 on TPU — these arms measure it and the next doubling
+    for bs in (4096, 8192):
+        sc = KubeSchedulerConfiguration(device_batch_size=bs)
+        _warm(sched_config=sc)
+        r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+        _result_line(f"batchsize-{bs}", r, {"device_batch_size": bs})
 
 
 def pipeline() -> None:
