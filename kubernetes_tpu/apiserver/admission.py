@@ -155,6 +155,176 @@ class PodSecurityPolicyAdmission(AdmissionPlugin):
         )
 
 
+class ExtendedResourceTolerationAdmission(AdmissionPlugin):
+    """Pods requesting extended resources get tolerations for taints keyed
+    by those resources (plugin/pkg/admission/extendedresourcetoleration):
+    the TPU-shaped flow — nodes carrying tpu.dev/chip advertise a matching
+    NoSchedule taint so ordinary pods stay off the accelerator pool, and
+    chip-requesting pods tolerate it automatically."""
+
+    name = "ExtendedResourceToleration"
+
+    BUILTIN = frozenset({"cpu", "memory", "ephemeral-storage", "pods"})
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        wanted = set()
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for name in list(c.requests) + list(c.limits):
+                if "/" in name and name not in self.BUILTIN:
+                    wanted.add(name)
+        for res_name in sorted(wanted):
+            taint = v1.Taint(res_name, "", v1.TAINT_NO_SCHEDULE)
+            # effect/operator-aware: a key-matching toleration with the
+            # wrong effect would NOT tolerate the pool's NoSchedule taint
+            if any(t.tolerates(taint) for t in obj.spec.tolerations):
+                continue
+            obj.spec.tolerations.append(
+                v1.Toleration(
+                    key=res_name, operator="Exists", effect="NoSchedule"
+                )
+            )
+
+
+class PodNodeSelectorAdmission(AdmissionPlugin):
+    """Namespace-pinned node selectors (plugin/pkg/admission/podnodeselector):
+    the namespace's scheduler.alpha.kubernetes.io/node-selector annotation
+    merges into every pod created there; conflicts are denied."""
+
+    name = "PodNodeSelector"
+    ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+    def __init__(self, server):
+        self.server = server
+
+    def _ns_selector(self, namespace: str) -> dict:
+        try:
+            ns = self.server.get("namespaces", "", namespace)
+        except Exception:
+            return {}
+        raw = ns.metadata.annotations.get(self.ANNOTATION, "")
+        out = {}
+        for part in raw.split(","):
+            if "=" in part:
+                k, _, val = part.partition("=")
+                out[k.strip()] = val.strip()
+        return out
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        # create merges the pin; update re-verifies it (a PUT rewriting the
+        # selector must not escape the namespace pin before scheduling)
+        if verb not in ("create", "update") or resource != "pods":
+            return
+        sel = self._ns_selector(obj.metadata.namespace)
+        for k, val in sel.items():
+            if obj.spec.node_selector.get(k, val) != val:
+                raise AdmissionDenied(
+                    f"pod node selector {k}={obj.spec.node_selector[k]} "
+                    f"conflicts with namespace selector {k}={val}"
+                )
+            obj.spec.node_selector[k] = val
+
+
+class PodTolerationRestrictionAdmission(AdmissionPlugin):
+    """Namespace toleration whitelists (plugin/pkg/admission/
+    podtolerationrestriction): a pod may only carry tolerations the
+    namespace's whitelist annotation allows (JSON list of {key} objects;
+    no annotation = everything allowed).
+
+    Registered as a MUTATING-phase gate ordered BEFORE the toleration
+    injectors (DefaultTolerationSeconds, ExtendedResourceToleration) —
+    the upstream ordering — so it judges only USER-supplied tolerations,
+    never the chain's own additions. On update, only NEWLY ADDED
+    toleration keys are checked (the stored pod legitimately carries
+    chain-injected keys from create)."""
+
+    name = "PodTolerationRestriction"
+    WHITELIST = "scheduler.alpha.kubernetes.io/defaultTolerationsWhitelist"
+
+    def __init__(self, server):
+        self.server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb not in ("create", "update") or resource != "pods":
+            return
+        import json as _json
+
+        try:
+            ns = self.server.get("namespaces", "", obj.metadata.namespace)
+        except Exception:
+            return
+        raw = ns.metadata.annotations.get(self.WHITELIST, "")
+        if not raw:
+            return
+        try:
+            allowed = {e.get("key", "") for e in _json.loads(raw)}
+        except (ValueError, AttributeError):
+            return  # malformed whitelist: fail open like a missing one
+        exempt: set = set()
+        if verb == "update":
+            try:
+                cur = self.server.get(
+                    "pods", obj.metadata.namespace, obj.metadata.name
+                )
+                exempt = {t.key for t in cur.spec.tolerations}
+            except Exception:
+                pass
+        for t in obj.spec.tolerations:
+            if t.key not in allowed and t.key not in exempt:
+                raise AdmissionDenied(
+                    f"toleration {t.key!r} is not whitelisted in namespace "
+                    f"{obj.metadata.namespace}"
+                )
+
+
+class PVCResizeAdmission(AdmissionPlugin):
+    """PVC expansion gate (plugin/pkg/admission/storage/
+    persistentvolumeclaimresize): size may only GROW, and only when the
+    claim's StorageClass allows expansion."""
+
+    name = "PersistentVolumeClaimResize"
+
+    def __init__(self, server):
+        self.server = server
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "update" or resource != "persistentvolumeclaims":
+            return
+        from ..api.resources import parse_quantity
+
+        try:
+            cur = self.server.get(
+                "persistentvolumeclaims",
+                obj.metadata.namespace,
+                obj.metadata.name,
+            )
+        except Exception:
+            return
+        old_size = parse_quantity(cur.spec.resources.get("storage", 0))
+        new_size = parse_quantity(obj.spec.resources.get("storage", 0))
+        if new_size == old_size:
+            return
+        if new_size < old_size:
+            raise AdmissionDenied("persistent volume claims may not shrink")
+        # the BOUND class decides (the incoming object could swap in an
+        # expandable class in the same update to dodge the gate; nothing
+        # else enforces storage-class immutability here)
+        sc_name = cur.spec.storage_class_name
+        if not sc_name:
+            raise AdmissionDenied(
+                "only claims with an expandable StorageClass may be resized"
+            )
+        try:
+            sc = self.server.get("storageclasses", "", sc_name)
+        except Exception:
+            raise AdmissionDenied(f"storage class {sc_name!r} not found")
+        if not sc.allow_volume_expansion:
+            raise AdmissionDenied(
+                f"storage class {sc_name!r} does not allow volume expansion"
+            )
+
+
 def pod_matches_scopes(pod, scopes) -> bool:
     """Quota scope selection (podMatchesScopeFunc): a scoped quota tracks
     and limits only matching pods. BestEffort = no container requests or

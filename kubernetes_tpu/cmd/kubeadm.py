@@ -70,8 +70,12 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
     unauthenticated, admission-free API server). Returns (authn, authz)
     and installs the admit-hook chain on the store."""
     from ..apiserver.admission import (
+        ExtendedResourceTolerationAdmission,
         NodeRestrictionAdmission,
+        PodNodeSelectorAdmission,
         PodSecurityPolicyAdmission,
+        PodTolerationRestrictionAdmission,
+        PVCResizeAdmission,
     )
     from ..apiserver.auth import (
         MASTERS_GROUP,
@@ -132,7 +136,13 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
                 ServiceAccountAdmission(),
                 PriorityAdmission(store),
                 DefaultStorageClassAdmission(store),
+                # the whitelist gate runs BEFORE the toleration injectors
+                # (upstream ordering): it judges user-supplied tolerations
+                # only, never the chain's own additions
+                PodTolerationRestrictionAdmission(store),
                 DefaultTolerationSecondsAdmission(),
+                ExtendedResourceTolerationAdmission(),
+                PodNodeSelectorAdmission(store),
                 LimitRangerAdmission(store),
                 MutatingWebhookAdmission(store),
             ],
@@ -140,6 +150,7 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
                 NamespaceLifecycleAdmission(store),
                 NodeRestrictionAdmission(),
                 PodSecurityPolicyAdmission(store),
+                PVCResizeAdmission(store),
                 LimitRangerAdmission(store),
                 QuotaAdmission(store),
                 ValidatingWebhookAdmission(store),

@@ -196,3 +196,190 @@ def test_scoped_quota_status_tracks_matching_usage_only():
         compute_namespace_usage(store, "default", ["NotBestEffort"])["pods"]
         == 1
     )
+
+
+def test_extended_resource_toleration_tpu_flow():
+    """The TPU-shaped admission flow: chip-requesting pods automatically
+    tolerate the accelerator pool's resource-keyed taint."""
+    from kubernetes_tpu.apiserver.admission import (
+        ExtendedResourceTolerationAdmission,
+    )
+
+    plugin = ExtendedResourceTolerationAdmission()
+    pod = _pod(
+        "chips",
+        containers=[v1.Container(requests={"tpu.dev/chip": "4", "cpu": "1"})],
+    )
+    plugin.mutate("create", "pods", pod)
+    tols = {t.key: t for t in pod.spec.tolerations}
+    assert "tpu.dev/chip" in tols
+    assert tols["tpu.dev/chip"].operator == "Exists"
+    assert tols["tpu.dev/chip"].effect == "NoSchedule"
+    # idempotent; plain pods untouched
+    plugin.mutate("create", "pods", pod)
+    assert len([t for t in pod.spec.tolerations if t.key == "tpu.dev/chip"]) == 1
+    plain = _pod("plain")
+    plugin.mutate("create", "pods", plain)
+    assert plain.spec.tolerations == []
+
+
+def test_pod_node_selector_merges_and_conflicts():
+    from kubernetes_tpu.apiserver.admission import PodNodeSelectorAdmission
+
+    store = APIServer()
+    store.create(
+        "namespaces",
+        v1.Namespace(
+            metadata=v1.ObjectMeta(
+                name="default",
+                namespace="",
+                annotations={
+                    PodNodeSelectorAdmission.ANNOTATION: "pool=gpu, tier=prod"
+                },
+            )
+        ),
+    )
+    plugin = PodNodeSelectorAdmission(store)
+    pod = _pod("p")
+    plugin.mutate("create", "pods", pod)
+    assert pod.spec.node_selector == {"pool": "gpu", "tier": "prod"}
+    clash = _pod("q")
+    clash.spec.node_selector = {"pool": "cpu"}
+    with pytest.raises(AdmissionDenied, match="conflicts"):
+        plugin.mutate("create", "pods", clash)
+
+
+def test_pod_toleration_restriction_whitelist():
+    import json as _json
+
+    from kubernetes_tpu.apiserver.admission import (
+        PodTolerationRestrictionAdmission,
+    )
+
+    store = APIServer()
+    store.create(
+        "namespaces",
+        v1.Namespace(
+            metadata=v1.ObjectMeta(
+                name="default",
+                namespace="",
+                annotations={
+                    PodTolerationRestrictionAdmission.WHITELIST: _json.dumps(
+                        [{"key": "dedicated"}]
+                    )
+                },
+            )
+        ),
+    )
+    plugin = PodTolerationRestrictionAdmission(store)
+    ok = _pod("ok")
+    ok.spec.tolerations = [v1.Toleration(key="dedicated", operator="Exists")]
+    plugin.mutate("create", "pods", ok)
+    bad = _pod("bad")
+    bad.spec.tolerations = [v1.Toleration(key="other", operator="Exists")]
+    with pytest.raises(AdmissionDenied, match="not whitelisted"):
+        plugin.mutate("create", "pods", bad)
+    # the PUT bypass is closed: adding a new non-whitelisted key on update
+    store.create("pods", ok)
+    upd = store.get("pods", "default", "ok")
+    upd.spec.tolerations = list(upd.spec.tolerations) + [
+        v1.Toleration(key="sneaky", operator="Exists")
+    ]
+    with pytest.raises(AdmissionDenied, match="not whitelisted"):
+        plugin.mutate("update", "pods", upd)
+    # but keys already on the stored pod (chain-injected at create) pass
+    upd2 = store.get("pods", "default", "ok")
+    plugin.mutate("update", "pods", upd2)
+
+
+def test_pvc_resize_gate():
+    from kubernetes_tpu.apiserver.admission import PVCResizeAdmission
+
+    store = APIServer()
+    store.create(
+        "storageclasses",
+        v1.StorageClass(
+            metadata=v1.ObjectMeta(name="fast", namespace=""),
+            allow_volume_expansion=True,
+        ),
+    )
+    store.create(
+        "storageclasses",
+        v1.StorageClass(metadata=v1.ObjectMeta(name="fixed", namespace="")),
+    )
+    pvc = v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="c1"),
+        spec=v1.PersistentVolumeClaimSpec(
+            resources={"storage": "10Gi"}, storage_class_name="fast"
+        ),
+    )
+    store.create("persistentvolumeclaims", pvc)
+    plugin = PVCResizeAdmission(store)
+    grown = store.get("persistentvolumeclaims", "default", "c1")
+    grown.spec.resources = {"storage": "20Gi"}
+    plugin.validate("update", "persistentvolumeclaims", grown)  # allowed
+    shrunk = store.get("persistentvolumeclaims", "default", "c1")
+    shrunk.spec.resources = {"storage": "5Gi"}
+    with pytest.raises(AdmissionDenied, match="shrink"):
+        plugin.validate("update", "persistentvolumeclaims", shrunk)
+    # inexpandable class refuses growth
+    pvc2 = v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="c2"),
+        spec=v1.PersistentVolumeClaimSpec(
+            resources={"storage": "10Gi"}, storage_class_name="fixed"
+        ),
+    )
+    store.create("persistentvolumeclaims", pvc2)
+    g2 = store.get("persistentvolumeclaims", "default", "c2")
+    g2.spec.resources = {"storage": "20Gi"}
+    with pytest.raises(AdmissionDenied, match="does not allow"):
+        plugin.validate("update", "persistentvolumeclaims", g2)
+
+
+def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
+    """Through the REAL assembled chain: a whitelisted namespace still
+    admits plain pods (the chain's own not-ready/unreachable injections
+    are not judged) and chip pods still get their resource toleration."""
+    import json as _json
+
+    from kubernetes_tpu.cmd.kubeadm import assemble_security
+    from kubernetes_tpu.apiserver.admission import (
+        PodTolerationRestrictionAdmission,
+    )
+
+    store = APIServer()
+    assemble_security(store, admin_token="t")
+    store.create(
+        "namespaces",
+        v1.Namespace(
+            metadata=v1.ObjectMeta(
+                name="wl",
+                namespace="",
+                annotations={
+                    PodTolerationRestrictionAdmission.WHITELIST: _json.dumps(
+                        [{"key": "dedicated"}]
+                    )
+                },
+            )
+        ),
+    )
+    p = v1.Pod(
+        metadata=v1.ObjectMeta(name="plain", namespace="wl"),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"tpu.dev/chip": "1"})]
+        ),
+    )
+    store.create("pods", p)  # must NOT be rejected
+    stored = store.get("pods", "wl", "plain")
+    keys = {t.key for t in stored.spec.tolerations}
+    assert "tpu.dev/chip" in keys  # injector still ran (after the gate)
+    # a USER-supplied non-whitelisted toleration is still denied
+    q = v1.Pod(
+        metadata=v1.ObjectMeta(name="bad", namespace="wl"),
+        spec=v1.PodSpec(
+            containers=[v1.Container()],
+            tolerations=[v1.Toleration(key="other", operator="Exists")],
+        ),
+    )
+    with pytest.raises(AdmissionDenied, match="not whitelisted"):
+        store.create("pods", q)
