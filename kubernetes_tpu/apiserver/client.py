@@ -56,6 +56,29 @@ class RESTClient:
                 raise NotFound(msg) from None
             raise RuntimeError(msg) from None
 
+    def post_text(self, resource: str, namespace: str, name: str, body: dict) -> str:
+        """Plain-text POST to a subresource (pods/{name}/exec): same URL
+        scheme, headers, timeout, and error mapping as the JSON path."""
+        req = urllib.request.Request(
+            self._url(resource, namespace, name),
+            data=json.dumps(body).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json", **self._headers},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except Exception:
+                pass
+            msg = payload.get("message", str(e))
+            if e.code == 404:
+                raise NotFound(msg) from None
+            raise RuntimeError(msg) from None
+
     def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(

@@ -567,6 +567,34 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         try:
             body = self._read_body()
+            if resource == "pods" and name and name.endswith("/exec"):
+                # pods/{name}/exec subresource (ExecSync through the pod's
+                # kubelet); body: {"command": [...]} — plain-text reply
+                cmd = body.get("command") or []
+                if (
+                    not isinstance(cmd, list)
+                    or not cmd
+                    or not all(isinstance(c, str) for c in cmd)
+                ):
+                    return self._status_error(
+                        400, "BadRequest", "exec body needs a list of strings"
+                    )
+                try:
+                    out = self.store.pod_exec(
+                        ns or "default", name[: -len("/exec")], cmd
+                    )
+                except NotImplementedError:
+                    return self._status_error(
+                        501, "NotImplemented", "runtime does not support exec"
+                    )
+                data = out.encode()
+                self.send_response(200)
+                self._last_code = 200
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if resource == "pods" and name and name.endswith("/binding"):
                 b = codec.from_dict(Binding, body)
                 pod_name = name.rsplit("/", 1)[0]

@@ -72,10 +72,12 @@ class APIServer:
         # a deposed primary gets when a higher term appears
         self.replicator = None
         self.read_only = False
-        # node name -> callable(pod_key, tail_lines) -> str: the kubelet's
-        # log surface (kubectl logs flows apiserver -> kubelet -> runtime
-        # GetContainerLogs in the reference; node agent pools register here)
+        # node name -> callable(pod_key, ...) -> str: the kubelet's log and
+        # exec surfaces (kubectl logs/exec flow apiserver -> kubelet ->
+        # runtime GetContainerLogs/ExecSync in the reference; node agent
+        # pools register here)
         self.log_providers: Dict[str, Callable] = {}
+        self.exec_providers: Dict[str, Callable] = {}
 
     @classmethod
     def recover(cls, wal_path: str, watch_history: int = 200000) -> "APIServer":
@@ -324,6 +326,21 @@ class APIServer:
         if provider is None:
             raise NotFound(f"no log provider for node {node}")
         return provider(f"{namespace}/{name}", tail_lines)
+
+    def pod_exec(self, namespace: str, name: str, command) -> str:
+        """pods/{name}/exec subresource: ExecSync through the pod's node's
+        registered exec provider (the kubelet hop of kubectl exec)."""
+        pod = self.get("pods", namespace, name)
+        node = pod.spec.node_name
+        if not node:
+            raise NotFound(f"pod {namespace}/{name} is not scheduled")
+        provider = self.exec_providers.get(node)
+        if provider is None:
+            raise NotFound(f"no exec provider for node {node}")
+        try:
+            return provider(f"{namespace}/{name}", command)
+        except KeyError as e:
+            raise NotFound(str(e)) from None
 
     def exists(self, kind: str, key: str) -> bool:
         """O(1) copy-free presence check by store key ("ns/name")."""

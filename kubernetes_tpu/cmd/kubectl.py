@@ -525,6 +525,24 @@ def cmd_logs(client: RESTClient, args) -> int:
     return 0
 
 
+def cmd_exec(client: RESTClient, args) -> int:
+    """kubectl exec: POST pods/{name}/exec (ExecSync through the pod's
+    kubelet; kubectl/pkg/cmd/exec)."""
+    try:
+        sys.stdout.write(
+            client.post_text(
+                "pods",
+                args.namespace,
+                f"{args.name}/exec",
+                {"command": args.command},
+            )
+        )
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_cordon(client: RESTClient, args, unschedulable=True) -> int:
     def mutate(n):
         n.spec.unschedulable = unschedulable
@@ -1038,6 +1056,9 @@ def main(argv=None) -> int:
     p_logs = sub.add_parser("logs")
     p_logs.add_argument("name")
     p_logs.add_argument("--tail", type=int, default=None)
+    p_exec = sub.add_parser("exec")
+    p_exec.add_argument("name")
+    p_exec.add_argument("command", nargs="+")
     p_create = sub.add_parser("create")
     p_create.add_argument("-f", "--filename", required=True)
     p_del = sub.add_parser("delete")
@@ -1117,6 +1138,8 @@ def main(argv=None) -> int:
             return cmd_kustomize(client, args)
         if args.verb == "logs":
             return cmd_logs(client, args)
+        if args.verb == "exec":
+            return cmd_exec(client, args)
         if args.verb == "apply":
             return cmd_apply(client, args)
         if args.verb == "create":

@@ -533,11 +533,14 @@ class NodeAgentPool:
         )
         with self._lock:
             self.kubelets[name] = kl
-        # surface the node's logs to the apiserver (kubectl logs hop);
-        # remote clients (joined pools) have no provider registry
+        # surface the node's logs/exec to the apiserver (kubectl logs/exec
+        # hop); remote clients (joined pools) have no provider registry
         providers = getattr(self.server, "log_providers", None)
         if providers is not None:
             providers[name] = kl.runtime.logs
+        execs = getattr(self.server, "exec_providers", None)
+        if execs is not None:
+            execs[name] = kl.runtime.exec
         return kl
 
     def remove_node(self, name: str) -> None:
@@ -545,9 +548,10 @@ class NodeAgentPool:
         nodelifecycle to notice the missed heartbeats)."""
         with self._lock:
             self.kubelets.pop(name, None)
-        providers = getattr(self.server, "log_providers", None)
-        if providers is not None:
-            providers.pop(name, None)
+        for attr in ("log_providers", "exec_providers"):
+            providers = getattr(self.server, attr, None)
+            if providers is not None:
+                providers.pop(name, None)
 
     # -- lifecycle -----------------------------------------------------------
 

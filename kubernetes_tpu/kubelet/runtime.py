@@ -54,6 +54,12 @@ class PodRuntime:
         reaches through the kubelet). Default: empty."""
         return ""
 
+    def exec(self, pod_key: str, command) -> str:
+        """One-shot command execution in the pod's sandbox (the ExecSync
+        surface kubectl exec reaches through the kubelet). Default:
+        unsupported."""
+        raise NotImplementedError("runtime does not support exec")
+
 
 class _FakePod:
     __slots__ = ("ip", "started", "run_seconds", "fail", "ready_after", "unhealthy_after")
@@ -157,3 +163,19 @@ class FakeRuntime(PodRuntime):
         if tail_lines is not None:
             lines = lines[-tail_lines:] if tail_lines > 0 else []
         return "\n".join(lines) + "\n" if lines else ""
+
+    def exec(self, pod_key: str, command) -> str:
+        """ExecSync against the fake sandbox: a few built-in commands give
+        tests something real to assert on; everything else echoes."""
+        with self._lock:
+            fp = self._pods.get(pod_key)
+        if fp is None:
+            raise KeyError(f"pod {pod_key} has no running sandbox")
+        cmd = list(command)
+        if cmd[:1] == ["hostname"]:
+            return pod_key.rsplit("/", 1)[-1] + "\n"
+        if cmd[:1] == ["ip"]:
+            return fp.ip + "\n"
+        if cmd[:1] == ["echo"]:
+            return " ".join(cmd[1:]) + "\n"
+        return f"[fake-runtime] exec: {' '.join(cmd)}\n"

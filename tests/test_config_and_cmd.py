@@ -703,3 +703,47 @@ def test_kubectl_logs_via_log_subresource(capsys):
     finally:
         pool.stop()
         srv.shutdown()
+
+
+def test_kubectl_exec_via_exec_subresource(capsys):
+    """kubectl exec flows apiserver -> node exec provider -> runtime
+    ExecSync (reference kubectl/pkg/cmd/exec)."""
+    import time as _time
+
+    from kubernetes_tpu.api import objects as _v1
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.cmd import kubectl
+    from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
+
+    srv, port, store = serve()
+    pool = NodeAgentPool(server=store, housekeeping_interval=0.05)
+    try:
+        pool.add_node("n0")
+        pool.start()
+        store.create(
+            "pods",
+            _v1.Pod(
+                metadata=_v1.ObjectMeta(name="sh"),
+                spec=_v1.PodSpec(
+                    node_name="n0", containers=[_v1.Container(name="c")]
+                ),
+            ),
+        )
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if (
+                store.get("pods", "default", "sh").status.phase
+                == _v1.POD_RUNNING
+            ):
+                break
+            _time.sleep(0.05)
+        base = ["--server", f"http://127.0.0.1:{port}"]
+        assert kubectl.main(base + ["exec", "sh", "hostname"]) == 0
+        assert capsys.readouterr().out.strip() == "sh"
+        assert kubectl.main(base + ["exec", "sh", "echo", "hi", "there"]) == 0
+        assert capsys.readouterr().out.strip() == "hi there"
+        # unknown pod: clean error
+        assert kubectl.main(base + ["exec", "ghost", "hostname"]) == 1
+    finally:
+        pool.stop()
+        srv.shutdown()
