@@ -113,3 +113,69 @@ def test_deep_pipeline_device_host_convergence():
             )
     finally:
         sched.stop()
+
+
+def test_readback_failure_requeues_and_recovers(monkeypatch):
+    """A device/tunnel error during the combined readback must requeue the
+    in-flight pods, invalidate the device snapshot (HBM rebuilt from host
+    masters), and let the next cycle schedule them — no pod lost, no
+    double-commit (the chaos case the tunnel wedge makes real)."""
+    import jax
+
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+
+    server = APIServer()
+    for i in range(5):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=f"n{i}", namespace=""),
+                status=v1.NodeStatus(
+                    capacity={"cpu": "16", "memory": "64Gi", "pods": "110"}
+                ),
+            ),
+        )
+    scfg = KubeSchedulerConfiguration(
+        pipeline_depth=2, device_batch_size=64, use_mesh=False
+    )
+    sched = Scheduler(server, scfg)
+
+    real_device_get = jax.device_get
+    fail_once = {"armed": False, "fired": 0}
+
+    def flaky_device_get(x):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            fail_once["fired"] += 1
+            raise RuntimeError("injected tunnel failure")
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", flaky_device_get)
+    sched.start()
+    try:
+        for i in range(100):
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"p{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        import time as _time
+
+        _time.sleep(0.3)
+        fail_once["armed"] = True  # next readback dies
+        deadline = _time.monotonic() + 60.0
+        while _time.monotonic() < deadline:
+            if server.count("pods", lambda p: bool(p.spec.node_name)) == 100:
+                break
+            _time.sleep(0.05)
+        bound = server.count("pods", lambda p: bool(p.spec.node_name))
+        assert bound == 100, f"only {bound}/100 scheduled after injected failure"
+        assert fail_once["fired"] >= 1 or not fail_once["armed"]
+    finally:
+        sched.stop()
