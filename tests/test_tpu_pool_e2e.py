@@ -1,0 +1,146 @@
+"""The TPU accelerator-pool story, end to end (the flow this framework
+exists for): tainted chip nodes advertise extended resources through
+device plugins, chip-requesting pods get tolerations injected by
+admission, the DEVICE scheduler kernel packs them onto the pool
+(extended-resource fit + taints are kernel-side), and the kubelet's
+device manager hands out topology-aligned chips.
+
+Composes: apiserver admission (ExtendedResourceToleration) + scheduler
+(NodeResourcesFit/TaintToleration device components) + kubelet
+(DeviceManager registration/Allocate/topology hints)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.apiserver.admission import (
+    ExtendedResourceTolerationAdmission,
+)
+from kubernetes_tpu.apiserver.auth import AdmissionChain
+from kubernetes_tpu.kubelet.devicemanager import Device, DeviceManager, DevicePluginStub
+from kubernetes_tpu.kubelet.kubelet import Kubelet, make_node_object
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.kubemark.hollow_node import _fake_pod_ip
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+CHIP = "tpu.dev/chip"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = APIServer()
+    server.admit_hooks.append(
+        AdmissionChain(mutating=[ExtendedResourceTolerationAdmission()])
+    )
+    # 2 ordinary nodes + 2 accelerator nodes tainted chip-only
+    for i in range(2):
+        server.create("nodes", make_node_object(f"cpu-{i}"))
+    kubelets = {}
+    managers = []
+    stubs = []
+    for i in range(2):
+        name = f"tpu-{i}"
+        node = make_node_object(name)
+        node.spec.taints = [v1.Taint(CHIP, "", v1.TAINT_NO_SCHEDULE)]
+        server.create("nodes", node)
+        dm = DeviceManager(
+            str(tmp_path / f"{name}.sock"),
+            checkpoint_path=str(tmp_path / f"{name}.state"),
+        )
+        dm.start()
+        stub = DevicePluginStub(
+            dm.socket_path,
+            CHIP,
+            [
+                Device("d0", topology=0),
+                Device("d1", topology=0),
+                Device("d2", topology=1),
+                Device("d3", topology=1),
+            ],
+        )
+        stub.start()
+        kl = Kubelet(server, name, FakeRuntime(_fake_pod_ip), device_manager=dm)
+        deadline = time.monotonic() + 5.0
+        while CHIP not in dm.capacities() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        kl.sync_device_capacity()
+        kubelets[name] = kl
+        managers.append(dm)
+        stubs.append(stub)
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_mesh=False))
+    sched.start()
+    try:
+        yield server, sched, kubelets
+    finally:
+        sched.stop()
+        for s in stubs:
+            s.stop()
+        for dm in managers:
+            dm.stop()
+
+
+def _chip_pod(name, n):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={CHIP: str(n), "cpu": "100m"})]
+        ),
+    )
+
+
+def _wait_bound(server, name, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        p = server.get("pods", "default", name)
+        if p.spec.node_name:
+            return p
+        time.sleep(0.05)
+    raise TimeoutError(f"{name} never scheduled")
+
+
+def test_chip_pods_land_on_the_pool_with_aligned_devices(cluster):
+    server, sched, kubelets = cluster
+    # capacity surfaced: the scheduler sees 4 chips per tpu node
+    for name in ("tpu-0", "tpu-1"):
+        assert server.get("nodes", "", name).status.capacity[CHIP] == 4
+
+    # plain pods stay OFF the tainted pool
+    server.create(
+        "pods",
+        v1.Pod(
+            metadata=v1.ObjectMeta(name="plain"),
+            spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "1"})]),
+        ),
+    )
+    p = _wait_bound(server, "plain")
+    assert p.spec.node_name.startswith("cpu-")
+
+    # chip pods: admission injected the toleration, the kernel placed them
+    # on the pool, the device manager allocates topology-aligned chips
+    for i in range(4):
+        server.create("pods", _chip_pod(f"chip-{i}", 2))
+    placed_nodes = []
+    for i in range(4):
+        p = _wait_bound(server, f"chip-{i}")
+        assert p.spec.node_name.startswith("tpu-"), (
+            f"chip pod landed on {p.spec.node_name}"
+        )
+        assert any(t.key == CHIP for t in p.spec.tolerations)
+        placed_nodes.append(p.spec.node_name)
+        kl = kubelets[p.spec.node_name]
+        kl.handle_pod_event("ADDED", p)
+        ids = kl.device_manager.allocations(p.metadata.key)[CHIP]
+        assert len(ids) == 2
+        doms = {0 if d in ("d0", "d1") else 1 for d in ids}
+        assert len(doms) == 1, f"unaligned allocation {ids}"
+    # 4 pods x 2 chips = 8 chips = both nodes fully used: capacity-exact
+    assert sorted(placed_nodes).count("tpu-0") == 2
+    assert sorted(placed_nodes).count("tpu-1") == 2
+
+    # a 5th chip pod has nowhere to go (pool exhausted): stays pending
+    server.create("pods", _chip_pod("overflow", 2))
+    time.sleep(1.5)
+    assert server.get("pods", "default", "overflow").spec.node_name == ""
