@@ -143,6 +143,8 @@ def main() -> int:
         gang = None
         if not platform.startswith("cpu"):
             try:
+                from kubernetes_tpu.ops import wavelattice
+                from kubernetes_tpu.parallel import sharded
                 from kubernetes_tpu.scheduler.config import (
                     KubeSchedulerConfiguration,
                     ProfileConfig,
@@ -154,11 +156,19 @@ def main() -> int:
                 gcfg = KubeSchedulerConfiguration(
                     profiles=[ProfileConfig(plugin_set=coscheduling_plugin_set())]
                 )
+                v0 = (
+                    wavelattice.make_wave_kernel_jit.cache_info().misses
+                    + sharded.make_sharded_wave_kernel.cache_info().misses
+                )
                 gres = run_benchmark(
                     WORKLOADS["Gang/5000"],
                     sched_config=gcfg,
                     quiet=True,
                     timeout_s=600.0,
+                )
+                v1 = (
+                    wavelattice.make_wave_kernel_jit.cache_info().misses
+                    + sharded.make_sharded_wave_kernel.cache_info().misses
                 )
                 gang = {
                     "workload": "Gang/5000 (300 gangs x 50, min-member 50)",
@@ -166,6 +176,9 @@ def main() -> int:
                     "unscheduled": gres.unscheduled,
                     "duration_s": round(gres.duration_s, 3),
                     "pods_per_s": round(gres.throughput_pods_per_s, 1),
+                    # the r3 wedge was variant churn (one compile per gang
+                    # batch); effect-keyed fingerprints collapse it
+                    "kernel_variant_compiles": v1 - v0,
                 }
             except Exception:
                 traceback.print_exc()
