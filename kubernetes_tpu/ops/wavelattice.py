@@ -116,13 +116,21 @@ def make_wave_kernel(
     n_waves: int = 8,
     hard_pod_affinity_weight: float = 1.0,
     use_pallas_fit: bool = False,
+    score_refresh: bool = True,
 ):
     """Build the wave kernel (unjitted) for the given static capacities.
 
     use_pallas_fit routes the resource-fit mask (Stage A's fits0 and each
     wave's fits_w — the kernel's hottest recomputation) through the fused
     Pallas kernel in ops/pallas_ops.py instead of the XLA [TPL, N, R]
-    broadcast; interpret mode on non-TPU backends keeps it testable."""
+    broadcast; interpret mode on non-TPU backends keeps it testable.
+
+    score_refresh re-evaluates the RESOURCE score components at each pod's
+    candidate nodes every wave (cheap [P, M] gathers) so later waves see
+    in-batch commits in their packing decisions instead of the batch-start
+    snapshot — the serial-fidelity improvement for SURVEY §7 hard part (c);
+    non-resource components stay Stage-A static (their pair counts are the
+    documented in-batch staleness)."""
     if use_pallas_fit:
         from .pallas_ops import fit_mask as _pallas_fit_mask
 
@@ -404,6 +412,32 @@ def make_wave_kernel(
         cand_valid = cand_valid & (tb.pod_name_row != -2)[:, None]
         cand_nodes = jnp.clip(cand_nodes, 0, n - 1)
 
+        if score_refresh:
+            # static pieces of the per-wave candidate re-score: the
+            # NON-resource score residual at each candidate, plus the
+            # batch-start nonzero/alloc cpu+mem columns there
+            w_res = (
+                weights[SC_LEAST_ALLOC] * least
+                + weights[SC_MOST_ALLOC] * most
+                + weights[SC_BALANCED] * balanced
+                + weights[SC_REQ_TO_CAP] * rtc
+            )  # [TPL, N]
+            cand_resid = jnp.take_along_axis(
+                (total_score - w_res)[t_of], cand_nodes, axis=1
+            )  # [P, M]
+            alloc_cpu_c = jnp.maximum(
+                snap.allocatable[:, RES_CPU][cand_nodes].astype(jnp.float32),
+                1.0,
+            )
+            alloc_mem_c = jnp.maximum(
+                snap.allocatable[:, RES_MEM][cand_nodes].astype(jnp.float32),
+                1.0,
+            )
+            nz_cpu0_c = snap.nonzero_req[:, RES_CPU][cand_nodes]
+            nz_mem0_c = snap.nonzero_req[:, RES_MEM][cand_nodes]
+            pod_nz_cpu = tpl.nonzero_req[:, RES_CPU][t_of][:, None]
+            pod_nz_mem = tpl.nonzero_req[:, RES_MEM][t_of][:, None]
+
         # which pods participate in pair exclusivity (contributor or
         # hard-checker), per pair
         checks = jnp.zeros((TPL, J), bool)
@@ -435,7 +469,7 @@ def make_wave_kernel(
 
         # ================= Stage B: waves =================
         def wave(_, state):
-            placed, chosen, req_d, port_d, dom_d = state
+            placed, chosen, req_d, port_d, dom_d, nz2_d = state
             free_d = free0 - req_d  # [N, R]
             fits_w = _fit(tpl.req, free_d)
             ports_w = jnp.any(
@@ -461,7 +495,39 @@ def make_wave_kernel(
                 jnp.take_along_axis(wave_feas[t_of], cand_nodes, axis=1)
                 & cand_valid
             )  # [P, M]
-            first = jnp.argmax(cand_feas, axis=1)
+            if score_refresh:
+                # re-evaluate the resource scores at the candidates with
+                # this wave's committed occupancy; the candidate list is
+                # pre-shuffled within equal-static-score groups, so a
+                # plain argmax inherits the uniform tie-break
+                cpu_f_c = jnp.clip(
+                    (nz_cpu0_c + nz2_d[:, 0][cand_nodes] + pod_nz_cpu)
+                    .astype(jnp.float32)
+                    / alloc_cpu_c,
+                    0.0,
+                    1.0,
+                )
+                mem_f_c = jnp.clip(
+                    (nz_mem0_c + nz2_d[:, 1][cand_nodes] + pod_nz_mem)
+                    .astype(jnp.float32)
+                    / alloc_mem_c,
+                    0.0,
+                    1.0,
+                )
+                res_c = (
+                    weights[SC_LEAST_ALLOC]
+                    * (((1.0 - cpu_f_c) + (1.0 - mem_f_c)) * 50.0)
+                    + weights[SC_MOST_ALLOC] * ((cpu_f_c + mem_f_c) * 50.0)
+                    + weights[SC_BALANCED]
+                    * ((1.0 - jnp.abs(cpu_f_c - mem_f_c)) * 100.0)
+                    + weights[SC_REQ_TO_CAP] * ((cpu_f_c + mem_f_c) * 50.0)
+                )
+                score_c = jnp.where(
+                    cand_feas, cand_resid + res_c, -jnp.inf
+                )  # [P, M]
+                first = jnp.argmax(score_c, axis=1)
+            else:
+                first = jnp.argmax(cand_feas, axis=1)
             has = jnp.any(cand_feas, axis=1)
             cand_n = cand_nodes[jnp.arange(P), first]
             active = tb.pod_valid & ~placed & has
@@ -545,6 +611,16 @@ def make_wave_kernel(
             port_d = port_d.at[ci].add(
                 tpl.port_mask[t_of].astype(jnp.int32), mode="drop"
             )
+            nz2_d = nz2_d.at[ci].add(
+                jnp.stack(
+                    [
+                        tpl.nonzero_req[:, RES_CPU],
+                        tpl.nonzero_req[:, RES_MEM],
+                    ],
+                    axis=1,
+                )[t_of],
+                mode="drop",
+            )
             contrib_p = pt.contrib[t_of] * commit[:, None]  # [P, J]
             dd_key = jnp.where(
                 (pod_dom >= 0) & (contrib_p != 0),
@@ -559,7 +635,7 @@ def make_wave_kernel(
             )
             placed = placed | commit
             chosen = jnp.where(commit, cand_n, chosen)
-            return placed, chosen, req_d, port_d, dom_d
+            return placed, chosen, req_d, port_d, dom_d, nz2_d
 
         state0 = (
             jnp.zeros(P, bool),
@@ -567,11 +643,12 @@ def make_wave_kernel(
             jnp.zeros_like(snap.requested),
             jnp.zeros_like(snap.port_counts),
             jnp.zeros((J, v_cap), jnp.float32),
+            jnp.zeros((n, 2), snap.nonzero_req.dtype),
         )
         # Static trip count on purpose: a data-dependent while_loop hangs the
         # axon PJRT tunnel (empirically — even a trivial one never returns).
         # The host picks n_waves per batch shape instead (scheduler.py).
-        placed, chosen, req_d, port_d, dom_d = jax.lax.fori_loop(
+        placed, chosen, req_d, port_d, dom_d, _nz2_d = jax.lax.fori_loop(
             0, n_waves, wave, state0
         )
 
@@ -629,10 +706,16 @@ def make_wave_kernel_jit(
     n_waves: int = 8,
     hard_pod_affinity_weight: float = 1.0,
     use_pallas_fit: bool = False,
+    score_refresh: bool = True,
 ):
     return jax.jit(
         make_wave_kernel(
-            v_cap, m_cand, n_waves, hard_pod_affinity_weight, use_pallas_fit
+            v_cap,
+            m_cand,
+            n_waves,
+            hard_pod_affinity_weight,
+            use_pallas_fit,
+            score_refresh,
         ),
         donate_argnums=(0,),
     )

@@ -74,6 +74,7 @@ def make_sharded_wave_kernel(
     hard_pod_affinity_weight: float,
     mesh: Mesh,
     use_pallas_fit: bool = False,
+    score_refresh: bool = True,
 ):
     """The PRODUCTION wave kernel (ops/wavelattice.py) jitted with the
     snapshot sharded over the mesh's node axis.
@@ -94,7 +95,12 @@ def make_sharded_wave_kernel(
     (generic_scheduler.go:490) with ICI collectives instead of goroutines.
     """
     base = make_wave_kernel(
-        v_cap, m_cand, n_waves, hard_pod_affinity_weight, use_pallas_fit
+        v_cap,
+        m_cand,
+        n_waves,
+        hard_pod_affinity_weight,
+        use_pallas_fit,
+        score_refresh,
     )
     rep = replicated(mesh)
     snap_sh = snapshot_shardings(mesh)

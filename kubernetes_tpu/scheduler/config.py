@@ -67,6 +67,15 @@ class KubeSchedulerConfiguration:
     # through the fused Pallas kernel (ops/pallas_ops.py) instead of the
     # XLA broadcast; off by default pending on-hardware measurement
     use_pallas_fit: bool = False
+    # per-wave resource-score refresh at candidate nodes: later waves see
+    # in-batch commits in their packing decisions (serial fidelity) for a
+    # few cheap [P, M] gathers per wave. Default-ON deliberately (unlike
+    # use_pallas_fit, whose benefit is hardware-only): the behavior is the
+    # CORRECTNESS-fidelity direction, its cost is O(P·M) per wave — noise
+    # next to the [TPL, N] stages — and it is pinned by a CPU test
+    # (test_wave_score_refresh_sees_in_batch_commits). Off = batch-start
+    # scores only (the round-3 behavior, kept for A/B).
+    wave_score_refresh: bool = True
     # debug: cross-check every device placement against the HOST filter
     # chain per cycle (SURVEY §5's per-cycle verify mode — the live
     # analogue of the offline differential fuzz). Costs a host snapshot +

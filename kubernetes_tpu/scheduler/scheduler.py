@@ -629,6 +629,7 @@ class Scheduler:
                 self.cfg.hard_pod_affinity_weight,
                 self._mesh,
                 self.cfg.use_pallas_fit,
+                self.cfg.wave_score_refresh,
             )
         else:
             kern = make_wave_kernel_jit(
@@ -637,6 +638,7 @@ class Scheduler:
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self.cfg.use_pallas_fit,
+                self.cfg.wave_score_refresh,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:

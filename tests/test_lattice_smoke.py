@@ -333,3 +333,61 @@ def test_add_pods_bulk_matches_sequential():
     assert set(
         (r, k) for r, d in a._pods.items() for k in d
     ) == set((r, k) for r, d in b._pods.items() for k in d)
+
+
+def test_wave_score_refresh_sees_in_batch_commits():
+    """Serial-fidelity (SURVEY §7 hard part (c)): a pod committing in a
+    LATER wave must score nodes with the batch's earlier commits applied.
+    Setup: n1 (10 cpu) statically beats n2 (9 cpu); two 6-cpu pods and a
+    1-cpu pod batch together. The 6-cpu pair forces the small pod past
+    wave 1 (prefix-fit conservatism); with refresh it then prefers the
+    emptier n2, without refresh it returns to the statically-best n1."""
+    from kubernetes_tpu.ops.lattice import (
+        NUM_SCORE_COMPONENTS,
+        SC_LEAST_ALLOC,
+    )
+    from kubernetes_tpu.ops.templates import TemplateCache, build_pair_table
+    from kubernetes_tpu.ops.wavelattice import make_wave_kernel_jit
+
+    def build():
+        enc = SnapshotEncoder()
+        enc.add_node(make_node("n1", cpu="10", mem="64Gi"))
+        enc.add_node(make_node("n2", cpu="9", mem="64Gi"))
+        tc = TemplateCache(enc)
+        pods = [
+            make_pod("big-0", cpu="6"),
+            make_pod("big-1", cpu="6"),
+            make_pod("small", cpu="1"),
+        ]
+        eb = tc.encode(pods, pad_to=4)
+        ptab, _ = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+        snap = enc.flush()
+        return enc, eb, ptab, snap
+
+    weights = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
+    weights[SC_LEAST_ALLOC] = 1.0
+
+    placements = {}
+    for refresh in (True, False):
+        enc, eb, ptab, snap = build()
+        kern = make_wave_kernel_jit(
+            enc.cfg.v_cap, 8, 4, 1.0, False, refresh
+        )
+        _snap2, res = kern(
+            snap, eb.batch, ptab, weights, jax.random.PRNGKey(0)
+        )
+        chosen = jax.device_get(res.chosen)
+        placed = jax.device_get(res.placed)
+        assert placed[:3].all(), (refresh, placed)
+        placements[refresh] = {
+            p.metadata.name: enc.row_names[int(chosen[i])]
+            for i, p in enumerate(eb.pods[:3])
+        }
+    # the big pair lands one per node either way (capacity)
+    for ref, pl in placements.items():
+        assert {pl["big-0"], pl["big-1"]} == {"n1", "n2"}, (ref, pl)
+    # the refreshed kernel steers the wave-2 small pod to the node the
+    # batch left emptier; the static kernel returns to the statically-best
+    # n1 — BOTH arms are pinned so a refresh no-op regression is caught
+    assert placements[True]["small"] == "n2", placements
+    assert placements[False]["small"] == "n1", placements
