@@ -68,14 +68,14 @@ class KubeSchedulerConfiguration:
     # XLA broadcast; off by default pending on-hardware measurement
     use_pallas_fit: bool = False
     # per-wave resource-score refresh at candidate nodes: later waves see
-    # in-batch commits in their packing decisions (serial fidelity) for a
-    # few cheap [P, M] gathers per wave. Default-ON deliberately (unlike
-    # use_pallas_fit, whose benefit is hardware-only): the behavior is the
-    # CORRECTNESS-fidelity direction, its cost is O(P·M) per wave — noise
-    # next to the [TPL, N] stages — and it is pinned by a CPU test
-    # (test_wave_score_refresh_sees_in_batch_commits). Off = batch-start
-    # scores only (the round-3 behavior, kept for A/B).
-    wave_score_refresh: bool = True
+    # in-batch commits in their packing decisions (serial fidelity) for
+    # O(P·M) gathers per wave. None = auto: ON for TPU backends (the cost
+    # is noise next to the [TPL, N] stages there) and OFF on CPU, where
+    # the same gathers are ~25% of kernel wall (measured: 898 -> 665
+    # pods/s on the CPU A/B with it forced on). Explicit True/False
+    # overrides; False is the round-3 behavior. Pinned by
+    # test_wave_score_refresh_sees_in_batch_commits either way.
+    wave_score_refresh: Optional[bool] = None
     # debug: cross-check every device placement against the HOST filter
     # chain per cycle (SURVEY §5's per-cycle verify mode — the live
     # analogue of the offline differential fuzz). Costs a host snapshot +

@@ -216,6 +216,13 @@ class Scheduler:
         self._batch_size = self.cfg.device_batch_size or (
             4096 if jax.default_backend() == "tpu" else 1024
         )
+        # auto: serial-fidelity refresh where it's free (TPU); the same
+        # [P, M] per-wave gathers are ~25% of CPU kernel wall
+        self._score_refresh = (
+            self.cfg.wave_score_refresh
+            if self.cfg.wave_score_refresh is not None
+            else jax.default_backend() == "tpu"
+        )
         self._busy = False  # scheduling loop mid-batch (wait_for_idle)
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
@@ -629,7 +636,7 @@ class Scheduler:
                 self.cfg.hard_pod_affinity_weight,
                 self._mesh,
                 self.cfg.use_pallas_fit,
-                self.cfg.wave_score_refresh,
+                self._score_refresh,
             )
         else:
             kern = make_wave_kernel_jit(
@@ -638,7 +645,7 @@ class Scheduler:
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self.cfg.use_pallas_fit,
-                self.cfg.wave_score_refresh,
+                self._score_refresh,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:
