@@ -289,6 +289,7 @@ class Follower:
         self.objects: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._stopped = threading.Event()
+        self._compacting = threading.Event()
         self._last_seen = time.monotonic()
         self._promoted: Optional[Any] = None
         self._synced = threading.Event()  # snapshot applied
@@ -354,14 +355,42 @@ class Follower:
                 }
                 for kind, objs in snap["objects"].items()
             }
-            objects_by_kind = {
-                kind: list(d.values()) for kind, d in self.objects.items()
-            }
         if self.wal is not None:
             # persist the handshake snapshot too: recovery from this WAL
             # must rebuild the FULL replicated state, not just the records
             # streamed after the connection (review r4)
-            self.wal.write_snapshot(snap["rv"], objects_by_kind)
+            self.wal.write_snapshot(*self._snapshot_state())
+
+    def _snapshot_state(self):
+        """(rv, {kind: [DEEP-COPIED objects]}) under the lock: a promotion
+        racing a snapshot write mutates the live objects (the promoted
+        APIServer shares self.objects), so the write must encode copies —
+        the same rule as APIServer._compact_async."""
+        import copy as _copy
+
+        with self._lock:
+            return self.rv, {
+                kind: [_copy.deepcopy(o) for o in d.values()]
+                for kind, d in self.objects.items()
+            }
+
+    def _maybe_compact(self) -> None:
+        """Follower-side WAL compaction, OFF the replication tail thread:
+        inline it would stall the ack past the primary's ship timeout and
+        starve heartbeats into a spurious self-promotion."""
+        if self.wal is None or not self.wal.due() or self._compacting.is_set():
+            return
+        self._compacting.set()
+
+        def run():
+            try:
+                self.wal.write_snapshot(*self._snapshot_state())
+            except Exception:
+                logger.exception("follower WAL compaction failed")
+            finally:
+                self._compacting.clear()
+
+        threading.Thread(target=run, daemon=True, name="repl-compact").start()
 
     def _apply_records(self, recs: List) -> None:
         wal_batch = []
@@ -380,8 +409,10 @@ class Follower:
                 wal_batch.append((rv, verb, kind, obj))
         if self.wal is not None and wal_batch:
             # replica durability: promotion after OUR crash recovers from
-            # this WAL exactly like a primary restart
+            # this WAL exactly like a primary restart; compaction is the
+            # follower's own job (the primary's doesn't cross the wire)
             self.wal.append_batch(wal_batch)
+            self._maybe_compact()
 
     # -- failover -------------------------------------------------------------
 

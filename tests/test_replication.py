@@ -272,3 +272,41 @@ def test_promotion_fences_stalled_primary(tmp_path):
         if standby is not None:
             standby.stop()
         primary.stop()
+
+
+def test_follower_wal_compacts(tmp_path):
+    """The replica's WAL must compact on its own cadence (the primary's
+    compaction doesn't reach across the wire); recovery from the
+    compacted WAL still holds the full state."""
+    from kubernetes_tpu.runtime.wal import WriteAheadLog
+
+    primary = APIServer()
+    listener = ReplicationListener(heartbeat_s=0.1)
+    listener.attach(primary)
+    wal = WriteAheadLog(
+        str(tmp_path / "replica"), compact_every=50, fsync=False
+    )
+    follower = Follower(listener.address, lease_s=30.0, wal=wal).start()
+    assert follower.wait_synced(5.0)
+    for i in range(120):
+        primary.create("pods", _pod(f"w-{i}"))
+    deadline = time.monotonic() + 10.0
+    while follower.rv < primary._rv and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert follower.rv == primary._rv
+    # compaction runs async off the tail thread: poll for the shrunken log
+    deadline = time.monotonic() + 10.0
+    tail_records = 10**9
+    while time.monotonic() < deadline:
+        with open(wal.log_path, encoding="utf-8") as f:
+            tail_records = sum(1 for line in f if line.strip())
+        if tail_records < 120:
+            break
+        time.sleep(0.05)
+    assert tail_records < 120, f"follower WAL never compacted: {tail_records}"
+    # and recovery from the compacted state is complete
+    rv, objects = WriteAheadLog.recover(str(tmp_path / "replica"))
+    assert rv == follower.rv
+    assert len(objects.get("pods", {})) == 120
+    listener.close()
+    follower.stop()
