@@ -17,7 +17,7 @@ import urllib.request
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..api import serialization as codec
-from ..client.apiserver import AlreadyExists, Conflict, NotFound
+from ..client.apiserver import AlreadyExists, Conflict, Expired, NotFound
 from ..runtime.watch import Event, Watcher
 
 
@@ -160,11 +160,33 @@ class RESTClient:
     def watch(self, kind: str, from_version: int = 0) -> Watcher:
         w = Watcher()
         url = self._url(kind, "") + f"?watch=1&resourceVersion={from_version}"
+        # open SYNCHRONOUSLY so a 410 Gone ("resourceVersion too old")
+        # surfaces to the caller as Expired — informers re-list on it; a
+        # silent pump-thread death would hand them a gapped stream. Other
+        # connection errors keep the old contract (a stopped watcher, not
+        # an exception), and the connect itself is bounded by the client
+        # timeout; the STREAM then clears the socket timeout (an idle but
+        # healthy watch must not be killed by a read timeout).
+        req = urllib.request.Request(url, headers=dict(self._headers))
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise Expired(e.read().decode() or "resourceVersion too old") from None
+            w.stop()
+            return w
+        except (urllib.error.URLError, OSError):
+            w.stop()
+            return w
+        try:
+            resp.fp.raw._sock.settimeout(None)  # stream: no read timeout
+        except AttributeError:
+            pass  # CPython internals moved: 30s idle kills the stream,
+            # and the consumer's relist path recovers
 
         def pump():
             try:
-                req = urllib.request.Request(url, headers=dict(self._headers))
-                with urllib.request.urlopen(req, timeout=None) as resp:
+                with resp:
                     for line in resp:
                         if w.stopped:
                             break

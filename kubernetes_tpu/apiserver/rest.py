@@ -36,6 +36,7 @@ from ..client.apiserver import (
     AlreadyExists,
     APIServer,
     Conflict,
+    Expired,
     NotFound,
 )
 from .auth import AdmissionDenied
@@ -521,7 +522,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_watch(self, resource: str, ns: Optional[str], query: dict):
         from_rv = int(query.get("resourceVersion", 0) or 0)
-        watcher = self.store.watch(resource, from_version=from_rv)
+        try:
+            watcher = self.store.watch(resource, from_version=from_rv)
+        except Expired as e:
+            # 410 Gone ("resourceVersion too old"): the client must
+            # re-list, exactly like the reference's etcd3 watcher
+            return self._status_error(410, "Expired", str(e))
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")

@@ -42,6 +42,26 @@ class Conflict(ValueError):
     """Stale resource_version on update (optimistic-concurrency failure)."""
 
 
+class Expired(ValueError):
+    """Watch resourceVersion older than retained history (HTTP 410 Gone;
+    the reference's "The resourceVersion for the provided watch is too
+    old" — watchers must re-list)."""
+
+
+def list_and_watch(server, kind: str, seed) -> "Watcher":
+    """list → seed(objs) → watch(list rv), retrying the whole pair on
+    Expired (the reflector's ListAndWatch restart). seed must tolerate
+    re-delivery (queue adds dedup; event handlers treat re-adds as
+    updates)."""
+    while True:
+        objs, rv = server.list(kind)
+        seed(objs)
+        try:
+            return server.watch(kind, from_version=rv)
+        except Expired:
+            continue
+
+
 AdmitHook = Callable[[str, str, Any], None]  # (verb, kind, obj) -> raise to deny
 
 
@@ -61,6 +81,9 @@ class APIServer:
         # kind -> ring buffer of past events for watch-from-version replay
         self._history: Dict[str, deque] = {}
         self._history_len = watch_history
+        # kind -> rv of the newest event EVICTED from its ring (watch()'s
+        # exact staleness check)
+        self._evicted_rv: Dict[str, int] = {}
         self.admit_hooks: List[AdmitHook] = []
         # optional durability (runtime/wal.py): every mutation is logged
         # before acknowledgment; recover() rebuilds a server from disk —
@@ -150,6 +173,12 @@ class APIServer:
 
     def _notify(self, kind: str, ev: Event) -> None:
         hist = self._history.setdefault(kind, deque(maxlen=self._history_len))
+        if len(hist) == self._history_len and hist:
+            # the append below evicts the oldest event: remember its rv so
+            # watch() raises Expired exactly when a caller would actually
+            # miss this kind's events (a global-rv heuristic would fire
+            # spuriously for gaps made entirely of OTHER kinds' writes)
+            self._evicted_rv[kind] = hist[0].resource_version
         hist.append(ev)
         for w in list(self._watchers.get(kind, [])):
             if w.stopped:
@@ -361,10 +390,25 @@ class APIServer:
     # -- watch --------------------------------------------------------------
 
     def watch(self, kind: str, from_version: int = 0) -> Watcher:
-        """Watch a kind; events with rv > from_version are replayed first."""
+        """Watch a kind; events with rv > from_version are replayed first.
+
+        Raises Expired ("resourceVersion too old", the reference's 410
+        Gone from the etcd3 watcher / cacher) when the ring has already
+        evicted events the caller would need: silently skipping them
+        would hand the watcher a gapped stream it can't detect. Reflector
+        equivalents respond by re-listing (SharedInformer does)."""
         with self._lock:
+            hist = self._history.get(kind, ())
+            evicted = self._evicted_rv.get(kind, 0)
+            # from_version=0 is "from whenever" (no completeness contract);
+            # list+watch pairs pass the list rv explicitly
+            if from_version and from_version < evicted:
+                raise Expired(
+                    f"{kind} resourceVersion {from_version} is too old "
+                    f"(events up to rv {evicted} were evicted)"
+                )
             w = Watcher()
-            for ev in self._history.get(kind, ()):
+            for ev in hist:
                 if ev.resource_version > from_version:
                     w.push(ev)
             self._watchers.setdefault(kind, []).append(w)

@@ -11,13 +11,16 @@ list is delivered.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..runtime.store import Indexer, IndexFunc
 from ..runtime.watch import ADDED, DELETED, MODIFIED
 
-from .apiserver import APIServer
+from .apiserver import APIServer, Expired
+
+logger = logging.getLogger("kubernetes_tpu.client.informers")
 
 
 class ResourceEventHandler:
@@ -100,14 +103,51 @@ class SharedInformer:
         )
         self._thread.start()
 
-    def _run(self) -> None:
-        objs, rv = self._server.list(self.kind)
+    def _replace(self, objs) -> None:
+        """Replace-semantics sync (the reflector's DeltaFIFO Replace):
+        upsert everything listed, and DELETE + on_delete anything the
+        indexer holds that the list no longer contains — a plain upsert
+        replay would leave ghosts for objects deleted during a watch gap."""
+        listed = {o.metadata.key for o in objs}
+        for stale_key in [
+            k for k in (o.metadata.key for o in self.indexer.list())
+            if k not in listed
+        ]:
+            gone = self.indexer.get(stale_key)
+            if gone is None:
+                continue
+            self.indexer.delete(gone)
+            for h in self._handlers:
+                h.on_delete(gone)
         for obj in objs:
             self.indexer.add(obj)
             for h in self._handlers:
                 h.on_add(obj)
+
+    def _run(self) -> None:
+        objs, rv = self._server.list(self.kind)
+        self._replace(objs)
         self._synced.set()
-        self._watcher = self._server.watch(self.kind, from_version=rv)
+        # Expired ("resourceVersion too old", 410 Gone): the event window
+        # between list and watch was already evicted — re-list with
+        # Replace semantics and retry, like the reflector's ListAndWatch
+        # restart loop (indefinitely, with backoff: a burst that outruns
+        # the ring must not permanently kill the informer)
+        while not self._stop.is_set():
+            try:
+                self._watcher = self._server.watch(
+                    self.kind, from_version=rv
+                )
+                break
+            except Expired:
+                logger.warning(
+                    "watch for %s expired at rv %d; re-listing", self.kind, rv
+                )
+                self._stop.wait(0.2)
+                objs, rv = self._server.list(self.kind)
+                self._replace(objs)
+        if self._stop.is_set():
+            return
         for ev in self._watcher:
             if self._stop.is_set():
                 return

@@ -152,8 +152,14 @@ def cmd_get(client: RESTClient, args) -> int:
         _print_table(resource, objs, wide=args.output == "wide")
     if getattr(args, "watch", False):
         # stream subsequent changes (kubectl get -w), same filters as the
-        # initial list
-        w = client.watch(resource, from_version=rv)
+        # initial list; on 410 Gone re-list silently like the reflector
+        from ..client.apiserver import Expired
+
+        try:
+            w = client.watch(resource, from_version=rv)
+        except Expired:
+            objs, rv = client.list(resource)
+            w = client.watch(resource, from_version=rv)
         try:
             while True:
                 ev = w.get(timeout=1.0)

@@ -80,16 +80,19 @@ class WorkqueueController:
         return obj.metadata.key
 
     def _watch_loop(self) -> None:
-        objs, rv = self.server.list(self.primary_kind)
-        for o in objs:
-            key = self.primary_key_of(o)
-            if key:
-                self.queue.add(key)
-        primary_watch = self.server.watch(self.primary_kind, from_version=rv)
-        sec_watches = []
-        for res in self.secondary_kinds:
-            _, srv = self.server.list(res)
-            sec_watches.append((res, self.server.watch(res, from_version=srv)))
+        from ..client.apiserver import list_and_watch
+
+        def seed(objs):
+            for o in objs:
+                key = self.primary_key_of(o)
+                if key:
+                    self.queue.add(key)
+
+        primary_watch = list_and_watch(self.server, self.primary_kind, seed)
+        sec_watches = [
+            (res, list_and_watch(self.server, res, lambda _objs: None))
+            for res in self.secondary_kinds
+        ]
         while not self._stop.is_set():
             # block briefly on the primary, then DRAIN all streams — one
             # event per tick would cap secondary throughput at ~5/s and

@@ -53,12 +53,14 @@ class ReplicaSetController:
     # -- event plumbing ------------------------------------------------------
 
     def _watch_loop(self) -> None:
-        sets, rv = self.server.list(self.resource)
-        for rs in sets:
-            self.queue.add(rs.metadata.key)
-        rs_watch = self.server.watch(self.resource, from_version=rv)
-        pods, prv = self.server.list("pods")
-        pod_watch = self.server.watch("pods", from_version=prv)
+        from ..client.apiserver import list_and_watch
+
+        def seed(sets):
+            for rs in sets:
+                self.queue.add(rs.metadata.key)
+
+        rs_watch = list_and_watch(self.server, self.resource, seed)
+        pod_watch = list_and_watch(self.server, "pods", lambda _p: None)
         while not self._stop.is_set():
             ev = rs_watch.get(timeout=0.2)
             if ev is not None and ev.type in ("ADDED", "MODIFIED"):

@@ -577,12 +577,15 @@ class NodeAgentPool:
             return self.kubelets.get(pod.spec.node_name)
 
     def _watch_loop(self) -> None:
-        pods, rv = self.server.list("pods")
-        for pod in pods:
-            kl = self._kubelet_for(pod)
-            if kl is not None:
-                kl.handle_pod_event("ADDED", pod)
-        watcher = self.server.watch("pods", from_version=rv)
+        from ..client.apiserver import list_and_watch
+
+        def seed(pods):
+            for pod in pods:
+                kl = self._kubelet_for(pod)
+                if kl is not None:
+                    kl.handle_pod_event("ADDED", pod)
+
+        watcher = list_and_watch(self.server, "pods", seed)
         while not self._stop.is_set():
             ev = watcher.get(timeout=0.2)
             if ev is None:

@@ -303,3 +303,56 @@ def test_scheduler_binds_pvc_pod_end_to_end():
         assert pv.spec.claim_ref == "default/c1"
     finally:
         sched.stop()
+
+
+def test_watch_resource_version_too_old_is_expired():
+    """A watch from a resourceVersion older than the retained history must
+    raise Expired (the reference's 410 Gone) instead of silently handing
+    the watcher a gapped stream; informers re-list on it."""
+    import pytest
+
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.client.apiserver import APIServer, Expired
+
+    server = APIServer(watch_history=10)
+    for i in range(25):
+        server.create(
+            "configmaps",
+            v1.ConfigMap(metadata=v1.ObjectMeta(name=f"c{i}")),
+        )
+    # rv=1 predates the 10-entry ring: events 2..15 are gone
+    with pytest.raises(Expired, match="too old"):
+        server.watch("configmaps", from_version=1)
+    # a fresh watch from the current rv works
+    w = server.watch("configmaps", from_version=server.resource_version)
+    server.create(
+        "configmaps", v1.ConfigMap(metadata=v1.ObjectMeta(name="new"))
+    )
+    ev = w.get(timeout=5.0)
+    assert ev is not None and ev.object.metadata.name == "new"
+    w.stop()
+
+
+def test_watch_expired_over_http_is_410():
+    import urllib.error
+    import urllib.request
+
+    import pytest
+
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.client.apiserver import APIServer
+
+    srv, port, store = serve(store=APIServer(watch_history=5))
+    try:
+        for i in range(20):
+            store.create(
+                "configmaps",
+                v1.ConfigMap(metadata=v1.ObjectMeta(name=f"c{i}")),
+            )
+        url = f"http://127.0.0.1:{port}/api/v1/configmaps?watch=1&resourceVersion=1"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5.0)
+        assert ei.value.code == 410
+    finally:
+        srv.shutdown()
