@@ -260,7 +260,8 @@ def main() -> int:
         detail = out.get("detail") or {}
         if str(detail.get("platform", "")).startswith("tpu") and "error" not in out:
             path = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), "BENCH_r04_tpu.json"
+                os.path.dirname(os.path.abspath(__file__)),
+                os.environ.get("BENCH_TPU_CHECKPOINT", "BENCH_r05_tpu.json"),
             )
             best = None
             if os.path.exists(path):

@@ -224,14 +224,19 @@ class DeviceManager:
         """Possible locality-domain sets that can satisfy `count` devices
         of `resource`; single-domain sets are preferred."""
         with self._lock:
-            ep = self._endpoints.get(resource)
-            if ep is None:
-                return []
-            used = self._in_use(resource)
-            by_domain: Dict[int, int] = {}
-            for d in ep.devices.values():
-                if d.healthy and d.id not in used:
-                    by_domain[d.topology] = by_domain.get(d.topology, 0) + 1
+            return self._topology_hints_locked(resource, count)
+
+    def _topology_hints_locked(
+        self, resource: str, count: int
+    ) -> List[TopologyHint]:
+        ep = self._endpoints.get(resource)
+        if ep is None:
+            return []
+        used = self._in_use(resource)
+        by_domain: Dict[int, int] = {}
+        for d in ep.devices.values():
+            if d.healthy and d.id not in used:
+                by_domain[d.topology] = by_domain.get(d.topology, 0) + 1
         hints = [
             TopologyHint({dom}, True)
             for dom, avail in by_domain.items()
@@ -277,26 +282,32 @@ class DeviceManager:
                     wants[name] = wants.get(name, 0) + int(str(qty))
         if not wants:
             return {}
+        # hints + merge + grant under ONE lock hold: computing the hints
+        # lock-free and re-locking for the grant lets a concurrent
+        # allocation consume the aligned pool in between, silently
+        # spilling cross-domain even under policy='restricted' (the
+        # alignment guarantee the merged.preferred check enforces)
+        granted: Dict[str, List[str]] = {}
         with self._lock:
             if key in self._allocations:
                 return dict(self._allocations[key])
-        hints = {
-            res: self.topology_hints(res, cnt) for res, cnt in wants.items()
-        }
-        for res, hs in hints.items():
-            if not hs:
+            hints = {
+                res: self._topology_hints_locked(res, cnt)
+                for res, cnt in wants.items()
+            }
+            for res, hs in hints.items():
+                if not hs:
+                    raise RuntimeError(
+                        f"insufficient {res}: want {wants[res]}, none available"
+                    )
+            merged = self._merge_hints(hints)
+            if merged is None:
+                raise RuntimeError(f"cannot satisfy device request {wants}")
+            if self.policy == "restricted" and not merged.preferred:
                 raise RuntimeError(
-                    f"insufficient {res}: want {wants[res]}, none available"
+                    f"topology policy=restricted: no aligned allocation for "
+                    f"{wants}"
                 )
-        merged = self._merge_hints(hints)
-        if merged is None:
-            raise RuntimeError(f"cannot satisfy device request {wants}")
-        if self.policy == "restricted" and not merged.preferred:
-            raise RuntimeError(
-                f"topology policy=restricted: no aligned allocation for {wants}"
-            )
-        granted: Dict[str, List[str]] = {}
-        with self._lock:
             for res, cnt in wants.items():
                 ep = self._endpoints[res]
                 used = self._in_use(res)
@@ -311,7 +322,18 @@ class DeviceManager:
                     raise RuntimeError(
                         f"insufficient {res}: want {cnt}, have {len(pool)}"
                     )
-                granted[res] = [d.id for d in pool[:cnt]]
+                grant = pool[:cnt]
+                if self.policy == "restricted" and any(
+                    d.topology not in merged.domains for d in grant
+                ):
+                    # belt-and-braces: the hint said aligned capacity
+                    # exists; a grant outside merged.domains would violate
+                    # the restricted contract — fail admission instead
+                    raise RuntimeError(
+                        f"topology policy=restricted: aligned pool for {res} "
+                        "exhausted during allocation"
+                    )
+                granted[res] = [d.id for d in grant]
             self._allocations[key] = granted
             self._save_checkpoint_locked()
         # dial each plugin's endpoint for the actual Allocate call (the

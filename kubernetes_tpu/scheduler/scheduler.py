@@ -785,48 +785,89 @@ class Scheduler:
         trace.step("assume+bind")
         return fallback_pis, failed
 
+    # Bound on full preemption scans per resolved batch: with the
+    # per-(template, priority) dedup below the bound only engages when a
+    # batch fails across MANY distinct templates at once; the skipped pods
+    # retry preemption on their next cycle (the reference bounds work the
+    # same way — one nominated node per pod per cycle,
+    # pkg/scheduler/core/generic_scheduler.go:270).
+    _MAX_PREEMPT_SCANS_PER_BATCH = 128
+
     def _finish_batch(
         self, p: "_InFlightBatch", fallback_pis: List, failed: List
     ) -> None:
         """Host fallback + failure/preemption handling for one committed
-        batch (runs after EVERY sibling batch's placements are replayed)."""
+        batch (runs after EVERY sibling batch's placements are replayed).
+
+        Storm path (soak lesson, r4): a full cluster fails WHOLE batches of
+        one template. Failure handling is deduplicated at template
+        granularity — one preemption scan per (template, priority) per
+        unchanged snapshot, not one per pod — and the unschedulable
+        condition write is skipped when the stored condition already says
+        exactly the same thing, so a 1024-pod unschedulable batch costs one
+        scan + zero redundant API writes instead of 1024 scans + 2048
+        writes."""
         eb, row_names, res, moves0 = p.eb, p.row_names, p.res, p.moves0
-        if fallback_pis or failed:
-            # the host paths below read the host cache; a NEWER in-flight
-            # batch holds device-committed placements the cache can't see
-            # yet — resolve it first or fallback/preemption would grant the
-            # same capacity twice (bounded recursion: pending is detached
-            # before each resolve)
-            self._resolve_pending()
-            self._snapshot = self.cache.update_snapshot()
-        for pi in fallback_pis:
-            self._schedule_one_host(pi, moves0)
-        if failed:
-            resolvable_tpl = jax.device_get(res.resolvable_tpl)
-            pod_tpl = eb.pod_tpl_np
-            # batched masked what-if (one device call for ALL failed pods):
-            # per-template optimistic preemption mask, priority = max over
-            # the batch's pods of that template so the mask stays a superset
-            # for every pod; the host reprieve loop is the exact check
-            whatif_tpl = self._preempt_whatif_tpl(eb, failed, pod_tpl)
-            for pi, i in failed:
-                t = int(pod_tpl[i])
-                rows_mask = resolvable_tpl[t]
-                if (
-                    whatif_tpl is not None
-                    and whatif_tpl.shape[1] == rows_mask.shape[0]
-                ):
-                    rows_mask = rows_mask & whatif_tpl[t]
-                rows = np.nonzero(rows_mask)[0]
-                self._handle_failure(
-                    pi,
-                    moves0,
-                    message=f"0/{self.cache.node_count} nodes are available",
-                    candidate_nodes=[
-                        row_names[r] for r in rows if row_names[r]
-                    ],
-                )
+        with _stage_timer("finish"):
+            if fallback_pis or failed:
+                # the host paths below read the host cache; a NEWER in-flight
+                # batch holds device-committed placements the cache can't see
+                # yet — resolve it first or fallback/preemption would grant the
+                # same capacity twice (bounded recursion: pending is detached
+                # before each resolve)
+                self._resolve_pending()
+                self._snapshot = self.cache.update_snapshot()
+            for pi in fallback_pis:
+                self._schedule_one_host(pi, moves0)
+            if failed:
+                self._finish_failed(p, failed)
         p.trace.log_if_long(0.1)
+
+    def _finish_failed(self, p: "_InFlightBatch", failed: List) -> None:
+        eb, row_names, res, moves0 = p.eb, p.row_names, p.res, p.moves0
+        resolvable_tpl = jax.device_get(res.resolvable_tpl)
+        pod_tpl = eb.pod_tpl_np
+        pod_prio = eb.pod_prio_np
+        # batched masked what-if (one device call for ALL failed pods):
+        # per-template optimistic preemption mask, priority = max over
+        # the batch's pods of that template so the mask stays a superset
+        # for every pod; the host reprieve loop is the exact check
+        whatif_tpl = self._preempt_whatif_tpl(eb, failed, pod_tpl)
+        # (template, priority) groups whose scan on the CURRENT snapshot
+        # found no viable node: siblings share the spec, so their scans
+        # are provably identical — skip them. A successful preemption
+        # mutates the cluster (victims deleted), which can unblock other
+        # groups: clear the memo.
+        hopeless: set = set()
+        scans = 0
+        for pi, i in failed:
+            t = int(pod_tpl[i])
+            rows_mask = resolvable_tpl[t]
+            if (
+                whatif_tpl is not None
+                and whatif_tpl.shape[1] == rows_mask.shape[0]
+            ):
+                rows_mask = rows_mask & whatif_tpl[t]
+            rows = np.nonzero(rows_mask)[0]
+            candidates = [row_names[r] for r in rows if row_names[r]]
+            group = (t, int(pod_prio[i]))
+            scan_would_run = bool(candidates)
+            skip = scan_would_run and (
+                group in hopeless or scans >= self._MAX_PREEMPT_SCANS_PER_BATCH
+            )
+            preempted = self._handle_failure(
+                pi,
+                moves0,
+                message=f"0/{self.cache.node_count} nodes are available",
+                candidate_nodes=candidates,
+                skip_preemption=skip,
+            )
+            if scan_would_run and not skip:
+                scans += 1
+                if preempted:
+                    hopeless.clear()
+                else:
+                    hopeless.add(group)
 
     # pre-batch-sound plugins: anti-monotone (or invariant) under in-batch
     # commits, so a device placement MUST pass them on the pre-batch host
@@ -1193,7 +1234,9 @@ class Scheduler:
         fit_error: Optional[FitError] = None,
         candidate_nodes: Optional[List[str]] = None,
         error: bool = False,
-    ) -> None:
+        skip_preemption: bool = False,
+    ) -> bool:
+        """Returns True iff a preemption was performed (cluster mutated)."""
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
         metrics.inc(
@@ -1215,14 +1258,30 @@ class Scheduler:
                 except Exception:
                     logger.exception("permit failure hook %s", name)
         self._set_pod_unschedulable_condition(pod, message)
-        if not error and not self.cfg.disable_preemption:
-            self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
+        preempted = False
+        if not error and not self.cfg.disable_preemption and not skip_preemption:
+            preempted = bool(
+                self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
+            )
         self.queue.add_unschedulable_if_not_present(pi, moves0)
+        return preempted
 
     def _set_pod_unschedulable_condition(self, pod: v1.Pod, message: str) -> None:
         def mutate(p):
             for c in p.status.conditions:
                 if c.type == v1.COND_POD_SCHEDULED:
+                    if (
+                        c.status == "False"
+                        and c.reason == "Unschedulable"
+                        and c.message == message
+                    ):
+                        # no-op write suppression (the reference's
+                        # podutil.UpdatePodCondition returns false on an
+                        # identical condition and the caller skips the
+                        # PATCH): in an unschedulable storm every re-failed
+                        # pod would otherwise rewrite the same condition —
+                        # an API write + watch fan-out per pod per cycle
+                        return None
                     c.status = "False"
                     c.reason = "Unschedulable"
                     c.message = message
@@ -1246,9 +1305,9 @@ class Scheduler:
 
     def _attempt_preemption(
         self, pod, prof, fit_error, candidate_nodes: Optional[List[str]]
-    ) -> None:
+    ) -> str:
         """sched.preempt (scheduler.go:392): find victims, delete them, set
-        NominatedNodeName."""
+        NominatedNodeName. Returns the nominated node ('' if none)."""
         if self._snapshot is None:
             self._snapshot = self.cache.update_snapshot()
         preemptor = self._preemptors[prof.name]
@@ -1259,7 +1318,7 @@ class Scheduler:
             pod, self._snapshot, fit_error, candidate_nodes
         )
         if not node:
-            return
+            return ""
         for victim in victims:
             try:
                 self.server.delete(
@@ -1283,5 +1342,6 @@ class Scheduler:
                 "pods", pod.metadata.namespace, pod.metadata.name, mutate
             )
         except NotFound:
-            return
+            return node
         self.queue.add_nominated_pod(pod, node)
+        return node
