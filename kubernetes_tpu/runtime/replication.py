@@ -167,7 +167,18 @@ class ReplicationListener:
         wfile = sock.makefile("wb")
         try:
             hello = _recv(rfile)
-            if hello is None or "hello" not in hello:
+            if hello is None:
+                sock.close()
+                return
+            if "ping" in hello:
+                # liveness probe (see Follower._primary_reachable): a bare
+                # TCP connect is answered by the kernel's listen backlog
+                # even when this process is wedged — only an application
+                # reply proves the primary is actually serving
+                _send(wfile, {"pong": self.term})
+                sock.close()
+                return
+            if "hello" not in hello:
                 sock.close()
                 return
             peer_term = int(hello["hello"].get("term", 0))
@@ -200,10 +211,16 @@ class ReplicationListener:
         except (OSError, ValueError, json.JSONDecodeError):
             sock.close()
             return
-        # ack reader: runs for the life of the connection
+        # ack reader: runs for the life of the connection. A recv timeout
+        # is NOT a dead follower — ship() may briefly set a socket timeout
+        # for its bounded send; an idle link simply has nothing to say —
+        # only EOF/hard errors drop the connection.
         try:
             while not self._stopped.is_set():
-                frame = _recv(rfile)
+                try:
+                    frame = _recv(rfile)
+                except TimeoutError:
+                    continue
                 if frame is None:
                     break
                 if "ack" in frame:
@@ -273,9 +290,16 @@ class ReplicationListener:
         live: List[_FollowerConn] = []
         for conn in followers:
             try:
-                conn.sock.settimeout(self.ack_timeout_s)
+                # bound the SEND only, and restore blocking mode right
+                # after: a persistent socket timeout would poison the ack
+                # reader's blocking recv on the same socket (any write-idle
+                # gap > ack_timeout would look like a dead follower)
                 with conn.lock:
-                    _send(conn.wfile, {"recs": recs, "term": self.term})
+                    conn.sock.settimeout(self.ack_timeout_s)
+                    try:
+                        _send(conn.wfile, {"recs": recs, "term": self.term})
+                    finally:
+                        conn.sock.settimeout(None)
                 live.append(conn)
             except OSError:
                 logger.warning("dropping follower (send failed)")
@@ -296,24 +320,31 @@ class ReplicationListener:
                 self._ack_cond.wait(remaining)
         acked = [c for c in live if c.acked_rv >= last_rv]
         laggards = [c for c in live if c.acked_rv < last_rv]
-        if needed is not None and len(acked) >= needed:
-            # quorum committed: laggards keep their connection (the TCP
-            # stream already buffers what they missed; their acks catch up)
+        if needed is not None:
+            if len(acked) < needed:
+                # quorum miss: the laggards may hold the ONLY follower
+                # copies of earlier writes — ejecting them here would turn
+                # the next primary death into a permanent outage (every
+                # replica parked un-promotable). Keep them connected; the
+                # stream is buffered and their acks can catch up. Dead
+                # links clean up via send/heartbeat failures (plain drop →
+                # the follower reconnects and full-resyncs).
+                logger.error(
+                    "write quorum NOT met (%d/%d follower acks): proceeding "
+                    "availability-first; durability degraded until followers "
+                    "catch up",
+                    len(acked),
+                    needed,
+                )
+            # quorum met: laggards also keep their connection and catch up
             return
         for conn in laggards:
-            # these followers are blocking the required quorum: eject them
-            # from the sync set (etcd's analogue: a dying member stalls the
+            # legacy all-ack mode: a follower that can't keep up inside
+            # ack_timeout is ejected from the sync set with an explicit
+            # stale notice (etcd's analogue: a dying member stalls the
             # quorum round until the leader drops it)
-            logger.warning("ejecting follower (ack timeout at quorum)")
+            logger.warning("ejecting follower (ack timeout)")
             self._drop(conn, eject=True)
-        if needed is not None and len(acked) < needed:
-            logger.error(
-                "write quorum NOT met (%d/%d follower acks): proceeding "
-                "availability-first; durability degraded until followers "
-                "re-sync",
-                len(acked),
-                needed,
-            )
 
     def _heartbeat_loop(self) -> None:
         while not self._stopped.wait(self.heartbeat_s):
@@ -602,13 +633,24 @@ class Follower:
     def _primary_reachable(self) -> bool:
         """A lease can lapse because the primary died OR because this link
         (or this process) stalled. Before any promotion, distinguish: if
-        the primary still accepts connections, it is alive — re-tail
-        instead of splitting the brain (advisor r4 medium)."""
+        the primary still ANSWERS, it is alive — re-tail instead of
+        splitting the brain (advisor r4 medium). The probe requires an
+        application-level pong: a bare TCP connect is completed by the
+        kernel's listen backlog even when the primary process is wedged,
+        which would defer failover forever for a hung-but-listening
+        primary."""
         try:
             sock = socket.create_connection(self.primary_addr, timeout=0.5)
-            sock.close()
-            return True
-        except OSError:
+            try:
+                sock.settimeout(0.5)
+                wfile = sock.makefile("wb")
+                rfile = sock.makefile("rb")
+                _send(wfile, {"ping": 1})
+                reply = _recv(rfile)
+                return bool(reply) and "pong" in reply
+            finally:
+                sock.close()
+        except (OSError, ValueError, json.JSONDecodeError):
             return False
 
     def _lease_loop(self) -> None:

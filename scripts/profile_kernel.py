@@ -16,14 +16,19 @@ import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # default to CPU: this box's env pins JAX_PLATFORMS=axon (the tunneled
 # TPU), and a wedged tunnel hangs every jit forever. Pass --platform tpu
-# (or axon) explicitly to profile on hardware.
-if "--platform" in sys.argv:
-    os.environ["JAX_PLATFORMS"] = sys.argv[sys.argv.index("--platform") + 1]
-else:
-    os.environ["JAX_PLATFORMS"] = "cpu"
+# (or axon) explicitly to profile on hardware. Parsed pre-import (both
+# --platform X and --platform=X forms) because JAX_PLATFORMS must be set
+# before jax loads.
+_plat = "cpu"
+for _i, _a in enumerate(sys.argv):
+    if _a == "--platform" and _i + 1 < len(sys.argv):
+        _plat = sys.argv[_i + 1]
+    elif _a.startswith("--platform="):
+        _plat = _a.split("=", 1)[1]
+os.environ["JAX_PLATFORMS"] = _plat
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

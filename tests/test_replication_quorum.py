@@ -54,6 +54,66 @@ def test_parallel_fanout_single_shared_deadline():
     listener.close()
 
 
+def test_idle_gap_does_not_drop_followers():
+    """Regression (r5 review): ship() briefly bounds its send with a
+    socket timeout; that must not leak into the ack-reader's blocking
+    recv — a write-idle gap longer than ack_timeout_s is NOT a dead
+    follower."""
+    primary = APIServer()
+    listener = ReplicationListener(
+        heartbeat_s=5.0, ack_timeout_s=0.3, cluster_size=3
+    )
+    listener.attach(primary)
+    f1 = Follower(listener.address, lease_s=60.0).start()
+    f2 = Follower(listener.address, lease_s=60.0).start()
+    assert f1.wait_synced(5.0) and f2.wait_synced(5.0)
+    primary.create("pods", _pod("one"))
+    time.sleep(1.0)  # idle >> ack_timeout
+    assert listener.follower_count == 2, "idle gap dropped followers"
+    primary.create("pods", _pod("two"))
+    assert _wait(lambda: f1.rv >= primary._rv and f2.rv >= primary._rv)
+    listener.close()
+    f1.stop()
+    f2.stop()
+
+
+def test_quorum_miss_keeps_followers_connected():
+    """Regression (r5 review): a quorum miss must NOT eject the laggards —
+    they may hold the only follower copies; ejecting would park every
+    replica un-promotable (permanent outage on the next primary death)."""
+    primary = APIServer()
+    listener = ReplicationListener(
+        heartbeat_s=5.0, ack_timeout_s=0.3, cluster_size=3
+    )
+    listener.attach(primary)
+    f1 = Follower(listener.address, lease_s=60.0).start()
+    f2 = Follower(listener.address, lease_s=60.0).start()
+    assert f1.wait_synced(5.0) and f2.wait_synced(5.0)
+
+    # both followers stall their apply past the deadline -> quorum miss
+    evs = []
+    for f in (f1, f2):
+        orig = f._apply_records
+
+        def make(orig):
+            def slow(recs):
+                time.sleep(0.6)
+                orig(recs)
+            return slow
+
+        evs.append(orig)
+        f._apply_records = make(orig)
+    primary.create("pods", _pod("slow"))
+    # laggards kept: still connected, not ejected, and they catch up
+    assert listener.follower_count == 2, "quorum miss ejected laggards"
+    assert not f1.ejected and not f2.ejected
+    assert _wait(lambda: f1.rv >= primary._rv and f2.rv >= primary._rv,
+                 timeout=5.0)
+    listener.close()
+    f1.stop()
+    f2.stop()
+
+
 def test_quorum_commit_tolerates_dead_follower_without_stall():
     """cluster_size=3 (primary + 2 followers): majority = primary + 1
     follower ack. With one follower dead, writes commit at the live
