@@ -299,6 +299,9 @@ class PodSpec:
     # bounded-duration pods (Jobs set this); the quota "Terminating" scope
     # selects on its presence (reference core/v1 ActiveDeadlineSeconds)
     active_deadline_seconds: Optional[int] = None
+    # named RuntimeClass; the RuntimeClass admission plugin merges the
+    # class's overhead/scheduling into the pod (node/v1 RuntimeClassName)
+    runtime_class_name: str = ""
 
 
 @dataclass(frozen=True)
@@ -533,6 +536,7 @@ def _copy_pod_spec(s: PodSpec) -> PodSpec:
         volumes=[_copy_volume(v) for v in s.volumes],
         service_account_name=s.service_account_name,
         active_deadline_seconds=s.active_deadline_seconds,
+        runtime_class_name=s.runtime_class_name,
     )
 
 
@@ -1268,6 +1272,31 @@ class PodSecurityPolicy:
     kind: str = "PodSecurityPolicy"
 
     def deep_copy(self) -> "PodSecurityPolicy":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class RuntimeClassScheduling:
+    """node/v1 Scheduling: where pods of this class may run."""
+
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+
+
+@dataclass
+class RuntimeClass:
+    """node/v1 RuntimeClass (reference staging/src/k8s.io/api/node/v1):
+    names a container runtime handler; overhead joins the pod's resource
+    accounting and scheduling constrains placement — both merged into the
+    pod by the RuntimeClass admission plugin."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    handler: str = ""
+    overhead: Dict[str, Quantity] = field(default_factory=dict)
+    scheduling: Optional[RuntimeClassScheduling] = None
+    kind: str = "RuntimeClass"
+
+    def deep_copy(self) -> "RuntimeClass":
         return copy.deepcopy(self)
 
 

@@ -58,7 +58,33 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "ingresses": v1.Ingress,
     "networkpolicies": v1.NetworkPolicy,
     "podsecuritypolicies": v1.PodSecurityPolicy,
+    "runtimeclasses": v1.RuntimeClass,
 }
+
+# Cluster-scoped resources: the store normalizes their namespace to ""
+# ONCE at the write boundary (client/apiserver.py), so an object decoded
+# from a plain manifest (ObjectMeta defaults namespace to "default") and
+# one created namespace-less land under the SAME key — consumers never
+# probe both spellings. kubectl shares this set for its path routing.
+CLUSTER_SCOPED = frozenset(
+    {
+        "nodes",
+        "persistentvolumes",
+        "storageclasses",
+        "csinodes",
+        "namespaces",
+        "priorityclasses",
+        "customresourcedefinitions",
+        "apiservices",
+        "clusterroles",
+        "clusterrolebindings",
+        "mutatingwebhookconfigurations",
+        "validatingwebhookconfigurations",
+        "certificatesigningrequests",
+        "runtimeclasses",
+        "podsecuritypolicies",
+    }
+)
 
 KIND_TO_RESOURCE = {
     cls.__name__: res for res, cls in RESOURCE_KINDS.items()

@@ -1,0 +1,207 @@
+"""API-boundary object validation (the high-value subset of
+pkg/apis/core/validation/validation.go, ~16k LoC in the reference):
+malformed objects are rejected AT WRITE TIME with a 400, never discovered
+later as a scheduler-side encode exception (r4 verdict #6).
+
+Covered: DNS-1123 name/namespace formats, label key/value syntax,
+resource-quantity syntax (requests/limits/overhead/capacity/allocatable),
+label-selector operator syntax, and spec immutability on update
+(pod.spec.nodeName may be set once, never moved; container resources are
+immutable). Everything else (the reference's long tail of per-field
+rules) is intentionally out of scope at this stage.
+
+Always-on: wired directly into APIServer.create/update after admission
+mutators (the reference's strategy.Validate runs after admission too, so
+defaulted fields are validated, not raw input).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from .resources import parse_quantity
+
+# DNS-1123 subdomain (RFC 1123): what object names must look like
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")
+# label VALUE: empty, or 63 chars of alnum/-_. starting+ending alnum
+_LABEL_VALUE_RE = re.compile(r"^([A-Za-z0-9]([-A-Za-z0-9_.]{0,61}[A-Za-z0-9])?)?$")
+# label key NAME part (the bit after an optional dns-prefix/)
+_LABEL_NAME_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]{0,61}[A-Za-z0-9])?$")
+_SELECTOR_OPS = frozenset({"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"})
+
+
+class ValidationError(ValueError):
+    """Rejected at the API boundary; REST maps it to 400 BadRequest."""
+
+
+def _bad(msg: str) -> None:
+    raise ValidationError(msg)
+
+
+def validate_name(name: str, what: str) -> None:
+    if not name:
+        _bad(f"{what}: name is required")
+    if len(name) > 253 or not _NAME_RE.match(name):
+        _bad(
+            f"{what}: invalid name {name!r} (must be a DNS-1123 subdomain: "
+            "lowercase alphanumerics, '-' and '.')"
+        )
+
+
+def validate_label_key(key: str, what: str) -> None:
+    if not key:
+        _bad(f"{what}: empty label key")
+    prefix, slash, name = key.rpartition("/")
+    if slash and (
+        not prefix or len(prefix) > 253 or not _NAME_RE.match(prefix)
+    ):
+        _bad(f"{what}: invalid label key prefix {prefix!r}")
+    if len(name) > 63 or not _LABEL_NAME_RE.match(name):
+        _bad(f"{what}: invalid label key {key!r}")
+
+
+def validate_labels(labels, what: str) -> None:
+    for k, v in labels.items():
+        validate_label_key(k, what)
+        if len(str(v)) > 63 or not _LABEL_VALUE_RE.match(str(v)):
+            _bad(f"{what}: invalid label value {v!r} for key {k!r}")
+
+
+def validate_quantities(d, what: str) -> None:
+    for name, q in d.items():
+        try:
+            v = parse_quantity(q)
+        except Exception:
+            _bad(f"{what}: invalid quantity {q!r} for {name!r}")
+        else:
+            if v < 0:
+                _bad(f"{what}: negative quantity {q!r} for {name!r}")
+
+
+def validate_selector(sel: Optional[Any], what: str) -> None:
+    """LabelSelector: match_labels values + match_expressions operators
+    (apimachinery LabelSelectorAsSelector rules)."""
+    if sel is None:
+        return
+    ml = getattr(sel, "match_labels", None)
+    if ml:
+        # selectors store match_labels as a (key, value) tuple sequence
+        # (api/selectors.py LabelSelector); plain dicts also accepted
+        pairs = ml.items() if hasattr(ml, "items") else ml
+        validate_labels(dict(pairs), f"{what}.matchLabels")
+    for expr in getattr(sel, "match_expressions", ()) or ():
+        op = getattr(expr, "operator", "")
+        if op not in _SELECTOR_OPS:
+            _bad(f"{what}: invalid selector operator {op!r}")
+        values = getattr(expr, "values", ()) or ()
+        if op in ("In", "NotIn") and not values:
+            _bad(f"{what}: operator {op} requires values")
+        if op in ("Exists", "DoesNotExist") and values:
+            _bad(f"{what}: operator {op} must not carry values")
+        validate_label_key(getattr(expr, "key", ""), what)
+
+
+def _validate_pod(pod, what: str) -> None:
+    for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+        validate_quantities(c.requests, f"{what}.resources.requests")
+        validate_quantities(c.limits, f"{what}.resources.limits")
+    if pod.spec.overhead:
+        validate_quantities(pod.spec.overhead, f"{what}.overhead")
+    validate_labels(pod.spec.node_selector, f"{what}.nodeSelector")
+    aff = pod.spec.affinity
+    if aff is not None:
+        pa = getattr(aff, "pod_affinity", None)
+        paa = getattr(aff, "pod_anti_affinity", None)
+        for grp, gname in ((pa, "podAffinity"), (paa, "podAntiAffinity")):
+            if grp is None:
+                continue
+            for term in getattr(grp, "required", ()) or ():
+                validate_selector(term.label_selector, f"{what}.{gname}")
+                if not term.topology_key:
+                    _bad(f"{what}.{gname}: topologyKey is required")
+            for w in getattr(grp, "preferred", ()) or ():
+                term = getattr(w, "pod_affinity_term", None) or getattr(
+                    w, "term", None
+                )
+                if term is not None:
+                    validate_selector(term.label_selector, f"{what}.{gname}")
+    for tsc in pod.spec.topology_spread_constraints:
+        validate_selector(tsc.label_selector, f"{what}.topologySpread")
+        if not tsc.topology_key:
+            _bad(f"{what}.topologySpread: topologyKey is required")
+
+
+def _validate_pod_update(new, old, what: str) -> None:
+    # spec.nodeName is write-once (the bind); moving a running pod is not
+    # a thing (validation.go ValidatePodUpdate: spec is immutable except
+    # image/activeDeadlineSeconds/tolerations additions)
+    if (
+        old.spec.node_name
+        and new.spec.node_name
+        and new.spec.node_name != old.spec.node_name
+    ):
+        _bad(
+            f"{what}: spec.nodeName is immutable "
+            f"({old.spec.node_name!r} -> {new.spec.node_name!r})"
+        )
+    old_req = [c.requests for c in old.spec.containers]
+    new_req = [c.requests for c in new.spec.containers]
+    if len(old_req) == len(new_req) and old_req != new_req:
+        _bad(f"{what}: container resource requests are immutable")
+
+
+def _validate_node(node, what: str) -> None:
+    validate_quantities(node.status.capacity, f"{what}.status.capacity")
+    validate_quantities(node.status.allocatable, f"{what}.status.allocatable")
+
+
+def _validate_workload(obj, what: str) -> None:
+    sel = getattr(obj.spec, "selector", None)
+    # workload selectors may be a plain dict (service-style) or a
+    # LabelSelector object
+    if isinstance(sel, dict):
+        validate_labels(sel, f"{what}.selector")
+    else:
+        validate_selector(sel, f"{what}.selector")
+
+
+def validate_object(
+    verb: str, resource: str, obj: Any, old: Any = None
+) -> None:
+    """Entry point, called by APIServer.create/update after admission."""
+    meta = getattr(obj, "metadata", None)
+    if meta is None:
+        return
+    what = f"{resource}/{meta.name}"
+    # events are machine-generated at high rate with dotted composite
+    # names; skip the name gate there (the reference's event names are
+    # similarly synthetic)
+    if resource != "events":
+        validate_name(meta.name, what)
+        if meta.namespace:
+            validate_name(meta.namespace, what + ".namespace")
+        if meta.labels:
+            validate_labels(meta.labels, what + ".labels")
+    if resource == "pods":
+        _validate_pod(obj, what)
+        if verb == "update" and old is not None:
+            _validate_pod_update(obj, old, what)
+    elif resource == "nodes":
+        _validate_node(obj, what)
+    elif resource in (
+        "services",
+        "replicasets",
+        "deployments",
+        "daemonsets",
+        "statefulsets",
+        "jobs",
+        "poddisruptionbudgets",
+    ):
+        _validate_workload(obj, what)
+    elif resource in ("persistentvolumeclaims",):
+        validate_quantities(
+            getattr(obj.spec, "resources", {}) or {}, what + ".resources"
+        )
+    elif resource == "resourcequotas":
+        validate_quantities(obj.spec.hard, what + ".hard")

@@ -229,18 +229,23 @@ class PodNodeSelectorAdmission(AdmissionPlugin):
 class PodTolerationRestrictionAdmission(AdmissionPlugin):
     """Namespace toleration whitelists (plugin/pkg/admission/
     podtolerationrestriction): a pod may only carry tolerations the
-    namespace's whitelist annotation allows (JSON list of {key} objects;
-    no annotation = everything allowed).
+    namespace's whitelist annotation allows (JSON list of Toleration
+    objects, reference admission.go:59 NSWLTolerations; no annotation =
+    everything allowed).
 
-    Registered as a MUTATING-phase gate ordered BEFORE the toleration
-    injectors (DefaultTolerationSeconds, ExtendedResourceToleration) —
-    the upstream ordering — so it judges only USER-supplied tolerations,
-    never the chain's own additions. On update, only NEWLY ADDED
-    toleration keys are checked (the stored pod legitimately carries
-    chain-injected keys from create)."""
+    Chain position follows AllOrderedPlugins (plugins.go:83): AFTER
+    DefaultTolerationSeconds — whose injected not-ready/unreachable
+    tolerations are therefore whitelist-checked, exactly like the
+    reference's merged-set verification (VerifyAgainstWhitelist over
+    pod.Spec.Tolerations post-merge) — and BEFORE
+    ExtendedResourceToleration, whose additions escape the check. On
+    update, only NEWLY ADDED toleration keys are checked (the stored pod
+    legitimately carries chain-injected keys from create)."""
 
     name = "PodTolerationRestriction"
-    WHITELIST = "scheduler.alpha.kubernetes.io/defaultTolerationsWhitelist"
+    # the reference's NSWLTolerations annotation key (admission.go:59); the
+    # value is a JSON list of full Toleration objects
+    WHITELIST = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
 
     def __init__(self, server):
         self.server = server
@@ -258,6 +263,8 @@ class PodTolerationRestrictionAdmission(AdmissionPlugin):
         if not raw:
             return
         try:
+            # reference wire format: a list of Toleration objects; the
+            # key is the whitelist axis this build enforces
             allowed = {e.get("key", "") for e in _json.loads(raw)}
         except (ValueError, AttributeError):
             return  # malformed whitelist: fail open like a missing one
@@ -322,6 +329,129 @@ class PVCResizeAdmission(AdmissionPlugin):
         if not sc.allow_volume_expansion:
             raise AdmissionDenied(
                 f"storage class {sc_name!r} does not allow volume expansion"
+            )
+
+
+class RuntimeClassAdmission(AdmissionPlugin):
+    """Merge a pod's named RuntimeClass into its spec at create
+    (plugin/pkg/admission/runtimeclass/admission.go): the class's overhead
+    becomes spec.overhead (a user-supplied CONFLICTING overhead is denied),
+    and the class's scheduling nodeSelector/tolerations merge like
+    PodNodeSelector does — selector conflicts are denied, tolerations
+    append."""
+
+    name = "RuntimeClass"
+
+    def __init__(self, server):
+        self.server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "pods":
+            return
+        rc_name = obj.spec.runtime_class_name
+        if not rc_name:
+            return
+        try:
+            # cluster-scoped: the store canonicalizes the namespace to ""
+            rc = self.server.get("runtimeclasses", "", rc_name)
+        except Exception:
+            raise AdmissionDenied(
+                f"pod rejected: RuntimeClass {rc_name!r} not found"
+            )
+        if rc.overhead:
+            if obj.spec.overhead and dict(obj.spec.overhead) != dict(rc.overhead):
+                raise AdmissionDenied(
+                    "pod overhead does not match RuntimeClass "
+                    f"{rc_name!r} overhead"
+                )
+            obj.spec.overhead = dict(rc.overhead)
+        sched = rc.scheduling
+        if sched is not None:
+            for k, val in sched.node_selector.items():
+                if obj.spec.node_selector.get(k, val) != val:
+                    raise AdmissionDenied(
+                        f"pod node selector {k}={obj.spec.node_selector[k]} "
+                        f"conflicts with RuntimeClass selector {k}={val}"
+                    )
+                obj.spec.node_selector[k] = val
+            existing = {
+                (t.key, t.operator, t.value, t.effect)
+                for t in obj.spec.tolerations
+            }
+            for t in sched.tolerations:
+                if (t.key, t.operator, t.value, t.effect) not in existing:
+                    obj.spec.tolerations.append(t)
+
+
+class TaintNodesByConditionAdmission(AdmissionPlugin):
+    """New nodes are tainted not-ready at create
+    (plugin/pkg/admission/nodetaint/admission.go): the node lifecycle
+    controller lifts the taint once the node reports Ready, closing the
+    window where pods land on a node whose kubelet has not yet synced."""
+
+    name = "TaintNodesByCondition"
+    TAINT_KEY = "node.kubernetes.io/not-ready"
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "nodes":
+            return
+        # a registration that already reports Ready=True has no
+        # kubelet-not-yet-synced window to close (this build's kubelet
+        # registers with live status in one write; the reference's
+        # two-step register-then-sync is where the window exists)
+        if any(
+            c.type == v1.NODE_READY and c.status == "True"
+            for c in obj.status.conditions
+        ):
+            return
+        if any(t.key == self.TAINT_KEY for t in obj.spec.taints):
+            return
+        obj.spec.taints.append(
+            v1.Taint(self.TAINT_KEY, "", v1.TAINT_NO_SCHEDULE)
+        )
+
+
+class StorageObjectInUseProtectionAdmission(AdmissionPlugin):
+    """PVCs/PVs get their protection finalizer at create
+    (plugin/pkg/admission/storage/storageobjectinuseprotection): deletion
+    then parks until the protection controller confirms no pod uses the
+    object (controller/podgc.py PVC/PVProtectionController strips it)."""
+
+    name = "StorageObjectInUseProtection"
+    PVC_FINALIZER = "kubernetes.io/pvc-protection"
+    PV_FINALIZER = "kubernetes.io/pv-protection"
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create":
+            return
+        if resource == "persistentvolumeclaims":
+            if self.PVC_FINALIZER not in obj.metadata.finalizers:
+                obj.metadata.finalizers.append(self.PVC_FINALIZER)
+        elif resource == "persistentvolumes":
+            if self.PV_FINALIZER not in obj.metadata.finalizers:
+                obj.metadata.finalizers.append(self.PV_FINALIZER)
+
+
+class CertificateSubjectRestrictionAdmission(AdmissionPlugin):
+    """CSRs for the kube-apiserver-client signer claiming system:masters
+    are denied (plugin/pkg/admission/certificates/subjectrestriction):
+    auto-approval flows must never be able to mint a cluster-admin
+    credential."""
+
+    name = "CertificateSubjectRestriction"
+    SIGNER = "kubernetes.io/kube-apiserver-client"
+    BLOCKED_GROUP = "system:masters"
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "certificatesigningrequests":
+            return
+        if (
+            obj.spec.signer_name == self.SIGNER
+            and self.BLOCKED_GROUP in obj.spec.groups
+        ):
+            raise AdmissionDenied(
+                f"use of signer {self.SIGNER} is not allowed for group "
+                f"{self.BLOCKED_GROUP}"
             )
 
 

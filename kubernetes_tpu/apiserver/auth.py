@@ -78,6 +78,8 @@ class TokenAuthenticator:
         self._sa_index: Dict[str, UserInfo] = {}
         self._sa_built_at = float("-inf")
         self._sa_ttl = 2.0
+        self._csr_index: Dict[str, UserInfo] = {}
+        self._csr_built_at = float("-inf")
 
     def add_token(self, token: str, user: str, groups: Sequence[str] = ()) -> None:
         with self._lock:
@@ -113,13 +115,62 @@ class TokenAuthenticator:
             self._sa_built_at = now
         return idx
 
+    def _csr_tokens(self, force: bool = False) -> Dict[str, UserInfo]:
+        """Signed-CSR credential index: the CSR signer issues an HMAC
+        credential (controller/certificates.py CSRSigningController); a
+        bearer presenting it authenticates as the CSR's username — the
+        kubelet client-cert flow with tokens standing in for x509. Node
+        usernames (system:node:*) get the system:nodes group, which routes
+        them into the node authorizer (apiserver/nodeauth.py)."""
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._csr_built_at < self._sa_ttl:
+                return self._csr_index
+        try:
+            csrs, _ = self._server.list("certificatesigningrequests")
+        except Exception:
+            # transient store failure: keep serving the stale index rather
+            # than caching an empty one (which would 401 every node
+            # credential for a TTL)
+            logger.exception("rebuilding CSR token index failed; serving stale")
+            with self._lock:
+                self._csr_built_at = now
+                return self._csr_index
+        from .admission import NODE_USER_PREFIX, NODES_GROUP
+
+        idx: Dict[str, UserInfo] = {}
+        for c in csrs:
+            cert = c.status.certificate
+            if not cert:
+                continue
+            groups = tuple(c.spec.groups)
+            if c.spec.username.startswith(NODE_USER_PREFIX):
+                groups = tuple(sorted(set(groups) | {NODES_GROUP}))
+            idx[cert] = UserInfo(c.spec.username, groups)
+        with self._lock:
+            self._csr_index = idx
+            self._csr_built_at = now
+        return idx
+
     def authenticate_token(self, token: str) -> Optional[UserInfo]:
         with self._lock:
             ui = self._tokens.get(token)
         if ui is not None:
             return ui
         if self._server is not None:
-            return self._sa_tokens().get(token)
+            ui = self._sa_tokens().get(token)
+            if ui is not None:
+                return ui
+            ui = self._csr_tokens().get(token)
+            if ui is None:
+                # a freshly signed credential can be newer than the cached
+                # index (a node joining within the TTL of the last rebuild
+                # would be 401'd and its informer threads killed) — a miss
+                # triggers one immediate rebuild before rejecting
+                ui = self._csr_tokens(force=True).get(token)
+            return ui
         return None
 
     def authenticate_header(self, authorization: str) -> Optional[UserInfo]:

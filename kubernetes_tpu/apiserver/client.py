@@ -210,6 +210,18 @@ class RESTClient:
         threading.Thread(target=pump, daemon=True).start()
         return w
 
+    def bind_pod(self, binding) -> None:
+        """Single-pod binding subresource (DefaultBinder's surface; the
+        bulk bind_pods below shares the wire path). Raises on failure so
+        the bind plugin's error handling fires like the in-process store."""
+        self._request(
+            "POST",
+            self.base
+            + f"/api/v1/namespaces/{binding.pod_namespace}/pods/"
+            + f"{binding.pod_name}/binding",
+            codec.encode(binding),
+        )
+
     def bind_pods(self, bindings) -> list:
         errors = []
         for b in bindings:

@@ -39,6 +39,7 @@ from ..client.apiserver import (
     Expired,
     NotFound,
 )
+from ..api.validation import ValidationError
 from .auth import AdmissionDenied
 
 _WATCH_POLL_S = 0.5
@@ -569,7 +570,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._status_error(
                     404, "NotFound", f"no such resource {resource}"
                 )
-            if not self._authorize("create", resource, ns):
+            # subresources authorize under their own resource name
+            # (authorization.k8s.io attributes): pods/binding is the verb
+            # the SCHEDULER holds — the node authorizer denies it to
+            # kubelets even though they may create (mirror) pods
+            authz_resource = resource
+            if resource == "pods" and name and name.endswith("/binding"):
+                authz_resource = "bindings"
+            if not self._authorize("create", authz_resource, ns):
                 return
         try:
             body = self._read_body()
@@ -667,6 +675,8 @@ class _Handler(BaseHTTPRequestHandler):
             # e.g. evicting/binding a pod that vanished — NotFound is a
             # KeyError subclass, so this must precede the 400 handler
             return self._status_error(404, "NotFound", str(e))
+        except ValidationError as e:
+            return self._status_error(400, "Invalid", str(e))
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 
@@ -692,6 +702,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(409, "Conflict", str(e))
         except AdmissionDenied as e:
             return self._status_error(403, "Forbidden", str(e))
+        except ValidationError as e:
+            return self._status_error(400, "Invalid", str(e))
         except (KeyError, json.JSONDecodeError) as e:
             return self._status_error(400, "BadRequest", str(e))
 

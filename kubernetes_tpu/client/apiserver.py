@@ -21,6 +21,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..api import serialization, validation
 from ..api.objects import event_copy
 from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
 
@@ -162,6 +163,20 @@ class APIServer:
     def _key(obj: Any) -> str:
         return obj.metadata.key
 
+    @staticmethod
+    def _normalize_scope(kind: str, obj: Any) -> None:
+        """Cluster-scoped kinds store under namespace '' regardless of how
+        the client spelled it (a plain manifest decode defaults to
+        'default') — one canonical key, no per-consumer probe loops."""
+        if kind in serialization.CLUSTER_SCOPED and obj.metadata.namespace:
+            obj.metadata.namespace = ""
+
+    @staticmethod
+    def _normalize_ns(kind: str, namespace: str) -> str:
+        if kind in serialization.CLUSTER_SCOPED:
+            return ""
+        return namespace
+
     def _bump(self, obj: Any) -> int:
         self._rv += 1
         obj.metadata.resource_version = self._rv
@@ -201,7 +216,13 @@ class APIServer:
         # themselves: QuotaAdmission check-and-reserves under its own mutex
         # (racing creates cannot both pass a quota with room for one,
         # matching the reference's transactional quota reservation)
+        self._normalize_scope(kind, obj)
         self._admit("create", kind, obj)
+        # always-on boundary validation AFTER admission mutators (the
+        # reference's strategy.Validate ordering: defaulted fields are
+        # validated, not raw input) — malformed objects 400 here instead
+        # of surfacing later as encode-time scheduler exceptions
+        validation.validate_object("create", kind, obj)
         with self._lock:
             store = self._objects.setdefault(kind, {})
             key = self._key(obj)
@@ -218,6 +239,7 @@ class APIServer:
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
+        namespace = self._normalize_ns(kind, namespace)
         with self._lock:
             key = f"{namespace}/{name}" if namespace else name
             store = self._objects.get(kind, {})
@@ -227,6 +249,7 @@ class APIServer:
 
     def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
         self._check_writable()
+        self._normalize_scope(kind, obj)
         self._admit("update", kind, obj)  # outside the lock, see create()
         with self._lock:
             store = self._objects.setdefault(kind, {})
@@ -243,6 +266,7 @@ class APIServer:
                     f"{kind} {key}: rv {obj.metadata.resource_version} != "
                     f"{cur.metadata.resource_version}"
                 )
+            validation.validate_object("update", kind, obj, old=cur)
             self._bump(obj)
             stored = copy.deepcopy(obj)
             # graceful deletion completes when the last finalizer is
@@ -289,6 +313,7 @@ class APIServer:
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         self._check_writable()
+        namespace = self._normalize_ns(kind, namespace)
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
             store = self._objects.get(kind, {})

@@ -125,7 +125,21 @@ class SharedInformer:
                 h.on_add(obj)
 
     def _run(self) -> None:
-        objs, rv = self._server.list(self.kind)
+        # initial list with retry: a transient 401/5xx (e.g. an authn index
+        # catching up to a freshly issued credential) must not permanently
+        # kill the informer thread — the reflector relists with backoff
+        backoff = 0.1
+        while True:
+            try:
+                objs, rv = self._server.list(self.kind)
+                break
+            except Exception:
+                logger.exception(
+                    "initial list of %s failed; retrying", self.kind
+                )
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)
         self._replace(objs)
         self._synced.set()
         # Expired ("resourceVersion too old", 410 Gone): the event window

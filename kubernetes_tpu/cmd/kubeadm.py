@@ -21,6 +21,7 @@ import logging
 import os
 import secrets
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -70,12 +71,16 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
     unauthenticated, admission-free API server). Returns (authn, authz)
     and installs the admit-hook chain on the store."""
     from ..apiserver.admission import (
+        CertificateSubjectRestrictionAdmission,
         ExtendedResourceTolerationAdmission,
         NodeRestrictionAdmission,
         PodNodeSelectorAdmission,
         PodSecurityPolicyAdmission,
         PodTolerationRestrictionAdmission,
         PVCResizeAdmission,
+        RuntimeClassAdmission,
+        StorageObjectInUseProtectionAdmission,
+        TaintNodesByConditionAdmission,
     )
     from ..apiserver.auth import (
         MASTERS_GROUP,
@@ -107,8 +112,12 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
             bootstrap_token, "system:bootstrap", groups=("system:bootstrappers",)
         )
     # server-backed: ClusterRole/ClusterRoleBinding objects created via the
-    # API feed authorization alongside the programmatic bootstrap policy
-    authz = RBACAuthorizer(server=store)
+    # API feed authorization alongside the programmatic bootstrap policy;
+    # node identities (system:node:*) route through the node authorizer's
+    # decision table instead (plugin/pkg/auth/authorizer/node)
+    from ..apiserver.nodeauth import NodeAwareAuthorizer
+
+    authz = NodeAwareAuthorizer(RBACAuthorizer(server=store), store)
     # bootstrappers run node agents: register + heartbeat, sync pods, and
     # feed the node-side service dataplane (the system:node role shape)
     authz.bind(
@@ -127,33 +136,45 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
     authz.bind(
         "system:bootstrappers", make_rule(["get"], ["configmaps"], ["kube-public"])
     )
+    # TLS bootstrap: file + poll the kubelet CSR (the reference's
+    # system:node-bootstrapper ClusterRole)
+    authz.bind(
+        "system:bootstrappers",
+        make_rule(["create", "get"], ["certificatesigningrequests"]),
+    )
     store.admit_hooks.append(ClusterIPAllocator())
     # mutators first, then validators (admission/chain.go ordering); the
-    # plugin set mirrors the reference's default enabled admission list
+    # per-phase sequence follows the reference's recommended order
+    # (pkg/kubeapiserver/options/plugins.go:64 AllOrderedPlugins). Notable
+    # reference-faithful consequences: DefaultTolerationSeconds' injected
+    # tolerations ARE subject to a namespace whitelist (it precedes
+    # PodTolerationRestriction) while ExtendedResourceToleration's are
+    # not (it follows); ResourceQuota runs last, after the webhooks.
     store.admit_hooks.append(
         AdmissionChain(
             mutating=[
-                ServiceAccountAdmission(),
-                PriorityAdmission(store),
-                DefaultStorageClassAdmission(store),
-                # the whitelist gate runs BEFORE the toleration injectors
-                # (upstream ordering): it judges user-supplied tolerations
-                # only, never the chain's own additions
-                PodTolerationRestrictionAdmission(store),
-                DefaultTolerationSecondsAdmission(),
-                ExtendedResourceTolerationAdmission(),
-                PodNodeSelectorAdmission(store),
                 LimitRangerAdmission(store),
+                ServiceAccountAdmission(),
+                TaintNodesByConditionAdmission(),
+                PodNodeSelectorAdmission(store),
+                PriorityAdmission(store),
+                DefaultTolerationSecondsAdmission(),
+                PodTolerationRestrictionAdmission(store),
+                ExtendedResourceTolerationAdmission(),
+                DefaultStorageClassAdmission(store),
+                StorageObjectInUseProtectionAdmission(),
+                RuntimeClassAdmission(store),
                 MutatingWebhookAdmission(store),
             ],
             validating=[
                 NamespaceLifecycleAdmission(store),
+                LimitRangerAdmission(store),
                 NodeRestrictionAdmission(),
                 PodSecurityPolicyAdmission(store),
                 PVCResizeAdmission(store),
-                LimitRangerAdmission(store),
-                QuotaAdmission(store),
+                CertificateSubjectRestrictionAdmission(),
                 ValidatingWebhookAdmission(store),
+                QuotaAdmission(store),
             ],
         )
     )
@@ -435,6 +456,44 @@ def discover_cluster_info(
     raise PermissionError(f"cluster-info discovery failed: {last}")
 
 
+def _request_node_credential(
+    client, node_name: str, timeout_s: float = 10.0
+) -> str:
+    """File a kubelet CSR and wait for the signed credential ('' on
+    timeout). Reference flow: kubelet TLS bootstrap — CSR with
+    CN=system:node:<name>, O=system:nodes, auto-approved (sarapprove) and
+    signed (certificates signer)."""
+    from ..api import objects as v1
+    from ..client.apiserver import AlreadyExists
+
+    csr_name = f"node-csr-{node_name}"
+    csr = v1.CertificateSigningRequest(
+        metadata=v1.ObjectMeta(name=csr_name, namespace=""),
+        spec=v1.CertificateSigningRequestSpec(
+            request=node_name,
+            username=f"system:node:{node_name}",
+            groups=["system:nodes"],
+            usages=["client auth"],
+            signer_name="kubernetes.io/kube-apiserver-client-kubelet",
+        ),
+    )
+    try:
+        client.create("certificatesigningrequests", csr)
+    except AlreadyExists:
+        pass
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            cur = client.get("certificatesigningrequests", "", csr_name)
+        except Exception:
+            cur = None
+        cert = cur.status.certificate if cur is not None else ""
+        if cert:
+            return cert
+        time.sleep(0.1)
+    return ""
+
+
 def join_node(
     server_url: str,
     token: str,
@@ -463,6 +522,20 @@ def join_node(
         client.create("nodes", node)
     except AlreadyExists:
         pass  # re-join of a registered node
+    # TLS-bootstrap analogue (kubeadm's kubelet-start phase): trade the
+    # shared bootstrap token for a per-node identity. The CSR auto-approve
+    # + signing controllers issue the credential; the authenticator's
+    # signed-CSR index then maps it to system:node:<name> in the
+    # system:nodes group, where the node authorizer's decision table
+    # applies. If the control plane runs without those controllers, stay
+    # on the bootstrap token (degraded but functional).
+    try:
+        cred = _request_node_credential(client, node_name)
+        if cred:
+            client._headers["Authorization"] = f"Bearer {cred}"
+            logger.info("[join] node %s holds its node identity", node_name)
+    except Exception:
+        logger.exception("node credential bootstrap failed; keeping token")
     pool = NodeAgentPool(client)
     pool.add_node(node_name, register=False)
     pool.start()

@@ -67,21 +67,8 @@ ALIASES = {
 }
 
 
-CLUSTER_SCOPED = {
-    "nodes",
-    "persistentvolumes",
-    "storageclasses",
-    "csinodes",
-    "namespaces",
-    "priorityclasses",
-    "customresourcedefinitions",
-    "apiservices",
-    "clusterroles",
-    "clusterrolebindings",
-    "mutatingwebhookconfigurations",
-    "validatingwebhookconfigurations",
-    "certificatesigningrequests",
-}
+# shared with the store's namespace normalization (one source of truth)
+from ..api.serialization import CLUSTER_SCOPED  # noqa: E402
 
 
 def _resource(arg: str) -> str:

@@ -21,6 +21,11 @@ from ..kubemark.hollow_node import NODE_LEASE_NS
 logger = logging.getLogger("kubernetes_tpu.controller.nodelifecycle")
 
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+# applied at node CREATE by the TaintNodesByCondition admission plugin
+# (apiserver/admission.py); this controller lifts it once the node is
+# Ready and re-applies it while NotReady (nodetaint/admission.go pairs
+# with the lifecycle controller's taint reconciliation the same way)
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 
 
 class NodeLifecycleController:
@@ -63,8 +68,13 @@ class NodeLifecycleController:
             name = node.metadata.name
             healthy = self._node_healthy(name, now)
             if healthy:
-                if name in self._not_ready_since:
-                    del self._not_ready_since[name]
+                # also covers a NEW node healthy from its first pass: it
+                # carries the admission-time not-ready taint that only the
+                # ready reconcile below lifts
+                if name in self._not_ready_since or any(
+                    t.key == TAINT_NOT_READY for t in node.spec.taints
+                ):
+                    self._not_ready_since.pop(name, None)
                     self._set_ready(name, True)
             else:
                 since = self._not_ready_since.setdefault(name, now)
@@ -100,16 +110,27 @@ class NodeLifecycleController:
             has_taint = any(
                 t.key == TAINT_UNREACHABLE for t in node.spec.taints
             )
-            if ready and has_taint:
+            has_nr_taint = any(
+                t.key == TAINT_NOT_READY for t in node.spec.taints
+            )
+            if ready and (has_taint or has_nr_taint):
                 node.spec.taints = [
-                    t for t in node.spec.taints if t.key != TAINT_UNREACHABLE
+                    t
+                    for t in node.spec.taints
+                    if t.key not in (TAINT_UNREACHABLE, TAINT_NOT_READY)
                 ]
                 changed = True
-            elif not ready and not has_taint:
-                node.spec.taints.append(
-                    v1.Taint(TAINT_UNREACHABLE, "", v1.TAINT_NO_EXECUTE)
-                )
-                changed = True
+            elif not ready:
+                if not has_taint:
+                    node.spec.taints.append(
+                        v1.Taint(TAINT_UNREACHABLE, "", v1.TAINT_NO_EXECUTE)
+                    )
+                    changed = True
+                if not has_nr_taint:
+                    node.spec.taints.append(
+                        v1.Taint(TAINT_NOT_READY, "", v1.TAINT_NO_SCHEDULE)
+                    )
+                    changed = True
             return node if changed else None
 
         try:

@@ -75,6 +75,8 @@ def make_sharded_wave_kernel(
     mesh: Mesh,
     use_pallas_fit: bool = False,
     score_refresh: bool = True,
+    rtc_shape: tuple = None,
+    has_pinned: bool = True,
 ):
     """The PRODUCTION wave kernel (ops/wavelattice.py) jitted with the
     snapshot sharded over the mesh's node axis.
@@ -94,6 +96,8 @@ def make_sharded_wave_kernel(
     multi-chip analogue of the reference's 16-way node fan-out
     (generic_scheduler.go:490) with ICI collectives instead of goroutines.
     """
+    from ..ops.wavelattice import DEFAULT_RTC_SHAPE
+
     base = make_wave_kernel(
         v_cap,
         m_cand,
@@ -101,6 +105,8 @@ def make_sharded_wave_kernel(
         hard_pod_affinity_weight,
         use_pallas_fit,
         score_refresh,
+        rtc_shape or DEFAULT_RTC_SHAPE,
+        has_pinned,
     )
     rep = replicated(mesh)
     snap_sh = snapshot_shardings(mesh)

@@ -53,6 +53,9 @@ class SchedulerCache:
         self._ttl = ttl_seconds
         self.encoder = encoder or SnapshotEncoder(encoding_config)
         self._generation = 0
+        # name -> last handed-out clone (generation-tagged) for the
+        # incremental update_snapshot below
+        self._snap_clones: Dict[str, NodeInfo] = {}
         self._stop = threading.Event()
         self._janitor: Optional[threading.Thread] = None
 
@@ -335,9 +338,26 @@ class SchedulerCache:
 
     def update_snapshot(self) -> Snapshot:
         """Host snapshot for oracle/fallback/preemption paths. NodeInfos are
-        cloned so the cycle sees immutable state (snapshot.go semantics)."""
+        cloned so the cycle sees immutable state (snapshot.go semantics).
+
+        Incremental by node generation (the reference's
+        cache.UpdateSnapshot, cache.go:200): only nodes whose generation
+        moved since the last call are re-cloned — the host path re-snapshots
+        per pod (scheduleOne semantics), and a full 5k-node clone per pod
+        would dominate small-batch latency. Cycles never mutate snapshot
+        NodeInfos (preemption/nominated simulation clone first), so reuse
+        across snapshots is safe."""
         with self.lock:
-            snap = Snapshot([ni.clone() for ni in self._nodes.values()])
+            cached = self._snap_clones
+            fresh: Dict[str, NodeInfo] = {}
+            for name, ni in self._nodes.items():
+                old = cached.get(name)
+                if old is not None and old.generation == ni.generation:
+                    fresh[name] = old
+                else:
+                    fresh[name] = ni.clone()
+            self._snap_clones = fresh
+            snap = Snapshot(list(fresh.values()))
             snap.generation = self._generation
             return snap
 

@@ -95,7 +95,9 @@ def default_registry() -> Registry:
     r["NodeResourcesLeastAllocated"] = lambda ctx: p.NodeResourcesLeastAllocated()
     r["NodeResourcesMostAllocated"] = lambda ctx: p.NodeResourcesMostAllocated()
     r["NodeResourcesBalancedAllocation"] = lambda ctx: p.NodeResourcesBalancedAllocation()
-    r["RequestedToCapacityRatio"] = lambda ctx: p.RequestedToCapacityRatio()
+    r["RequestedToCapacityRatio"] = lambda ctx: p.RequestedToCapacityRatio(
+        ctx.get("rtc_shape")
+    )
     r["NodeAffinity"] = lambda ctx: p.NodeAffinityPlugin()
     r["TaintToleration"] = lambda ctx: p.TaintTolerationPlugin()
     r["PodTopologySpread"] = lambda ctx: p.PodTopologySpreadPlugin(

@@ -160,21 +160,31 @@ class PriorityQueue:
     ) -> List[QueuedPodInfo]:
         """Pop up to max_n pods: block for the first, then drain without
         blocking (optionally lingering up to `window` seconds to let a burst
-        accumulate — the gang/batch former)."""
+        accumulate — the gang/batch former).
+
+        The linger is ADAPTIVE (r4 verdict #4): it holds only while the
+        producer is actively producing. Once no new pod has arrived for
+        `idle_gap` the batch ships immediately — a lone low-load pod pays
+        ~3 ms of former latency instead of the full window, while a burst
+        mid-arrival keeps accumulating up to `window`."""
+        idle_gap = min(0.003, window) if window > 0 else 0.0
         first = self.pop(timeout, on_pop=on_first)
         if first is None:
             return []
         out = [first]
         deadline = time.monotonic() + window
+        last_arrival = time.monotonic()
         while len(out) < max_n:
             with self._cond:
                 pi = self._active.pop()
                 if pi is not None:
                     pi.attempts += 1
                     out.append(pi)
+                    last_arrival = time.monotonic()
                     continue
-            if window > 0 and time.monotonic() < deadline:
-                time.sleep(min(0.0005, window / 4))
+            now = time.monotonic()
+            if window > 0 and now < deadline and now - last_arrival < idle_gap:
+                time.sleep(0.0005)
                 continue
             break
         return out

@@ -157,7 +157,13 @@ class Scheduler:
                 for m in e.managed_resources
                 if m.ignored_by_scheduler
             ),
+            "rtc_shape": self.cfg.rtc_shape,
         }
+        # static per profile: part of the kernel-variant key so a custom
+        # shape compiles its own variant and matches the host plugin
+        self._rtc_shape = tuple(
+            sorted(tuple(p) for p in (self.cfg.rtc_shape or ()))
+        ) or None
         self.profiles: ProfileMap = new_profile_map(self.cfg, context, server=server)
         # queue order comes from the default profile's QueueSort plugin
         # (Configurator wires profiles[0].QueueSortFunc into the queue,
@@ -436,6 +442,25 @@ class Scheduler:
             self._schedule_one_host(pi, moves0)
         if not known:
             return
+        if (
+            0 < len(known) <= self.cfg.small_batch_host_max
+            and self.cache.node_count <= self.cfg.small_batch_host_node_max
+            and self.cfg.use_device
+        ):
+            # low-load latency path for SMALL clusters: a tiny batch on the
+            # device path pays a full cycle (kernel + >=1 readback RTT) for
+            # a handful of pods; the host scheduleOne at <=256 nodes costs
+            # single-digit ms (snapshot clones are generation-incremental).
+            # At thousands of nodes the Python filter chain is SLOWER than
+            # the kernel — big clusters stay on the device path and get the
+            # small-pad/m_cand variant instead. Device state stays
+            # consistent: the host path resolves in-flight batches and its
+            # binds dirty the encoder rows like any informer write.
+            self._resolve_pending()
+            for pi in known:
+                self._schedule_one_host(pi, moves0)
+            trace.log_if_long(0.1)
+            return
         if self.cfg.use_device and self.cfg.use_wave:
             self._schedule_batch_wave(known, moves0, trace, t_start)
         elif self.cfg.use_device:
@@ -589,6 +614,15 @@ class Scheduler:
         # bucket is another multi-second XLA compile on first use
         small = min(256, self._batch_size)
         pad = small if len(pis) <= small else self._batch_size
+        # tiny batches ride the narrow-candidate variant: per-wave cost
+        # scales with m_cand, and a 1-pod low-load cycle should not pay
+        # the 128-candidate list sized for 4096-pod bursts
+        small_bucket = pad == small and small < self._batch_size
+        m_cand = (
+            min(self.cfg.wave_m_cand_small, self.cfg.wave_m_cand)
+            if small_bucket
+            else self.cfg.wave_m_cand
+        )
         # encode → drain-check → flush must be ATOMIC under the cache lock:
         # a dirty-row scatter uploads full rows from the host masters, which
         # must already include the in-flight batch's replayed placements or
@@ -606,6 +640,13 @@ class Scheduler:
                 eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
                 trace.step("tpl-encode")
                 ptab, n_waves = self._pair_table(eb)
+                if small_bucket and n_waves <= 4:
+                    # latency bucket, no hard pairs in the batch (the
+                    # pair-table already picked the short count): ≤256
+                    # pods across the cluster rarely conflict, and a
+                    # deferred loser just requeues — 2 waves suffice and
+                    # halve the small-cycle cost
+                    n_waves = min(n_waves, 2)
                 trace.step("pair-table")
                 if (
                     not self._pending
@@ -626,26 +667,36 @@ class Scheduler:
                     break
             self._resolve_pending()
         trace.step("flush")
+        # static pinnedness: compiling the pinned-row plan only into
+        # batches that carry pinned pods keeps the common path lean (two
+        # variants max per config; pod_name_row is host-resident numpy)
+        has_pinned = bool((eb.batch.pod_name_row >= 0).any())
         if self._mesh is not None:
             from ..parallel.sharded import make_sharded_wave_kernel
 
             kern = make_sharded_wave_kernel(
                 enc_cfg.v_cap,
-                self.cfg.wave_m_cand,
+                m_cand,
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self._mesh,
                 self.cfg.use_pallas_fit,
                 self._score_refresh,
+                self._rtc_shape,
+                has_pinned,
             )
         else:
+            from ..ops.wavelattice import DEFAULT_RTC_SHAPE
+
             kern = make_wave_kernel_jit(
                 enc_cfg.v_cap,
-                self.cfg.wave_m_cand,
+                m_cand,
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self.cfg.use_pallas_fit,
                 self._score_refresh,
+                self._rtc_shape or DEFAULT_RTC_SHAPE,
+                has_pinned,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:

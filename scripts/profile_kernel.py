@@ -33,6 +33,10 @@ os.environ["JAX_PLATFORMS"] = _plat
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+# sitecustomize pins jax_platforms to the tunneled axon TPU via jax.config;
+# the env var alone does not override it — force the chosen platform here
+jax.config.update("jax_platforms", _plat)
+
 
 def build_inputs(n_nodes: int, n_pods: int):
     from kubernetes_tpu.client.apiserver import APIServer

@@ -337,9 +337,12 @@ def test_pvc_resize_gate():
 
 
 def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
-    """Through the REAL assembled chain: a whitelisted namespace still
-    admits plain pods (the chain's own not-ready/unreachable injections
-    are not judged) and chip pods still get their resource toleration."""
+    """Through the REAL assembled chain, reference-faithful ordering
+    (AllOrderedPlugins, plugins.go:82-87): DefaultTolerationSeconds runs
+    BEFORE the whitelist gate, so its not-ready/unreachable injections
+    ARE judged (a strict whitelist must include them — the reference's
+    merged-set VerifyAgainstWhitelist); ExtendedResourceToleration runs
+    AFTER, so its chip toleration escapes the check."""
     import json as _json
 
     from kubernetes_tpu.cmd.kubeadm import assemble_security
@@ -349,6 +352,32 @@ def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
 
     store = APIServer()
     assemble_security(store, admin_token="t")
+    # strict whitelist WITHOUT the chain-injected keys: plain pods are
+    # denied, exactly like the reference
+    store.create(
+        "namespaces",
+        v1.Namespace(
+            metadata=v1.ObjectMeta(
+                name="strict",
+                namespace="",
+                annotations={
+                    PodTolerationRestrictionAdmission.WHITELIST: _json.dumps(
+                        [{"key": "dedicated"}]
+                    )
+                },
+            )
+        ),
+    )
+    with pytest.raises(AdmissionDenied, match="not whitelisted"):
+        store.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name="plain", namespace="strict"),
+                spec=v1.PodSpec(containers=[v1.Container()]),
+            ),
+        )
+    # whitelist that includes the injected keys: admitted, and the
+    # post-gate chip injection still lands un-judged
     store.create(
         "namespaces",
         v1.Namespace(
@@ -357,7 +386,11 @@ def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
                 namespace="",
                 annotations={
                     PodTolerationRestrictionAdmission.WHITELIST: _json.dumps(
-                        [{"key": "dedicated"}]
+                        [
+                            {"key": "dedicated"},
+                            {"key": "node.kubernetes.io/not-ready"},
+                            {"key": "node.kubernetes.io/unreachable"},
+                        ]
                     )
                 },
             )
@@ -369,10 +402,10 @@ def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
             containers=[v1.Container(requests={"tpu.dev/chip": "1"})]
         ),
     )
-    store.create("pods", p)  # must NOT be rejected
+    store.create("pods", p)
     stored = store.get("pods", "wl", "plain")
     keys = {t.key for t in stored.spec.tolerations}
-    assert "tpu.dev/chip" in keys  # injector still ran (after the gate)
+    assert "tpu.dev/chip" in keys  # injector ran after the gate, unjudged
     # a USER-supplied non-whitelisted toleration is still denied
     q = v1.Pod(
         metadata=v1.ObjectMeta(name="bad", namespace="wl"),
@@ -383,3 +416,183 @@ def test_whitelist_composes_with_chain_injected_tolerations(tmp_path):
     )
     with pytest.raises(AdmissionDenied, match="not whitelisted"):
         store.create("pods", q)
+
+
+# ---------------------------------------------------------------------------
+# round-5 breadth: RuntimeClass, TaintNodesByCondition,
+# StorageObjectInUseProtection, CertificateSubjectRestriction, chain order
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_class_merges_overhead_and_scheduling():
+    from kubernetes_tpu.apiserver.admission import RuntimeClassAdmission
+
+    server = APIServer()
+    server.create(
+        "runtimeclasses",
+        v1.RuntimeClass(
+            metadata=v1.ObjectMeta(name="gvisor", namespace=""),
+            handler="runsc",
+            overhead={"cpu": "250m", "memory": "120Mi"},
+            scheduling=v1.RuntimeClassScheduling(
+                node_selector={"sandbox": "gvisor"},
+                tolerations=[
+                    v1.Toleration(key="sandbox", operator="Exists")
+                ],
+            ),
+        ),
+    )
+    plugin = RuntimeClassAdmission(server)
+    pod = _pod("p")
+    pod.spec.runtime_class_name = "gvisor"
+    plugin.mutate("create", "pods", pod)
+    assert pod.spec.overhead == {"cpu": "250m", "memory": "120Mi"}
+    assert pod.spec.node_selector["sandbox"] == "gvisor"
+    assert any(t.key == "sandbox" for t in pod.spec.tolerations)
+
+    # unknown class: denied
+    bad = _pod("q")
+    bad.spec.runtime_class_name = "nope"
+    with pytest.raises(AdmissionDenied):
+        plugin.mutate("create", "pods", bad)
+
+    # conflicting user-supplied overhead: denied
+    conflict = _pod("r")
+    conflict.spec.runtime_class_name = "gvisor"
+    conflict.spec.overhead = {"cpu": "1"}
+    with pytest.raises(AdmissionDenied):
+        plugin.mutate("create", "pods", conflict)
+
+    # conflicting node selector: denied
+    sel = _pod("s")
+    sel.spec.runtime_class_name = "gvisor"
+    sel.spec.node_selector = {"sandbox": "kata"}
+    with pytest.raises(AdmissionDenied):
+        plugin.mutate("create", "pods", sel)
+
+
+def test_taint_nodes_by_condition_and_lifecycle_lift():
+    from kubernetes_tpu.apiserver.admission import (
+        TaintNodesByConditionAdmission,
+    )
+    from kubernetes_tpu.controller.nodelifecycle import (
+        TAINT_NOT_READY,
+        NodeLifecycleController,
+    )
+
+    plugin = TaintNodesByConditionAdmission()
+    # a statusless registration gets the not-ready taint
+    bare = v1.Node(metadata=v1.ObjectMeta(name="new", namespace=""))
+    plugin.mutate("create", "nodes", bare)
+    assert any(t.key == TAINT_NOT_READY for t in bare.spec.taints)
+    # a registration already reporting Ready does not
+    ready = v1.Node(
+        metadata=v1.ObjectMeta(name="ready", namespace=""),
+        status=v1.NodeStatus(
+            conditions=[v1.NodeCondition(type=v1.NODE_READY, status="True")]
+        ),
+    )
+    plugin.mutate("create", "nodes", ready)
+    assert not ready.spec.taints
+
+    # the lifecycle controller lifts the taint once the node is healthy
+    server = APIServer()
+    server.create("nodes", bare)
+    ctrl = NodeLifecycleController(server)
+    ctrl._monitor_once()  # no lease -> healthy -> taint lifted
+    node = server.get("nodes", "", "new")
+    assert not any(t.key == TAINT_NOT_READY for t in node.spec.taints)
+
+
+def test_storage_object_in_use_protection_finalizers():
+    from kubernetes_tpu.apiserver.admission import (
+        StorageObjectInUseProtectionAdmission,
+    )
+
+    plugin = StorageObjectInUseProtectionAdmission()
+    pvc = v1.PersistentVolumeClaim(metadata=v1.ObjectMeta(name="c"))
+    plugin.mutate("create", "persistentvolumeclaims", pvc)
+    assert "kubernetes.io/pvc-protection" in pvc.metadata.finalizers
+    pv = v1.PersistentVolume(metadata=v1.ObjectMeta(name="v", namespace=""))
+    plugin.mutate("create", "persistentvolumes", pv)
+    assert "kubernetes.io/pv-protection" in pv.metadata.finalizers
+
+
+def test_certificate_subject_restriction_blocks_masters():
+    from kubernetes_tpu.apiserver.admission import (
+        CertificateSubjectRestrictionAdmission,
+    )
+
+    plugin = CertificateSubjectRestrictionAdmission()
+    csr = v1.CertificateSigningRequest(
+        metadata=v1.ObjectMeta(name="evil", namespace=""),
+        spec=v1.CertificateSigningRequestSpec(
+            username="mallory",
+            groups=["system:masters"],
+            signer_name="kubernetes.io/kube-apiserver-client",
+        ),
+    )
+    with pytest.raises(AdmissionDenied):
+        plugin.validate("create", "certificatesigningrequests", csr)
+    # other signers are unaffected
+    csr.spec.signer_name = "kubernetes.io/kube-apiserver-client-kubelet"
+    plugin.validate("create", "certificatesigningrequests", csr)
+
+
+def test_kubeadm_chain_matches_reference_recommended_order(tmp_path):
+    """The kubeadm chain's relative plugin order must match the
+    reference's recommended order (pkg/kubeapiserver/options/plugins.go
+    AllOrderedPlugins): mutators before validators, and within the
+    mutating phase the documented sequence."""
+    from kubernetes_tpu.apiserver.auth import AdmissionChain as AC
+    from kubernetes_tpu.cmd.kubeadm import init_cluster
+
+    cluster = init_cluster(str(tmp_path / "c"), controllers=[])
+    try:
+        chain = next(
+            h for h in cluster.store.admit_hooks if isinstance(h, AC)
+        )
+        mut = [p.name for p in chain.mutating]
+        val = [p.name for p in chain.validating]
+        # reference AllOrderedPlugins relative order
+        # (pkg/kubeapiserver/options/plugins.go:64, subset present here):
+        # LimitRanger(73) < ServiceAccount(74) < TaintNodesByCondition(76)
+        # < PodNodeSelector(80) < Priority(81) <
+        # DefaultTolerationSeconds(82) < PodTolerationRestriction(83) <
+        # ExtendedResourceToleration(87) < DefaultStorageClass(89) <
+        # StorageObjectInUseProtection(90) < RuntimeClass(93) <
+        # MutatingAdmissionWebhook(102)
+        expected_mut_order = [
+            "LimitRanger",
+            "ServiceAccount",
+            "TaintNodesByCondition",
+            "PodNodeSelector",
+            "Priority",
+            "DefaultTolerationSeconds",
+            "PodTolerationRestriction",
+            "ExtendedResourceToleration",
+            "DefaultStorageClass",
+            "StorageObjectInUseProtection",
+            "RuntimeClass",
+            "MutatingAdmissionWebhook",
+        ]
+        assert mut == expected_mut_order, mut
+        # NamespaceLifecycle(68) < LimitRanger(73) < NodeRestriction(75) <
+        # PodSecurityPolicy(79) < PersistentVolumeClaimResize(92) <
+        # CertificateSubjectRestriction(96) <
+        # ValidatingAdmissionWebhook(103) < ResourceQuota(104)
+        expected_val_order = [
+            "NamespaceLifecycle",
+            "LimitRanger",
+            "NodeRestriction",
+            "PodSecurityPolicy",
+            "PersistentVolumeClaimResize",
+            "CertificateSubjectRestriction",
+            "ValidatingAdmissionWebhook",
+            "ResourceQuota",
+        ]
+        assert val == expected_val_order, val
+        # 20 named plugins chained (LimitRanger appears in both phases)
+        assert len(set(mut) | set(val)) >= 18
+    finally:
+        cluster.stop()

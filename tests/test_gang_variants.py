@@ -37,7 +37,12 @@ def test_gang_burst_compiles_one_kernel_variant():
         wavelattice.make_wave_kernel_jit.cache_info().misses
         + sharded.make_sharded_wave_kernel.cache_info().misses
     )
-    # 30 gangs x 50 members, every batch shape identical: ONE kernel
-    # factory variant for the entire burst (each extra variant is a
-    # multi-second XLA compile over the tunnel — the wedge trigger)
-    assert variants == 1, f"kernel variant churn: {variants} variants"
+    # 30 gangs x 50 members: at most TWO kernel factory variants for the
+    # entire burst — the big-bucket kernel plus (when an early/tail batch
+    # lands under 256 pods) the small latency bucket, which runs a
+    # narrower candidate list (wave_m_cand_small) and therefore its own
+    # factory key. Before r5 the small pad compiled a second XLA shape
+    # anyway but shared the factory key, so "1" undercounted real
+    # compiles. Each variant beyond these is template churn — the
+    # multi-second-compile-over-the-tunnel wedge trigger (r3).
+    assert variants <= 2, f"kernel variant churn: {variants} variants"

@@ -200,6 +200,9 @@ def test_kubeadm_ha_standby_promotes_full_control_plane(tmp_path):
                 status=v1.NodeStatus(
                     capacity={"cpu": "8", "memory": "16Gi", "pods": "110"},
                     allocatable={"cpu": "8", "memory": "16Gi", "pods": "110"},
+                    conditions=[
+                        v1.NodeCondition(type=v1.NODE_READY, status="True")
+                    ],
                 ),
             ),
         )

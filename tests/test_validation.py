@@ -1,0 +1,246 @@
+"""API-boundary validation (api/validation.py; reference
+pkg/apis/core/validation/validation.go subset): malformed objects 400 at
+write time, never a scheduler-side encode exception (r4 verdict #6)."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.selectors import Requirement
+from kubernetes_tpu.api.validation import ValidationError
+from kubernetes_tpu.client.apiserver import APIServer
+
+
+def _pod(name="p", **spec):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": "100m"})], **spec
+        ),
+    )
+
+
+def test_bad_names_rejected():
+    server = APIServer()
+    for bad in ("", "UpperCase", "has_underscore", "-leading", "trailing-",
+                "a" * 254):
+        with pytest.raises(ValidationError):
+            server.create("pods", _pod(bad))
+
+
+def test_bad_quantities_rejected():
+    server = APIServer()
+    for bad in ("12xyz", "1.5.3", "--2", "1ZZi"):
+        p = v1.Pod(
+            metadata=v1.ObjectMeta(name="q"),
+            spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": bad})]),
+        )
+        with pytest.raises(ValidationError):
+            server.create("pods", p)
+    with pytest.raises(ValidationError):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name="n", namespace=""),
+                status=v1.NodeStatus(capacity={"cpu": "4q4"}),
+            ),
+        )
+
+
+def test_bad_labels_and_selectors_rejected():
+    server = APIServer()
+    with pytest.raises(ValidationError):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(
+                    name="l", labels={"bad key with spaces": "x"}
+                ),
+                spec=v1.PodSpec(containers=[v1.Container()]),
+            ),
+        )
+    with pytest.raises(ValidationError):
+        server.create(
+            "replicasets",
+            v1.ReplicaSet(
+                metadata=v1.ObjectMeta(name="rs"),
+                spec=v1.ReplicaSetSpec(
+                    selector=v1.LabelSelector(
+                        match_expressions=(
+                            Requirement(
+                                key="app", operator="Bogus", values=("x",)
+                            ),
+                        )
+                    )
+                ),
+            ),
+        )
+    # In without values / Exists with values
+    for op, values in (("In", ()), ("Exists", ("v",))):
+        with pytest.raises(ValidationError):
+            server.create(
+                "replicasets",
+                v1.ReplicaSet(
+                    metadata=v1.ObjectMeta(name="rs2"),
+                    spec=v1.ReplicaSetSpec(
+                        selector=v1.LabelSelector(
+                            match_expressions=(
+                                Requirement(
+                                    key="app", operator=op, values=values
+                                ),
+                            )
+                        )
+                    ),
+                ),
+            )
+
+
+def test_affinity_selector_and_topology_key_validated():
+    server = APIServer()
+    aff = v1.Affinity(
+        pod_affinity=v1.PodAffinity(
+            required=(
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_expressions=(
+                            Requirement(key="a", operator="NotAnOp"),
+                        )
+                    ),
+                    topology_key="zone",
+                ),
+            )
+        )
+    )
+    with pytest.raises(ValidationError):
+        server.create("pods", _pod("aff", affinity=aff))
+    # missing topology key
+    aff2 = v1.Affinity(
+        pod_affinity=v1.PodAffinity(
+            required=(
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector.make(
+                        match_labels={"a": "b"}
+                    ),
+                    topology_key="",
+                ),
+            )
+        )
+    )
+    with pytest.raises(ValidationError):
+        server.create("pods", _pod("aff2", affinity=aff2))
+
+
+def test_pod_node_name_immutable_once_set():
+    server = APIServer()
+    server.create("pods", _pod("bound"))
+
+    def bind(p):
+        p.spec.node_name = "n1"
+        return p
+
+    server.guaranteed_update("pods", "default", "bound", bind)  # "" -> n1 ok
+
+    def move(p):
+        p.spec.node_name = "n2"
+        return p
+
+    with pytest.raises(ValidationError):
+        server.guaranteed_update("pods", "default", "bound", move)
+
+
+def test_container_requests_immutable():
+    server = APIServer()
+    server.create("pods", _pod("fixed"))
+
+    def grow(p):
+        p.spec.containers[0].requests = {"cpu": "2"}
+        return p
+
+    with pytest.raises(ValidationError):
+        server.guaranteed_update("pods", "default", "fixed", grow)
+
+
+def test_rest_fuzz_malformed_objects_get_400_never_scheduler_exception():
+    """Malformed objects POSTed through the REST facade: every one is a
+    400/4xx; the scheduler keeps scheduling valid pods afterwards."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.apiserver.rest import serve
+    from kubernetes_tpu.scheduler import (
+        KubeSchedulerConfiguration,
+        Scheduler,
+    )
+
+    server = APIServer()
+    httpd, port, _store = serve(server, port=0)
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_mesh=False))
+    try:
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name="n0", namespace=""),
+                status=v1.NodeStatus(
+                    capacity={"cpu": "8", "memory": "16Gi", "pods": "110"},
+                    allocatable={"cpu": "8", "memory": "16Gi", "pods": "110"},
+                    conditions=[
+                        v1.NodeCondition(type=v1.NODE_READY, status="True")
+                    ],
+                ),
+            ),
+        )
+        sched.start()
+        malformed = [
+            {"metadata": {"name": "Bad Name"}, "spec": {"containers": []}},
+            {
+                "metadata": {"name": "badq"},
+                "spec": {"containers": [{"requests": {"cpu": "3x9z"}}]},
+            },
+            {
+                "metadata": {"name": "badlbl", "labels": {"a b": "c"}},
+                "spec": {"containers": []},
+            },
+            {
+                "metadata": {"name": "badaff"},
+                "spec": {
+                    "containers": [],
+                    "affinity": {
+                        "pod_affinity": {
+                            "required": [
+                                {
+                                    "label_selector": {
+                                        "match_expressions": [
+                                            {"key": "x", "operator": "Nope"}
+                                        ]
+                                    },
+                                    "topology_key": "zone",
+                                }
+                            ]
+                        }
+                    },
+                },
+            },
+        ]
+        for body in malformed:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/namespaces/default/pods",
+                data=_json.dumps(body).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10.0)
+            assert 400 <= ei.value.code < 500, body
+        # the plane survives: a VALID pod schedules
+        server.create("pods", _pod("good"))
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while _t.monotonic() < deadline:
+            if server.get("pods", "default", "good").spec.node_name:
+                break
+            _t.sleep(0.05)
+        assert server.get("pods", "default", "good").spec.node_name == "n0"
+    finally:
+        sched.stop()
+        httpd.shutdown()
