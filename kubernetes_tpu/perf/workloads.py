@@ -267,4 +267,7 @@ WORKLOADS: Dict[str, WorkloadConfig] = {
     "SchedulingSecrets/5000": WorkloadConfig("SchedulingSecrets", 5000, 1000, 5000),
     "SchedulingInTreePVs/500": WorkloadConfig("SchedulingInTreePVs", 500, 100, 400),
     "Gang/5000": WorkloadConfig("Gang", 5000, 0, 15000),
+    # the reference's large density gate: 30k pods / 1000 nodes
+    # (test/integration/scheduler_perf/scheduler_test.go:93-103)
+    "SchedulingDensity/1000": WorkloadConfig("SchedulingBasic", 1000, 0, 30000),
 }

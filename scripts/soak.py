@@ -151,19 +151,90 @@ def main() -> int:
     for t in threads:
         t.join(timeout=10)
 
-    # convergence: stop churn, let everything settle, then assert
-    deadline = time.time() + 120
-    pending = -1
-    while time.time() < deadline:
-        pending = server.count(
-            "pods",
-            lambda p: not p.spec.node_name
-            and p.metadata.deletion_timestamp is None,
-        )
-        if pending == 0:
-            break
+    # -- convergence ---------------------------------------------------------
+    # A 30-minute run OVERSUBSCRIBES the cluster ~5x (churn accumulates past
+    # the ~4.4k-pod capacity), so "pending == 0" is structurally unreachable
+    # once churn stops: nothing frees capacity. Converged means the system is
+    # RESPONSIVE, not that an oversubscribed cluster empties (r4 verdict #3):
+    #   A. marking quiescence — every unbound pod gets its Unschedulable
+    #      condition written (the storm path processed it), deadline extends
+    #      only while progress is being made (weak #8: scale with reality)
+    #   B. capacity-release probe — delete bound pods; the freed capacity
+    #      must refill from the unschedulable pool (delete event -> queue
+    #      flush -> storm requeue -> bind), the actual r4 pathology
+    #   C. device/host tensor audit (unchanged)
+    # plus: no batch's host-side finish stage may have exceeded the wall.
+
+    def unmarked_count() -> int:
+        def pending_unmarked(p) -> bool:
+            if p.spec.node_name or p.metadata.deletion_timestamp is not None:
+                return False
+            for c in p.status.conditions:
+                if (
+                    c.type == v1.COND_POD_SCHEDULED
+                    and c.status == "False"
+                    and c.reason == "Unschedulable"
+                ):
+                    return False
+            return True
+
+        return server.count("pods", pending_unmarked)
+
+    t_conv = time.time()
+    deadline = t_conv + 60
+    hard_cap = t_conv + 600
+    unmarked = last = unmarked_count()
+    while unmarked and time.time() < min(deadline, hard_cap):
         time.sleep(1)
-    # device/host convergence after the storm
+        unmarked = unmarked_count()
+        if unmarked < last:  # progress: extend, never past the hard cap
+            deadline = time.time() + 60
+        last = unmarked
+    marking_s = time.time() - t_conv
+
+    pending = server.count(
+        "pods",
+        lambda p: not p.spec.node_name
+        and p.metadata.deletion_timestamp is None,
+    )
+    bound0 = server.count("pods", lambda p: bool(p.spec.node_name))
+
+    # B: free capacity, assert the unschedulable pool refills it
+    refill_ok = True
+    refilled = 0
+    if pending > 0 and unmarked == 0:
+        k = min(400, pending, max(1, bound0 // 4))
+        # owner-less victims only: a controller-owned pod would be
+        # recreated by the live cm and refill the capacity itself,
+        # passing the probe without exercising the storm-requeue path
+        victims = [
+            p
+            for p in server.list("pods")[0]
+            if p.spec.node_name
+            and p.metadata.deletion_timestamp is None
+            and not p.metadata.owner_references
+        ][:k]
+        for p in victims:
+            try:
+                server.delete("pods", p.metadata.namespace, p.metadata.name)
+            except NotFound:
+                pass
+        want = bound0 - len(victims) + int(0.9 * min(len(victims), pending))
+        probe_deadline = time.time() + max(120.0, 0.2 * len(victims))
+        while time.time() < probe_deadline:
+            bound_now = server.count(
+                "pods",
+                lambda p: bool(p.spec.node_name)
+                and p.metadata.deletion_timestamp is None,
+            )
+            refilled = bound_now - (bound0 - len(victims))
+            if bound_now >= want:
+                break
+            time.sleep(1)
+        else:
+            refill_ok = False
+
+    # C: device/host convergence after the storm
     with sched.cache.lock:
         enc = sched.cache.encoder
         dev = jax.device_get(enc.flush())
@@ -175,13 +246,41 @@ def main() -> int:
             np.asarray(getattr(dev, f)), np.asarray(getattr(masters, f))
         )
     ]
+
+    # host-side batch wall time: the r4 storm hid 300-600 s batches outside
+    # every stage timer; 'finish' now covers that path. Assert none ran away
+    # (5 s is ~100x better than r4 and safe on a loaded 1-CPU CI box).
+    from kubernetes_tpu.utils.metrics import metrics
+
+    stage_max = {}
+    for st in ("encode", "kernel", "finish"):
+        h = metrics.histogram(
+            "scheduling_stage_duration_seconds", {"stage": st}
+        )
+        if h is not None and h._samples:
+            stage_max[st] = round(max(h._samples), 3)
+    # absence of finish samples is itself a FAIL: a renamed stage label
+    # would otherwise vacuously disable this gate
+    batch_ok = "finish" in stage_max and stage_max["finish"] <= 5.0
+    if stage_max.get("finish", 0.0) > 1.0:
+        print(f"WARNING: slowest finish stage {stage_max['finish']}s > 1s")
+
     sched.stop()
     cm.stop()
     pool.stop()
-    ok = not ERRORS and pending == 0 and not mismatch
+    ok = (
+        not ERRORS
+        and unmarked == 0
+        and refill_ok
+        and not mismatch
+        and batch_ok
+    )
     print(
-        f"SOAK {'PASS' if ok else 'FAIL'}: created={seq[0]} pending={pending} "
-        f"errors={ERRORS[:3]} device_host_mismatch={mismatch}",
+        f"SOAK {'PASS' if ok else 'FAIL'}: created={seq[0]} "
+        f"pending={pending} unmarked={unmarked} marking_s={marking_s:.0f} "
+        f"refill_ok={refill_ok} refilled={refilled} "
+        f"stage_max_s={stage_max} errors={ERRORS[:3]} "
+        f"device_host_mismatch={mismatch}",
         flush=True,
     )
     return 0 if ok else 1

@@ -165,15 +165,37 @@ def gang() -> None:
     _result_line("gang-5000", r)
 
 
+def tuned() -> None:
+    """Default config after the r5 hardware A/Bs (pallas fit auto-ON,
+    pipeline depth auto->2, batch auto->4096): the exact configuration
+    bench.py measures, validated as its own arm."""
+    _warm()
+    r = _run("SchedulingPodAffinity/5000")
+    _result_line("tuned-defaults", r)
+
+
+def density() -> None:
+    """The reference's 30k-pod/1000-node density gate
+    (test/integration/scheduler_perf/scheduler_test.go:93-103) on TPU:
+    a long sustained run, so per-batch fixed costs amortize out — the
+    closest arm to a steady-state throughput number."""
+    _warm()
+    r = _run("SchedulingDensity/1000", timeout_s=900.0)
+    _result_line("density-30k-1000", r)
+
+
 def pallas() -> None:
-    """use_pallas_fit A/B on the 5k suite (PERFORMANCE.md step 2)."""
+    """use_pallas_fit A/B on the 5k suite (PERFORMANCE.md step 2).
+
+    Since the r5 default flipped to auto (True on TPU), BOTH arms are
+    pinned explicitly: False is the control, True matches the default."""
     from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
 
-    # False is the default config: compare against traces()'s baseline
-    sc = KubeSchedulerConfiguration(use_pallas_fit=True)
-    _warm(sched_config=sc)
-    r = _run("SchedulingPodAffinity/5000", sched_config=sc)
-    _result_line("pallas-True", r, {"use_pallas_fit": True})
+    for flag in (False, True):
+        sc = KubeSchedulerConfiguration(use_pallas_fit=flag)
+        _warm(sched_config=sc)
+        r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+        _result_line(f"pallas-{flag}", r, {"use_pallas_fit": flag})
 
 
 STEPS = {
@@ -183,6 +205,8 @@ STEPS = {
     "pipeline": pipeline,
     "gang": gang,
     "pallas": pallas,
+    "density": density,
+    "tuned": tuned,
 }
 
 
@@ -200,7 +224,10 @@ def main(argv=None) -> int:
         if not probe():
             print(json.dumps({"error": "tpu unreachable; aborting"}))
             return 1
-        for step in ("traces", "batchsize", "pipeline", "gang", "pallas"):
+        for step in (
+            "traces", "batchsize", "pipeline", "gang", "pallas", "tuned",
+            "density",
+        ):
             t0 = time.time()
             try:
                 STEPS[step]()

@@ -36,7 +36,14 @@ while [ "$ATTEMPTS" -lt 12 ]; do
   if staged_probe; then
     ATTEMPTS=$((ATTEMPTS + 1))
     echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments (attempt $ATTEMPTS)" >> "$LOG"
-    timeout 5400 python scripts/tpu_experiments.py all >> "$LOG" 2>&1
+    # if the measurement arms already landed this round, run only the
+    # missing ones; a fresh/empty jsonl gets the full sequence
+    if grep -q '"step": "pallas' experiments/tpu_experiments.jsonl 2>/dev/null; then
+      ARMS="tuned density"
+    else
+      ARMS="all"
+    fi
+    timeout 5400 python scripts/tpu_experiments.py $ARMS >> "$LOG" 2>&1
     EXP_RC=$?
     echo "$(date -u +%FT%TZ) experiments rc=$EXP_RC - running bench" >> "$LOG"
     timeout 2400 python bench.py >> "$LOG" 2>&1
