@@ -271,6 +271,10 @@ class Container:
     readiness_probe: Optional[Probe] = None
     image_pull_policy: str = ""  # "" = kubelet default (IfNotPresent)
     security_context: Optional[SecurityContext] = None
+    # entrypoint (v1.Container Command/Args): consumed by ProcessRuntime,
+    # which supervises a real host process per container
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -495,6 +499,8 @@ def _copy_container(c: Container) -> Container:
         readiness_probe=c.readiness_probe,
         image_pull_policy=c.image_pull_policy,
         security_context=c.security_context,  # frozen
+        command=list(c.command),
+        args=list(c.args),
     )
 
 
@@ -1394,6 +1400,11 @@ class APIServiceSpec:
     version: str = "v1"
     service_url: str = ""  # backend base URL ("" = served locally)
     priority: int = 100
+    # TLS to the backend (kube-aggregator apiservice certs): base64 PEM
+    # bundle the proxy verifies https backends against; skip flag mirrors
+    # the reference's insecureSkipTLSVerify escape hatch
+    ca_bundle: str = ""
+    insecure_skip_tls_verify: bool = False
 
 
 @dataclass

@@ -119,3 +119,114 @@ def match_labels(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool
     convention PDBs and controllers rely on. Shared by the controllers and
     the preemptor so budget accounting and victim filtering can't diverge."""
     return all(labels.get(k) == val for k, val in selector.items())
+
+
+# ---------------------------------------------------------------------------
+# Selector-string parsing + field selectors (apimachinery pkg/labels
+# Parse and pkg/fields): the ?labelSelector= / ?fieldSelector= list-option
+# surface. Field selectors here are GENERIC dotted paths over the object
+# (camelCase, as on the wire) — a superset of the reference's
+# per-resource allowlists (spec.nodeName, status.phase, metadata.name...),
+# so every reference-legal selector works.
+# ---------------------------------------------------------------------------
+
+
+def parse_label_selector(s: str) -> LabelSelector:
+    """"a=b,c!=d,e,f in (x,y),!g" -> LabelSelector. ValueError on syntax
+    errors (maps to 400 at the REST boundary)."""
+    import re as _re
+
+    labels = {}
+    exprs = []
+    s = (s or "").strip()
+    # split on commas NOT inside parentheses
+    terms = _re.split(r",(?![^(]*\))", s) if s else []
+    for term in terms:
+        term = term.strip()
+        if not term:
+            continue
+        m = _re.match(r"^(\S+)\s+(in|notin)\s+\(([^)]*)\)$", term)
+        if m:
+            vals = tuple(v.strip() for v in m.group(3).split(",") if v.strip())
+            op = OP_IN if m.group(2) == "in" else OP_NOT_IN
+            exprs.append(Requirement(m.group(1), op, vals))
+        elif "!=" in term:
+            k, _, v = term.partition("!=")
+            exprs.append(Requirement(k.strip(), OP_NOT_IN, (v.strip(),)))
+        elif "==" in term or "=" in term:
+            k, _, v = term.partition("==") if "==" in term else term.partition("=")
+            k, v = k.strip(), v.strip()
+            if not _re.match(r"^[\w.\-/]+$", k) or not _re.match(
+                r"^[\w.\-]*$", v
+            ):
+                raise ValueError(f"bad label selector term {term!r}")
+            labels[k] = v
+        elif term.startswith("!"):
+            exprs.append(Requirement(term[1:].strip(), OP_DOES_NOT_EXIST))
+        elif _re.match(r"^[\w.\-/]+$", term):
+            exprs.append(Requirement(term, OP_EXISTS))
+        else:
+            raise ValueError(f"bad label selector term {term!r}")
+    return LabelSelector.make(match_labels=labels, match_expressions=exprs)
+
+
+@dataclass(frozen=True)
+class FieldSelector:
+    """Parsed ?fieldSelector=: AND of (dotted path, op, value) terms with
+    op '=' or '!='. Values compare as strings (fields.Set semantics)."""
+
+    terms: Tuple[Tuple[str, str, str], ...] = ()
+
+    @classmethod
+    def parse(cls, s: str) -> "FieldSelector":
+        terms = []
+        for term in (s or "").split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if "!=" in term:
+                path, _, v = term.partition("!=")
+                op = "!="
+            elif "==" in term:
+                path, _, v = term.partition("==")
+                op = "="
+            elif "=" in term:
+                path, _, v = term.partition("=")
+                op = "="
+            else:
+                raise ValueError(f"bad field selector term {term!r}")
+            if not path.strip():
+                raise ValueError(f"bad field selector term {term!r}")
+            terms.append((path.strip(), op, v.strip()))
+        return cls(terms=tuple(terms))
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.terms
+
+    @staticmethod
+    def _lookup(obj, path: str) -> str:
+        from .serialization import _snake
+
+        cur = obj
+        for seg in path.split("."):
+            if cur is None:
+                return ""
+            if isinstance(cur, Mapping):
+                cur = cur.get(seg)
+                continue
+            cur = getattr(cur, _snake(seg), None)
+        if cur is None or cur is False:
+            return "" if cur is None else "false"
+        if cur is True:
+            return "true"
+        return str(cur)
+
+    def matches(self, obj) -> bool:
+        for path, op, want in self.terms:
+            got = self._lookup(obj, path)
+            if op == "=" and got != want:
+                return False
+            if op == "!=" and got == want:
+                return False
+        return True

@@ -70,6 +70,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _wants_proto(self) -> bool:
+        from ..api.protocodec import CONTENT_TYPE
+
+        return CONTENT_TYPE in (self.headers.get("Accept") or "")
+
+    def _respond_obj(self, code: int, obj) -> None:
+        """Single-object response with content negotiation: the binary
+        envelope when the client asked for application/vnd.kubernetes.
+        protobuf (reference protobuf.go serializer), JSON otherwise.
+        Custom resources are JSON-only (as in the reference: protobuf is
+        unsupported for CRDs)."""
+        from ..api import objects as v1api
+        from ..api import protocodec
+
+        if self._wants_proto() and not isinstance(obj, v1api.Unstructured):
+            body = protocodec.encode_obj(obj)
+            self.send_response(code)
+            self.send_header("Content-Type", protocodec.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._json(code, codec.encode(obj))
+
     def _status_error(self, code: int, reason: str, message: str) -> None:
         self._json(
             code,
@@ -116,6 +140,28 @@ class _Handler(BaseHTTPRequestHandler):
             return parts[1]
         return None
 
+    def _version_of_path(self) -> Optional[str]:
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) >= 3 and parts[0] == "apis":
+            return parts[2]
+        return None
+
+    def _cr_write_gate(self, resource: str, body: dict) -> None:
+        """Custom-resource write validation (apiextensions): when the
+        resource is CRD-served, enforce per-version serving + the
+        version's openAPIV3Schema, and rewrite the body to the storage
+        apiVersion (conversion strategy None). No-op for built-ins."""
+        if resource in codec.RESOURCE_KINDS:
+            return
+        from .crdschema import check_cr_write, find_crd
+
+        crd = find_crd(self.store, resource, self._group_of_path())
+        if crd is None:
+            return
+        body["apiVersion"] = check_cr_write(
+            crd, self._version_of_path(), body
+        )
+
     def _resource_served(self, resource: str) -> bool:
         """Group-aware serving gate: core-path (/api/v1) requests serve
         built-ins only; /apis/{group}/... serves a resource only when an
@@ -135,10 +181,19 @@ class _Handler(BaseHTTPRequestHandler):
             return resource in codec.RESOURCE_KINDS or any(
                 c.spec.names.plural == resource for c in crds
             )
-        return any(
-            c.spec.group == group and c.spec.names.plural == resource
-            for c in crds
-        )
+        version = self._version_of_path()
+        for c in crds:
+            if c.spec.group != group or c.spec.names.plural != resource:
+                continue
+            # per-version serving (apiextensions served flag): an
+            # unserved version 404s even though the CRD claims the group
+            if version is not None:
+                from .crdschema import version_entry
+
+                entry = version_entry(c, version)
+                return entry is not None and entry["served"]
+            return True
+        return False
 
     def _maybe_proxy(self) -> bool:
         """kube-aggregator: if an APIService claims this path's group with a
@@ -152,20 +207,21 @@ class _Handler(BaseHTTPRequestHandler):
             svcs, _ = self.store.list("apiservices")
         except Exception:
             return False
-        backend = next(
+        svc = next(
             (
-                s.spec.service_url
+                s
                 for s in sorted(svcs, key=lambda s: s.spec.priority)
                 if s.spec.group == group and s.spec.service_url
             ),
             None,
         )
-        if not backend:
+        if svc is None:
             return False
+        backend = svc.spec.service_url
         # the aggregator AUTHENTICATES before proxying (authorization is the
         # backend's job, like the reference forwarding user headers); an
         # anonymous-rejecting front server must not leak a bypass
-        _user, ok = self._authenticate()
+        user, ok = self._authenticate()
         if not ok:
             return True  # 401 already written
         import urllib.error
@@ -178,8 +234,31 @@ class _Handler(BaseHTTPRequestHandler):
         for h in ("Content-Type", "Authorization"):
             if self.headers.get(h):
                 req.add_header(h, self.headers[h])
+        # requestheader identity propagation (X-Remote-*): the backend
+        # trusts these from the front proxy, so client-supplied values
+        # must NEVER pass through (spoof protection) — urllib won't copy
+        # them since only the allowlist above is forwarded — and the
+        # authenticated identity is stamped fresh
+        if user is not None:
+            req.add_header("X-Remote-User", user.name)
+            groups = getattr(user, "groups", ()) or ()
+            if groups:
+                # one comma-combined field (RFC 7230 §3.2.2) — urllib
+                # cannot emit repeated headers
+                req.add_header("X-Remote-Group", ",".join(groups))
+        ctx = None
+        if url.startswith("https:"):
+            try:
+                ctx = _backend_ssl_context(svc.spec)
+            except Exception as e:
+                # e.g. invalid base64 / garbage PEM in the caBundle: the
+                # APIService is misconfigured, not the request
+                self._status_error(
+                    502, "BadGateway", f"apiservice caBundle invalid: {e}"
+                )
+                return True
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
                 payload = resp.read()
                 self.send_response(resp.status)
                 for h, val in resp.headers.items():
@@ -201,6 +280,20 @@ class _Handler(BaseHTTPRequestHandler):
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(length) if length else b"{}"
+        from ..api.protocodec import CONTENT_TYPE, MAGIC, decode_obj
+
+        if CONTENT_TYPE in (
+            self.headers.get("Content-Type") or ""
+        ) and raw.startswith(MAGIC):
+            # binary write body: decode the envelope, then re-encode to the
+            # JSON-ready dict every downstream handler already consumes —
+            # one negotiation point covers every write path
+            try:
+                return codec.encode(decode_obj(raw))
+            except Exception as e:
+                # truncated varints/frames surface as IndexError/
+                # struct.error/ValueError — map to 400 like malformed JSON
+                raise ValidationError(f"malformed binary body: {e}") from e
         return json.loads(raw or b"{}")
 
     _request_user = None  # per-request memo set by _limited's APF path
@@ -278,13 +371,19 @@ class _Handler(BaseHTTPRequestHandler):
         from ..api.resources import CPU, MEMORY, cpu_to_millis
 
         def pod_usage(p):
-            raw = p.metadata.annotations.get("metrics.kubernetes.io/cpu-usage")
+            ann = p.metadata.annotations
+            raw = ann.get("metrics.kubernetes.io/cpu-usage")
+            raw_mem = ann.get("metrics.kubernetes.io/memory-usage")
             req = compute_pod_resource_request(p)
             try:
                 cpu = cpu_to_millis(raw) if raw else int(req.get(CPU, 0))
             except ValueError:
                 cpu = int(req.get(CPU, 0))
-            return {"cpu": f"{cpu}m", "memory": f"{int(req.get(MEMORY, 0))}"}
+            try:
+                mem = int(raw_mem) if raw_mem else int(req.get(MEMORY, 0))
+            except ValueError:
+                mem = int(req.get(MEMORY, 0))
+            return {"cpu": f"{cpu}m", "memory": str(mem)}
 
         pods, _ = self.store.list("pods")
         running = [p for p in pods if p.spec.node_name]
@@ -503,10 +602,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if name:
                 obj = self.store.get(resource, ns or "", name)
-                return self._json(200, codec.encode(obj))
+                return self._respond_obj(200, obj)
             if query.get("watch") in ("1", "true"):
                 return self._serve_watch(resource, ns, query)
+            try:
+                pred = _list_options_predicate(query)
+            except ValueError as e:
+                return self._status_error(400, "BadRequest", str(e))
             objs, rv = self.store.list(resource, namespace=ns)
+            if pred is not None:
+                objs = [o for o in objs if pred(o)]
             return self._json(
                 200,
                 {
@@ -529,6 +634,11 @@ class _Handler(BaseHTTPRequestHandler):
             # 410 Gone ("resourceVersion too old"): the client must
             # re-list, exactly like the reference's etcd3 watcher
             return self._status_error(410, "Expired", str(e))
+        try:
+            pred = _list_options_predicate(query)
+        except ValueError as e:
+            watcher.stop()
+            return self._status_error(400, "BadRequest", str(e))
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -542,6 +652,8 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 obj = ev.object
                 if ns is not None and obj.metadata.namespace != ns:
+                    continue
+                if pred is not None and not pred(obj):
                     continue
                 line = (
                     json.dumps(
@@ -661,6 +773,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "status": {"allowed": allowed},
                     },
                 )
+            self._cr_write_gate(resource, body)
             obj = codec.decode(resource, body)
             if ns is not None:
                 obj.metadata.namespace = ns
@@ -691,11 +804,13 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorize("update", resource, ns, name or ""):
             return
         try:
-            obj = codec.decode(resource, self._read_body())
+            body = self._read_body()
+            self._cr_write_gate(resource, body)
+            obj = codec.decode(resource, body)
             if ns is not None:
                 obj.metadata.namespace = ns
             updated = self.store.update(resource, obj)
-            return self._json(200, codec.encode(updated))
+            return self._respond_obj(200, updated)
         except NotFound as e:
             return self._status_error(404, "NotFound", str(e))
         except Conflict as e:
@@ -724,6 +839,52 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", str(e))
         except AdmissionDenied as e:
             return self._status_error(403, "Forbidden", str(e))
+
+
+def _list_options_predicate(query: dict):
+    """?labelSelector= / ?fieldSelector= -> combined object predicate, or
+    None when neither is present (apimachinery ListOptions). ValueError
+    (→400) on selector syntax errors.
+
+    Watch caveat vs the reference's cacher: an object MODIFIED out of the
+    selector is dropped, not synthesized into a DELETED event; informer
+    relists reconcile the difference."""
+    lsel_s = query.get("labelSelector")
+    fsel_s = query.get("fieldSelector")
+    if not lsel_s and not fsel_s:
+        return None
+    from ..api.selectors import FieldSelector, parse_label_selector
+
+    lsel = parse_label_selector(lsel_s) if lsel_s else None
+    fsel = FieldSelector.parse(fsel_s) if fsel_s else None
+
+    def pred(obj) -> bool:
+        if lsel is not None and not lsel.matches(obj.metadata.labels or {}):
+            return False
+        if fsel is not None and not fsel.matches(obj):
+            return False
+        return True
+
+    return pred
+
+
+def _backend_ssl_context(spec):
+    """SSL context for an https APIService backend: verify against the
+    spec's base64 caBundle when set (kube-aggregator apiservice cert
+    handling); insecureSkipTLSVerify disables verification entirely;
+    neither set falls back to system roots."""
+    import base64
+    import ssl
+
+    if spec.insecure_skip_tls_verify:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    if spec.ca_bundle:
+        pem = base64.b64decode(spec.ca_bundle).decode()
+        return ssl.create_default_context(cadata=pem)
+    return ssl.create_default_context()
 
 
 class APIServerHTTP(ThreadingHTTPServer):

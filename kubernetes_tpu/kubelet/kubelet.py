@@ -216,7 +216,56 @@ class Kubelet:
                 if self.volume_manager.mounts_ready(pod):
                     del self._wait_volumes[key]
                     self.handle_pod_event("ADDED", pod)
+        self.publish_pod_stats()
         self.run_probes()
+
+    # cAdvisor-analogue sampling state: pod key -> (cpu_seconds, mono_ts)
+    _stat_samples: Optional[Dict[str, tuple]] = None
+
+    def publish_pod_stats(self) -> None:
+        """Real usage -> the metrics pipeline: when the runtime measures
+        actual processes (ProcessRuntime.pod_stats reading /proc), derive
+        a CPU rate between housekeeping passes and publish it on the pod
+        as the metrics.kubernetes.io annotations the metrics.k8s.io
+        endpoints and HPA consume (the cAdvisor → summary API flow)."""
+        stats_fn = getattr(self.runtime, "pod_stats", None)
+        if stats_fn is None:
+            return
+        if self._stat_samples is None:
+            self._stat_samples = {}
+        now = time.monotonic()
+        for key in list(self._known):
+            cpu_s, rss = stats_fn(key)
+            prev = self._stat_samples.get(key)
+            self._stat_samples[key] = (cpu_s, now)
+            if prev is None:
+                continue
+            prev_cpu, prev_ts = prev
+            dt = now - prev_ts
+            if dt <= 0:
+                continue
+            millicores = max(0, int((cpu_s - prev_cpu) / dt * 1000))
+            ns, _, name = key.partition("/")
+
+            def mutate(p, mc=millicores, mem=rss):
+                ann = p.metadata.annotations
+                new_cpu, new_mem = f"{mc}m", str(mem)
+                if (
+                    ann.get("metrics.kubernetes.io/cpu-usage") == new_cpu
+                    and ann.get("metrics.kubernetes.io/memory-usage") == new_mem
+                ):
+                    return None  # no-op write suppression
+                ann["metrics.kubernetes.io/cpu-usage"] = new_cpu
+                ann["metrics.kubernetes.io/memory-usage"] = new_mem
+                return p
+
+            try:
+                self.server.guaranteed_update("pods", ns, name, mutate)
+            except NotFound:
+                self._stat_samples.pop(key, None)
+        for key in list(self._stat_samples):
+            if key not in self._known:
+                del self._stat_samples[key]
 
     # -- probes (pkg/kubelet/prober) -----------------------------------------
 

@@ -34,9 +34,13 @@ logger = logging.getLogger("kubernetes_tpu.kubelet.volumemanager")
 
 
 class VolumeManager:
-    def __init__(self, server, node_name: str):
+    def __init__(self, server, node_name: str, csi=None):
         self.server = server
         self.node_name = node_name
+        # CSI boundary (kubelet/csi.py): csi-backed PVs additionally drive
+        # the external driver's node service around these transitions
+        # (reference csi_client.go); None = no CSI support on this node
+        self.csi = csi
         self._lock = threading.Lock()
         # desired: pod key -> set of PV names
         self._desired: Dict[str, Set[str]] = {}
@@ -82,29 +86,71 @@ class VolumeManager:
     def reconcile(self) -> None:
         """One reconciler pass (reconciler.go reconcile()): mount what is
         desired and attached, tear down what is no longer desired, then
-        report volumes_in_use."""
+        report volumes_in_use. CSI-backed PVs drive the external driver
+        around each transition; the driver calls run OUTSIDE the lock (a
+        slow/dead driver must not block the populator), and a failed call
+        leaves the pair un-mounted for the next pass to retry."""
         with self._lock:
             desired = {k: set(v) for k, v in self._desired.items()}
         attached = self._attached_pvs()
+        setups: List = []  # (pod_key, pv)
+        teardowns: List = []  # (pod_key, pv, last_user)
         with self._lock:
-            # set up: pod-volume pairs that are desired, attached, not yet up
             for pod_key, pvs in desired.items():
                 for pv in pvs:
-                    users = self._mounted.setdefault(pv, set())
+                    users = self._mounted.get(pv, set())
                     if pod_key not in users and pv in attached:
-                        users.add(pod_key)  # MountDevice (first user) + SetUp
-            # tear down: mounted pairs no longer desired
-            for pv, users in list(self._mounted.items()):
-                for pod_key in list(users):
-                    if pv not in desired.get(pod_key, ()):
-                        users.discard(pod_key)  # TearDown
-                if not users:
-                    del self._mounted[pv]  # UnmountDevice (last user gone)
+                        setups.append((pod_key, pv))
+            for pv, users in self._mounted.items():
+                stale = [k for k in users if pv not in desired.get(k, ())]
+                for n, pod_key in enumerate(stale, start=1):
+                    teardowns.append(
+                        (pod_key, pv, n == len(stale) == len(users))
+                    )
+        done_setups = []
+        for pod_key, pv in setups:
+            src = self._csi_source(pv)
+            if src is not None:
+                if self.csi is None or not self.csi.has_driver(src.driver):
+                    continue  # no driver yet: stays pending, retried
+                try:
+                    self.csi.stage_and_publish(src, pod_key)
+                except Exception as e:  # CSIError and transport faults
+                    logger.warning("csi setup %s/%s: %s", pv, pod_key, e)
+                    continue
+            done_setups.append((pod_key, pv))
+        done_teardowns = []
+        for pod_key, pv, last_user in teardowns:
+            src = self._csi_source(pv)
+            if src is not None and self.csi is not None:
+                if not self.csi.unpublish(src, pod_key, last_user):
+                    # driver fault: keep the pair mounted so the next
+                    # pass re-issues the teardown (no driver-side leak)
+                    continue
+            done_teardowns.append((pod_key, pv))
+        with self._lock:
+            for pod_key, pv in done_setups:
+                # MountDevice (first user) + SetUp
+                self._mounted.setdefault(pv, set()).add(pod_key)
+            for pod_key, pv in done_teardowns:
+                users = self._mounted.get(pv)
+                if users is not None:
+                    users.discard(pod_key)  # TearDown
+                    if not users:
+                        del self._mounted[pv]  # UnmountDevice
             in_use = sorted(
                 set(self._mounted)
                 | {pv for pvs in desired.values() for pv in pvs}
             )
         self._report_volumes_in_use(in_use)
+
+    def _csi_source(self, pv_name: str):
+        """The PV's csi source, or None for in-tree volumes."""
+        try:
+            pv = self.server.get("persistentvolumes", "", pv_name)
+        except NotFound:
+            return None
+        return pv.spec.csi
 
     def _attached_pvs(self) -> Set[str]:
         try:

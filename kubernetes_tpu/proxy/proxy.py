@@ -20,11 +20,15 @@ Parity points:
     (service events directly, slice events via their service label); the
     sync recomputes only those services unless a full rebuild is due —
     the ServiceChangeTracker/EndpointChangeTracker split.
-  * **Two modes**: "iptables" resolves statistically (round-robin, the
-    `--mode random` chain equivalent) and "ipvs" adds real virtual-server
+  * **Three modes**: "iptables" resolves statistically (round-robin, the
+    `--mode random` chain equivalent); "ipvs" adds real virtual-server
     scheduling — least-connection with live connection tracking
-    (pkg/proxy/ipvs/proxier.go's rr/lc schedulers).
-  * ClientIP session affinity via a stable hash in both modes.
+    (pkg/proxy/ipvs/proxier.go's rr/lc schedulers); "userspace" runs
+    REAL TCP listeners with byte splicing to live backends
+    (proxy/userspace.py, pkg/proxy/userspace/proxier.go). The reference's
+    fourth mode, winkernel, is deliberately out of scope: it drives the
+    Windows HNS dataplane and this build targets Linux only.
+  * ClientIP session affinity via a stable hash in every mode.
 
 A min-sync interval coalesces event bursts the way the proxier's
 BoundedFrequencyRunner does.
@@ -125,7 +129,7 @@ class Proxier:
         mode: str = "iptables",
         ipvs_scheduler: str = "lc",
     ):
-        if mode not in ("iptables", "ipvs"):
+        if mode not in ("iptables", "ipvs", "userspace"):
             raise ValueError(f"unknown proxy mode {mode!r}")
         if ipvs_scheduler not in ("rr", "lc"):
             raise ValueError(f"unknown ipvs scheduler {ipvs_scheduler!r}")
@@ -153,6 +157,12 @@ class Proxier:
         self.syncs = 0  # sync counter (tests/metrics)
         self.slice_routed = 0  # services routed via EndpointSlices (tests)
         self.legacy_routed = 0  # services routed via the Endpoints fallback
+        # userspace mode: real TCP listeners + splicing (proxy/userspace.py)
+        self.userspace = None
+        if mode == "userspace":
+            from .userspace import UserspaceManager
+
+            self.userspace = UserspaceManager(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -218,6 +228,8 @@ class Proxier:
     def stop(self) -> None:
         self._stop.set()
         self._dirty.set()
+        if self.userspace is not None:
+            self.userspace.close()
         if self._own_informers:
             self.informers.stop()
 
@@ -282,6 +294,9 @@ class Proxier:
                 self._table.update(entries)
             self._affinity.update(new_affinity)
             self.syncs += 1
+            table_keys = list(self._table) if self.userspace else ()
+        if self.userspace is not None:
+            self.userspace.reconcile(table_keys)
 
     def _backends_for(self, svc: v1.Service) -> Dict[object, List[Tuple[str, int]]]:
         """EndpointSlices first; the legacy Endpoints object only for

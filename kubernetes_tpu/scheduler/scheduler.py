@@ -877,12 +877,17 @@ class Scheduler:
                 # yet — resolve it first or fallback/preemption would grant the
                 # same capacity twice (bounded recursion: pending is detached
                 # before each resolve)
-                self._resolve_pending()
-                self._snapshot = self.cache.update_snapshot()
-            for pi in fallback_pis:
-                self._schedule_one_host(pi, moves0)
+                with _stage_timer("finish.resolve"):
+                    self._resolve_pending()
+                with _stage_timer("finish.snapshot"):
+                    self._snapshot = self.cache.update_snapshot()
+            if fallback_pis:
+                with _stage_timer("finish.fallback"):
+                    for pi in fallback_pis:
+                        self._schedule_one_host(pi, moves0)
             if failed:
-                self._finish_failed(p, failed)
+                with _stage_timer("finish.failed"):
+                    self._finish_failed(p, failed)
         p.trace.log_if_long(0.1)
 
     def _finish_failed(self, p: "_InFlightBatch", failed: List) -> None:

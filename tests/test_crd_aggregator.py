@@ -187,3 +187,105 @@ def test_custom_resource_reachable_via_core_client():
         assert code == 404, "CRD resources must not serve under foreign groups"
     finally:
         srv.shutdown()
+
+
+def _versioned_crd():
+    """CRD with a served v1 (schema'd), an unserved v1alpha1, and v2 as
+    the storage version — the apiextensions versions surface."""
+    crd = _crd()
+    crd.spec.versions = [
+        {
+            "name": "v1",
+            "served": True,
+            "storage": False,
+            "schema": {
+                "openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "required": ["size"],
+                            "properties": {
+                                "size": {
+                                    "type": "integer",
+                                    "minimum": 1,
+                                    "maximum": 10,
+                                },
+                                "color": {
+                                    "type": "string",
+                                    "enum": ["red", "blue"],
+                                },
+                            },
+                        }
+                    },
+                }
+            },
+        },
+        {"name": "v1alpha1", "served": False, "storage": False},
+        {"name": "v2", "served": True, "storage": True},
+    ]
+    return crd
+
+
+def test_crd_versions_serving_and_schema():
+    """Per-version serving + openAPIV3Schema validation + storage-version
+    rewrite (apiextensions validation.go / customresource_handler.go)."""
+    srv, port, store = serve()
+    try:
+        store.create("customresourcedefinitions", _versioned_crd())
+        base = "/apis/example.com"
+        # unserved version: 404 on read AND write
+        code, _ = _req(port, f"{base}/v1alpha1/namespaces/default/widgets")
+        assert code == 404
+        code, _ = _req(
+            port,
+            f"{base}/v1alpha1/namespaces/default/widgets",
+            method="POST",
+            body={"metadata": {"name": "w0"}, "spec": {"size": 3}},
+        )
+        assert code == 404
+        # schema violation: 400 with the violation in the message
+        code, resp = _req(
+            port,
+            f"{base}/v1/namespaces/default/widgets",
+            method="POST",
+            body={
+                "metadata": {"name": "w1"},
+                "spec": {"size": 0, "color": "green"},
+            },
+        )
+        assert code == 400, resp
+        msg = resp.get("message", "")
+        assert "minimum" in msg and "enum" in msg
+        # missing required property
+        code, resp = _req(
+            port,
+            f"{base}/v1/namespaces/default/widgets",
+            method="POST",
+            body={"metadata": {"name": "w2"}, "spec": {"color": "red"}},
+        )
+        assert code == 400
+        assert "required" in resp.get("message", "")
+        # valid CR persists, rewritten to the STORAGE version (v2)
+        code, resp = _req(
+            port,
+            f"{base}/v1/namespaces/default/widgets",
+            method="POST",
+            body={
+                "metadata": {"name": "w3"},
+                "spec": {"size": 5, "color": "blue"},
+            },
+        )
+        assert code == 201, resp
+        obj = store.get("widgets", "default", "w3")
+        assert obj.api_version == "example.com/v2"
+        # v2 (schema-less) accepts anything served
+        code, _ = _req(
+            port,
+            f"{base}/v2/namespaces/default/widgets",
+            method="POST",
+            body={"metadata": {"name": "w4"}, "spec": {"whatever": True}},
+        )
+        assert code == 201
+    finally:
+        srv.shutdown()

@@ -1,0 +1,223 @@
+"""CSI node-driver boundary: stage/publish ordering around pod volume
+setup, driver-absent pending behavior, teardown on pod removal.
+
+Reference: pkg/volume/csi/csi_client.go (NodeStage/Publish/Unpublish/
+Unstage) driven from the kubelet volume manager's reconciler."""
+
+import socket
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.kubelet.csi import CSIDriverManager, CSIError
+from kubernetes_tpu.kubelet.devicemanager import _recv_frame, _reply
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
+
+
+class FakeCSIDriver:
+    """External driver process stand-in: answers the node service over a
+    framed unix socket and records the call sequence."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.calls = []
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(8)
+        self._stop = False
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                method, payload = _recv_frame(conn)
+                self.calls.append((method, payload))
+                _reply(conn, 0, {"ok": True})
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._stop = True
+        self._sock.close()
+
+
+@pytest.fixture
+def driver(tmp_path):
+    d = FakeCSIDriver(str(tmp_path / "csi.sock"))
+    yield d
+    d.close()
+
+
+def _cluster(csi):
+    server = APIServer()
+    server.create(
+        "nodes",
+        v1.Node(metadata=v1.ObjectMeta(name="n0", namespace="")),
+    )
+    server.create(
+        "persistentvolumes",
+        v1.PersistentVolume(
+            metadata=v1.ObjectMeta(name="pv-csi", namespace=""),
+            spec=v1.PersistentVolumeSpec(
+                csi=v1.CSIVolumeSource(
+                    driver="ebs.csi.example.com", volume_handle="vol-1"
+                )
+            ),
+        ),
+    )
+    server.create(
+        "persistentvolumeclaims",
+        v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="claim"),
+            spec=v1.PersistentVolumeClaimSpec(volume_name="pv-csi"),
+        ),
+    )
+    server.create(
+        "volumeattachments",
+        v1.VolumeAttachment(
+            metadata=v1.ObjectMeta(name="va-1", namespace=""),
+            spec=v1.VolumeAttachmentSpec(pv_name="pv-csi", node_name="n0"),
+            status=v1.VolumeAttachmentStatus(attached=True),
+        ),
+    )
+    vm = VolumeManager(server, "n0", csi=csi)
+    pod = v1.Pod(
+        metadata=v1.ObjectMeta(name="p0"),
+        spec=v1.PodSpec(
+            node_name="n0",
+            volumes=[v1.Volume(name="v", persistent_volume_claim="claim")],
+        ),
+    )
+    return server, vm, pod
+
+
+def test_stage_publish_unpublish_unstage_sequence(driver):
+    csi = CSIDriverManager("n0")
+    csi.register("ebs.csi.example.com", driver.path)
+    server, vm, pod = _cluster(csi)
+
+    vm.note_pod(pod)
+    vm.reconcile()
+    assert vm.mounts_ready(pod)
+    assert [m for m, _ in driver.calls] == [
+        "NodeStageVolume",
+        "NodePublishVolume",
+    ]
+    assert driver.calls[1][1]["target"] == pod.metadata.key
+
+    # a second pod on the same volume publishes WITHOUT re-staging
+    pod2 = v1.Pod(
+        metadata=v1.ObjectMeta(name="p1"),
+        spec=v1.PodSpec(
+            node_name="n0",
+            volumes=[v1.Volume(name="v", persistent_volume_claim="claim")],
+        ),
+    )
+    vm.note_pod(pod2)
+    vm.reconcile()
+    assert [m for m, _ in driver.calls] == [
+        "NodeStageVolume",
+        "NodePublishVolume",
+        "NodePublishVolume",
+    ]
+
+    # first pod leaves: unpublish only (volume still in use by pod2)
+    vm.forget_pod(pod.metadata.key)
+    vm.reconcile()
+    assert driver.calls[-1][0] == "NodeUnpublishVolume"
+    assert csi.staged() == [("ebs.csi.example.com", "vol-1")]
+
+    # last pod leaves: unpublish + unstage
+    vm.forget_pod(pod2.metadata.key)
+    vm.reconcile()
+    assert [m for m, _ in driver.calls[-2:]] == [
+        "NodeUnpublishVolume",
+        "NodeUnstageVolume",
+    ]
+    assert csi.staged() == []
+    assert not vm.mounted_for(pod2.metadata.key)
+
+
+def test_missing_driver_leaves_volume_pending_then_recovers(driver):
+    """No registered driver: the pod's volume stays un-ready (the
+    reference's missing-CSI-plugin behavior); registration + the next
+    reconcile pass recover without restart."""
+    csi = CSIDriverManager("n0")
+    server, vm, pod = _cluster(csi)
+    vm.note_pod(pod)
+    vm.reconcile()
+    assert not vm.mounts_ready(pod)
+    assert driver.calls == []
+
+    csi.register("ebs.csi.example.com", driver.path)
+    vm.reconcile()
+    assert vm.mounts_ready(pod)
+
+
+def test_unregistered_call_raises():
+    csi = CSIDriverManager("n0")
+    with pytest.raises(CSIError):
+        csi.stage_and_publish(
+            v1.CSIVolumeSource(driver="nope", volume_handle="v"), "ns/p"
+        )
+
+
+def test_in_tree_pv_does_not_touch_csi(driver):
+    """A GCE-PD PV must never reach the CSI boundary."""
+    csi = CSIDriverManager("n0")
+    csi.register("ebs.csi.example.com", driver.path)
+    server, vm, pod = _cluster(csi)
+    server.guaranteed_update(
+        "persistentvolumes", "", "pv-csi",
+        lambda pv: (
+            setattr(pv.spec, "csi", None),
+            setattr(
+                pv.spec,
+                "gce_persistent_disk",
+                v1.GCEPersistentDiskVolumeSource(pd_name="d"),
+            ),
+            pv,
+        )[-1],
+    )
+    vm.note_pod(pod)
+    vm.reconcile()
+    assert vm.mounts_ready(pod)
+    assert driver.calls == []
+
+
+def test_failed_teardown_is_retried(tmp_path, driver):
+    """Driver down at pod deletion: the pair stays mounted and the next
+    reconcile (driver back) re-issues NodeUnpublish + NodeUnstage."""
+    csi = CSIDriverManager("n0")
+    csi.register("ebs.csi.example.com", driver.path)
+    server, vm, pod = _cluster(csi)
+    vm.note_pod(pod)
+    vm.reconcile()
+    assert vm.mounts_ready(pod)
+
+    # driver goes away; pod deleted
+    csi.register("ebs.csi.example.com", str(driver.path) + ".gone")
+    vm.forget_pod(pod.metadata.key)
+    vm.reconcile()
+    # teardown failed -> still tracked, still staged
+    assert vm.mounted_for(pod.metadata.key) == ["pv-csi"]
+    assert csi.staged() == [("ebs.csi.example.com", "vol-1")]
+
+    # driver returns: the retry completes the teardown
+    csi.register("ebs.csi.example.com", driver.path)
+    vm.reconcile()
+    assert vm.mounted_for(pod.metadata.key) == []
+    assert csi.staged() == []
+    assert [m for m, _ in driver.calls[-2:]] == [
+        "NodeUnpublishVolume",
+        "NodeUnstageVolume",
+    ]

@@ -1,0 +1,200 @@
+"""Userspace proxy mode: real sockets, real byte splicing, real
+round-robin across live backends.
+
+Reference: pkg/proxy/userspace/proxier.go (accept → pick backend →
+copy bytes both ways)."""
+
+import socket
+import threading
+import time
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.proxy.proxy import Proxier
+
+
+class EchoServer:
+    """Real backend: replies b"<tag>:" + whatever it received."""
+
+    def __init__(self, tag: bytes):
+        self.tag = tag
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                data = conn.recv(4096)
+                if data:
+                    conn.sendall(self.tag + b":" + data)
+
+    def close(self):
+        self._stop = True
+        self._sock.close()
+
+
+def _call(port: int, payload: bytes) -> bytes:
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(payload)
+        s.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                return out
+            out += chunk
+
+
+def _cluster_with_backends(backends):
+    server = APIServer()
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="echo"),
+            spec=v1.ServiceSpec(selector={"app": "echo"}),
+        ),
+    )
+    server.create(
+        "endpoints",
+        v1.Endpoints(
+            metadata=v1.ObjectMeta(name="echo"),
+            subsets=[
+                v1.EndpointSubset(
+                    addresses=[
+                        v1.EndpointAddress(ip="127.0.0.1")
+                        for _ in backends
+                    ],
+                    ports=[("tcp", b.port) for b in backends][:1],
+                )
+            ],
+        ),
+    )
+    return server
+
+
+def test_userspace_splices_to_real_backends():
+    b1, b2 = EchoServer(b"b1"), EchoServer(b"b2")
+    # both backends behind one service port: same port number is
+    # impossible for two distinct 127.0.0.1 servers, so use two subsets
+    server = APIServer()
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="echo"),
+            spec=v1.ServiceSpec(selector={"app": "echo"}),
+        ),
+    )
+    server.create(
+        "endpoints",
+        v1.Endpoints(
+            metadata=v1.ObjectMeta(name="echo"),
+            subsets=[
+                v1.EndpointSubset(
+                    addresses=[v1.EndpointAddress(ip="127.0.0.1")],
+                    ports=[("tcp", b1.port)],
+                ),
+                v1.EndpointSubset(
+                    addresses=[v1.EndpointAddress(ip="127.0.0.1")],
+                    ports=[("tcp", b2.port)],
+                ),
+            ],
+        ),
+    )
+    prox = Proxier(server, mode="userspace")
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        # wait for the listener for either port to appear
+        deadline = time.monotonic() + 5
+        pport = None
+        while time.monotonic() < deadline and pport is None:
+            pport = prox.userspace.proxy_port(
+                "default/echo", b1.port
+            ) or prox.userspace.proxy_port("default/echo", b2.port)
+            time.sleep(0.02)
+        assert pport, "no userspace listener came up"
+        out = _call(pport, b"hello")
+        assert out in (b"b1:hello", b"b2:hello")
+    finally:
+        prox.stop()
+        b1.close()
+        b2.close()
+
+
+def test_userspace_round_robins_across_backends():
+    """Two real backends on ONE service port (distinct IP rows is the
+    realistic shape; here same IP + the proxy balances by address list)."""
+    b1, b2 = EchoServer(b"b1"), EchoServer(b"b2")
+    server = APIServer()
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="echo"),
+            spec=v1.ServiceSpec(selector={"app": "echo"}),
+        ),
+    )
+    # one port id, two backend (ip, port) rows — the proxier's table has
+    # both behind ("default/echo", <pnum>); give each row its own port
+    # via two named subsets sharing the port NAME
+    server.create(
+        "endpoints",
+        v1.Endpoints(
+            metadata=v1.ObjectMeta(name="echo"),
+            subsets=[
+                v1.EndpointSubset(
+                    addresses=[v1.EndpointAddress(ip="127.0.0.1")],
+                    ports=[("web", b1.port)],
+                ),
+                v1.EndpointSubset(
+                    addresses=[v1.EndpointAddress(ip="127.0.0.1")],
+                    ports=[("web", b2.port)],
+                ),
+            ],
+        ),
+    )
+    prox = Proxier(server, mode="userspace")
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        deadline = time.monotonic() + 5
+        seen = set()
+        while time.monotonic() < deadline and len(seen) < 2:
+            for p in (b1.port, b2.port):
+                pp = prox.userspace.proxy_port("default/echo", p)
+                if pp:
+                    out = _call(pp, b"x")
+                    if out:
+                        seen.add(out.split(b":")[0])
+            time.sleep(0.02)
+        assert seen == {b"b1", b"b2"}
+    finally:
+        prox.stop()
+        b1.close()
+        b2.close()
+
+
+def test_no_endpoints_closes_connection():
+    server = APIServer()
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="void"),
+            spec=v1.ServiceSpec(selector={"app": "void"}),
+        ),
+    )
+    prox = Proxier(server, mode="userspace")
+    prox.start()
+    try:
+        assert prox.wait_synced()
+        # empty service: no numeric ports -> no listener at all
+        assert prox.userspace.proxy_port("default/void", 80) is None
+    finally:
+        prox.stop()
