@@ -885,9 +885,24 @@ class ServiceSpec:
 
 
 @dataclass
+class LoadBalancerStatus:
+    """v1.LoadBalancerStatus: provisioned LB ingress points (IPs)."""
+
+    ingress: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer: LoadBalancerStatus = field(
+        default_factory=LoadBalancerStatus
+    )
+
+
+@dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
     kind: str = "Service"
 
     def deep_copy(self) -> "Service":

@@ -107,12 +107,22 @@ def _resolve_optional(tp):
     return tp
 
 
+# bare container hints (list, not List[X]) have no get_origin/get_args;
+# normalize them to their Any-parameterized forms so the container
+# branches fire
+_BARE_HINTS = {
+    list: List[Any],
+    tuple: Tuple[Any, ...],
+    dict: Dict[str, Any],
+}
+
+
 def _schema(cls: type) -> List[Tuple[int, str, Any]]:
     s = _SCHEMA.get(cls)
     if s is None:
         hints = get_type_hints(cls)
         s = _SCHEMA[cls] = [
-            (i, f.name, hints[f.name])
+            (i, f.name, _BARE_HINTS.get(hints[f.name], hints[f.name]))
             for i, f in enumerate(dataclasses.fields(cls), start=1)
         ]
         defaults = {}
@@ -208,10 +218,10 @@ def _enc_value(buf: bytearray, field: int, val: Any, tp: Any) -> None:
         _put_header(buf, field, _WIRE_FIXED64)
         buf += struct.pack("<d", float(val))
         return
-    if isinstance(val, frozenset):
-        for item in sorted(val):
-            _enc_value(buf, field, item, str)
-        return
+    if isinstance(val, (set, frozenset)):
+        # no set-typed fields exist in the wire model; fail loudly rather
+        # than letting the union fallback stringify it irreversibly
+        raise TypeError(f"set-typed field has no wire form: {val!r}")
     # Quantity (str|int|float union), Any, or a value whose runtime type
     # diverges from the hint: the tagged union keeps it lossless
     _enc_union(buf, field, val)
@@ -240,10 +250,13 @@ def _enc_message(obj: Any) -> bytearray:
             continue  # omitempty (value == default: decode restores it)
         if isinstance(val, (list, tuple, dict, str, bytes, frozenset)) and not val:
             # empty value. Skipping is only sound when decode's default
-            # restores the same empty — with a NON-empty default (e.g.
-            # namespace="default", scheduler_name="default-scheduler")
-            # the emptiness is meaningful and MUST hit the wire.
-            if not defaults.get(name):
+            # restores the same empty — i.e. the field HAS a default and
+            # it is itself empty. A REQUIRED field (no default) must
+            # always hit the wire or cls(**kwargs) fails at decode; a
+            # non-empty default (namespace="default",
+            # scheduler_name="default-scheduler") makes the emptiness
+            # meaningful.
+            if name in defaults and not defaults[name]:
                 continue
             if isinstance(val, (str, bytes)):
                 pass  # zero-length payload decodes back to ""/b""
@@ -401,8 +414,6 @@ def _dec_message(data: bytes, cls: type) -> Any:
         rtp = _resolve_optional(tp)
         if get_origin(rtp) is tuple and name in kwargs:
             kwargs[name] = tuple(kwargs[name])
-        if get_origin(rtp) is None and rtp is frozenset and name in kwargs:
-            kwargs[name] = frozenset(kwargs[name])
     return cls(**kwargs)
 
 

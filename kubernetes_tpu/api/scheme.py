@@ -134,6 +134,77 @@ def _slice_internal_to_v1(doc: dict) -> dict:
     return out
 
 
+def _ingress_v1_backend_to_internal(b: Optional[dict]) -> Optional[dict]:
+    """networking/v1 IngressBackend {service:{name,port:{number|name}}}
+    -> internal flat {serviceName, servicePort} (the v1beta1 shape the
+    internal type keeps; reference conversion in
+    pkg/apis/networking/v1beta1 zz_generated.conversion)."""
+    if not b:
+        return b
+    svc = b.get("service") or {}
+    port = svc.get("port") or {}
+    return {
+        "serviceName": svc.get("name", ""),
+        "servicePort": port.get("number") or port.get("name") or 0,
+    }
+
+
+def _ingress_internal_backend_to_v1(b: Optional[dict]) -> Optional[dict]:
+    if not b:
+        return b
+    port = b.get("servicePort", 0)
+    key = "number" if isinstance(port, int) else "name"
+    return {"service": {"name": b.get("serviceName", ""), "port": {key: port}}}
+
+
+def _ingress_v1_to_internal(doc: dict) -> dict:
+    out = dict(doc)
+    spec = dict(doc.get("spec", {}) or {})
+    if "defaultBackend" in spec:
+        spec["defaultBackend"] = _ingress_v1_backend_to_internal(
+            spec["defaultBackend"]
+        )
+    rules = []
+    for rule in spec.get("rules", []) or []:
+        rule = dict(rule)
+        # v1 nests paths under http.paths; internal keeps them flat
+        http = rule.pop("http", None)
+        paths = []
+        for p in (http or {}).get("paths", []) or rule.get("paths", []) or []:
+            p = dict(p)
+            if "backend" in p:
+                p["backend"] = _ingress_v1_backend_to_internal(p["backend"])
+            paths.append(p)
+        rule["paths"] = paths
+        rules.append(rule)
+    spec["rules"] = rules
+    out["spec"] = spec
+    return out
+
+
+def _ingress_internal_to_v1(doc: dict) -> dict:
+    out = dict(doc)
+    spec = dict(doc.get("spec", {}) or {})
+    if "defaultBackend" in spec:
+        spec["defaultBackend"] = _ingress_internal_backend_to_v1(
+            spec["defaultBackend"]
+        )
+    rules = []
+    for rule in spec.get("rules", []) or []:
+        rule = dict(rule)
+        paths = []
+        for p in rule.pop("paths", []) or []:
+            p = dict(p)
+            if "backend" in p:
+                p["backend"] = _ingress_internal_backend_to_v1(p["backend"])
+            paths.append(p)
+        rule["http"] = {"paths": paths}
+        rules.append(rule)
+    spec["rules"] = rules
+    out["spec"] = spec
+    return out
+
+
 def default_scheme() -> Scheme:
     s = Scheme()
     # core group: internal == v1 wire form (identity conversions)
@@ -150,6 +221,26 @@ def default_scheme() -> Scheme:
     )
     s.add_known_type(
         "discovery.k8s.io", "v1beta1", "EndpointSlice", "endpointslices"
+    )
+    # Ingress: internal keeps the v1beta1 flat backend; networking/v1 is
+    # the conversion spoke with the nested service backend + http.paths
+    # (the real v1beta1->v1 graduation's field moves)
+    s.add_known_type(
+        "networking.k8s.io",
+        "v1",
+        "Ingress",
+        "ingresses",
+        to_internal=_ingress_v1_to_internal,
+        from_internal=_ingress_internal_to_v1,
+    )
+    s.add_known_type("networking.k8s.io", "v1beta1", "Ingress", "ingresses")
+    s.add_known_type("extensions", "v1beta1", "Ingress", "ingresses")
+    # schema-identical graduations: both versions serve the internal shape
+    s.add_known_type("batch", "v1", "CronJob", "cronjobs")
+    s.add_known_type("batch", "v1beta1", "CronJob", "cronjobs")
+    s.add_known_type("policy", "v1", "PodDisruptionBudget", "poddisruptionbudgets")
+    s.add_known_type(
+        "policy", "v1beta1", "PodDisruptionBudget", "poddisruptionbudgets"
     )
     return s
 

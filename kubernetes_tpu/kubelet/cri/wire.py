@@ -82,6 +82,7 @@ class CRIServer:
         # sandbox id <-> pod bookkeeping (the runtime keys by pod key)
         self._meta: Dict[str, pb.PodSandboxMetadata] = {}
         self._ips: Dict[str, str] = {}
+        self._images: Dict[str, int] = {}  # ImageService store: name -> bytes
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -144,7 +145,17 @@ class CRIServer:
                 labels=dict(req.config.labels),
                 annotations=dict(req.config.annotations),
             ),
-            spec=v1.PodSpec(),
+            spec=v1.PodSpec(
+                containers=[
+                    v1.Container(
+                        name=c.name,
+                        image=c.image,
+                        command=list(c.command),
+                        args=list(c.args),
+                    )
+                    for c in req.config.containers
+                ]
+            ),
         )
         ip = self.runtime.run_pod(pod)
         sandbox_id = pod.metadata.key
@@ -182,6 +193,71 @@ class CRIServer:
                 if key in self._meta:
                     sb.metadata.CopyFrom(self._meta[key])
         return resp.SerializeToString()
+
+    def _h_ExecSync(self, payload: bytes) -> bytes:
+        # exceptions (no sandbox / unsupported) become error frames in
+        # _dispatch; a COMPLETED-but-failed command reports its real exit
+        # code (the reference's ExecSyncResponse.exit_code)
+        req = pb.ExecSyncRequest.FromString(payload)
+        out, code = self.runtime.exec_status(
+            req.pod_sandbox_id, list(req.command)
+        )
+        return pb.ExecSyncResponse(
+            stdout=out.encode(), exit_code=code
+        ).SerializeToString()
+
+    def _h_ContainerLogs(self, payload: bytes) -> bytes:
+        req = pb.ContainerLogsRequest.FromString(payload)
+        text = self.runtime.logs(
+            req.pod_sandbox_id,
+            tail_lines=req.tail_lines or None,
+        )
+        return pb.ContainerLogsResponse(data=text.encode()).SerializeToString()
+
+    # -- ImageService (subset) -----------------------------------------------
+    # the runtime side keeps the image store (real runtimes track pulled
+    # layers); this server holds it since PodRuntime has no image state
+
+    def _h_PullImage(self, payload: bytes) -> bytes:
+        req = pb.PullImageRequest.FromString(payload)
+        name = req.image.image
+        with self._lock:
+            self._images[name] = 10_000_000  # nominal layer size
+        return pb.PullImageResponse(image_ref=f"sha256:{name}").SerializeToString()
+
+    def _h_ListImages(self, payload: bytes) -> bytes:
+        resp = pb.ListImagesResponse()
+        with self._lock:
+            for name, size in sorted(self._images.items()):
+                img = resp.images.add()
+                img.id = f"sha256:{name}"
+                img.repo_tags.append(name)
+                img.size_bytes = size
+        return resp.SerializeToString()
+
+    def _h_ImageStatus(self, payload: bytes) -> bytes:
+        req = pb.ImageStatusRequest.FromString(payload)
+        resp = pb.ImageStatusResponse()
+        with self._lock:
+            size = self._images.get(req.image.image)
+        if size is not None:
+            resp.image.id = f"sha256:{req.image.image}"
+            resp.image.repo_tags.append(req.image.image)
+            resp.image.size_bytes = size
+        return resp.SerializeToString()
+
+    def _h_RemoveImage(self, payload: bytes) -> bytes:
+        req = pb.RemoveImageRequest.FromString(payload)
+        with self._lock:
+            self._images.pop(req.image.image, None)
+        return pb.RemoveImageResponse().SerializeToString()
+
+    def _h_ImageFsInfo(self, payload: bytes) -> bytes:
+        with self._lock:
+            used = sum(self._images.values())
+        return pb.ImageFsInfoResponse(
+            used_bytes=used, capacity_bytes=100 * 1024 * 1024 * 1024
+        ).SerializeToString()
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +325,12 @@ class RemoteRuntime(PodRuntime):
         for k, val in pod.metadata.annotations.items():
             if k in (ANN_RUN_SECONDS, ANN_FAIL):
                 cfg.annotations[k] = val
+        for c in pod.spec.containers:
+            cc = cfg.containers.add()
+            cc.name = c.name
+            cc.image = c.image
+            cc.command.extend(c.command)
+            cc.args.extend(c.args)
         resp = pb.RunPodSandboxResponse.FromString(
             self._call("RunPodSandbox", pb.RunPodSandboxRequest(config=cfg))
         )
@@ -271,6 +353,69 @@ class RemoteRuntime(PodRuntime):
             sb.id: _STATE_TO_PHASE.get(sb.state, v1.POD_RUNNING)
             for sb in resp.items
         }
+
+    def exec(self, pod_key: str, command) -> str:
+        return self.exec_status(pod_key, command)[0]
+
+    def exec_status(self, pod_key: str, command) -> Tuple[str, int]:
+        resp = pb.ExecSyncResponse.FromString(
+            self._call(
+                "ExecSync",
+                pb.ExecSyncRequest(
+                    pod_sandbox_id=pod_key, command=list(command)
+                ),
+            )
+        )
+        return resp.stdout.decode(errors="replace"), resp.exit_code
+
+    def logs(self, pod_key: str, tail_lines: Optional[int] = None) -> str:
+        resp = pb.ContainerLogsResponse.FromString(
+            self._call(
+                "ContainerLogs",
+                pb.ContainerLogsRequest(
+                    pod_sandbox_id=pod_key, tail_lines=tail_lines or 0
+                ),
+            )
+        )
+        return resp.data.decode(errors="replace")
+
+    # -- ImageService ---------------------------------------------------------
+
+    def pull_image(self, image: str) -> str:
+        resp = pb.PullImageResponse.FromString(
+            self._call(
+                "PullImage",
+                pb.PullImageRequest(image=pb.ImageSpec(image=image)),
+            )
+        )
+        return resp.image_ref
+
+    def list_images(self) -> Dict[str, int]:
+        resp = pb.ListImagesResponse.FromString(
+            self._call("ListImages", pb.ListImagesRequest())
+        )
+        return {img.repo_tags[0]: img.size_bytes for img in resp.images}
+
+    def image_status(self, image: str) -> Optional[str]:
+        resp = pb.ImageStatusResponse.FromString(
+            self._call(
+                "ImageStatus",
+                pb.ImageStatusRequest(image=pb.ImageSpec(image=image)),
+            )
+        )
+        return resp.image.id or None
+
+    def remove_image(self, image: str) -> None:
+        self._call(
+            "RemoveImage",
+            pb.RemoveImageRequest(image=pb.ImageSpec(image=image)),
+        )
+
+    def image_fs_info(self) -> Tuple[int, int]:
+        resp = pb.ImageFsInfoResponse.FromString(
+            self._call("ImageFsInfo", pb.ImageFsInfoRequest())
+        )
+        return resp.used_bytes, resp.capacity_bytes
 
     def close(self) -> None:
         with self._lock:

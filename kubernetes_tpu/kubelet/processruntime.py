@@ -69,7 +69,16 @@ class ProcessRuntime(PodRuntime):
         procs: List[_Proc] = []
         try:
             for c in pod.spec.containers:
-                cmd = (list(c.command) + list(c.args)) if c.command else _PAUSE
+                # command overrides the (nonexistent) image entrypoint;
+                # args-only becomes the argv — with no image metadata to
+                # supply an entrypoint, failing loudly on a non-executable
+                # args[0] beats silently running the pause sleep
+                if c.command:
+                    cmd = list(c.command) + list(c.args)
+                elif c.args:
+                    cmd = list(c.args)
+                else:
+                    cmd = _PAUSE
                 log_path = os.path.join(pod_dir, f"{c.name or 'c'}.log")
                 logf = open(log_path, "ab")
                 try:
@@ -179,6 +188,9 @@ class ProcessRuntime(PodRuntime):
         return text
 
     def exec(self, pod_key: str, command) -> str:
+        return self.exec_status(pod_key, command)[0]
+
+    def exec_status(self, pod_key: str, command) -> Tuple[str, int]:
         with self._lock:
             pp = self._pods.get(pod_key)
         if pp is None:
@@ -187,7 +199,7 @@ class ProcessRuntime(PodRuntime):
             list(command), cwd=pp.dir, capture_output=True, text=True,
             timeout=30,
         )
-        return r.stdout + r.stderr
+        return r.stdout + r.stderr, r.returncode
 
     # -- resource accounting (the /proc "cgroup read") -----------------------
 

@@ -60,6 +60,12 @@ class PodRuntime:
         unsupported."""
         raise NotImplementedError("runtime does not support exec")
 
+    def exec_status(self, pod_key: str, command) -> tuple:
+        """(output, exit_code) — the full ExecSync contract. Runtimes
+        that can observe the exit status override this; the default
+        preserves exec()'s output-only behavior with code 0."""
+        return self.exec(pod_key, command), 0
+
 
 class _FakePod:
     __slots__ = ("ip", "started", "run_seconds", "fail", "ready_after", "unhealthy_after")

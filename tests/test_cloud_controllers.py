@@ -81,3 +81,186 @@ def test_routes_follow_node_pod_cidrs():
     finally:
         routes.stop()
         ipam.stop()
+
+
+def test_cloud_node_controller_initializes_nodes():
+    """A node registering with the cloudprovider uninitialized taint gets
+    providerID, instance-type/zone labels, addresses, and the taint
+    cleared once the instance is known
+    (pkg/controller/cloud/node_controller.go)."""
+    from kubernetes_tpu.controller.cloud import (
+        TAINT_UNINITIALIZED,
+        CloudInstance,
+        CloudNodeController,
+    )
+
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    cloud.add_instance(
+        "n0",
+        CloudInstance(
+            provider_id="fake://n0",
+            instance_type="tpu-v5e-8",
+            zone="zone-b",
+            addresses=(("InternalIP", "10.0.0.5"),),
+        ),
+    )
+    ctrl = CloudNodeController(server, cloud=cloud)
+    server.create(
+        "nodes",
+        v1.Node(
+            metadata=v1.ObjectMeta(name="n0", namespace=""),
+            spec=v1.NodeSpec(
+                taints=[
+                    v1.Taint(
+                        key=TAINT_UNINITIALIZED,
+                        effect=v1.TAINT_NO_SCHEDULE,
+                    )
+                ]
+            ),
+        ),
+    )
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: not any(
+                t.key == TAINT_UNINITIALIZED
+                for t in server.get("nodes", "", "n0").spec.taints
+            )
+        )
+        n = server.get("nodes", "", "n0")
+        assert n.spec.provider_id == "fake://n0"
+        assert n.metadata.labels["node.kubernetes.io/instance-type"] == "tpu-v5e-8"
+        assert n.metadata.labels["topology.kubernetes.io/zone"] == "zone-b"
+        assert ("InternalIP", "10.0.0.5") in [
+            tuple(a) for a in n.status.addresses
+        ]
+    finally:
+        ctrl.stop()
+
+
+def test_cloud_node_lifecycle_deletes_gone_and_taints_shutdown():
+    """(pkg/controller/cloud/node_lifecycle_controller.go): instance gone
+    -> Node deleted; instance shutdown -> shutdown taint; instance back
+    -> taint removed. Uncloud-managed nodes are never touched."""
+    from kubernetes_tpu.client.apiserver import NotFound
+    from kubernetes_tpu.controller.cloud import (
+        TAINT_SHUTDOWN,
+        CloudNodeLifecycleController,
+    )
+
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    for name in ("gone", "asleep", "healthy"):
+        cloud.add_instance(name)
+        server.create(
+            "nodes", v1.Node(metadata=v1.ObjectMeta(name=name, namespace=""))
+        )
+    # a node the cloud never knew (on-prem): must never be deleted
+    server.create(
+        "nodes", v1.Node(metadata=v1.ObjectMeta(name="onprem", namespace=""))
+    )
+    lc = CloudNodeLifecycleController(server, cloud=cloud, period_s=999)
+
+    cloud.instances["gone"].exists = False
+    cloud.instances["asleep"].shutdown = True
+    lc.sweep()
+
+    try:
+        server.get("nodes", "", "gone")
+        assert False, "gone node should be deleted"
+    except NotFound:
+        pass
+    asleep = server.get("nodes", "", "asleep")
+    assert any(t.key == TAINT_SHUTDOWN for t in asleep.spec.taints)
+    assert server.get("nodes", "", "healthy").spec.taints == []
+    assert server.get("nodes", "", "onprem") is not None
+
+    # instance wakes: the taint clears on the next sweep
+    cloud.instances["asleep"].shutdown = False
+    lc.sweep()
+    asleep = server.get("nodes", "", "asleep")
+    assert not any(t.key == TAINT_SHUTDOWN for t in asleep.spec.taints)
+
+
+def test_lb_status_and_hosts_follow_nodes():
+    """status.loadBalancer.ingress is written alongside external_ips, and
+    the LB's backend host set tracks Ready schedulable nodes."""
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    for name, ready in (("n0", True), ("n1", True), ("n2", False)):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=name, namespace=""),
+                status=v1.NodeStatus(
+                    conditions=[
+                        v1.NodeCondition(
+                            type=v1.NODE_READY,
+                            status="True" if ready else "False",
+                        )
+                    ]
+                ),
+            ),
+        )
+    ctrl = ServiceLBController(server, cloud=cloud)
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="lb2"),
+            spec=v1.ServiceSpec(type="LoadBalancer", ports=[("http", 80)]),
+        ),
+    )
+    ctrl.start()
+    try:
+        assert wait_until(
+            lambda: server.get("services", "default", "lb2")
+            .status.load_balancer.ingress
+        )
+        assert cloud.lb_hosts["default/lb2"] == ("n0", "n1")
+        # a node drains: the host-sync hook updates every LB
+        server.guaranteed_update(
+            "nodes", "", "n1",
+            lambda n: (setattr(n.spec, "unschedulable", True), n)[1],
+        )
+        ctrl.sync_hosts()
+        assert cloud.lb_hosts["default/lb2"] == ("n0",)
+    finally:
+        ctrl.stop()
+
+
+def test_node_events_refresh_lb_hosts():
+    """A node draining triggers the host-set refresh through the
+    controller's own node watch (no manual sync_hosts call)."""
+    server = APIServer()
+    cloud = FakeCloudProvider()
+    for name in ("na", "nb"):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=name, namespace=""),
+                status=v1.NodeStatus(
+                    conditions=[
+                        v1.NodeCondition(type=v1.NODE_READY, status="True")
+                    ]
+                ),
+            ),
+        )
+    ctrl = ServiceLBController(server, cloud=cloud)
+    server.create(
+        "services",
+        v1.Service(
+            metadata=v1.ObjectMeta(name="lb3"),
+            spec=v1.ServiceSpec(type="LoadBalancer", ports=[("http", 80)]),
+        ),
+    )
+    ctrl.start()
+    try:
+        assert wait_until(lambda: cloud.lb_hosts.get("default/lb3") == ("na", "nb"))
+        server.guaranteed_update(
+            "nodes", "", "nb",
+            lambda n: (setattr(n.spec, "unschedulable", True), n)[1],
+        )
+        assert wait_until(lambda: cloud.lb_hosts.get("default/lb3") == ("na",))
+    finally:
+        ctrl.stop()

@@ -130,15 +130,49 @@ def test_over_the_cri_wire(tmp_path):
     srv = CRIServer(rt, str(tmp_path / "cri.sock"))
     srv.start()
     remote = RemoteRuntime(str(tmp_path / "cri.sock"))
-    p = _pod("wire", ["/bin/sh", "-c", "echo over-the-wire"])
+    p = _pod("wire", ["/bin/sh", "-c", "echo over-the-wire; sleep 5"])
     try:
         remote.run_pod(p)
+        # the container REALLY ran remotely: its output is visible through
+        # the ContainerLogs RPC while it is still Running (guards against
+        # the command being dropped at the wire and the empty pod
+        # vacuously 'succeeding')
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            if remote.relist().get(p.metadata.key) == v1.POD_SUCCEEDED:
+            if "over-the-wire" in remote.logs(p.metadata.key):
                 break
             time.sleep(0.05)
-        assert remote.relist()[p.metadata.key] == v1.POD_SUCCEEDED
+        assert "over-the-wire" in remote.logs(p.metadata.key)
+        assert remote.relist()[p.metadata.key] == v1.POD_RUNNING
+        # real exec through ExecSync
+        assert remote.exec(p.metadata.key, ["/bin/echo", "rpc"]).strip() == "rpc"
+        remote.kill_pod(p.metadata.key)
+        assert p.metadata.key not in remote.relist()
+    finally:
+        remote.close()
+        srv.stop()
+
+
+def test_cri_image_service(tmp_path):
+    """ImageService subset over the wire: Pull/List/Status/Remove/FsInfo
+    (reference RuntimeService sibling in cri-api api.proto)."""
+    from kubernetes_tpu.kubelet.cri.wire import CRIServer, RemoteRuntime
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+    srv = CRIServer(FakeRuntime(_ip_alloc), str(tmp_path / "cri.sock"))
+    srv.start()
+    remote = RemoteRuntime(str(tmp_path / "cri.sock"))
+    try:
+        assert remote.image_status("busybox:1.36") is None
+        ref = remote.pull_image("busybox:1.36")
+        assert ref == "sha256:busybox:1.36"
+        remote.pull_image("app:v2")
+        assert set(remote.list_images()) == {"busybox:1.36", "app:v2"}
+        assert remote.image_status("busybox:1.36") is not None
+        used, cap = remote.image_fs_info()
+        assert 0 < used < cap
+        remote.remove_image("app:v2")
+        assert set(remote.list_images()) == {"busybox:1.36"}
     finally:
         remote.close()
         srv.stop()
@@ -177,6 +211,7 @@ def test_real_stats_reach_metrics_api(tmp_path):
         )
         store.create("pods", p)
         kl.handle_pod_event("ADDED", store.get("pods", "default", "burner"))
+        kl.stats_publish_interval_s = 0.0  # test: defeat the 10 s throttle
         kl.housekeeping()  # first sample
         time.sleep(1.0)  # let the spinner accumulate real cpu time
         kl.housekeeping()  # second sample -> rate published
@@ -200,3 +235,82 @@ def test_real_stats_reach_metrics_api(tmp_path):
     finally:
         rt.kill_pod("default/burner")
         srv.shutdown()
+
+
+def test_kubelet_pulls_images_before_start(tmp_path):
+    """EnsureImageExists ordering: the kubelet pulls each container's
+    image through the runtime's ImageService before the sandbox starts;
+    IfNotPresent skips present images, Always re-pulls, Never never."""
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.kubelet.cri.wire import CRIServer, RemoteRuntime
+    from kubernetes_tpu.kubelet.kubelet import Kubelet, make_node_object
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+    srv = CRIServer(FakeRuntime(_ip_alloc), str(tmp_path / "cri.sock"))
+    srv.start()
+    remote = RemoteRuntime(str(tmp_path / "cri.sock"))
+    store = APIServer()
+    store.create("nodes", make_node_object("n0"))
+    kl = Kubelet(store, "n0", remote)
+    try:
+        pod = v1.Pod(
+            metadata=v1.ObjectMeta(name="imgpod"),
+            spec=v1.PodSpec(
+                node_name="n0",
+                containers=[
+                    v1.Container(name="a", image="busybox:1.36"),
+                    v1.Container(
+                        name="b", image="secret:v1", image_pull_policy="Never"
+                    ),
+                ],
+            ),
+        )
+        store.create("pods", pod)
+        kl.handle_pod_event("ADDED", store.get("pods", "default", "imgpod"))
+        imgs = remote.list_images()
+        assert "busybox:1.36" in imgs
+        assert "secret:v1" not in imgs  # Never means never
+    finally:
+        remote.close()
+        srv.stop()
+
+
+def test_exec_exit_code_propagates(tmp_path):
+    """ExecSync carries the command's REAL exit status end-to-end
+    (reference ExecSyncResponse.exit_code)."""
+    from kubernetes_tpu.kubelet.cri.wire import CRIServer, RemoteRuntime
+
+    rt = ProcessRuntime(_ip_alloc, str(tmp_path / "pods"))
+    srv = CRIServer(rt, str(tmp_path / "cri.sock"))
+    srv.start()
+    remote = RemoteRuntime(str(tmp_path / "cri.sock"))
+    p = _pod("ec", ["/bin/sleep", "30"])
+    try:
+        remote.run_pod(p)
+        out, code = remote.exec_status(
+            p.metadata.key, ["/bin/sh", "-c", "echo hi; exit 7"]
+        )
+        assert out.strip() == "hi" and code == 7
+        out, code = remote.exec_status(p.metadata.key, ["/bin/true"])
+        assert code == 0
+    finally:
+        remote.kill_pod(p.metadata.key)
+        remote.close()
+        srv.stop()
+
+
+def test_args_only_container_runs_args(tmp_path):
+    """Container(args=...) with no command: args become the argv (no
+    image entrypoint exists to prepend) instead of silently running the
+    pause sleep."""
+    rt = ProcessRuntime(_ip_alloc, str(tmp_path))
+    p = v1.Pod(
+        metadata=v1.ObjectMeta(name="argsonly"),
+        spec=v1.PodSpec(
+            node_name="n0",
+            containers=[v1.Container(name="m", args=["/bin/echo", "via-args"])],
+        ),
+    )
+    rt.run_pod(p)
+    assert _wait_phase(rt, p.metadata.key, v1.POD_SUCCEEDED)
+    assert "via-args" in rt.logs(p.metadata.key)

@@ -235,3 +235,28 @@ def test_malformed_binary_body_is_400():
             assert e.code == 400
     finally:
         srv.shutdown()
+
+
+def test_required_field_with_empty_value_roundtrips():
+    """A REQUIRED (no-default) field holding an empty value must still
+    hit the wire, or decode's cls(**kwargs) crashes."""
+
+    @dataclasses.dataclass
+    class Req:
+        key: str
+        n: int
+        tags: list
+
+    r = Req(key="", n=0, tags=[])
+    raw = bytes(protocodec._enc_message(r))
+    back = protocodec._dec_message(raw, Req)
+    assert back == r
+
+    node = v1.Node(
+        metadata=v1.ObjectMeta(name="n0", namespace=""),
+        status=v1.NodeStatus(
+            conditions=[v1.NodeCondition(type="", status="True")]
+        ),
+    )
+    back = protocodec.decode_obj(protocodec.encode_obj(node))
+    assert back.status.conditions[0].type == ""

@@ -88,3 +88,70 @@ def test_nil_conditions_ready_means_ready():
     }
     _res, obj = codec.decode_any(doc)
     assert [e.ready for e in obj.endpoints] == [True, True]
+
+
+def test_ingress_v1_conversion_field_moves():
+    """networking.k8s.io/v1 Ingress: the nested service backend and
+    http.paths convert to the internal flat shape and back (the real
+    v1beta1 -> v1 graduation's field moves, reference
+    pkg/apis/networking conversions)."""
+    from kubernetes_tpu.api.scheme import scheme
+
+    doc = {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": {"name": "ing"},
+        "spec": {
+            "defaultBackend": {
+                "service": {"name": "fallback", "port": {"number": 8080}}
+            },
+            "rules": [
+                {
+                    "host": "a.example.com",
+                    "http": {
+                        "paths": [
+                            {
+                                "path": "/api",
+                                "pathType": "Prefix",
+                                "backend": {
+                                    "service": {
+                                        "name": "api-svc",
+                                        "port": {"number": 80},
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                }
+            ],
+        },
+    }
+    resource, obj = scheme.decode(doc)
+    assert resource == "ingresses"
+    assert obj.spec.default_backend.service_name == "fallback"
+    p = obj.spec.rules[0].paths[0]
+    assert (p.backend.service_name, p.backend.service_port) == ("api-svc", 80)
+    out = scheme.encode(obj, "networking.k8s.io/v1")
+    back = out["spec"]["rules"][0]["http"]["paths"][0]["backend"]["service"]
+    assert back == {"name": "api-svc", "port": {"number": 80}}
+    # named ports round-trip through the name key
+    obj.spec.rules[0].paths[0].backend.service_port = "web"
+    out = scheme.encode(obj, "networking.k8s.io/v1")
+    assert out["spec"]["rules"][0]["http"]["paths"][0]["backend"]["service"][
+        "port"
+    ] == {"name": "web"}
+
+
+def test_graduated_groups_registered():
+    """Schema-identical graduations decode at either version."""
+    from kubernetes_tpu.api.scheme import scheme
+
+    for av, kind in (
+        ("batch/v1", "CronJob"),
+        ("batch/v1beta1", "CronJob"),
+        ("policy/v1", "PodDisruptionBudget"),
+        ("policy/v1beta1", "PodDisruptionBudget"),
+        ("extensions/v1beta1", "Ingress"),
+    ):
+        assert scheme.recognizes(av, kind), f"{av}/{kind}"
+    assert scheme.prioritized_versions("batch") == ["v1", "v1beta1"]
