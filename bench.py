@@ -30,6 +30,10 @@ import traceback
 
 TARGET_PODS_PER_S = 50_000.0  # BASELINE.json north-star, v5e-8
 
+# one definition for the reader (CPU-fallback attach) and the writer
+# (on-TPU self-checkpoint): a round bump edits exactly one line
+_TPU_CHECKPOINT = os.environ.get("BENCH_TPU_CHECKPOINT", "BENCH_r05_tpu.json")
+
 _PROBE = (
     "import jax, jax.numpy as jnp;"
     "x = jnp.ones((256, 256), jnp.bfloat16);"
@@ -183,11 +187,29 @@ def main() -> int:
             except Exception:
                 traceback.print_exc()
 
+        # CPU fallback: attach the round's checkpointed on-TPU artifact (if
+        # one landed earlier — the watchdog self-checkpoints every real-TPU
+        # pass) so the official round artifact carries the hardware evidence
+        # even when the tunnel is wedged at driver-run time. Clearly labeled
+        # as a checkpoint: `value` stays the CPU measurement.
+        tpu_checkpoint = None
+        if platform.startswith("cpu"):
+            try:
+                ckpt_path = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), _TPU_CHECKPOINT
+                )
+                if os.path.exists(ckpt_path):
+                    with open(ckpt_path, encoding="utf-8") as f:
+                        tpu_checkpoint = json.load(f)
+            except Exception:
+                traceback.print_exc()
+
         out.update(
             value=round(res.throughput_pods_per_s, 1),
             vs_baseline=round(res.throughput_pods_per_s / TARGET_PODS_PER_S, 4),
             detail={
                 "platform": platform,
+                "tpu_checkpoint_this_round": tpu_checkpoint,
                 "device_readback_rtt_ms": tunnel_rtt_ms,
                 # the steady-state pod-p99 floor on THIS deployment: every
                 # cycle needs >=1 device->host readback (bind consumes the
@@ -260,8 +282,7 @@ def main() -> int:
         detail = out.get("detail") or {}
         if str(detail.get("platform", "")).startswith("tpu") and "error" not in out:
             path = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                os.environ.get("BENCH_TPU_CHECKPOINT", "BENCH_r05_tpu.json"),
+                os.path.dirname(os.path.abspath(__file__)), _TPU_CHECKPOINT
             )
             best = None
             if os.path.exists(path):

@@ -191,6 +191,16 @@ class TemplateCache:
         self._vocab_sig = self._sig()
         self._label_memo: Dict[Tuple, Tuple] = {}
         self._label_memo_sig = (0, 0)
+        # per-pod fingerprint memo: an unschedulable-storm batch re-encodes
+        # the SAME pods every cycle (a full cluster retries thousands of
+        # pending pods per event), and the per-pod tuple build in
+        # pod_fingerprint was the dominant tpl-encode cost. (uid, rv)
+        # uniquely identifies pod content (the API bumps rv on every
+        # write); the epoch ties an entry to the vocab state its
+        # fingerprint embedded.
+        self._fp_memo: Dict[str, Tuple] = {}
+        self._fp_epoch = 0
+        self._fp_epoch_sig: Tuple = self._vocab_sig
 
     def _sig(self) -> Tuple:
         e = self.encoder
@@ -259,7 +269,27 @@ class TemplateCache:
                     len(self.encoder.sel_vocab),
                     len(self.encoder.eterm_vocab),
                 )
-            fps = [self._fingerprint(p) for p in pods]
+            if sig0 != self._fp_epoch_sig:
+                self._fp_epoch += 1
+                self._fp_epoch_sig = sig0
+            memo, epoch = self._fp_memo, self._fp_epoch
+            fps = []
+            for p in pods:
+                uid = p.metadata.uid
+                ent = memo.get(uid) if uid else None
+                if (
+                    ent is not None
+                    and ent[0] == p.metadata.resource_version
+                    and ent[1] == epoch
+                ):
+                    fps.append(ent[2])
+                    continue
+                fp = self._fingerprint(p)
+                if uid:
+                    if len(memo) > 65536:
+                        memo.clear()
+                    memo[uid] = (p.metadata.resource_version, epoch, fp)
+                fps.append(fp)
             changed = False
             for pod, fp in zip(pods, fps):
                 if fp not in self._rows:

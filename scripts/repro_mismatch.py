@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Minimal aggressive repro for the soak device/host 'requested' mismatch.
+
+Runs the same churn shape as scripts/soak.py (create/delete churn past
+capacity, node unschedulable flaps) on a tiny cluster with frequent
+quiesce+audit passes. On the first surviving mismatch it dumps the
+differing rows: master vs device values, the host pod entries on the row,
+the dirty set, and the assumed set — enough to identify the path that
+broke the dirty-row invariant.
+
+    python scripts/repro_mismatch.py [minutes] [n_nodes]
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubernetes_tpu.api import objects as v1  # noqa: E402
+from kubernetes_tpu.client.apiserver import APIServer, NotFound  # noqa: E402
+from kubernetes_tpu.kubelet.kubelet import NodeAgentPool, make_node_object  # noqa: E402
+from kubernetes_tpu.scheduler import (  # noqa: E402
+    KubeSchedulerConfiguration,
+    Scheduler,
+)
+
+STOP = threading.Event()
+ERRORS = []
+
+
+def guarded(fn):
+    def run():
+        try:
+            while not STOP.is_set():
+                fn()
+        except Exception as e:  # noqa: BLE001
+            ERRORS.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+
+    return run
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 3.0
+    n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    rng = random.Random(0)
+    server = APIServer()
+    for i in range(n_nodes):
+        server.create("nodes", make_node_object(f"n{i}", cpu="4"))
+    pool = NodeAgentPool(server, housekeeping_interval=0.2)
+    for i in range(n_nodes):
+        pool.add_node(f"n{i}", register=False)
+    pool.start()
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_mesh=False))
+    sched.start()
+
+    seq = [0]
+
+    def churn_pods():
+        i = seq[0] = seq[0] + 1
+        try:
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"churn-{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        except Exception:
+            pass
+        if i > 30 and rng.random() < 0.9:
+            victim = f"churn-{rng.randrange(max(1, i - 30), i)}"
+            try:
+                server.delete("pods", "default", victim)
+            except NotFound:
+                pass
+        time.sleep(0.005)
+
+    def flap_nodes():
+        name = f"n{rng.randrange(n_nodes)}"
+        try:
+            server.guaranteed_update(
+                "nodes", "", name,
+                lambda n: (setattr(n.spec, "unschedulable", True), n)[1],
+            )
+            time.sleep(0.2)
+            server.guaranteed_update(
+                "nodes", "", name,
+                lambda n: (setattr(n.spec, "unschedulable", False), n)[1],
+            )
+        except NotFound:
+            pass
+        time.sleep(0.3)
+
+    threads = [
+        threading.Thread(target=guarded(churn_pods), daemon=True),
+        threading.Thread(target=guarded(flap_nodes), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+
+    def audit_once():
+        """Quiesce the pipeline, then compare device vs masters."""
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not sched._pending and not sched._busy:
+                time.sleep(0.05)
+                if not sched._pending and not sched._busy:
+                    break
+            time.sleep(0.05)
+        with sched.cache.lock:
+            enc = sched.cache.encoder
+            dev = jax.device_get(enc.flush())
+            masters = enc._masters()
+            bad = {}
+            for f in ("requested", "nonzero_req", "sel_counts", "port_counts"):
+                d = np.asarray(getattr(dev, f))
+                m = np.asarray(getattr(masters, f))
+                if not np.array_equal(d, m):
+                    rows = sorted(set(np.nonzero(d != m)[0].tolist()))
+                    bad[f] = rows
+            if bad:
+                print(f"MISMATCH at t={time.time()-t0:.0f}s: {bad}", flush=True)
+                for f, rows in bad.items():
+                    d = np.asarray(getattr(dev, f))
+                    m = np.asarray(getattr(masters, f))
+                    for r in rows[:4]:
+                        cols = np.nonzero(d[r] != m[r])[0] if d[r].ndim else []
+                        print(
+                            f"  {f} row={r} node={enc.row_names[r]} "
+                            f"cols={cols[:8].tolist() if len(cols) else '?'} "
+                            f"dev={d[r][cols[:8]].tolist() if len(cols) else d[r]} "
+                            f"mst={m[r][cols[:8]].tolist() if len(cols) else m[r]}",
+                            flush=True,
+                        )
+                        pods = enc._pods.get(r, {})
+                        print(
+                            f"    host pods on row ({len(pods)}): "
+                            f"{sorted(pods.keys())[:6]}",
+                            flush=True,
+                        )
+                with sched.cache.lock:
+                    print(
+                        f"  assumed={sorted(sched.cache._assumed.keys())[:8]} "
+                        f"dirty={sorted(enc._dirty_rows)} "
+                        f"pending={len(sched._pending)}",
+                        flush=True,
+                    )
+                return True
+            return False
+
+    # Phase loop mirroring the soak's failing sequence: oversubscribe ->
+    # stop churn -> burst-delete bound pods (capacity release) -> refill
+    # from the unschedulable pool -> quiesce + audit. The r5 soak mismatch
+    # was audited right after this exact sequence.
+    t0 = time.time()
+    found = False
+    cycle = 0
+    while time.time() - t0 < minutes * 60 and not ERRORS and not found:
+        cycle += 1
+        time.sleep(45)  # churn phase: oversubscribe
+        # capacity-release burst: delete ~25% of bound pods
+        victims = [
+            p
+            for p in server.list("pods")[0]
+            if p.spec.node_name and p.metadata.deletion_timestamp is None
+        ]
+        burst = victims[: max(1, len(victims) // 4)]
+        for p in burst:
+            try:
+                server.delete("pods", p.metadata.namespace, p.metadata.name)
+            except NotFound:
+                pass
+        print(
+            f"[cycle {cycle}] deleted {len(burst)} bound pods "
+            f"(t={time.time()-t0:.0f}s, created={seq[0]})",
+            flush=True,
+        )
+        time.sleep(15)  # refill phase
+        if audit_once():
+            found = True
+            break
+    STOP.set()
+    for t in threads:
+        t.join(timeout=5)
+    sched.stop()
+    pool.stop()
+    total = server.count("pods")
+    bound = server.count("pods", lambda p: bool(p.spec.node_name))
+    print(
+        f"REPRO {'HIT' if found else 'no-hit'}: created={seq[0]} pods={total} "
+        f"bound={bound} errors={ERRORS[:3]}",
+        flush=True,
+    )
+    return 0 if found else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,6 +127,26 @@ def main() -> int:
                 pass
         time.sleep(0.7)
 
+    # starvation sentinel: on a saturated 1-CPU box a thread can sit
+    # descheduled for many seconds between two adjacent bytecodes, which
+    # inflates every wall-clock stage timer without any work happening.
+    # Measure it (max sleep overshoot) so wall-vs-work gaps in the stage
+    # timers are attributable instead of mysterious.
+    # runs through the convergence phases too (the refill probe's
+    # full-store counts starve the scheduler hardest), so it gets its own
+    # stop event, set only after the stage histograms are read
+    starve = {"max_s": 0.0}
+    sentinel_stop = threading.Event()
+
+    def sentinel():
+        while not sentinel_stop.is_set():
+            t = time.monotonic()
+            time.sleep(0.25)
+            over = time.monotonic() - t - 0.25
+            if over > starve["max_s"]:
+                starve["max_s"] = over
+
+    threading.Thread(target=sentinel, daemon=True).start()
     threads = [
         threading.Thread(target=guarded(churn_pods), daemon=True),
         threading.Thread(target=guarded(flap_nodes), daemon=True),
@@ -270,8 +290,13 @@ def main() -> int:
         time.sleep(2)
 
     # host-side batch wall time: the r4 storm hid 300-600 s batches outside
-    # every stage timer; 'finish' now covers that path. Assert none ran away
-    # (5 s is ~100x better than r4 and safe on a loaded 1-CPU CI box).
+    # every stage timer; 'finish' plus its sub-stages (resolve / snapshot /
+    # fallback / failed) now cover that path. The gate is on the WORK
+    # sub-stages: the enclosing 'finish' wall also absorbs scheduler-thread
+    # starvation on a saturated 1-CPU box (the sentinel above measures it),
+    # so a no-op block can read as seconds without any work. A sub-stage
+    # over 5 s is real runaway work and FAILs; a finish wall far above the
+    # sub-stage sum is reported with the measured starvation for context.
     from kubernetes_tpu.utils.metrics import metrics
 
     stage_max = {}
@@ -286,9 +311,25 @@ def main() -> int:
             stage_max[st] = round(max(h._samples), 3)
     # absence of finish samples is itself a FAIL: a renamed stage label
     # would otherwise vacuously disable this gate
-    batch_ok = "finish" in stage_max and stage_max["finish"] <= 5.0
+    has_sub = any(k.startswith("finish.") for k in stage_max)
+    sub_max = max(
+        (v for k, v in stage_max.items() if k.startswith("finish.")),
+        default=0.0,
+    )
+    # a >5s finish wall with NO sub-stage samples means either a renamed
+    # sub-stage label or a runaway path outside every work timer — both
+    # must FAIL, not slip through on an empty generator
+    batch_ok = (
+        "finish" in stage_max
+        and sub_max <= 5.0
+        and (has_sub or stage_max["finish"] <= 5.0)
+    )
+    sentinel_stop.set()
     if stage_max.get("finish", 0.0) > 1.0:
-        print(f"WARNING: slowest finish stage {stage_max['finish']}s > 1s")
+        print(
+            f"WARNING: finish wall {stage_max['finish']}s (work sub-stages "
+            f"max {sub_max}s, sentinel starvation max {starve['max_s']:.1f}s)"
+        )
 
     sched.stop()
     cm.stop()
@@ -304,8 +345,8 @@ def main() -> int:
         f"SOAK {'PASS' if ok else 'FAIL'}: created={seq[0]} "
         f"pending={pending} unmarked={unmarked} marking_s={marking_s:.0f} "
         f"refill_ok={refill_ok} refilled={refilled} "
-        f"stage_max_s={stage_max} errors={ERRORS[:3]} "
-        f"device_host_mismatch={mismatch}",
+        f"stage_max_s={stage_max} starvation_max_s={starve['max_s']:.1f} "
+        f"errors={ERRORS[:3]} device_host_mismatch={mismatch}",
         flush=True,
     )
     return 0 if ok else 1
