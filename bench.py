@@ -201,6 +201,15 @@ def main() -> int:
                 if os.path.exists(ckpt_path):
                     with open(ckpt_path, encoding="utf-8") as f:
                         tpu_checkpoint = json.load(f)
+                    # surface freshness: a checkpoint from an earlier code
+                    # state must be readable as such, not pass silently as
+                    # current evidence
+                    ck_ts = tpu_checkpoint.get("checkpointed_at")
+                    tpu_checkpoint["checkpoint_age_hours"] = (
+                        round((time.time() - ck_ts) / 3600.0, 2)
+                        if ck_ts
+                        else None
+                    )
             except Exception:
                 traceback.print_exc()
 
@@ -290,7 +299,9 @@ def main() -> int:
                     best = json.load(f)
             if best is None or out["value"] >= best.get("value", 0):
                 # atomic publish: a crash mid-write must not destroy the
-                # previously checkpointed artifact
+                # previously checkpointed artifact. checkpointed_at lets
+                # the fallback reader (and the judge) see freshness.
+                out["checkpointed_at"] = time.time()
                 tmp = path + ".tmp"
                 with open(tmp, "w", encoding="utf-8") as f:
                     json.dump(out, f, indent=1)

@@ -40,12 +40,16 @@ all int32. Nodes with >2 TiB of a single resource clamp to int32 max.
 from __future__ import annotations
 
 import functools
+import logging
+import time
 from dataclasses import dataclass, replace
 from typing import Any, Dict, FrozenSet, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger("kubernetes_tpu.ops.encoding")
 
 from ..api import objects as v1
 from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, ResourceList
@@ -164,7 +168,23 @@ class EncodingConfig:
         # compaction), plus a flat allowance for non-hostname label values.
         n_cap = pow2(int(num_nodes * 1.25) + 1, 128)
         v_cap = pow2(int(num_nodes * 1.25) + 512, 256)
-        base = dict(n_cap=n_cap, v_cap=v_cap)
+        base = dict(
+            n_cap=n_cap,
+            v_cap=v_cap,
+            # pod-side vocab headroom: at real-cluster scale the first
+            # burst's pods intern label keys / selector predicates /
+            # affinity eterms / host ports past the tiny defaults, and
+            # every growth is a mid-window field re-upload PLUS a
+            # multi-second kernel recompile (shapes change). Start wide
+            # enough that steady state never grows; the extra columns ride
+            # the one pre-window upload (~a few MB at 5k nodes).
+            k_cap=128,
+            s_cap=64,
+            t_cap=64,
+            pv_cap=32,
+            im_cap=64,
+            av_cap=16,
+        )
         base.update(overrides)
         return cls(**base)
 
@@ -352,6 +372,10 @@ class SnapshotEncoder:
         self._alloc_masters()
         self._dirty_rows: set = set()
         self._full_upload = True
+        # device CONTENT unknowable (readback failure, kernel exception,
+        # resharding): forces a true full re-upload. _full_upload alone now
+        # means "shapes may have grown" and flush re-uploads per-field.
+        self._content_invalid = True
         self._globals_dirty = False  # non-row fields (band_prio, eterm meta)
         self._device: Optional[DeviceSnapshot] = None
         # multi-chip placement: snapshot sharding pytree + replicated spec
@@ -414,6 +438,9 @@ class SnapshotEncoder:
             dst = getattr(self, name)
             sl = tuple(slice(0, s) for s in arr.shape)
             dst[sl] = arr
+        # shape growth, not content loss: flush re-uploads only the fields
+        # whose shape changed (a mid-burst t_cap bump cost a ~2 s full
+        # 5k-row re-upload through the tunnel before this distinction)
         self._full_upload = True
 
     def presize_for_cluster(self, num_nodes: int) -> None:
@@ -421,8 +448,11 @@ class SnapshotEncoder:
         EncodingConfig.for_cluster). Cheap before the first flush; later it
         costs the same single re-upload a demand-grow would."""
         want = EncodingConfig.for_cluster(num_nodes)
-        self._ensure_cap("n_cap", want.n_cap)
-        self._ensure_cap("v_cap", want.v_cap)
+        for cap in (
+            "n_cap", "v_cap", "k_cap", "s_cap", "t_cap", "pv_cap",
+            "im_cap", "av_cap",
+        ):
+            self._ensure_cap(cap, getattr(want, cap))
 
     def _ensure_cap(self, attr: str, needed: int) -> None:
         cur = getattr(self.cfg, attr)
@@ -898,16 +928,56 @@ class SnapshotEncoder:
         cache). Global (non-row) fields changed without any dirty row
         (band allocation, eterm interning) refresh via a row-less scatter.
         """
+        t0 = time.monotonic()
+        self._flush_what = None
+        try:
+            return self._flush_inner()
+        finally:
+            dt = time.monotonic() - t0
+            if dt > 0.2:
+                logger.warning(
+                    "slow flush %.0f ms: %s", dt * 1e3, self._flush_what
+                )
+
+    def _flush_inner(self) -> DeviceSnapshot:
         masters = self._masters()
-        if self._device is None or self._full_upload:
+        if self._device is None or self._content_invalid:
+            self._flush_what = "full upload (first use or content invalid)"
             if self._snap_shardings is not None:
                 self._device = jax.device_put(masters, self._snap_shardings)
             else:
                 self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
             self._full_upload = False
+            self._content_invalid = False
             self._globals_dirty = False
             self._dirty_rows.clear()
             return self._device
+        if self._full_upload:
+            # capacity growth (_grow): device content is still valid, only
+            # some field SHAPES changed. Re-upload exactly those fields from
+            # the (grown, content-preserving) masters and keep the rest —
+            # a t_cap bump mid-burst then costs one [N, t_cap] transfer, not
+            # the full ~2 s snapshot re-upload. Dirty rows stay pending: the
+            # scatter below applies them to the kept fields (for re-uploaded
+            # fields it rewrites values already present — harmless).
+            merged = {}
+            reshaped = []
+            for name in DeviceSnapshot._fields:
+                m = getattr(masters, name)
+                d = getattr(self._device, name)
+                if tuple(d.shape) != m.shape:
+                    reshaped.append(name)
+                    if self._snap_shardings is not None:
+                        merged[name] = jax.device_put(
+                            m, getattr(self._snap_shardings, name)
+                        )
+                    else:
+                        merged[name] = jax.device_put(jnp.asarray(m))
+                else:
+                    merged[name] = d
+            self._device = DeviceSnapshot(**merged)
+            self._full_upload = False
+            self._flush_what = f"reshape upload of {reshaped}"
         if not self._dirty_rows:
             if not self._globals_dirty:
                 return self._device
@@ -916,9 +986,35 @@ class SnapshotEncoder:
             rows = sorted(self._dirty_rows)
             self._dirty_rows.clear()
         self._globals_dirty = False
-        pad = 1
-        while pad < max(len(rows), 1):
-            pad *= 4
+        # exactly TWO scatter program sizes (16 / 1024), chunking larger
+        # sets: every distinct pad is an XLA compile that costs 1.5-2 s
+        # through the tunnel, and the old O(log4 N) pad ladder put those
+        # compiles in the measured window the first time each size
+        # appeared. Both variants are warmable at startup
+        # (warm_scatter_programs). Chunk dispatches pipeline (async) so a
+        # large set still costs ~one tunnel exchange.
+        self._flush_what = (
+            f"{(self._flush_what + ' + ') if self._flush_what else ''}"
+            f"scatter of {len(rows)} dirty rows"
+        )
+        first = True
+        i = 0
+        while first or i < len(rows):
+            first = False
+            chunk = rows[i : i + _SCATTER_PAD_BIG]
+            i += _SCATTER_PAD_BIG
+            self._scatter_chunk(masters, chunk)
+        return self._device
+
+    def _scatter_chunk(
+        self, masters: DeviceSnapshot, rows: list, pad: Optional[int] = None
+    ) -> None:
+        if pad is None:
+            pad = (
+                _SCATTER_PAD_SMALL
+                if len(rows) <= _SCATTER_PAD_SMALL
+                else _SCATTER_PAD_BIG
+            )
         n_cap = self.cfg.n_cap
         idx = np.full(pad, n_cap, np.int32)  # OOB pad rows -> dropped
         idx[: len(rows)] = rows
@@ -942,7 +1038,16 @@ class SnapshotEncoder:
         else:
             idx_d, updates_d = jax.device_put((idx, updates))
         self._device = _scatter_rows(self._device, idx_d, updates_d)
-        return self._device
+
+    def warm_scatter_programs(self) -> None:
+        """Compile both scatter pad variants out-of-window (no-op scatters:
+        all indices OOB-dropped). Call at component start, after the
+        snapshot exists — 2 compiles at bring-up instead of mid-burst."""
+        if self._device is None:
+            self.flush()
+        masters = self._masters()
+        self._scatter_chunk(masters, [], pad=_SCATTER_PAD_SMALL)
+        self._scatter_chunk(masters, [], pad=_SCATTER_PAD_BIG)
 
     def set_sharding(self, snap_shardings, replicated_sharding) -> None:
         """Adopt multi-chip placement (parallel/mesh.snapshot_shardings):
@@ -959,7 +1064,12 @@ class SnapshotEncoder:
         there is no device state to be stale, so nothing is pending."""
         if self._device is None:
             return False
-        return bool(self._dirty_rows) or self._globals_dirty or self._full_upload
+        return (
+            bool(self._dirty_rows)
+            or self._globals_dirty
+            or self._full_upload
+            or self._content_invalid
+        )
 
     def mark_row_dirty(self, node_name: str) -> None:
         """Force a re-upload of one node row from the host masters. Used when
@@ -971,7 +1081,10 @@ class SnapshotEncoder:
             self._dirty_rows.add(row)
 
     def invalidate_device(self) -> None:
+        """Device content unknowable (readback/kernel failure, resharding):
+        the next flush re-uploads everything from the host masters."""
         self._full_upload = True
+        self._content_invalid = True
 
     def set_device_snapshot(self, snap: DeviceSnapshot) -> None:
         """Install a kernel-returned snapshot (occupancy committed on device).
@@ -988,6 +1101,11 @@ class SnapshotEncoder:
 # Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata
 # columns, replaced wholesale on flush instead of row-scattered).
 _GLOBAL_FIELDS = frozenset({"eterm_topo_key", "eterm_kind", "band_prio"})
+
+# The only two dirty-row scatter program sizes (see flush): small for the
+# low-load trickle, big for storm/churn sets; larger sets chunk by big.
+_SCATTER_PAD_SMALL = 16
+_SCATTER_PAD_BIG = 1024
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -87,3 +87,36 @@ class CacheDebugger:
                 logger.info("cache comparison: consistent with informers")
 
         signal.signal(signum, handler)
+
+
+def audit_device_vs_masters(enc, dev, masters, fields=("requested", "sel_counts", "port_counts")):
+    """Compare a fetched device snapshot against the host masters and print
+    row/column/value diagnostics for every differing field. Shared by the
+    soak driver and the mismatch reproducer so their reports can't drift.
+    Returns the list of differing field names. Caller holds the cache lock
+    (the row_names/_pods reads must be consistent with the arrays)."""
+    import numpy as np
+
+    bad = []
+    for f in fields:
+        d = np.asarray(getattr(dev, f))
+        m = np.asarray(getattr(masters, f))
+        if np.array_equal(d, m):
+            continue
+        bad.append(f)
+        rows = sorted(set(np.nonzero(d != m)[0].tolist()))
+        print(f"AUDIT {f}: {len(rows)} rows differ", flush=True)
+        for r in rows[:4]:
+            if d[r].ndim:
+                cols = np.nonzero(d[r] != m[r])[0]
+                dv, mv = d[r][cols[:8]].tolist(), m[r][cols[:8]].tolist()
+                cshow = cols[:8].tolist()
+            else:
+                cshow, dv, mv = "-", d[r], m[r]
+            print(
+                f"  row={r} node={enc.row_names[r] if r < len(enc.row_names) else '?'} "
+                f"cols={cshow} dev={dv} mst={mv} "
+                f"host_pods={len(enc._pods.get(r, {}))}",
+                flush=True,
+            )
+    return bad

@@ -296,6 +296,12 @@ class PriorityQueue:
         with self._lock:
             return len(self._active) + len(self._backoff) + len(self._unschedulable)
 
+    def active_len(self) -> int:
+        """Pods poppable RIGHT NOW (activeQ only — backoff/unschedulable
+        pods are not available to the batch former)."""
+        with self._lock:
+            return len(self._active)
+
 
 def _significant_update(old: Optional[v1.Pod], new: v1.Pod) -> bool:
     """UpdatePodInSchedulingQueue / isPodUpdated: ignore pure status churn."""

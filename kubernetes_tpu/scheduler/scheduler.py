@@ -299,6 +299,15 @@ class Scheduler:
                 )
         if self.cfg.pipeline_depth == 0 and self.cfg.use_device:
             self._pipeline_depth = self._auto_pipeline_depth()
+        if self.cfg.use_device:
+            # compile the two dirty-row scatter programs at bring-up: each
+            # is a ~2 s XLA compile through the tunnel that would otherwise
+            # land mid-burst the first time that pad size appears
+            try:
+                with self.cache.lock:
+                    self.cache.encoder.warm_scatter_programs()
+            except Exception:
+                logger.exception("scatter warmup failed")
         self.queue.run()
         self.cache.start_janitor()
         self._sched_thread = threading.Thread(
@@ -388,10 +397,22 @@ class Scheduler:
 
     def _scheduling_loop(self) -> None:
         while not self._stop.is_set():
-            # with a batch in flight don't block or linger waiting for
-            # arrivals — resolving the in-flight results (binding its pods)
-            # is the more urgent work, and any poll delay here would be
-            # charged to those pods' latency
+            # Batch-fill policy: the wave kernel's cycle cost is nearly
+            # batch-size-independent (per-wave [TPL, N] work dominates), so
+            # burst throughput = fill per kernel. With a batch in flight and
+            # less than a full batch queued, resolve the in-flight batch
+            # FIRST: its readback + bind work overlaps the device compute,
+            # and the burst keeps accumulating toward a full batch instead
+            # of being split into runt kernels (a 267-pod launch pays the
+            # same ~cycle as a 4096-pod one). A full queue keeps the eager
+            # depth-N pipeline exactly as before; with nothing in flight
+            # don't block or linger — a lone low-load pod ships immediately.
+            if self._pending and self.queue.active_len() < self._batch_size:
+                self._busy = True
+                try:
+                    self._resolve_pending()
+                finally:
+                    self._busy = False
             inflight = bool(self._pending)
             # on_first marks the loop busy UNDER the queue lock before the
             # first pod leaves the queue, so wait_for_idle can never

@@ -26,7 +26,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np  # noqa: E402
 
 from kubernetes_tpu.api import objects as v1  # noqa: E402
 from kubernetes_tpu.client.apiserver import APIServer, NotFound  # noqa: E402
@@ -113,7 +112,14 @@ def main() -> int:
         t.start()
 
     def audit_once():
-        """Quiesce the pipeline, then compare device vs masters."""
+        """Quiesce the pipeline, then compare device vs masters. A pipeline
+        that never quiesces is NOT auditable: an in-flight batch holds
+        device commits the host hasn't replayed yet (a designed transient),
+        so auditing anyway would report a false MISMATCH."""
+        from kubernetes_tpu.scheduler.cache.debugger import (
+            audit_device_vs_masters,
+        )
+
         deadline = time.time() + 30
         while time.time() < deadline:
             if not sched._pending and not sched._busy:
@@ -121,44 +127,26 @@ def main() -> int:
                 if not sched._pending and not sched._busy:
                     break
             time.sleep(0.05)
+        else:
+            print("audit skipped: pipeline never quiesced", flush=True)
+            return False
         with sched.cache.lock:
             enc = sched.cache.encoder
             dev = jax.device_get(enc.flush())
-            masters = enc._masters()
-            bad = {}
-            for f in ("requested", "nonzero_req", "sel_counts", "port_counts"):
-                d = np.asarray(getattr(dev, f))
-                m = np.asarray(getattr(masters, f))
-                if not np.array_equal(d, m):
-                    rows = sorted(set(np.nonzero(d != m)[0].tolist()))
-                    bad[f] = rows
+            bad = audit_device_vs_masters(
+                enc,
+                dev,
+                enc._masters(),
+                fields=("requested", "nonzero_req", "sel_counts", "port_counts"),
+            )
             if bad:
                 print(f"MISMATCH at t={time.time()-t0:.0f}s: {bad}", flush=True)
-                for f, rows in bad.items():
-                    d = np.asarray(getattr(dev, f))
-                    m = np.asarray(getattr(masters, f))
-                    for r in rows[:4]:
-                        cols = np.nonzero(d[r] != m[r])[0] if d[r].ndim else []
-                        print(
-                            f"  {f} row={r} node={enc.row_names[r]} "
-                            f"cols={cols[:8].tolist() if len(cols) else '?'} "
-                            f"dev={d[r][cols[:8]].tolist() if len(cols) else d[r]} "
-                            f"mst={m[r][cols[:8]].tolist() if len(cols) else m[r]}",
-                            flush=True,
-                        )
-                        pods = enc._pods.get(r, {})
-                        print(
-                            f"    host pods on row ({len(pods)}): "
-                            f"{sorted(pods.keys())[:6]}",
-                            flush=True,
-                        )
-                with sched.cache.lock:
-                    print(
-                        f"  assumed={sorted(sched.cache._assumed.keys())[:8]} "
-                        f"dirty={sorted(enc._dirty_rows)} "
-                        f"pending={len(sched._pending)}",
-                        flush=True,
-                    )
+                print(
+                    f"  assumed={sorted(sched.cache._assumed.keys())[:8]} "
+                    f"dirty={sorted(enc._dirty_rows)} "
+                    f"pending={len(sched._pending)}",
+                    flush=True,
+                )
                 return True
             return False
 

@@ -26,7 +26,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np  # noqa: E402
 
 from kubernetes_tpu.api import objects as v1  # noqa: E402
 from kubernetes_tpu.client.apiserver import APIServer, NotFound  # noqa: E402
@@ -270,17 +269,16 @@ def main() -> int:
                 if not sched._pending and not sched._busy:
                     break
             time.sleep(0.05)
+        from kubernetes_tpu.scheduler.cache.debugger import (
+            audit_device_vs_masters,
+        )
+
         with sched.cache.lock:
             enc = sched.cache.encoder
             dev = jax.device_get(enc.flush())
-            masters = enc._masters()
-        return [
-            f
-            for f in ("requested", "sel_counts", "port_counts")
-            if not np.array_equal(
-                np.asarray(getattr(dev, f)), np.asarray(getattr(masters, f))
-            )
-        ]
+            # diagnostics printed while the lock still pins the state: a
+            # surviving mismatch must be actionable (rows, cols, values)
+            return audit_device_vs_masters(enc, dev, enc._masters())
 
     mismatch = []
     for _ in range(3):
@@ -311,18 +309,21 @@ def main() -> int:
             stage_max[st] = round(max(h._samples), 3)
     # absence of finish samples is itself a FAIL: a renamed stage label
     # would otherwise vacuously disable this gate
-    has_sub = any(k.startswith("finish.") for k in stage_max)
     sub_max = max(
         (v for k, v in stage_max.items() if k.startswith("finish.")),
         default=0.0,
     )
-    # a >5s finish wall with NO sub-stage samples means either a renamed
-    # sub-stage label or a runaway path outside every work timer — both
-    # must FAIL, not slip through on an empty generator
+    # Gate BOTH the work sub-stages and the enclosing wall. The wall's
+    # allowance is 5 s plus what the run can legitimately attribute: the
+    # slowest recorded sub-stage and the measured worst-case thread
+    # starvation (the sentinel). A runaway path outside every sub-stage
+    # timer (the r4 failure class: 300-600 s batches, sub-stages near
+    # zero) blows the allowance and FAILs; an 18 s wall on a saturated
+    # box with 18 s of measured starvation passes, attributably.
     batch_ok = (
         "finish" in stage_max
         and sub_max <= 5.0
-        and (has_sub or stage_max["finish"] <= 5.0)
+        and stage_max["finish"] <= 5.0 + sub_max + starve["max_s"]
     )
     sentinel_stop.set()
     if stage_max.get("finish", 0.0) > 1.0:
