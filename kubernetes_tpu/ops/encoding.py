@@ -448,11 +448,19 @@ class SnapshotEncoder:
         EncodingConfig.for_cluster). Cheap before the first flush; later it
         costs the same single re-upload a demand-grow would."""
         want = EncodingConfig.for_cluster(num_nodes)
+        grown = {}
         for cap in (
             "n_cap", "v_cap", "k_cap", "s_cap", "t_cap", "pv_cap",
             "im_cap", "av_cap",
         ):
-            self._ensure_cap(cap, getattr(want, cap))
+            cur, target = getattr(self.cfg, cap), getattr(want, cap)
+            if target > cur:
+                new = cur
+                while new < target:
+                    new *= 2
+                grown[cap] = new
+        if grown:
+            self._grow(**grown)  # ONE reallocate-and-copy pass for all caps
 
     def _ensure_cap(self, attr: str, needed: int) -> None:
         cur = getattr(self.cfg, attr)

@@ -411,6 +411,11 @@ class Scheduler:
                 self._busy = True
                 try:
                     self._resolve_pending()
+                except Exception:
+                    # _resolve_oldest's contract is "never raises", but a
+                    # failure here must degrade to a logged skip, not kill
+                    # the scheduling thread for the life of the process
+                    logger.exception("early batch resolve failed")
                 finally:
                     self._busy = False
             inflight = bool(self._pending)

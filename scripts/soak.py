@@ -269,6 +269,14 @@ def main() -> int:
                 if not sched._pending and not sched._busy:
                     break
             time.sleep(0.05)
+        else:
+            # auditing mid-flight would report the designed transient
+            # (device commits not yet replayed) as a false MISMATCH — but
+            # with churn stopped and the probe done, a pipeline that can't
+            # go idle for 60 s is itself a requeue hot-loop: report it as
+            # its own failure, don't audit and don't pass silently
+            print("audit: pipeline never quiesced", flush=True)
+            return None
         from kubernetes_tpu.scheduler.cache.debugger import (
             audit_device_vs_masters,
         )
@@ -283,9 +291,11 @@ def main() -> int:
     mismatch = []
     for _ in range(3):
         mismatch = audit_once()
-        if not mismatch:
+        if not mismatch and mismatch is not None:
             break
         time.sleep(2)
+    if mismatch is None:
+        mismatch = ["audit-never-quiesced"]
 
     # host-side batch wall time: the r4 storm hid 300-600 s batches outside
     # every stage timer; 'finish' plus its sub-stages (resolve / snapshot /
