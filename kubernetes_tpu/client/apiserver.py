@@ -490,6 +490,84 @@ class APIServer:
                 self._notify("pods", ev)
         return errors
 
+    def write_events_bulk(self, events_in) -> None:
+        """Event-recorder sink: upsert a drained batch of Event objects in
+        ONE lock acquisition with ownership transfer — the recorder hands
+        over freshly built objects and never touches them again, so the
+        create path's three defensive deepcopies (~0.45 ms of GIL per
+        event — per BOUND POD during a burst) are skipped. Watch delivery
+        still isolates with a cheap shell copy; readers get deepcopies
+        from get/list as usual. Existing (object, reason) rows aggregate
+        count in place, matching the recorder's correlation semantics."""
+        import dataclasses as _dc
+
+        import dataclasses as _dc0
+
+        self._check_writable()
+        # admit/validate with the verb the apply below will actually use
+        # (aggregating onto an existing row is an update, not a create) so
+        # verb-sensitive hooks see the same stream as the per-event path.
+        # Existence is snapshotted briefly under the lock; a concurrent
+        # recorder racing the same key can at worst mis-verb one
+        # best-effort event write.
+        with self._lock:
+            ev_store = self._objects.get("events", {})
+            olds = {}
+            for ev in events_in:
+                self._normalize_scope("events", ev)
+                cur = ev_store.get(self._key(ev))
+                if cur is not None:
+                    olds[id(ev)] = _dc0.replace(
+                        cur, metadata=_dc0.replace(cur.metadata)
+                    )
+        for ev in events_in:
+            old = olds.get(id(ev))
+            verb = "create" if old is None else "update"
+            self._admit(verb, "events", ev)
+            validation.validate_object(verb, "events", ev, old=old)
+        with self._lock:
+            store = self._objects.setdefault("events", {})
+            records = []
+            notifies = []
+            for ev in events_in:
+                key = self._key(ev)
+                cur = store.get(key)
+                if cur is not None:
+                    cur.count += ev.count
+                    cur.last_timestamp = ev.last_timestamp
+                    cur.note = ev.note
+                    self._bump(cur)
+                    records.append(
+                        (cur.metadata.resource_version, "update", "events", cur)
+                    )
+                    notifies.append(
+                        Event(
+                            MODIFIED,
+                            _dc.replace(
+                                cur, metadata=_dc.replace(cur.metadata)
+                            ),
+                            cur.metadata.resource_version,
+                        )
+                    )
+                else:
+                    self._bump(ev)
+                    store[key] = ev
+                    records.append(
+                        (ev.metadata.resource_version, "create", "events", ev)
+                    )
+                    notifies.append(
+                        Event(
+                            ADDED,
+                            _dc.replace(
+                                ev, metadata=_dc.replace(ev.metadata)
+                            ),
+                            ev.metadata.resource_version,
+                        )
+                    )
+            self._log_batch(records)
+            for e in notifies:
+                self._notify("events", e)
+
     def evict_pod(self, namespace: str, name: str) -> None:
         """pods/{name}/eviction: a PDB-respecting delete (reference
         registry/core/pod/rest/eviction.go). Blocked evictions raise

@@ -113,6 +113,26 @@ class EventRecorder:
         if drain:
             self._drain()
 
+    def _write_batch(self, batch: Dict[tuple, ClusterEvent]) -> None:
+        """One drained batch → the store. Prefers the bulk ownership-
+        transfer sink (one lock, no defensive copies — the recorder never
+        touches handed-over objects again); REST-shaped servers without it
+        get the per-event upsert."""
+        bulk = getattr(self._server, "write_events_bulk", None)
+        if bulk is not None:
+            try:
+                bulk(list(batch.values()))
+            except Exception:
+                # NO per-event fallback here: the bulk apply mutates the
+                # store before its WAL append, so a late failure may have
+                # already committed the counts in memory — re-applying
+                # per-event would double them. Events are best-effort;
+                # drop the batch instead.
+                pass
+            return
+        for ev in batch.values():
+            self._write(ev)
+
     def _drain(self) -> None:
         """Write everything pending using the swap/_inflight protocol
         (shared with the flusher thread)."""
@@ -126,8 +146,7 @@ class EventRecorder:
                 self._pending = {}
                 self._inflight = True
             try:
-                for ev in batch.values():
-                    self._write(ev)
+                self._write_batch(batch)
             finally:
                 with self._cond:
                     self._inflight = False
@@ -148,8 +167,7 @@ class EventRecorder:
                 self._pending = {}
                 self._inflight = True
             try:
-                for ev in batch.values():
-                    self._write(ev)
+                self._write_batch(batch)
             finally:
                 with self._cond:
                     self._inflight = False

@@ -74,14 +74,26 @@ logger = logging.getLogger("kubernetes_tpu.scheduler")
 
 @contextmanager
 def _stage_timer(stage: str):
-    """Feed the bench's stage_breakdown_s (encode vs kernel time per batch)."""
+    """Feed the bench's stage_breakdown_s (encode vs kernel time per batch).
+
+    Records wall AND this-thread CPU time: on a saturated box a stage's
+    wall inflates with GIL/scheduler starvation from unrelated threads,
+    which is unattributable from wall alone (the r5 soak recorded a 30 s
+    'finish' wall whose actual work was ~0.7 s). The CPU series is the
+    work; the wall minus CPU is time spent descheduled or blocked."""
     t0 = time.monotonic()
+    c0 = time.thread_time()
     try:
         yield
     finally:
         metrics.observe(
             "scheduling_stage_duration_seconds",
             time.monotonic() - t0,
+            {"stage": stage},
+        )
+        metrics.observe(
+            "scheduling_stage_cpu_seconds",
+            time.thread_time() - c0,
             {"stage": stage},
         )
 
