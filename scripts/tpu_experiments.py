@@ -198,6 +198,24 @@ def pallas() -> None:
         _result_line(f"pallas-{flag}", r, {"use_pallas_fit": flag})
 
 
+def wavesweep() -> None:
+    """wave_m_cand x wave_n_waves on the 5k suite. The hard-pair path
+    (required pod-affinity carries eterms) runs the FULL wave count, and
+    per-wave cost scales with m_cand x P (PERFORMANCE.md r5 profiling) —
+    the ~450 ms device cycle at defaults (512, 32) is mostly waves.
+    Fewer waves / narrower candidates defer more pods (they requeue and
+    retry), so pods_per_s is the net metric; scheduled must stay full."""
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+
+    for m, w in ((256, 16), (128, 8), (512, 8), (256, 32)):
+        sc = KubeSchedulerConfiguration(wave_m_cand=m, wave_n_waves=w)
+        _warm(sched_config=sc)
+        r = _run("SchedulingPodAffinity/5000", sched_config=sc)
+        _result_line(
+            f"wavesweep-m{m}-w{w}", r, {"wave_m_cand": m, "wave_n_waves": w}
+        )
+
+
 STEPS = {
     "probe": probe,
     "traces": traces,
@@ -207,6 +225,7 @@ STEPS = {
     "pallas": pallas,
     "density": density,
     "tuned": tuned,
+    "wavesweep": wavesweep,
 }
 
 
