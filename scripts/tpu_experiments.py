@@ -244,8 +244,8 @@ def main(argv=None) -> int:
             print(json.dumps({"error": "tpu unreachable; aborting"}))
             return 1
         for step in (
-            "traces", "batchsize", "pipeline", "gang", "pallas", "tuned",
-            "density",
+            "traces", "batchsize", "pipeline", "gang", "pallas",
+            "wavesweep", "tuned", "density",
         ):
             t0 = time.time()
             try:

@@ -39,7 +39,7 @@ while [ "$ATTEMPTS" -lt 12 ]; do
     # if the measurement arms already landed this round, run only the
     # missing ones; a fresh/empty jsonl gets the full sequence
     if grep -q '"step": "pallas' experiments/tpu_experiments.jsonl 2>/dev/null; then
-      ARMS="wavesweep tuned"
+      ARMS="wavesweep tuned density"
     else
       ARMS="all"
     fi
