@@ -44,6 +44,10 @@ class OwnerReference:
     name: str = ""
     uid: str = ""
     controller: bool = False
+    # finalizer gate: the GC may not delete the owner until this dependent
+    # is gone (reference metav1.OwnerReference.BlockOwnerDeletion; enforced
+    # at admission by OwnerReferencesPermissionEnforcement)
+    block_owner_deletion: bool = False
 
 
 @dataclass
@@ -478,7 +482,10 @@ def _copy_meta(m: ObjectMeta) -> ObjectMeta:
         creation_timestamp=m.creation_timestamp,
         deletion_timestamp=m.deletion_timestamp,
         owner_references=[
-            OwnerReference(r.api_version, r.kind, r.name, r.uid, r.controller)
+            OwnerReference(
+                r.api_version, r.kind, r.name, r.uid, r.controller,
+                r.block_owner_deletion,
+            )
             for r in m.owner_references
         ],
         finalizers=list(m.finalizers),
@@ -1663,6 +1670,27 @@ class Ingress:
     kind: str = "Ingress"
 
     def deep_copy(self) -> "Ingress":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class IngressClassSpec:
+    controller: str = ""  # e.g. "example.com/ingress-controller"
+
+
+@dataclass
+class IngressClass:
+    """networking.k8s.io IngressClass (reference v1beta1, 1.18): names an
+    ingress controller implementation; the cluster default is marked with
+    the ingressclass.kubernetes.io/is-default-class annotation and
+    stamped onto classless Ingresses by the DefaultIngressClass admission
+    plugin."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: IngressClassSpec = field(default_factory=IngressClassSpec)
+    kind: str = "IngressClass"
+
+    def deep_copy(self) -> "IngressClass":
         return copy.deepcopy(self)
 
 

@@ -56,6 +56,7 @@ RESOURCE_KINDS: Dict[str, Type] = {
     "mutatingwebhookconfigurations": v1.MutatingWebhookConfiguration,
     "validatingwebhookconfigurations": v1.ValidatingWebhookConfiguration,
     "ingresses": v1.Ingress,
+    "ingressclasses": v1.IngressClass,
     "networkpolicies": v1.NetworkPolicy,
     "podsecuritypolicies": v1.PodSecurityPolicy,
     "runtimeclasses": v1.RuntimeClass,
@@ -83,6 +84,7 @@ CLUSTER_SCOPED = frozenset(
         "certificatesigningrequests",
         "runtimeclasses",
         "podsecuritypolicies",
+        "ingressclasses",
     }
 )
 

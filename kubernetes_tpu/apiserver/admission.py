@@ -474,3 +474,331 @@ def pod_matches_scopes(pod, scopes) -> bool:
         if scope == "NotTerminating" and terminating:
             return False
     return True
+
+
+class NamespaceAutoProvisionAdmission(AdmissionPlugin):
+    """Create the namespace on first use (plugin/pkg/admission/namespace/
+    autoprovision/admission.go): a namespaced create whose namespace does
+    not exist provisions it instead of failing. Default-off in the
+    reference's recommended set, like here."""
+
+    name = "NamespaceAutoProvision"
+
+    def __init__(self, server):
+        self._server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource in ("namespaces", "events"):
+            return
+        from ..api.serialization import CLUSTER_SCOPED
+
+        if resource in CLUSTER_SCOPED:
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns:
+            return
+        from ..client.apiserver import AlreadyExists, NotFound
+
+        try:
+            self._server.get("namespaces", "", ns)
+        except NotFound:
+            try:
+                self._server.create(
+                    "namespaces",
+                    v1.Namespace(metadata=v1.ObjectMeta(name=ns, namespace="")),
+                )
+            except AlreadyExists:
+                pass
+
+
+class NamespaceExistsAdmission(AdmissionPlugin):
+    """Reject namespaced creates into a namespace that does not exist
+    (plugin/pkg/admission/namespace/exists/admission.go)."""
+
+    name = "NamespaceExists"
+
+    def __init__(self, server):
+        self._server = server
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource in ("namespaces", "events"):
+            return
+        from ..api.serialization import CLUSTER_SCOPED
+
+        if resource in CLUSTER_SCOPED:
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns:
+            return
+        from ..client.apiserver import NotFound
+
+        try:
+            self._server.get("namespaces", "", ns)
+        except NotFound:
+            raise AdmissionDenied(f"namespace {ns!r} does not exist")
+
+
+class SecurityContextDenyAdmission(AdmissionPlugin):
+    """Deny pods that customize the security-sensitive SecurityContext
+    fields (plugin/pkg/admission/securitycontext/scdeny/admission.go —
+    the pre-PSP hard gate). Default-off, for clusters without PSP."""
+
+    name = "SecurityContextDeny"
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if resource != "pods" or verb not in ("create", "update"):
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            sc = c.security_context
+            if sc is None:
+                continue
+            if sc.privileged or sc.run_as_user is not None:
+                raise AdmissionDenied(
+                    "SecurityContextDeny: securityContext.privileged and "
+                    "runAsUser are forbidden"
+                )
+
+
+class LimitPodHardAntiAffinityTopologyAdmission(AdmissionPlugin):
+    """Deny required pod anti-affinity with a topology key other than
+    kubernetes.io/hostname (plugin/pkg/admission/antiaffinity): a
+    zone-wide REQUIRED anti-affinity term lets one tenant fence whole
+    failure domains from everyone else."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if resource != "pods" or verb not in ("create", "update"):
+            return
+        aff = obj.spec.affinity
+        if aff is None or aff.pod_anti_affinity is None:
+            return
+        for term in aff.pod_anti_affinity.required:
+            if term.topology_key != self.HOSTNAME:
+                raise AdmissionDenied(
+                    "affinity.podAntiAffinity.requiredDuringScheduling... "
+                    f"topologyKey must be {self.HOSTNAME} "
+                    f"(got {term.topology_key!r})"
+                )
+
+
+class EventRateLimitAdmission(AdmissionPlugin):
+    """Server-scope token bucket over event writes (plugin/pkg/admission/
+    eventratelimit): an event storm (crash-looping workload, hot failure
+    path) must not starve the API server for every other client. Over
+    budget => deny (the recorder treats event writes as best-effort and
+    drops). Default-off, like the reference."""
+
+    name = "EventRateLimit"
+
+    def __init__(self, qps: float = 50.0, burst: int = 100):
+        import threading
+        import time as _time
+
+        self._qps = float(qps)
+        self._burst = float(burst)
+        self._tokens = float(burst)
+        self._t_last = _time.monotonic()
+        self._mu = threading.Lock()
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if resource != "events" or verb not in ("create", "update"):
+            return
+        import time as _time
+
+        with self._mu:
+            now = _time.monotonic()
+            self._tokens = min(
+                self._burst, self._tokens + (now - self._t_last) * self._qps
+            )
+            self._t_last = now
+            if self._tokens < 1.0:
+                raise AdmissionDenied(
+                    "EventRateLimit: server event budget exhausted"
+                )
+            self._tokens -= 1.0
+
+
+class OwnerReferencesPermissionEnforcementAdmission(AdmissionPlugin):
+    """Setting ownerReferences[].blockOwnerDeletion makes the GC hold the
+    OWNER's deletion until this dependent is gone — so it requires
+    delete (finalizer-grade) permission on that owner (plugin/pkg/
+    admission/gc/gc_admission.go). Gates the DELTA like the reference:
+    only refs NEWLY gaining the bit are checked, so an unrelated update
+    (label patch) by a user without owner-delete permission still lands
+    on an already-protected object. In-process callers (no request user)
+    are unrestricted, like loopback cluster-admin."""
+
+    name = "OwnerReferencesPermissionEnforcement"
+
+    def __init__(self, authorizer, server=None):
+        self._authz = authorizer
+        self._server = server
+
+    def _blocking(self, obj) -> dict:
+        return {
+            (r.kind, r.name): r
+            for r in (getattr(obj.metadata, "owner_references", None) or [])
+            if getattr(r, "block_owner_deletion", False)
+        }
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if verb not in ("create", "update"):
+            return
+        user = request_user.get()
+        if user is None:
+            return
+        new_blocking = self._blocking(obj)
+        if not new_blocking:
+            return
+        if verb == "update" and self._server is not None:
+            from ..client.apiserver import NotFound
+
+            try:
+                old = self._server.get(
+                    resource, obj.metadata.namespace, obj.metadata.name
+                )
+                for key in self._blocking(old):
+                    new_blocking.pop(key, None)  # already protected
+            except NotFound:
+                pass
+        from ..api.serialization import KIND_TO_RESOURCE
+
+        for (kind, name), ref in new_blocking.items():
+            owner_res = KIND_TO_RESOURCE.get(kind, kind.lower() + "s")
+            if not self._authz.authorize(
+                user, "delete", owner_res, obj.metadata.namespace, name
+            ):
+                raise AdmissionDenied(
+                    f"cannot set blockOwnerDeletion on {kind} "
+                    f"{name!r}: user {user.name!r} may not delete it"
+                )
+
+
+class DefaultIngressClassAdmission(AdmissionPlugin):
+    """Stamp the cluster-default IngressClass onto classless Ingresses at
+    create (plugin/pkg/admission/defaultingressclass — the 1.18
+    networking analogue of DefaultStorageClass). Multiple defaults =>
+    deny, like the reference."""
+
+    name = "DefaultIngressClass"
+    DEFAULT_ANN = "ingressclass.kubernetes.io/is-default-class"
+
+    def __init__(self, server):
+        self._server = server
+
+    def mutate(self, verb: str, resource: str, obj) -> None:
+        if verb != "create" or resource != "ingresses":
+            return
+        if obj.spec.ingress_class_name is not None:
+            return
+        defaults = [
+            ic
+            for ic in self._server.list("ingressclasses")[0]
+            if ic.metadata.annotations.get(self.DEFAULT_ANN) == "true"
+        ]
+        if not defaults:
+            return
+        if len(defaults) > 1:
+            raise AdmissionDenied(
+                "multiple default IngressClasses marked: "
+                + ", ".join(sorted(ic.metadata.name for ic in defaults))
+            )
+        obj.spec.ingress_class_name = defaults[0].metadata.name
+
+
+class CertificateApprovalAdmission(AdmissionPlugin):
+    """Approving a CSR requires 'approve' permission on the signer
+    (plugin/pkg/admission/certificates/approval): RBAC gates WHO may
+    bless requests for WHICH signerName. Gates the DELTA like the
+    reference (only updates that CHANGE the approval conditions) — a
+    signer identity writing status.certificate on an already-approved
+    CSR must not need 'approve' — and ALSO gates create: a CSR born
+    with an Approved condition would otherwise bypass the gate entirely
+    and mint a live credential via the CSR token index."""
+
+    name = "CertificateApproval"
+
+    def __init__(self, authorizer, server=None):
+        self._authz = authorizer
+        self._server = server
+
+    @staticmethod
+    def _approval_state(obj) -> tuple:
+        return tuple(
+            sorted(
+                (c.type, c.status)
+                for c in obj.status.conditions
+                if c.type in ("Approved", "Denied")
+            )
+        )
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if resource != "certificatesigningrequests" or verb not in (
+            "create", "update",
+        ):
+            return
+        user = request_user.get()
+        if user is None:
+            return
+        new_state = self._approval_state(obj)
+        if not new_state:
+            return
+        if verb == "update" and self._server is not None:
+            from ..client.apiserver import NotFound
+
+            try:
+                old = self._server.get(resource, "", obj.metadata.name)
+                if self._approval_state(old) == new_state:
+                    return  # approval unchanged: not an approval write
+            except NotFound:
+                pass
+        if not self._authz.authorize(
+            user, "approve", "signers", "", obj.spec.signer_name
+        ):
+            raise AdmissionDenied(
+                f"user {user.name!r} may not approve requests for signer "
+                f"{obj.spec.signer_name!r}"
+            )
+
+
+class CertificateSigningAdmission(AdmissionPlugin):
+    """Issuing the certificate (writing status.certificate) requires
+    'sign' permission on the signer (plugin/pkg/admission/certificates/
+    signing). Delta-gated like approval, and create-gated for the same
+    reason: a CSR created WITH a certificate would otherwise inject a
+    live credential without anyone holding 'sign'."""
+
+    name = "CertificateSigning"
+
+    def __init__(self, authorizer, server=None):
+        self._authz = authorizer
+        self._server = server
+
+    def validate(self, verb: str, resource: str, obj) -> None:
+        if resource != "certificatesigningrequests" or verb not in (
+            "create", "update",
+        ):
+            return
+        user = request_user.get()
+        if user is None:
+            return
+        if not obj.status.certificate:
+            return
+        if verb == "update" and self._server is not None:
+            from ..client.apiserver import NotFound
+
+            try:
+                old = self._server.get(resource, "", obj.metadata.name)
+                if old.status.certificate == obj.status.certificate:
+                    return  # certificate unchanged: not a signing write
+            except NotFound:
+                pass
+        if not self._authz.authorize(
+            user, "sign", "signers", "", obj.spec.signer_name
+        ):
+            raise AdmissionDenied(
+                f"user {user.name!r} may not sign requests for signer "
+                f"{obj.spec.signer_name!r}"
+            )

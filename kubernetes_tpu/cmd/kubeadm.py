@@ -71,7 +71,10 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
     unauthenticated, admission-free API server). Returns (authn, authz)
     and installs the admit-hook chain on the store."""
     from ..apiserver.admission import (
+        CertificateApprovalAdmission,
+        CertificateSigningAdmission,
         CertificateSubjectRestrictionAdmission,
+        DefaultIngressClassAdmission,
         ExtendedResourceTolerationAdmission,
         NodeRestrictionAdmission,
         PodNodeSelectorAdmission,
@@ -164,6 +167,7 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
                 DefaultStorageClassAdmission(store),
                 StorageObjectInUseProtectionAdmission(),
                 RuntimeClassAdmission(store),
+                DefaultIngressClassAdmission(store),
                 MutatingWebhookAdmission(store),
             ],
             validating=[
@@ -172,6 +176,8 @@ def assemble_security(store, admin_token=None, bootstrap_token=None):
                 NodeRestrictionAdmission(),
                 PodSecurityPolicyAdmission(store),
                 PVCResizeAdmission(store),
+                CertificateApprovalAdmission(authz, store),
+                CertificateSigningAdmission(authz, store),
                 CertificateSubjectRestrictionAdmission(),
                 ValidatingWebhookAdmission(store),
                 QuotaAdmission(store),

@@ -561,7 +561,7 @@ def test_kubeadm_chain_matches_reference_recommended_order(tmp_path):
         # DefaultTolerationSeconds(82) < PodTolerationRestriction(83) <
         # ExtendedResourceToleration(87) < DefaultStorageClass(89) <
         # StorageObjectInUseProtection(90) < RuntimeClass(93) <
-        # MutatingAdmissionWebhook(102)
+        # DefaultIngressClass(101) < MutatingAdmissionWebhook(102)
         expected_mut_order = [
             "LimitRanger",
             "ServiceAccount",
@@ -574,11 +574,13 @@ def test_kubeadm_chain_matches_reference_recommended_order(tmp_path):
             "DefaultStorageClass",
             "StorageObjectInUseProtection",
             "RuntimeClass",
+            "DefaultIngressClass",
             "MutatingAdmissionWebhook",
         ]
         assert mut == expected_mut_order, mut
         # NamespaceLifecycle(68) < LimitRanger(73) < NodeRestriction(75) <
         # PodSecurityPolicy(79) < PersistentVolumeClaimResize(92) <
+        # CertificateApproval(94) < CertificateSigning(95) <
         # CertificateSubjectRestriction(96) <
         # ValidatingAdmissionWebhook(103) < ResourceQuota(104)
         expected_val_order = [
@@ -587,12 +589,14 @@ def test_kubeadm_chain_matches_reference_recommended_order(tmp_path):
             "NodeRestriction",
             "PodSecurityPolicy",
             "PersistentVolumeClaimResize",
+            "CertificateApproval",
+            "CertificateSigning",
             "CertificateSubjectRestriction",
             "ValidatingAdmissionWebhook",
             "ResourceQuota",
         ]
         assert val == expected_val_order, val
-        # 20 named plugins chained (LimitRanger appears in both phases)
-        assert len(set(mut) | set(val)) >= 18
+        # 23 named plugins chained (LimitRanger appears in both phases)
+        assert len(set(mut) | set(val)) >= 21
     finally:
         cluster.stop()

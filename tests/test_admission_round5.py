@@ -1,0 +1,301 @@
+"""Round-5 admission breadth: the remaining reference in-tree plugins
+(plugin/pkg/admission/): namespace autoprovision/exists, SecurityContextDeny,
+LimitPodHardAntiAffinityTopology, EventRateLimit, gc (blockOwnerDeletion),
+DefaultIngressClass, certificate approval/signing signer gates."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.apiserver.admission import (
+    CertificateApprovalAdmission,
+    CertificateSigningAdmission,
+    DefaultIngressClassAdmission,
+    EventRateLimitAdmission,
+    LimitPodHardAntiAffinityTopologyAdmission,
+    NamespaceAutoProvisionAdmission,
+    NamespaceExistsAdmission,
+    OwnerReferencesPermissionEnforcementAdmission,
+    SecurityContextDenyAdmission,
+    request_user,
+)
+from kubernetes_tpu.apiserver.auth import (
+    AdmissionDenied,
+    RBACAuthorizer,
+    UserInfo,
+    make_rule,
+)
+from kubernetes_tpu.client.apiserver import APIServer, NotFound
+
+
+class _Ctx:
+    def __init__(self, user):
+        self.user = user
+
+    def __enter__(self):
+        self.tok = request_user.set(self.user)
+
+    def __exit__(self, *a):
+        request_user.reset(self.tok)
+
+
+def _pod(name="p", **spec_kw):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": "100m"})], **spec_kw
+        ),
+    )
+
+
+def test_namespace_autoprovision_creates_missing_namespace():
+    server = APIServer()
+    plugin = NamespaceAutoProvisionAdmission(server)
+    pod = _pod()
+    pod.metadata.namespace = "fresh-ns"
+    with pytest.raises(NotFound):
+        server.get("namespaces", "", "fresh-ns")
+    plugin.mutate("create", "pods", pod)
+    assert server.get("namespaces", "", "fresh-ns").metadata.name == "fresh-ns"
+    # idempotent
+    plugin.mutate("create", "pods", pod)
+
+
+def test_namespace_exists_denies_missing_allows_present():
+    server = APIServer()
+    plugin = NamespaceExistsAdmission(server)
+    pod = _pod()
+    pod.metadata.namespace = "nope"
+    with pytest.raises(AdmissionDenied, match="does not exist"):
+        plugin.validate("create", "pods", pod)
+    server.create(
+        "namespaces", v1.Namespace(metadata=v1.ObjectMeta(name="nope", namespace=""))
+    )
+    plugin.validate("create", "pods", pod)  # no raise
+    # cluster-scoped kinds exempt
+    plugin.validate(
+        "create", "nodes", v1.Node(metadata=v1.ObjectMeta(name="n", namespace=""))
+    )
+
+
+def test_security_context_deny():
+    plugin = SecurityContextDenyAdmission()
+    ok = _pod()
+    plugin.validate("create", "pods", ok)
+    bad = _pod()
+    bad.spec.containers[0].security_context = v1.SecurityContext(privileged=True)
+    with pytest.raises(AdmissionDenied, match="SecurityContextDeny"):
+        plugin.validate("create", "pods", bad)
+    bad2 = _pod()
+    bad2.spec.containers[0].security_context = v1.SecurityContext(run_as_user=0)
+    with pytest.raises(AdmissionDenied):
+        plugin.validate("create", "pods", bad2)
+
+
+def test_limit_hard_anti_affinity_topology():
+    plugin = LimitPodHardAntiAffinityTopologyAdmission()
+    sel = LabelSelector.make(match_labels={"app": "a"})
+    host = _pod(
+        affinity=v1.Affinity(
+            pod_anti_affinity=v1.PodAntiAffinity(
+                required=(
+                    v1.PodAffinityTerm(
+                        label_selector=sel, topology_key="kubernetes.io/hostname"
+                    ),
+                )
+            )
+        )
+    )
+    plugin.validate("create", "pods", host)  # hostname: allowed
+    zone = _pod(
+        affinity=v1.Affinity(
+            pod_anti_affinity=v1.PodAntiAffinity(
+                required=(
+                    v1.PodAffinityTerm(label_selector=sel, topology_key="zone"),
+                )
+            )
+        )
+    )
+    with pytest.raises(AdmissionDenied, match="topologyKey"):
+        plugin.validate("create", "pods", zone)
+
+
+def test_event_rate_limit_sheds_over_burst():
+    plugin = EventRateLimitAdmission(qps=0.0, burst=3)
+    ev = object.__new__(object)  # the plugin never touches the object
+    for _ in range(3):
+        plugin.validate("create", "events", ev)
+    with pytest.raises(AdmissionDenied, match="budget exhausted"):
+        plugin.validate("create", "events", ev)
+    # non-event kinds unaffected
+    plugin.validate("create", "pods", ev)
+
+
+def test_block_owner_deletion_requires_delete_on_owner():
+    server = APIServer()
+    authz = RBACAuthorizer()
+    authz.bind("dev", make_rule(["create"], ["pods"]))
+    plugin = OwnerReferencesPermissionEnforcementAdmission(authz, server)
+    pod = _pod()
+    pod.metadata.owner_references = [
+        v1.OwnerReference(
+            kind="ReplicaSet", name="rs1", controller=True,
+            block_owner_deletion=True,
+        )
+    ]
+    # in-process caller (no identity): unrestricted
+    plugin.validate("create", "pods", pod)
+    with _Ctx(UserInfo("dev", ())):
+        with pytest.raises(AdmissionDenied, match="blockOwnerDeletion"):
+            plugin.validate("create", "pods", pod)
+    authz.bind("ops", make_rule(["delete"], ["replicasets"]))
+    with _Ctx(UserInfo("ops", ())):
+        plugin.validate("create", "pods", pod)
+    # without the gate bit there is nothing to enforce
+    pod.metadata.owner_references[0].block_owner_deletion = False
+    with _Ctx(UserInfo("dev", ())):
+        plugin.validate("create", "pods", pod)
+
+
+def test_block_owner_deletion_delta_gated_on_update():
+    """An unrelated update of an ALREADY-protected object needs no owner
+    permission (gc_admission.go compares against oldObject); only newly
+    protected refs are gated."""
+    server = APIServer()
+    authz = RBACAuthorizer()
+    plugin = OwnerReferencesPermissionEnforcementAdmission(authz, server)
+    pod = _pod("owned")
+    pod.metadata.owner_references = [
+        v1.OwnerReference(
+            kind="ReplicaSet", name="rs1", controller=True,
+            block_owner_deletion=True,
+        )
+    ]
+    stored = server.create("pods", pod)
+    # label patch by a user who cannot delete replicasets: allowed
+    stored.metadata.labels["x"] = "y"
+    with _Ctx(UserInfo("labeler", ())):
+        plugin.validate("update", "pods", stored)
+        # but ADDING protection on another owner is gated
+        stored.metadata.owner_references.append(
+            v1.OwnerReference(
+                kind="Deployment", name="d1", block_owner_deletion=True
+            )
+        )
+        with pytest.raises(AdmissionDenied, match="Deployment"):
+            plugin.validate("update", "pods", stored)
+
+
+def test_default_ingress_class_stamped_and_ambiguity_denied():
+    server = APIServer()
+    plugin = DefaultIngressClassAdmission(server)
+    ing = v1.Ingress(metadata=v1.ObjectMeta(name="web"))
+    plugin.mutate("create", "ingresses", ing)
+    assert ing.spec.ingress_class_name is None  # no classes at all
+    server.create(
+        "ingressclasses",
+        v1.IngressClass(
+            metadata=v1.ObjectMeta(
+                name="nginx",
+                namespace="",
+                annotations={"ingressclass.kubernetes.io/is-default-class": "true"},
+            )
+        ),
+    )
+    plugin.mutate("create", "ingresses", ing)
+    assert ing.spec.ingress_class_name == "nginx"
+    # explicit class untouched
+    ing2 = v1.Ingress(
+        metadata=v1.ObjectMeta(name="api"),
+        spec=v1.IngressSpec(ingress_class_name="haproxy"),
+    )
+    plugin.mutate("create", "ingresses", ing2)
+    assert ing2.spec.ingress_class_name == "haproxy"
+    # two defaults: ambiguous
+    server.create(
+        "ingressclasses",
+        v1.IngressClass(
+            metadata=v1.ObjectMeta(
+                name="traefik",
+                namespace="",
+                annotations={"ingressclass.kubernetes.io/is-default-class": "true"},
+            )
+        ),
+    )
+    with pytest.raises(AdmissionDenied, match="multiple default"):
+        plugin.mutate(
+            "create", "ingresses", v1.Ingress(metadata=v1.ObjectMeta(name="x"))
+        )
+
+
+def _csr(signer="kubernetes.io/kube-apiserver-client-kubelet"):
+    return v1.CertificateSigningRequest(
+        metadata=v1.ObjectMeta(name="csr1", namespace=""),
+        spec=v1.CertificateSigningRequestSpec(signer_name=signer),
+    )
+
+
+def test_certificate_approval_requires_signer_permission():
+    server = APIServer()
+    authz = RBACAuthorizer()
+    plugin = CertificateApprovalAdmission(authz, server)
+    csr = _csr()
+    csr.status.conditions.append(v1.PodCondition(type="Approved", status="True"))
+    # in-process approver controller: unrestricted
+    plugin.validate("update", "certificatesigningrequests", csr)
+    with _Ctx(UserInfo("rando", ())):
+        with pytest.raises(AdmissionDenied, match="may not approve"):
+            plugin.validate("update", "certificatesigningrequests", csr)
+        # creating a CSR PRE-approved is gated the same way (a create
+        # would otherwise bypass the gate and mint a credential)
+        with pytest.raises(AdmissionDenied, match="may not approve"):
+            plugin.validate("create", "certificatesigningrequests", csr)
+    authz.bind(
+        "approver",
+        make_rule(
+            ["approve"], ["signers"],
+            names=["kubernetes.io/kube-apiserver-client-kubelet"],
+        ),
+    )
+    with _Ctx(UserInfo("approver", ())):
+        plugin.validate("update", "certificatesigningrequests", csr)
+    # updates that do NOT carry an approval are not gated
+    plain = _csr()
+    with _Ctx(UserInfo("rando", ())):
+        plugin.validate("update", "certificatesigningrequests", plain)
+
+
+def test_certificate_approval_delta_gated():
+    """A signer writing status.certificate on an ALREADY-approved CSR does
+    not need 'approve' (the approval state did not change) — reference
+    certificates/approval gates only condition changes."""
+    server = APIServer()
+    authz = RBACAuthorizer()
+    authz.bind("signer", make_rule(["sign"], ["signers"]))
+    approval = CertificateApprovalAdmission(authz, server)
+    signing = CertificateSigningAdmission(authz, server)
+    csr = _csr()
+    csr.status.conditions.append(v1.PodCondition(type="Approved", status="True"))
+    stored = server.create("certificatesigningrequests", csr)
+    stored.status.certificate = "issued"
+    with _Ctx(UserInfo("signer", ())):
+        approval.validate("update", "certificatesigningrequests", stored)
+        signing.validate("update", "certificatesigningrequests", stored)
+
+
+def test_certificate_signing_requires_signer_permission():
+    server = APIServer()
+    authz = RBACAuthorizer()
+    plugin = CertificateSigningAdmission(authz, server)
+    csr = _csr()
+    csr.status.certificate = "signed-bytes"
+    plugin.validate("update", "certificatesigningrequests", csr)  # loopback
+    with _Ctx(UserInfo("rando", ())):
+        with pytest.raises(AdmissionDenied, match="may not sign"):
+            plugin.validate("update", "certificatesigningrequests", csr)
+        # create with a pre-set certificate: same gate
+        with pytest.raises(AdmissionDenied, match="may not sign"):
+            plugin.validate("create", "certificatesigningrequests", csr)
+    authz.bind("signer", make_rule(["sign"], ["signers"]))
+    with _Ctx(UserInfo("signer", ())):
+        plugin.validate("update", "certificatesigningrequests", csr)
