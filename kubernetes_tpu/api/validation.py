@@ -39,6 +39,16 @@ def _bad(msg: str) -> None:
     raise ValidationError(msg)
 
 
+def _as_int(v, what: str) -> int:
+    """Boundary-safe int coercion: wire decodes can leave numeric fields
+    as strings, and a TypeError/ValueError here must surface as a 400,
+    not a 500 (the module's contract)."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        _bad(f"{what}: invalid integer {v!r}")
+
+
 def validate_name(name: str, what: str) -> None:
     if not name:
         _bad(f"{what}: name is required")
@@ -103,7 +113,14 @@ def validate_selector(sel: Optional[Any], what: str) -> None:
 
 
 def _validate_pod(pod, what: str) -> None:
+    if not pod.spec.containers:
+        _bad(f"{what}: spec.containers must not be empty")
+    seen = set()
     for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+        if c.name:
+            if c.name in seen:
+                _bad(f"{what}: duplicate container name {c.name!r}")
+            seen.add(c.name)
         validate_quantities(c.requests, f"{what}.resources.requests")
         validate_quantities(c.limits, f"{what}.resources.limits")
     if pod.spec.overhead:
@@ -130,6 +147,8 @@ def _validate_pod(pod, what: str) -> None:
         validate_selector(tsc.label_selector, f"{what}.topologySpread")
         if not tsc.topology_key:
             _bad(f"{what}.topologySpread: topologyKey is required")
+        if _as_int(tsc.max_skew, f"{what}.topologySpread.maxSkew") < 1:
+            _bad(f"{what}.topologySpread: maxSkew must be >= 1")
 
 
 def _validate_pod_update(new, old, what: str) -> None:
@@ -164,6 +183,95 @@ def _validate_workload(obj, what: str) -> None:
         validate_labels(sel, f"{what}.selector")
     else:
         validate_selector(sel, f"{what}.selector")
+    # validate the pod TEMPLATE at workload write time (the reference's
+    # ValidatePodTemplateSpec): an empty-containers template would pass
+    # here only for its controller to fail EVERY pod create forever
+    tmpl = getattr(obj.spec, "template", None)
+    tmpl_spec = getattr(tmpl, "spec", None) if tmpl is not None else None
+    if tmpl_spec is not None and hasattr(tmpl_spec, "containers"):
+        shell = type("_TmplPod", (), {"spec": tmpl_spec})
+        _validate_pod(shell, f"{what}.template")
+
+
+def _validate_workload_update(new, old, what: str) -> None:
+    """spec.selector is immutable on workload updates (validation.go
+    ValidateDeploymentUpdate / ValidateReplicaSetUpdate / apps
+    ValidateStatefulSetUpdate): retargeting a live controller's selector
+    silently orphans/adopts pods."""
+    old_sel = getattr(old.spec, "selector", None)
+    new_sel = getattr(new.spec, "selector", None)
+
+    def norm(s):
+        """Representation-independent canonical form: the same selector
+        may arrive as a LabelSelector object (in-process), a plain
+        matchLabels dict, or a wire-decoded {"matchLabels": ...} dict —
+        an unchanged selector in a different shape must NOT read as a
+        mutation. Order-insensitive throughout."""
+        if s is None:
+            return None
+        if isinstance(s, dict):
+            if "matchLabels" in s or "matchExpressions" in s or (
+                "match_labels" in s or "match_expressions" in s
+            ):
+                ml = s.get("matchLabels", s.get("match_labels")) or {}
+                me = s.get("matchExpressions", s.get("match_expressions")) or ()
+                pairs = ml.items() if hasattr(ml, "items") else ml
+                return (
+                    tuple(sorted((str(k), str(v)) for k, v in pairs)),
+                    tuple(
+                        sorted(
+                            (
+                                str(e.get("key", "")),
+                                str(e.get("operator", "")),
+                                tuple(sorted(map(str, e.get("values") or ()))),
+                            )
+                            for e in me
+                        )
+                    ),
+                )
+            return (
+                tuple(sorted((str(k), str(v)) for k, v in s.items())), ()
+            )
+        ml = getattr(s, "match_labels", None)
+        pairs = ml.items() if hasattr(ml, "items") else (ml or ())
+        me = getattr(s, "match_expressions", ()) or ()
+        return (
+            tuple(sorted((str(k), str(v)) for k, v in pairs)),
+            tuple(
+                sorted(
+                    (
+                        str(e.key),
+                        str(e.operator),
+                        tuple(sorted(map(str, e.values or ()))),
+                    )
+                    for e in me
+                )
+            ),
+        )
+
+    if old_sel is not None and norm(new_sel) != norm(old_sel):
+        _bad(f"{what}: spec.selector is immutable")
+
+
+def _validate_service(svc, what: str, old=None) -> None:
+    for p in getattr(svc.spec, "ports", ()) or ():
+        # ports are (protocol, port) tuples in this build's ServiceSpec
+        port = p[1] if isinstance(p, (tuple, list)) and len(p) > 1 else getattr(p, "port", None)
+        if port is not None and not (
+            0 < _as_int(port, f"{what}.port") <= 65535
+        ):
+            _bad(f"{what}: port {port} out of range 1-65535")
+    # clusterIP is allocate-once and may not be changed OR CLEARED
+    # (validation.go ValidateServiceUpdate: a manifest re-apply without
+    # the allocated IP must not wipe the VIP existing clients resolve)
+    if old is not None:
+        old_ip = getattr(old.spec, "cluster_ip", "")
+        new_ip = getattr(svc.spec, "cluster_ip", "")
+        if old_ip and new_ip != old_ip:
+            _bad(
+                f"{what}: spec.clusterIP is immutable "
+                f"({old_ip!r} -> {new_ip!r})"
+            )
 
 
 def validate_object(
@@ -189,8 +297,10 @@ def validate_object(
             _validate_pod_update(obj, old, what)
     elif resource == "nodes":
         _validate_node(obj, what)
+    elif resource == "services":
+        _validate_workload(obj, what)
+        _validate_service(obj, what, old=old if verb == "update" else None)
     elif resource in (
-        "services",
         "replicasets",
         "deployments",
         "daemonsets",
@@ -199,6 +309,8 @@ def validate_object(
         "poddisruptionbudgets",
     ):
         _validate_workload(obj, what)
+        if verb == "update" and old is not None and resource != "poddisruptionbudgets":
+            _validate_workload_update(obj, old, what)
     elif resource in ("persistentvolumeclaims",):
         validate_quantities(
             getattr(obj.spec, "resources", {}) or {}, what + ".resources"

@@ -32,7 +32,7 @@ staged_probe() {
 }
 
 ATTEMPTS=0
-while [ "$ATTEMPTS" -lt 12 ]; do
+while [ "$ATTEMPTS" -lt 60 ]; do
   if staged_probe; then
     ATTEMPTS=$((ATTEMPTS + 1))
     echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments (attempt $ATTEMPTS)" >> "$LOG"

@@ -395,7 +395,13 @@ def test_audit_log_records_requests(tmp_path):
             port,
             "/api/v1/namespaces/default/pods",
             method="POST",
-            body={"kind": "Pod", "metadata": {"name": "a1"}},
+            body={
+                "kind": "Pod",
+                "metadata": {"name": "a1"},
+                # boundary validation rejects container-less pods (the
+                # reference requires spec.containers non-empty)
+                "spec": {"containers": [{"name": "c"}]},
+            },
             token="tok",
         )
         _req(port, "/api/v1/namespaces/default/pods/missing")  # anonymous 404

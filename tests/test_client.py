@@ -5,7 +5,7 @@ import time
 
 import pytest
 
-from kubernetes_tpu.api.objects import Binding, Node, ObjectMeta, Pod, PodSpec
+from kubernetes_tpu.api.objects import Binding, Container, Node, ObjectMeta, Pod, PodSpec
 from kubernetes_tpu.client import (
     APIServer,
     Conflict,
@@ -19,7 +19,11 @@ from kubernetes_tpu.client import (
 
 
 def make_pod(name, ns="default", node=""):
-    return Pod(metadata=ObjectMeta(name=name, namespace=ns), spec=PodSpec(node_name=node))
+    # boundary validation requires >=1 container, like the reference
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(node_name=node, containers=[Container()]),
+    )
 
 
 def test_crud_and_resource_versions():

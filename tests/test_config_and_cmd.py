@@ -258,7 +258,14 @@ def test_metrics_api_and_kubectl_top_scale_rollout(capsys):
 
         dep = v1.Deployment(
             metadata=v1.ObjectMeta(name="web"),
-            spec=v1.DeploymentSpec(replicas=2, selector={"app": "web"}),
+            spec=v1.DeploymentSpec(
+                replicas=2,
+                selector={"app": "web"},
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "web"}),
+                    spec=v1.PodSpec(containers=[v1.Container()]),
+                ),
+            ),
         )
         store.create("deployments", dep)
         assert (
@@ -521,7 +528,14 @@ def test_kubectl_workload_tables_and_describe_node(capsys):
             "deployments",
             v1.Deployment(
                 metadata=v1.ObjectMeta(name="api"),
-                spec=v1.DeploymentSpec(replicas=3, selector={"app": "api"}),
+                spec=v1.DeploymentSpec(
+                    replicas=3,
+                    selector={"app": "api"},
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "api"}),
+                        spec=v1.PodSpec(containers=[v1.Container()]),
+                    ),
+                ),
                 status=v1.DeploymentStatus(ready_replicas=2, updated_replicas=3),
             ),
         )

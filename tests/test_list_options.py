@@ -18,7 +18,7 @@ from kubernetes_tpu.apiserver.rest import serve
 def _mkpod(name, node="", labels=None, phase=""):
     return v1.Pod(
         metadata=v1.ObjectMeta(name=name, labels=labels or {}),
-        spec=v1.PodSpec(node_name=node),
+        spec=v1.PodSpec(node_name=node, containers=[v1.Container()]),
         status=v1.PodStatus(phase=phase),
     )
 

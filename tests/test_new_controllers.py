@@ -356,7 +356,11 @@ def test_ttl_after_finished_deletes_job():
     server = APIServer()
     job = v1.Job(
         metadata=v1.ObjectMeta(name="done"),
-        spec=v1.JobSpec(completions=1, ttl_seconds_after_finished=1),
+        spec=v1.JobSpec(
+            completions=1,
+            ttl_seconds_after_finished=1,
+            template=_template({"app": "done"}),
+        ),
     )
     job.status.conditions.append(
         v1.PodCondition(type="Complete", status="True")

@@ -6,6 +6,7 @@ import pytest
 
 from kubernetes_tpu.api import objects as v1
 from kubernetes_tpu.api.selectors import Requirement
+from kubernetes_tpu.api import validation
 from kubernetes_tpu.api.validation import ValidationError
 from kubernetes_tpu.client.apiserver import APIServer
 
@@ -244,3 +245,99 @@ def test_rest_fuzz_malformed_objects_get_400_never_scheduler_exception():
     finally:
         sched.stop()
         httpd.shutdown()
+
+
+def test_workload_selector_immutable_on_update():
+    """validation.go ValidateDeploymentUpdate: retargeting a live
+    controller's selector is rejected (it would orphan/adopt pods)."""
+    server = APIServer()
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    rs = v1.ReplicaSet(
+        metadata=v1.ObjectMeta(name="rs1"),
+        spec=v1.ReplicaSetSpec(
+            selector=LabelSelector.make(match_labels={"app": "a"}),
+            template=v1.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": "a"}),
+                spec=v1.PodSpec(containers=[v1.Container()]),
+            ),
+        ),
+    )
+    stored = server.create("replicasets", rs)
+    stored.spec.selector = LabelSelector.make(match_labels={"app": "b"})
+    with pytest.raises(validation.ValidationError, match="selector is immutable"):
+        server.update("replicasets", stored, check_version=False)
+    # template/replica changes still fine
+    again = server.get("replicasets", "default", "rs1")
+    again.spec.replicas = 3
+    server.update("replicasets", again, check_version=False)
+
+
+def test_service_cluster_ip_immutable_and_port_range():
+    server = APIServer()
+    svc = v1.Service(
+        metadata=v1.ObjectMeta(name="svc"),
+        spec=v1.ServiceSpec(ports=[("TCP", 80)]),
+    )
+    stored = server.create("services", svc)
+    ip0 = stored.spec.cluster_ip
+    if ip0:
+        stored.spec.cluster_ip = "10.96.99.99"
+        with pytest.raises(
+            validation.ValidationError, match="clusterIP is immutable"
+        ):
+            server.update("services", stored, check_version=False)
+    bad = v1.Service(
+        metadata=v1.ObjectMeta(name="svc2"),
+        spec=v1.ServiceSpec(ports=[("TCP", 70000)]),
+    )
+    with pytest.raises(validation.ValidationError, match="out of range"):
+        server.create("services", bad)
+
+
+def test_pod_container_rules():
+    server = APIServer()
+    with pytest.raises(validation.ValidationError, match="must not be empty"):
+        server.create(
+            "pods",
+            v1.Pod(metadata=v1.ObjectMeta(name="noc"), spec=v1.PodSpec()),
+        )
+    with pytest.raises(validation.ValidationError, match="duplicate container"):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name="dup"),
+                spec=v1.PodSpec(
+                    containers=[
+                        v1.Container(name="c", requests={"cpu": "1"}),
+                        v1.Container(name="c", requests={"cpu": "1"}),
+                    ]
+                ),
+            ),
+        )
+
+
+def test_topology_spread_max_skew_validated():
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    server = APIServer()
+    with pytest.raises(validation.ValidationError, match="maxSkew"):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name="skew"),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "1"})],
+                    topology_spread_constraints=[
+                        v1.TopologySpreadConstraint(
+                            max_skew=0,
+                            topology_key="zone",
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector.make(
+                                match_labels={"a": "b"}
+                            ),
+                        )
+                    ],
+                ),
+            ),
+        )
