@@ -113,20 +113,24 @@ def validate_selector(sel: Optional[Any], what: str) -> None:
 
 
 def _validate_pod(pod, what: str) -> None:
-    if not pod.spec.containers:
+    _validate_pod_spec(pod.spec, what)
+
+
+def _validate_pod_spec(spec, what: str) -> None:
+    if not spec.containers:
         _bad(f"{what}: spec.containers must not be empty")
     seen = set()
-    for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+    for c in list(spec.containers) + list(spec.init_containers):
         if c.name:
             if c.name in seen:
                 _bad(f"{what}: duplicate container name {c.name!r}")
             seen.add(c.name)
         validate_quantities(c.requests, f"{what}.resources.requests")
         validate_quantities(c.limits, f"{what}.resources.limits")
-    if pod.spec.overhead:
-        validate_quantities(pod.spec.overhead, f"{what}.overhead")
-    validate_labels(pod.spec.node_selector, f"{what}.nodeSelector")
-    aff = pod.spec.affinity
+    if spec.overhead:
+        validate_quantities(spec.overhead, f"{what}.overhead")
+    validate_labels(spec.node_selector, f"{what}.nodeSelector")
+    aff = spec.affinity
     if aff is not None:
         pa = getattr(aff, "pod_affinity", None)
         paa = getattr(aff, "pod_anti_affinity", None)
@@ -143,7 +147,7 @@ def _validate_pod(pod, what: str) -> None:
                 )
                 if term is not None:
                     validate_selector(term.label_selector, f"{what}.{gname}")
-    for tsc in pod.spec.topology_spread_constraints:
+    for tsc in spec.topology_spread_constraints:
         validate_selector(tsc.label_selector, f"{what}.topologySpread")
         if not tsc.topology_key:
             _bad(f"{what}.topologySpread: topologyKey is required")
@@ -189,8 +193,7 @@ def _validate_workload(obj, what: str) -> None:
     tmpl = getattr(obj.spec, "template", None)
     tmpl_spec = getattr(tmpl, "spec", None) if tmpl is not None else None
     if tmpl_spec is not None and hasattr(tmpl_spec, "containers"):
-        shell = type("_TmplPod", (), {"spec": tmpl_spec})
-        _validate_pod(shell, f"{what}.template")
+        _validate_pod_spec(tmpl_spec, f"{what}.template")
 
 
 def _validate_workload_update(new, old, what: str) -> None:
@@ -315,5 +318,14 @@ def validate_object(
         validate_quantities(
             getattr(obj.spec, "resources", {}) or {}, what + ".resources"
         )
+    elif resource == "cronjobs":
+        # the jobTemplate's pod template must be valid at write time, or
+        # the cronjob controller's per-tick job create fails forever
+        jt = getattr(obj.spec, "job_template", None)
+        jspec = getattr(jt, "spec", None) if jt is not None else None
+        tmpl = getattr(jspec, "template", None) if jspec is not None else None
+        tspec = getattr(tmpl, "spec", None) if tmpl is not None else None
+        if tspec is not None and hasattr(tspec, "containers"):
+            _validate_pod_spec(tspec, what + ".jobTemplate.template")
     elif resource == "resourcequotas":
         validate_quantities(obj.spec.hard, what + ".hard")

@@ -1,0 +1,118 @@
+"""Regression guards for the round-5 device-sync work (ops/encoding.py):
+per-field reshape upload on capacity growth, the two-pad chunked dirty-row
+scatter, warm_scatter_programs, and the per-pod fingerprint memo's
+vocab-epoch invalidation (ops/templates.py)."""
+
+import jax
+import numpy as np
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.kubelet.kubelet import make_node_object
+from kubernetes_tpu.ops.encoding import EncodingConfig, SnapshotEncoder
+from kubernetes_tpu.ops.templates import TemplateCache
+
+
+def _enc(n_nodes=8, **overrides):
+    enc = SnapshotEncoder(EncodingConfig(**overrides))
+    for i in range(n_nodes):
+        enc.add_node(make_node_object(f"n{i}", cpu="8"))
+    return enc
+
+
+def _pod(name, cpu="100m", labels=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, labels=labels or {}),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": cpu})]),
+    )
+
+
+def _masters_equal_device(enc):
+    dev = jax.device_get(enc.flush())
+    m = enc._masters()
+    for f in ("requested", "sel_counts", "port_counts", "eterm_w", "alloc"):
+        name = {"alloc": "allocatable"}.get(f, f)
+        assert np.array_equal(
+            np.asarray(getattr(dev, name)), np.asarray(getattr(m, name))
+        ), name
+
+
+def test_reshape_upload_keeps_unchanged_device_fields():
+    """Capacity growth re-uploads ONLY the reshaped fields; untouched
+    fields keep their existing device arrays (identity-preserved), and
+    the result still equals the masters everywhere."""
+    enc = _enc()
+    enc.add_pod("n0", _pod("a"))
+    dev0 = enc.flush()
+    req0 = dev0.requested
+    # grow ONLY the eterm capacity: eterm_w reshapes, requested must not
+    enc._ensure_cap("t_cap", enc.cfg.t_cap * 2)
+    assert enc._full_upload and not enc._content_invalid
+    dev1 = enc.flush()
+    assert dev1.eterm_w.shape[1] == enc.cfg.t_cap
+    # identity: the requested array was NOT re-uploaded (no dirty rows)
+    assert dev1.requested is req0
+    _masters_equal_device(enc)
+
+
+def test_content_invalid_forces_true_full_upload():
+    enc = _enc()
+    enc.add_pod("n0", _pod("a"))
+    dev0 = enc.flush()
+    enc.invalidate_device()
+    dev1 = enc.flush()
+    assert dev1.requested is not dev0.requested  # fresh upload
+    _masters_equal_device(enc)
+
+
+def test_scatter_chunking_handles_large_dirty_sets():
+    """>1024 dirty rows chunk through the big pad and land exactly."""
+    enc = _enc(n_nodes=1100)
+    for i in range(1100):
+        enc.add_pod(f"n{i}", _pod(f"p{i}"))  # dirties every row
+    assert len(enc._dirty_rows) >= 1100
+    enc.flush()  # first flush may be the full-upload path
+    # now dirty a large set again against an existing device snapshot
+    for i in range(1100):
+        enc.add_pod(f"n{i}", _pod(f"q{i}"))
+    assert len(enc._dirty_rows) >= 1100
+    _masters_equal_device(enc)
+
+
+def test_warm_scatter_programs_is_content_neutral():
+    enc = _enc()
+    enc.add_pod("n0", _pod("a"))
+    enc.flush()
+    before = jax.device_get(enc.flush())
+    enc.warm_scatter_programs()
+    after = jax.device_get(enc.flush())
+    assert np.array_equal(
+        np.asarray(before.requested), np.asarray(after.requested)
+    )
+    _masters_equal_device(enc)
+
+
+def test_fingerprint_memo_invalidates_on_vocab_growth():
+    """A memoized pod fingerprint must not survive vocab growth: a new
+    service predicate changes the label-effect encoding, and a stale memo
+    would collapse pods the new predicate distinguishes."""
+    enc = _enc()
+    tc = TemplateCache(enc)
+    pods = [_pod(f"p{i}", labels={"app": "web"}) for i in range(4)]
+    eb1 = tc.encode(pods, pad_to=4)
+    t1 = eb1.num_templates
+    # interning a service predicate that MATCHES the pods changes their
+    # label-effect key -> epoch bump -> fingerprints recompute
+    enc.register_service_predicate(
+        "default", LabelSelector.make(match_labels={"app": "web"})
+    )
+    eb2 = tc.encode(pods, pad_to=4)
+    assert eb2.num_templates >= 1
+    # the template row must now carry the service-sid match
+    sid_mask = enc.service_sid_mask()
+    tpl = eb2.tpl_np
+    assert bool(np.asarray(tpl.match_sel)[:, sid_mask.nonzero()[0]].any())
+    # and re-encoding with NO vocab change hits the memo (same outputs)
+    eb3 = tc.encode(pods, pad_to=4)
+    assert eb3.num_templates == eb2.num_templates
+    assert t1 >= 1

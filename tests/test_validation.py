@@ -279,14 +279,23 @@ def test_service_cluster_ip_immutable_and_port_range():
         metadata=v1.ObjectMeta(name="svc"),
         spec=v1.ServiceSpec(ports=[("TCP", 80)]),
     )
+    # bare APIServer has no ClusterIPAllocator hook: set the allocated IP
+    # explicitly so the immutability branch actually runs
+    svc.spec.cluster_ip = "10.96.0.7"
     stored = server.create("services", svc)
-    ip0 = stored.spec.cluster_ip
-    if ip0:
-        stored.spec.cluster_ip = "10.96.99.99"
-        with pytest.raises(
-            validation.ValidationError, match="clusterIP is immutable"
-        ):
-            server.update("services", stored, check_version=False)
+    assert stored.spec.cluster_ip == "10.96.0.7"
+    stored.spec.cluster_ip = "10.96.99.99"
+    with pytest.raises(
+        validation.ValidationError, match="clusterIP is immutable"
+    ):
+        server.update("services", stored, check_version=False)
+    # clearing the allocated IP is equally rejected (manifest re-apply)
+    cleared = server.get("services", "default", "svc")
+    cleared.spec.cluster_ip = ""
+    with pytest.raises(
+        validation.ValidationError, match="clusterIP is immutable"
+    ):
+        server.update("services", cleared, check_version=False)
     bad = v1.Service(
         metadata=v1.ObjectMeta(name="svc2"),
         spec=v1.ServiceSpec(ports=[("TCP", 70000)]),
