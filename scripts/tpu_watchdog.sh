@@ -33,6 +33,15 @@ staged_probe() {
 
 ATTEMPTS=0
 while [ "$ATTEMPTS" -lt 60 ]; do
+  # never compete with a running bench: the probe's 60 s jax-init storm
+  # measurably degrades a concurrent measured run on this 1-CPU box
+  # (observed: 1655 -> 1377 pods/s), and the driver's official round-end
+  # bench must see an idle machine
+  if pgrep -f 'python bench[.]py' > /dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) bench running - probe skipped" >> "$LOG"
+    sleep 120
+    continue
+  fi
   if staged_probe; then
     ATTEMPTS=$((ATTEMPTS + 1))
     echo "$(date -u +%FT%TZ) TPU ALIVE - running experiments (attempt $ATTEMPTS)" >> "$LOG"
