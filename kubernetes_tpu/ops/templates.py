@@ -187,6 +187,12 @@ class TemplateCache:
         self._rows: Dict[Tuple, int] = {}
         self._exemplars: List[v1.Pod] = []
         self._fallback: List[bool] = []
+        # bumped whenever the fingerprint->row mapping changes (new
+        # template, churn rebuild, vocab-growth clear): consumers caching
+        # per-template-set derivations (the scheduler's pair table) key on
+        # it so a DIFFERENT set with coincidentally equal count + vocab
+        # sizes cannot alias a stale cache entry
+        self.rows_gen = 0
         self._tpl_batch_np: Optional[PodBatch] = None
         self._vocab_sig = self._sig()
         self._label_memo: Dict[Tuple, Tuple] = {}
@@ -307,6 +313,8 @@ class TemplateCache:
                 self._exemplars = [first_by_fp[fp] for fp in uniq]
                 changed = True
 
+            if changed:
+                self.rows_gen += 1
             if self._sig() != self._vocab_sig or changed:
                 # (re-)encode every template with current vocabularies
                 eb = encode_pod_batch(
@@ -327,6 +335,7 @@ class TemplateCache:
             self._rows = {}
             self._exemplars = []
             self._fallback = []
+            self.rows_gen += 1
 
         pod_tpl = np.full(P, -1, np.int32)
         pod_valid = np.zeros(P, np.bool_)

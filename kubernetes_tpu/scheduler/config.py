@@ -118,9 +118,11 @@ class KubeSchedulerConfiguration:
     # conflict-resolution waves for batches with hard (anti-affinity/
     # spread) pairs; static trip count — every such batch pays all waves
     # (the axon tunnel hangs on data-dependent while_loops). Batches
-    # without hard pairs use min(4, wave_n_waves). Retuned 32 -> 16 (r5
-    # sweep: 8 measured marginally faster still, but 16 keeps headroom
-    # for dense hard-pair shapes the sweep didn't cover).
+    # whose PRESENT templates carry no hard pairs use min(2,
+    # wave_n_waves) (scheduler._batch_waves; measured 2020 vs 1602
+    # pods/s on CPU at 5k nodes). Retuned 32 -> 16 (r5 sweep: 8 measured
+    # marginally faster still, but 16 keeps headroom for dense hard-pair
+    # shapes the sweep didn't cover).
     wave_n_waves: int = 16
     sync_batch_bind: bool = True  # bulk bind in-cycle when no permit/prebind
 

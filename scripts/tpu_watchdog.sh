@@ -37,7 +37,7 @@ while [ "$ATTEMPTS" -lt 60 ]; do
   # measurably degrades a concurrent measured run on this 1-CPU box
   # (observed: 1655 -> 1377 pods/s), and the driver's official round-end
   # bench must see an idle machine
-  if pgrep -f 'python bench[.]py' > /dev/null 2>&1; then
+  if pgrep -f '[b]ench\.py' > /dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) bench running - probe skipped" >> "$LOG"
     sleep 120
     continue
