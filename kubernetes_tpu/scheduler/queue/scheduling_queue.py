@@ -126,6 +126,23 @@ class PriorityQueue:
         d = self._initial_backoff * (2 ** max(pi.attempts - 1, 0))
         return time.monotonic() + min(d, self._max_backoff)
 
+    def requeue_backoff(self, pi: QueuedPodInfo) -> None:
+        """Re-queue a RETRYABLE pod through backoffQ (not unschedulableQ):
+        it was feasible but lost a structural contention (e.g. an
+        all-deferred hard-spread batch) — an immediate readd would hot-loop
+        the identical conflict, and unschedulableQ would mislabel it (and
+        sit out the flush interval). Backoff retries in 1-10 s."""
+        with self._cond:
+            if (
+                pi.key in self._active
+                or pi.key in self._backoff
+                or pi.key in self._unschedulable
+            ):
+                return
+            pi.timestamp = time.monotonic()
+            pi.backoff_expiry = self._backoff_time(pi)
+            self._backoff.add(pi)
+
     # -- pops ---------------------------------------------------------------
 
     def pop(

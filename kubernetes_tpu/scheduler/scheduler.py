@@ -622,14 +622,8 @@ class Scheduler:
     # -- wave device path -----------------------------------------------------
 
     def _pair_table(self, eb):
-        """Pair table cached by (template set, vocab) signature.
-
-        Also derives the wave count for the batch: batches with no
-        hard-checked pairs (no required anti-affinity / hard spread) commit
-        in a few waves; hard-checked pairs serialize commits per topology
-        domain and need more. The trip count must be static — the axon
-        tunnel hangs on data-dependent while_loops — so the host picks it.
-        """
+        """Pair table cached by (template set, vocab) signature. The wave
+        count is derived separately per batch (_batch_waves)."""
         enc = self.cache.encoder
         sig = (
             eb.num_templates,
@@ -643,28 +637,33 @@ class Scheduler:
             len(enc.eterm_vocab),
         )
         if self._pair_cache is not None and self._pair_cache[0] == sig:
-            return self._pair_cache[1], self._batch_waves(eb)
+            return self._pair_cache[1]
         table, overflow = build_pair_table(enc, eb.tpl_np, eb.num_templates)
         if overflow:
             logger.warning("pair table overflow; kernel capacity grew")
         self._pair_cache = (sig, table)
-        return table, self._batch_waves(eb)
+        return table
 
-    def _batch_waves(self, eb) -> int:
-        """Wave count for THIS batch, from the templates actually present
-        in it (NOT the whole accumulated template cache — one historical
-        hard-pair template must not pin every later soft-only burst to
-        the full wave count). No-hard batches: prefix-fit packing commits
-        many pods per node per wave, so conflicts drain in 1-2 waves even
-        at 4096-pod bursts; losers defer and retry next batch. Measured
-        (r5, CPU 5k nodes, PodAffinity): 2 waves 2020 pods/s vs 4 waves
-        1602, all scheduled, same batch count. Hard-pair batches keep the
-        configured count."""
+    def _batch_waves(self, eb) -> tuple:
+        """(wave count, has_hard) for THIS batch, from the templates
+        actually present in it (NOT the whole accumulated template cache —
+        one historical hard-pair template must not pin every later
+        soft-only burst to the full wave count). No-hard batches:
+        prefix-fit packing commits many pods per node per wave, so
+        conflicts drain in 1-2 waves even at 4096-pod bursts; losers
+        defer and retry next batch. Measured (r5, CPU 5k nodes,
+        PodAffinity): 2 waves 2020 pods/s vs 4 waves 1602, all scheduled,
+        same batch count. Hard-pair batches keep the configured count —
+        and get the per-wave score refresh regardless of backend (see
+        _schedule_batch_wave): without it the candidate columns chase
+        batch-start domain counts while in-batch commits fill the
+        low-count domains, and a 5k-node hard-spread storm was measured
+        converging bimodally (7 vs 88 pods/s) on CPU."""
         enc = self.cache.encoder
         b = eb.tpl_np
         present = np.unique(eb.pod_tpl_np[eb.pod_tpl_np >= 0])
         if present.size == 0:
-            return min(2, self.cfg.wave_n_waves)
+            return min(2, self.cfg.wave_n_waves), False
         anti_kinds = [
             tid
             for tid in range(len(enc.eterm_vocab))
@@ -682,9 +681,9 @@ class Scheduler:
                 for tid in anti_kinds
             )
         )
-        return self.cfg.wave_n_waves if has_hard else min(
-            2, self.cfg.wave_n_waves
-        )
+        if has_hard:
+            return self.cfg.wave_n_waves, True
+        return min(2, self.cfg.wave_n_waves), False
 
     def _schedule_batch_wave(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
@@ -721,13 +720,13 @@ class Scheduler:
             with self.cache.lock, _stage_timer("encode"):
                 eb = self._tpl_cache.encode([pi.pod for pi in pis], pad_to=pad)
                 trace.step("tpl-encode")
-                ptab, n_waves = self._pair_table(eb)
-                if small_bucket and n_waves <= 4:
-                    # latency bucket, no hard pairs in the batch (the
-                    # pair-table already picked the short count): ≤256
-                    # pods across the cluster rarely conflict, and a
-                    # deferred loser just requeues — 2 waves suffice and
-                    # halve the small-cycle cost
+                ptab = self._pair_table(eb)
+                n_waves, batch_has_hard = self._batch_waves(eb)
+                if small_bucket and not batch_has_hard:
+                    # latency bucket, no hard pairs present: ≤256 pods
+                    # across the cluster rarely conflict, and a deferred
+                    # loser just requeues — 2 waves suffice and halve the
+                    # small-cycle cost
                     n_waves = min(n_waves, 2)
                 trace.step("pair-table")
                 if (
@@ -763,7 +762,11 @@ class Scheduler:
                 self.cfg.hard_pod_affinity_weight,
                 self._mesh,
                 self._use_pallas_fit,
-                self._score_refresh,
+                # hard-pair batches get the per-wave refresh on EVERY
+                # backend: in-batch commits fill the low-count domains the
+                # batch-start candidate columns chase, and a CPU hard-
+                # spread storm measured bimodal convergence without it
+                self._score_refresh or batch_has_hard,
                 self._rtc_shape,
                 has_pinned,
             )
@@ -776,7 +779,7 @@ class Scheduler:
                 n_waves,
                 self.cfg.hard_pod_affinity_weight,
                 self._use_pallas_fit,
-                self._score_refresh,
+                self._score_refresh or batch_has_hard,
                 self._rtc_shape or DEFAULT_RTC_SHAPE,
                 has_pinned,
             )
@@ -875,6 +878,7 @@ class Scheduler:
         protos: dict = {}  # template -> shared encoder proto
         fallback_pis: List[QueuedPodInfo] = []
         failed: List = []  # (pi, tpl_index)
+        deferred_pis: List[QueuedPodInfo] = []
         for i, pi in enumerate(pis):
             if eb.fallback[i]:
                 fallback_pis.append(pi)
@@ -902,9 +906,21 @@ class Scheduler:
                     (pi, node_name, int(eb.pod_band_np[i]), proto)
                 )
             elif deferred[i]:
-                self.queue.readd(pi)
+                deferred_pis.append(pi)
             else:
                 failed.append((pi, i))
+        # stall breaker: a batch that placed NOTHING but deferred pods is
+        # structurally contended (e.g. a hard-spread burst whose every
+        # candidate domain is serialized) — an immediate readd would hot-
+        # loop the identical batch through a full wave cycle each time.
+        # Route the deferred pods through BACKOFF (they are retryable, not
+        # unschedulable: no condition/event, 1-10 s retry, and move events
+        # re-activate backoffQ normally).
+        for pi in deferred_pis:
+            if to_bind:
+                self.queue.readd(pi)
+            else:
+                self.queue.requeue_backoff(pi)
 
         if self.cfg.verify_cycles and to_bind:
             try:
