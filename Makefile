@@ -5,13 +5,20 @@
 #   make tpu-experiments  queued on-hardware measurement sequence
 #   make dryrun           multi-chip dryrun (virtual 8-device CPU mesh)
 #   make verify           test + dryrun (the pre-commit gate)
+#   make chaos            kill-primary + partition suites (slow soaks
+#                         included) + the acked-write-loss checker selftest
 
 PY ?= python
 
-.PHONY: test bench bench-cpu tpu-experiments dryrun verify
+.PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos
 
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+chaos:
+	$(PY) -m pytest tests/test_consensus.py tests/test_replication_quorum.py \
+		tests/test_replication.py tests/test_chaos.py -q
+	$(PY) scripts/consistency_check.py --selftest
 
 bench:
 	$(PY) bench.py

@@ -12,19 +12,44 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..api import serialization as codec
-from ..client.apiserver import AlreadyExists, Conflict, Expired, NotFound
+from ..client.apiserver import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    NotFound,
+    NotPrimary,
+)
+from ..runtime.consensus import DegradedWrites, QuorumLost
 from ..runtime.watch import Event, Watcher
 
 
 class RESTClient:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    """degraded_retries / degraded_retry_cap_s: a fast-fail 503 from a
+    degraded read-only store (reason "Degraded": the write gate refused
+    BEFORE applying anything, runtime/consensus.py) is transparently
+    retried — the client honors the Retry-After header (capped) for up
+    to degraded_retries attempts before surfacing DegradedWrites. A
+    "WriteQuorumLost" 503 (the write applied locally but missed quorum:
+    outcome unknown) surfaces as QuorumLost without replay, and a 503
+    with no Retry-After (fenced ex-primary) surfaces as NotPrimary."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        degraded_retries: int = 3,
+        degraded_retry_cap_s: float = 2.0,
+    ):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self.degraded_retries = degraded_retries
+        self.degraded_retry_cap_s = degraded_retry_cap_s
         self._headers: dict = {}
 
     # -- plumbing ------------------------------------------------------------
@@ -81,30 +106,62 @@ class RESTClient:
 
     def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json", **self._headers},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read().decode() or "{}")
-        except urllib.error.HTTPError as e:
-            payload = {}
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                url,
+                data=data,
+                method=method,
+                headers={"Content-Type": "application/json", **self._headers},
+            )
             try:
-                payload = json.loads(e.read().decode() or "{}")
-            except Exception:
-                pass
-            msg = payload.get("message", str(e))
-            if e.code == 404:
-                raise NotFound(msg) from None
-            if e.code == 409:
-                reason = payload.get("reason", "")
-                if reason == "AlreadyExists":
-                    raise AlreadyExists(msg) from None
-                raise Conflict(msg) from None
-            raise
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                payload = {}
+                try:
+                    payload = json.loads(e.read().decode() or "{}")
+                except Exception:
+                    pass
+                msg = payload.get("message", str(e))
+                if e.code == 404:
+                    raise NotFound(msg) from None
+                if e.code == 409:
+                    reason = payload.get("reason", "")
+                    if reason == "AlreadyExists":
+                        raise AlreadyExists(msg) from None
+                    raise Conflict(msg) from None
+                if e.code == 503:
+                    # three distinct 503 contracts (rest.py):
+                    #   "Degraded"        gate refused before applying:
+                    #                     replaying is safe — honor
+                    #                     Retry-After (capped) and retry;
+                    #                     the store re-opens the moment
+                    #                     followers catch the commit up
+                    #   "WriteQuorumLost" THIS request applied locally but
+                    #                     missed quorum: outcome unknown —
+                    #                     a blind replay would 409 against
+                    #                     its own first attempt; surface it
+                    #   no Retry-After    fenced primary (permanent for
+                    #                     that process): never hammer it —
+                    #                     callers must re-discover the
+                    #                     leader
+                    reason = payload.get("reason", "")
+                    retry_after = e.headers.get("Retry-After")
+                    if retry_after is None:
+                        raise NotPrimary(msg) from None
+                    if reason == "WriteQuorumLost":
+                        raise QuorumLost(msg) from None
+                    if attempt < self.degraded_retries:
+                        attempt += 1
+                        try:
+                            delay = float(retry_after)
+                        except ValueError:
+                            delay = 0.5
+                        time.sleep(min(delay, self.degraded_retry_cap_s))
+                        continue
+                    raise DegradedWrites(msg) from None
+                raise
 
     def get_raw(self, path: str) -> dict:
         """GET an arbitrary API path (aggregated APIs like metrics.k8s.io)."""

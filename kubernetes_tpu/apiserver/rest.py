@@ -38,7 +38,9 @@ from ..client.apiserver import (
     Conflict,
     Expired,
     NotFound,
+    NotPrimary,
 )
+from ..runtime.consensus import DegradedWrites, QuorumLost
 from ..api.validation import ValidationError
 from .auth import AdmissionDenied
 
@@ -62,10 +64,12 @@ class _Handler(BaseHTTPRequestHandler):
     def store(self) -> APIServer:
         return self.server.store
 
-    def _json(self, code: int, payload) -> None:
+    def _json(self, code: int, payload, extra_headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for h, v in (extra_headers or {}).items():
+            self.send_header(h, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -94,7 +98,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(code, codec.encode(obj))
 
-    def _status_error(self, code: int, reason: str, message: str) -> None:
+    def _status_error(
+        self,
+        code: int,
+        reason: str,
+        message: str,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        # retry_after_s -> Retry-After header: a degraded read-only store
+        # (503) tells well-behaved clients when to come back (client.py's
+        # RESTClient honors it)
         self._json(
             code,
             {
@@ -105,6 +118,27 @@ class _Handler(BaseHTTPRequestHandler):
                 "message": message,
                 "code": code,
             },
+            extra_headers=(
+                {"Retry-After": str(max(1, round(retry_after_s)))}
+                if retry_after_s is not None
+                else None
+            ),
+        )
+
+    def _degraded_error(self, e: DegradedWrites) -> None:
+        """Degraded-store write rejection: 503 + Retry-After. The reason
+        distinguishes the two retry contracts: "Degraded" (the gate
+        refused BEFORE applying anything — safe to replay verbatim) vs
+        "WriteQuorumLost" (THIS write applied locally but missed quorum;
+        its outcome is unknown — a blind replay of a create would 409
+        AlreadyExists against its own first attempt once followers catch
+        up, so the client must surface it instead of auto-retrying).
+        Reads and watches keep serving — only mutations land here."""
+        self._status_error(
+            503,
+            "WriteQuorumLost" if isinstance(e, QuorumLost) else "Degraded",
+            str(e),
+            retry_after_s=getattr(e, "retry_after_s", 1.0),
         )
 
     def _parse(self) -> Tuple[Optional[str], Optional[str], Optional[str], dict]:
@@ -781,6 +815,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(201, codec.encode(created))
         except AlreadyExists as e:
             return self._status_error(409, "AlreadyExists", str(e))
+        except DegradedWrites as e:
+            return self._degraded_error(e)
+        except NotPrimary as e:
+            # fenced store: permanent for this process (a successor
+            # exists) — 503 without Retry-After; clients must re-discover
+            # the primary, not hammer this one
+            return self._status_error(503, "ServiceUnavailable", str(e))
         except AdmissionDenied as e:
             # quota denial is 403 Forbidden like the reference's admission
             return self._status_error(403, "Forbidden", str(e))
@@ -815,6 +856,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", str(e))
         except Conflict as e:
             return self._status_error(409, "Conflict", str(e))
+        except DegradedWrites as e:
+            return self._degraded_error(e)
+        except NotPrimary as e:
+            return self._status_error(503, "ServiceUnavailable", str(e))
         except AdmissionDenied as e:
             return self._status_error(403, "Forbidden", str(e))
         except ValidationError as e:
@@ -837,6 +882,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"kind": "Status", "status": "Success"})
         except NotFound as e:
             return self._status_error(404, "NotFound", str(e))
+        except DegradedWrites as e:
+            return self._degraded_error(e)
+        except NotPrimary as e:
+            return self._status_error(503, "ServiceUnavailable", str(e))
         except AdmissionDenied as e:
             return self._status_error(403, "Forbidden", str(e))
 

@@ -92,10 +92,14 @@ class APIServer:
         self._wal = wal
         self._compacting = threading.Event()
         # optional HA (runtime/replication.py): mutations ship to followers
-        # synchronously after the local WAL append; read_only is the fence
-        # a deposed primary gets when a higher term appears
+        # synchronously after the local WAL append. write_gate is the one
+        # write-admission authority (runtime/store.py): read_only maps to
+        # its higher-term fence; consensus mode also arms its degraded
+        # (quorum-lost, 503-retryable) state through it
         self.replicator = None
-        self.read_only = False
+        from ..runtime.store import WriteGate
+
+        self.write_gate = WriteGate()
         # node name -> callable(pod_key, ...) -> str: the kubelet's log and
         # exec surfaces (kubectl logs/exec flow apiserver -> kubelet ->
         # runtime GetContainerLogs/ExecSync in the reference; node agent
@@ -132,7 +136,22 @@ class APIServer:
             self._wal.append_batch(records)
             self._maybe_compact()
         if self.replicator is not None:
-            self.replicator.ship(records)
+            try:
+                self.replicator.ship(records)
+            except Exception:
+                # quorum loss (QuorumLost/NotPrimary) aborts the caller
+                # BEFORE its own _notify — but the records stay applied,
+                # WAL-durable, and READABLE (and may yet commit), so
+                # watchers must still learn of them or every informer
+                # desyncs from list() with a permanent rv gap in the
+                # stream. Synthesize the events the caller would have
+                # sent, then re-raise (the client still sees the 503).
+                for rv, verb, kind, obj in records:
+                    ev_type = {"create": ADDED, "delete": DELETED}.get(
+                        verb, MODIFIED
+                    )
+                    self._notify(kind, Event(ev_type, copy.deepcopy(obj), rv))
+                raise
 
     def _maybe_compact(self) -> None:
         if self._wal.due() and not self._compacting.is_set():
@@ -203,9 +222,21 @@ class APIServer:
 
     # -- CRUD ---------------------------------------------------------------
 
+    @property
+    def read_only(self) -> bool:
+        return self.write_gate.fenced
+
+    @read_only.setter
+    def read_only(self, value: bool) -> None:
+        self.write_gate.fenced = bool(value)
+
     def _check_writable(self) -> None:
-        if self.read_only:
+        if self.write_gate.fenced:
             raise NotPrimary("store fenced: a newer primary holds the lease")
+        # degraded read-only (consensus quorum lost): raises the retryable
+        # DegradedWrites BEFORE any mutation is applied — reads and
+        # watches are never gated
+        self.write_gate.check_degraded()
 
     def create(self, kind: str, obj: Any) -> Any:
         self._check_writable()

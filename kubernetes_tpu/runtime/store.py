@@ -1,9 +1,17 @@
-"""Thread-safe keyed stores with indexers.
+"""Thread-safe keyed stores with indexers + the API store's write gate.
 
 Equivalent of client-go tools/cache thread_safe_store.go / index.go: a
 locked map keyed by namespace/name with pluggable index functions, used as
 the informer-backed local cache every component reads instead of the API
 server (reference pattern: Reflector -> DeltaFIFO -> Indexer).
+
+WriteGate is the API store's write-admission authority: one place that
+answers "may this store accept a mutation right now?" across the two
+distinct refusal modes the HA stack produces — fenced (a higher-term
+primary exists; permanent for this process, NotPrimary) and degraded
+(write quorum lost; lifts when followers catch the commit index up,
+DegradedWrites/503-retryable — see runtime/consensus.py). Reads and
+watches are never gated.
 """
 
 from __future__ import annotations
@@ -12,6 +20,44 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 IndexFunc = Callable[[Any], List[str]]
+
+
+class WriteGate:
+    """Write-admission gate for the API store (client/apiserver.py).
+
+    ``fenced`` is the raft higher-term-wins fence: set when a successor
+    primary appears; this process never writes again. ``degraded``
+    delegates to the attached ConsensusCoordinator's commit-index state:
+    writes fail fast (retryable) while a quorum is not caught up, instead
+    of burning a replication ack window per rejected write. The store
+    calls :meth:`check_degraded` BEFORE applying any mutation."""
+
+    def __init__(self):
+        self.fenced = False
+        self._consensus = None
+
+    def attach_consensus(self, coordinator) -> None:
+        """Arm the degraded-mode gate (runtime/replication.py attach())."""
+        self._consensus = coordinator
+
+    @property
+    def degraded(self) -> bool:
+        c = self._consensus
+        return bool(c is not None and c.degraded)
+
+    def check_degraded(self) -> None:
+        """Raise consensus.DegradedWrites when the quorum is lost."""
+        c = self._consensus
+        if c is not None:
+            c.check_writable()
+
+    def describe(self) -> str:
+        """One-line state for debug dumps (SIGUSR2 debugger)."""
+        if self.fenced:
+            return "fenced (higher-term primary exists)"
+        if self.degraded:
+            return "degraded read-only (write quorum lost)"
+        return "open"
 
 
 def meta_namespace_key(obj: Any) -> str:

@@ -10,6 +10,13 @@ the log (etcd's snapshot/compact cycle).
 
 Record format: one JSON line per mutation
   {"rv": N, "verb": "create|update|delete", "kind": resource, "obj": {...}}
+Commit-index control records (runtime/consensus.py epoch transitions) share
+the stream so replay sees durability state in log order:
+  {"rv": N, "verb": "commit", "kind": "-", "obj": null,
+   "commit": C, "term": T, "event": "degraded|restored"}
+They carry the rv at which they were logged (so snapshot compaction
+retires them naturally) but apply no object change; recovery tracks the
+highest commit index seen (recover_full) and skips them during replay.
 Snapshot format: {"rv": N, "objects": {resource: [obj, ...]}}
 """
 
@@ -118,9 +125,26 @@ class WriteAheadLog:
         """Durably append records IN ORDER; acknowledged once ALL are on
         disk. With the native sink the whole batch (plus any concurrent
         appenders') shares one fsync."""
-        if not records:
+        self._append_lines([self._record(*r) for r in records])
+
+    def append_commit(self, rv: int, commit: int, term: int, event: str) -> None:
+        """Durably log a commit-index epoch transition (consensus mode:
+        entering/leaving degraded read-only). Same fsync contract as a
+        mutation record — the epoch boundary must survive a crash."""
+        rec = {
+            "rv": rv,
+            "verb": "commit",
+            "kind": "-",
+            "obj": None,
+            "commit": commit,
+            "term": term,
+            "event": event,
+        }
+        self._append_lines([json.dumps(rec) + "\n"])
+
+    def _append_lines(self, lines: List[str]) -> None:
+        if not lines:
             return
-        lines = [self._record(*r) for r in records]
         with self._lock:
             if self._native is not None:
                 lib, h = self._native
@@ -140,7 +164,8 @@ class WriteAheadLog:
                     os.fsync(self._f.fileno())
             self._since_compact += len(lines)
             if _DEBUG:
-                _trace(self.path, f"append acked rvs={[r[0] for r in records]} native={self._native is not None}")
+                rvs = [json.loads(line).get("rv") for line in lines]
+                _trace(self.path, f"append acked rvs={rvs} native={self._native is not None}")
 
     def due(self) -> bool:
         with self._lock:
@@ -211,9 +236,20 @@ class WriteAheadLog:
 
     @staticmethod
     def recover(path: str) -> Tuple[int, Dict[str, Dict[str, Any]]]:
-        """Load snapshot + replay log tail. Returns (rv, {kind: {key: obj}}).
-        Tolerates a torn final record (crash mid-append), like etcd's WAL
-        CRC-truncate on recovery.
+        """Load snapshot + replay log tail. Returns (rv, {kind: {key: obj}})."""
+        rv, objects, _commit = WriteAheadLog.recover_full(path)
+        return rv, objects
+
+    @staticmethod
+    def recover_full(
+        path: str,
+    ) -> Tuple[int, Dict[str, Dict[str, Any]], int]:
+        """Load snapshot + replay log tail. Returns
+        (rv, {kind: {key: obj}}, commit_index) — commit_index is the
+        highest consensus commit index recorded in the log (0 when the
+        store never ran in consensus mode; the consistency checker ranks
+        surviving replicas by it). Tolerates a torn final record (crash
+        mid-append), like etcd's WAL CRC-truncate on recovery.
 
         Crash-point consistency: the compactor publishes the snapshot
         (atomic replace) BEFORE rewriting the log, so every on-disk state a
@@ -228,7 +264,7 @@ class WriteAheadLog:
         14/25-pod recovery under a compacting writer). etcd forbids the
         scenario outright via flock."""
         for _ in range(10):
-            rv, objects, snap_rv = WriteAheadLog._recover_once(path)
+            rv, objects, snap_rv, commit = WriteAheadLog._recover_once(path)
             if _DEBUG:
                 _trace(path, f"recover pass snap_rv={snap_rv} rv={rv} nobjs={sum(len(v) for v in objects.values())}")
             snap_path = path + SNAPSHOT_SUFFIX
@@ -244,18 +280,20 @@ class WriteAheadLog:
                 # log tail we replayed is consistent with the snapshot we
                 # loaded (a pending rewrite of THIS snapshot's log only
                 # drops records the snapshot already covers)
-                return rv, objects
-        return rv, objects
+                return rv, objects, commit
+        return rv, objects, commit
 
     @staticmethod
     def _recover_once(
         path: str,
-    ) -> Tuple[int, Dict[str, Dict[str, Any]], int]:
-        """Returns (rv, objects, snap_rv) — snap_rv is the rv of the
-        snapshot file as loaded (0 if none), for the caller's staleness
-        re-check."""
+    ) -> Tuple[int, Dict[str, Dict[str, Any]], int, int]:
+        """Returns (rv, objects, snap_rv, commit_index) — snap_rv is the
+        rv of the snapshot file as loaded (0 if none), for the caller's
+        staleness re-check; commit_index is the highest consensus commit
+        recorded in the log tail (0 if none)."""
         rv = 0
         snap_rv = 0
+        commit = 0
         objects: Dict[str, Dict[str, Any]] = {}
         snap_path = path + SNAPSHOT_SUFFIX
         log_path = path + LOG_SUFFIX
@@ -278,11 +316,17 @@ class WriteAheadLog:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         break  # torn tail record: truncate here
+                    verb = rec.get("verb")
+                    if verb == "commit":
+                        # consensus epoch record: no object change; it may
+                        # share a data record's rv, so handle BEFORE the
+                        # rv-dedup skip below
+                        commit = max(commit, int(rec.get("commit", 0)))
+                        continue
                     if rec["rv"] <= rv:
                         continue  # already in snapshot
                     rv = rec["rv"]
                     kind = rec["kind"]
-                    verb = rec["verb"]
                     d = objects.setdefault(kind, {})
                     if verb == "delete":
                         obj = serialization.decode(kind, rec["obj"])
@@ -290,4 +334,4 @@ class WriteAheadLog:
                     else:
                         obj = serialization.decode(kind, rec["obj"])
                         d[obj.metadata.key] = obj
-        return rv, objects, snap_rv
+        return rv, objects, snap_rv, commit

@@ -73,6 +73,10 @@ class CacheDebugger:
         lines.append("Dump of scheduling queue:")
         for section, keys in queue.pending_pods().items():
             lines.append(f"  {section}: {keys}")
+        repl = replication_health_lines()
+        if repl:
+            lines.append("Dump of API-store replication/consensus state:")
+            lines.extend(repl)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------
@@ -87,6 +91,29 @@ class CacheDebugger:
                 logger.info("cache comparison: consistent with informers")
 
         signal.signal(signum, handler)
+
+
+def replication_health_lines() -> List[str]:
+    """The consensus/replication gauges (runtime/consensus.py publishes
+    commit_index, quorum_state, per-follower lag under ``apiserver_``)
+    rendered for the SIGUSR2 dump: a wedged cluster — writes 503ing,
+    followers lagging, quorum lost — is diagnosable from one signal with
+    no log access. Empty when this process runs no replicated store."""
+    from ...utils.metrics import metrics
+
+    lines: List[str] = []
+    for name, labels, value in metrics.snapshot_gauges("apiserver_"):
+        label_s = (
+            "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+            if labels
+            else ""
+        )
+        if name == "apiserver_quorum_state":
+            state = "healthy" if value else "DEGRADED (writes 503)"
+            lines.append(f"  {name}{label_s}: {value:g} [{state}]")
+        else:
+            lines.append(f"  {name}{label_s}: {value:g}")
+    return lines
 
 
 def audit_device_vs_masters(enc, dev, masters, fields=("requested", "sel_counts", "port_counts")):

@@ -80,6 +80,12 @@ class Metrics:
         with self._lock:
             self._gauges[self._k(name, labels)] = value
 
+    def remove_gauge(self, name: str, labels: Optional[dict] = None) -> None:
+        """Retire one labeled gauge series (e.g. a departed follower's lag
+        — a stale series would read as a live replica in the debugger)."""
+        with self._lock:
+            self._gauges.pop(self._k(name, labels), None)
+
     def observe(self, name: str, value: float, labels: Optional[dict] = None) -> None:
         with self._lock:
             k = self._k(name, labels)
@@ -91,6 +97,23 @@ class Metrics:
     def counter(self, name: str, labels: Optional[dict] = None) -> float:
         with self._lock:
             return self._counters.get(self._k(name, labels), 0.0)
+
+    def gauge(self, name: str, labels: Optional[dict] = None) -> Optional[float]:
+        """Read back a gauge (None when never set) — the consensus/
+        replication health gauges are read-path state for the SIGUSR2
+        debugger dump and tests, not just exposition output."""
+        with self._lock:
+            return self._gauges.get(self._k(name, labels))
+
+    def snapshot_gauges(self, prefix: str = "") -> List[Tuple[str, dict, float]]:
+        """(name, labels, value) for every gauge under prefix, sorted —
+        the debugger's replication section renders exactly this."""
+        with self._lock:
+            return sorted(
+                (name, dict(labels), v)
+                for (name, labels), v in self._gauges.items()
+                if name.startswith(prefix)
+            )
 
     def histogram(self, name: str, labels: Optional[dict] = None) -> Optional[Histogram]:
         with self._lock:

@@ -75,11 +75,15 @@ def test_chaos_kill_primary_mid_burst_no_acked_write_lost():
 
     t = threading.Thread(target=writer)
     t.start()
-    time.sleep(0.15)  # mid-burst…
+    # kill mid-burst, but only once the burst is real: a fixed sleep
+    # under-shoots on a loaded machine (writes pace at follower-ack speed)
+    deadline = time.monotonic() + 10.0
+    while len(acked) < 20 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(acked) >= 20, "burst never got going"
     listener.close()  # kill -9 the primary's replication + service
     dead.set()
     t.join()
-    assert len(acked) > 10, "burst never got going"
 
     # lease lapses -> promotion (automatic via the monitor thread)
     deadline = time.monotonic() + 5.0

@@ -77,10 +77,16 @@ def test_idle_gap_does_not_drop_followers():
     f2.stop()
 
 
-def test_quorum_miss_keeps_followers_connected():
-    """Regression (r5 review): a quorum miss must NOT eject the laggards —
-    they may hold the only follower copies; ejecting would park every
-    replica un-promotable (permanent outage on the next primary death)."""
+def test_quorum_miss_degrades_keeps_followers_and_reopens():
+    """The consensus contract (replaces the availability-first fallback):
+    a quorum miss (a) does NOT acknowledge the in-flight write — it
+    raises the retryable QuorumLost and the store goes degraded
+    read-only, (b) does NOT eject the laggards — they may hold the only
+    follower copies, and their buffered stream is exactly what lifts
+    degraded mode, (c) re-opens writes once follower acks catch the
+    commit index up to the leader's tip."""
+    from kubernetes_tpu.runtime.consensus import DegradedWrites, QuorumLost
+
     primary = APIServer()
     listener = ReplicationListener(
         heartbeat_s=5.0, ack_timeout_s=0.3, cluster_size=3
@@ -91,7 +97,7 @@ def test_quorum_miss_keeps_followers_connected():
     assert f1.wait_synced(5.0) and f2.wait_synced(5.0)
 
     # both followers stall their apply past the deadline -> quorum miss
-    evs = []
+    origs = {}
     for f in (f1, f2):
         orig = f._apply_records
 
@@ -101,14 +107,32 @@ def test_quorum_miss_keeps_followers_connected():
                 orig(recs)
             return slow
 
-        evs.append(orig)
+        origs[f] = orig
         f._apply_records = make(orig)
-    primary.create("pods", _pod("slow"))
+    with pytest.raises(QuorumLost):
+        primary.create("pods", _pod("slow"))
+    # degraded read-only: subsequent writes fail FAST (no ack window burn)
+    assert primary.write_gate.degraded
+    t0 = time.monotonic()
+    with pytest.raises(DegradedWrites):
+        primary.create("pods", _pod("rejected"))
+    assert time.monotonic() - t0 < 0.2, "degraded write burned an ack window"
+    # reads still serve
+    objs, _rv = primary.list("pods")
+    assert {o.metadata.name for o in objs} == {"slow"}
     # laggards kept: still connected, not ejected, and they catch up
     assert listener.follower_count == 2, "quorum miss ejected laggards"
     assert not f1.ejected and not f2.ejected
     assert _wait(lambda: f1.rv >= primary._rv and f2.rv >= primary._rv,
                  timeout=5.0)
+    # ...and their acks re-open the store
+    assert _wait(lambda: not primary.write_gate.degraded, timeout=5.0), (
+        "store never left degraded mode after followers caught up"
+    )
+    for f, orig in origs.items():
+        f._apply_records = orig  # un-wedge: post-recovery writes ack fast
+    primary.create("pods", _pod("after-recovery"))
+    assert listener.consensus.commit_index >= primary._rv
     listener.close()
     f1.stop()
     f2.stop()
@@ -277,12 +301,17 @@ def test_chaos_kill_primary_and_one_follower_no_acked_write_lost():
 
     t = threading.Thread(target=writer)
     t.start()
-    time.sleep(0.2)  # mid-burst…
+    # kill mid-burst, but only once the burst is real: commit-gated
+    # writes pace at follower-ack speed, so a fixed sleep under-shoots
+    # on a loaded machine
+    deadline = time.monotonic() + 10.0
+    while len(acked) < 20 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(acked) >= 20, "burst never got going"
     listener.close()  # primary dies
     fs[0].stop()  # …and so does one follower
     dead.set()
     t.join()
-    assert len(acked) > 10, "burst never got going"
 
     survivors = fs[1:]
     assert _wait(
