@@ -17,7 +17,8 @@ test:
 
 chaos:
 	$(PY) -m pytest tests/test_consensus.py tests/test_replication_quorum.py \
-		tests/test_replication.py tests/test_chaos.py -q
+		tests/test_replication.py tests/test_chaos.py \
+		tests/test_chaos_pipeline.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 bench:

@@ -280,8 +280,22 @@ class RESTClient:
         )
 
     def bind_pods(self, bindings) -> list:
+        """Per-binding error list (None = bound). Retryable degraded-store
+        refusals come back as the EXCEPTION OBJECT (DegradedWrites /
+        QuorumLost), not a string — the scheduler's ride-through layer
+        parks those placements instead of failing them. After the first
+        degraded refusal the remaining bindings are not attempted (each
+        would burn its own client-side retry budget against a store that
+        just said "read-only"); they get a fresh DegradedWrites — none of
+        them was applied, so replaying them later is safe."""
         errors = []
+        degraded: Optional[DegradedWrites] = None
         for b in bindings:
+            if degraded is not None:
+                errors.append(
+                    DegradedWrites(f"not attempted: {degraded}")
+                )
+                continue
             try:
                 self._request(
                     "POST",
@@ -291,6 +305,19 @@ class RESTClient:
                     codec.encode(b),
                 )
                 errors.append(None)
+            except QuorumLost as e:
+                # THIS binding applied remotely but missed quorum: its
+                # outcome is unknown — surface the exception itself so the
+                # caller reads the pod back before any retry
+                errors.append(e)
+                degraded = e
+            except DegradedWrites as e:
+                errors.append(e)
+                degraded = e
+            except (NotFound, Conflict) as e:
+                # typed like the in-process store's error list, so the
+                # scheduler's reconciler branches identically over REST
+                errors.append(e)
             except Exception as e:
                 errors.append(str(e))
         return errors

@@ -761,8 +761,17 @@ class _Handler(BaseHTTPRequestHandler):
                 b.pod_name = b.pod_name or pod_name
                 b.pod_namespace = b.pod_namespace or (ns or "default")
                 errs = self.store.bind_pods([b])
-                if errs and errs[0]:
-                    return self._status_error(409, "Conflict", errs[0])
+                if errs and errs[0] is not None:
+                    # preserve the store's error taxonomy across the wire
+                    # (bind_pods returns the typed exception): a vanished
+                    # pod is 404 — the scheduler's reconciler branches on
+                    # NotFound — and only real bind conflicts (already
+                    # bound / uid mismatch) are 409
+                    if isinstance(errs[0], NotFound):
+                        return self._status_error(
+                            404, "NotFound", str(errs[0])
+                        )
+                    return self._status_error(409, "Conflict", str(errs[0]))
                 return self._json(201, {"kind": "Status", "status": "Success"})
             if resource == "pods" and name and name.endswith("/eviction"):
                 # PDB-respecting delete (registry/core/pod/rest/eviction.go)

@@ -481,7 +481,10 @@ class APIServer:
         """Batch bind: one lock acquisition for a whole device batch (the
         uplink analogue of the reference's per-pod POST /binding — our
         scheduler commits hundreds of placements per cycle, so the API layer
-        accepts them in bulk). Returns per-binding error strings (None = ok).
+        accepts them in bulk). Returns per-binding errors (None = ok); an
+        error entry is the NotFound/Conflict exception itself, so callers
+        (the REST route's status mapping, the scheduler's reconciler)
+        branch on type instead of re-deriving it from message text.
         """
         self._check_writable()
         errors = []
@@ -513,7 +516,7 @@ class APIServer:
                     )
                     errors.append(None)
                 except (NotFound, Conflict) as e:
-                    errors.append(str(e))
+                    errors.append(e)
             # durable BEFORE any watcher learns of the binds (etcd fires
             # watch events post-commit); the batch shares one fsync
             self._log_batch(records)

@@ -17,10 +17,18 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..runtime.store import Indexer, IndexFunc
 from ..runtime.watch import ADDED, DELETED, MODIFIED
+from ..utils.metrics import metrics
 
 from .apiserver import APIServer, Expired
 
 logger = logging.getLogger("kubernetes_tpu.client.informers")
+
+# relist backoff for the ListAndWatch restart loop: grows on consecutive
+# failures (Expired/410, list errors, watch streams dying at birth), resets
+# to the floor once a re-established watch actually delivers an event
+RELIST_BACKOFF_INITIAL = 0.05
+RELIST_BACKOFF_CAP = 5.0
+COUNTER_RELISTS = "informer_relists_total"  # labels: kind, reason
 
 
 class ResourceEventHandler:
@@ -82,6 +90,7 @@ class SharedInformer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watcher = None
+        self._relist_backoff = RELIST_BACKOFF_INITIAL
 
     def add_handler(
         self,
@@ -105,9 +114,16 @@ class SharedInformer:
 
     def _replace(self, objs) -> None:
         """Replace-semantics sync (the reflector's DeltaFIFO Replace):
-        upsert everything listed, and DELETE + on_delete anything the
-        indexer holds that the list no longer contains — a plain upsert
-        replay would leave ghosts for objects deleted during a watch gap."""
+        DELETE + on_delete anything the indexer holds that the list no
+        longer contains (a plain upsert replay would leave ghosts for
+        objects deleted during a watch gap), on_update for keys already
+        known, on_add only for genuinely new ones — a relist must not
+        replay the world as adds: add handlers legitimately treat an add
+        as new state (queue re-activation, cache accounting), and a
+        flapping watch would hammer them with the full object set per
+        flap. The filtering handler wrapper turns updates that cross its
+        predicate into the right add/delete, so objects that changed
+        sides during the gap still land correctly."""
         listed = {o.metadata.key for o in objs}
         for stale_key in [
             k for k in (o.metadata.key for o in self.indexer.list())
@@ -120,65 +136,85 @@ class SharedInformer:
             for h in self._handlers:
                 h.on_delete(gone)
         for obj in objs:
+            old = self.indexer.get(obj.metadata.key)
             self.indexer.add(obj)
-            for h in self._handlers:
-                h.on_add(obj)
+            if old is None:
+                for h in self._handlers:
+                    h.on_add(obj)
+            else:
+                for h in self._handlers:
+                    h.on_update(old, obj)
+
+    def _backoff_failure(self, reason: str) -> bool:
+        """Count one relist cause, sleep the current backoff, grow it.
+        Returns True when the informer is stopping."""
+        metrics.inc(COUNTER_RELISTS, {"kind": self.kind, "reason": reason})
+        if self._stop.wait(self._relist_backoff):
+            return True
+        self._relist_backoff = min(self._relist_backoff * 2, RELIST_BACKOFF_CAP)
+        return False
 
     def _run(self) -> None:
-        # initial list with retry: a transient 401/5xx (e.g. an authn index
-        # catching up to a freshly issued credential) must not permanently
-        # kill the informer thread — the reflector relists with backoff
-        backoff = 0.1
-        while True:
-            try:
-                objs, rv = self._server.list(self.kind)
-                break
-            except Exception:
-                logger.exception(
-                    "initial list of %s failed; retrying", self.kind
-                )
-                if self._stop.wait(backoff):
-                    return
-                backoff = min(backoff * 2, 5.0)
-        self._replace(objs)
-        self._synced.set()
-        # Expired ("resourceVersion too old", 410 Gone): the event window
-        # between list and watch was already evicted — re-list with
-        # Replace semantics and retry, like the reflector's ListAndWatch
-        # restart loop (indefinitely, with backoff: a burst that outruns
-        # the ring must not permanently kill the informer)
+        """The reflector's ListAndWatch restart loop: list (Replace
+        semantics) → watch from the list rv → dispatch until the stream
+        dies → relist. Every failure mode re-enters the loop instead of
+        killing the informer thread:
+
+          * list errors (transient 401/5xx) retry with backoff
+          * Expired ("resourceVersion too old", 410 Gone): the event
+            window between list and watch was already evicted — re-list
+          * a watch stream that closes WITHOUT stop() (flapping
+            connection, REST stream death): re-list — the Replace pass
+            reconciles anything missed during the gap
+
+        The shared backoff grows across consecutive failures and resets
+        to the floor once a re-established watch delivers an event (not
+        merely connects — an instantly-dying stream must keep growing)."""
         while not self._stop.is_set():
             try:
-                self._watcher = self._server.watch(
-                    self.kind, from_version=rv
-                )
-                break
+                objs, rv = self._server.list(self.kind)
+            except Exception:
+                logger.exception("list of %s failed; retrying", self.kind)
+                if self._backoff_failure("list-error"):
+                    return
+                continue
+            self._replace(objs)
+            self._synced.set()
+            try:
+                self._watcher = self._server.watch(self.kind, from_version=rv)
             except Expired:
                 logger.warning(
                     "watch for %s expired at rv %d; re-listing", self.kind, rv
                 )
-                self._stop.wait(0.2)
-                objs, rv = self._server.list(self.kind)
-                self._replace(objs)
-        if self._stop.is_set():
-            return
-        for ev in self._watcher:
+                if self._backoff_failure("expired"):
+                    return
+                continue
+            delivered = False
+            for ev in self._watcher:
+                if self._stop.is_set():
+                    return
+                if not delivered:
+                    delivered = True
+                    self._relist_backoff = RELIST_BACKOFF_INITIAL
+                key = ev.object.metadata.key
+                if ev.type == ADDED:
+                    self.indexer.add(ev.object)
+                    for h in self._handlers:
+                        h.on_add(ev.object)
+                elif ev.type == MODIFIED:
+                    old = self.indexer.get(key)
+                    self.indexer.update(ev.object)
+                    for h in self._handlers:
+                        h.on_update(old, ev.object)
+                elif ev.type == DELETED:
+                    self.indexer.delete(ev.object)
+                    for h in self._handlers:
+                        h.on_delete(ev.object)
             if self._stop.is_set():
                 return
-            key = ev.object.metadata.key
-            if ev.type == ADDED:
-                self.indexer.add(ev.object)
-                for h in self._handlers:
-                    h.on_add(ev.object)
-            elif ev.type == MODIFIED:
-                old = self.indexer.get(key)
-                self.indexer.update(ev.object)
-                for h in self._handlers:
-                    h.on_update(old, ev.object)
-            elif ev.type == DELETED:
-                self.indexer.delete(ev.object)
-                for h in self._handlers:
-                    h.on_delete(ev.object)
+            # stream closed under us (watch flap): relist and re-watch
+            if self._backoff_failure("watch-closed"):
+                return
 
     def has_synced(self) -> bool:
         return self._synced.is_set()

@@ -16,13 +16,16 @@ watches both).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import objects as v1
-from ..client.apiserver import Conflict, NotFound
+from ..client.apiserver import Conflict, NotFound, NotPrimary
 from ..client.leaderelection import Lease
+from ..runtime.consensus import DegradedWrites
+from ..utils.metrics import metrics
 from .runtime import FakeRuntime, PodRuntime
 
 logger = logging.getLogger("kubernetes_tpu.kubelet")
@@ -460,6 +463,19 @@ class Kubelet:
 
     # -- heartbeats (pkg/kubelet/nodelease) ----------------------------------
 
+    # lease-renewal retry budget on retryable 503s (DegradedWrites): a
+    # transient degraded blip must not silently drop the renewal — that is
+    # how a control-plane outage turns into false NotReady → eviction.
+    # Both attempt- AND time-bounded; a persistent in-process outage bails
+    # even faster via write-gate introspection. NOTE the budget only gates
+    # BETWEEN attempts: a RESTClient burns its own Retry-After sleeps
+    # (~3 s at defaults) INSIDE each call before DegradedWrites surfaces,
+    # which this loop cannot shorten — a REST-backed pool that must not
+    # stall its serial heartbeat sweep should wire the heartbeat path
+    # with degraded_retries=0 and let this loop own the retry policy.
+    heartbeat_retries: int = 3
+    heartbeat_retry_budget_s: float = 0.5
+
     def heartbeat(self, now: Optional[float] = None) -> None:
         now = now if now is not None else time.time()
 
@@ -467,12 +483,38 @@ class Kubelet:
             lease.renew_time = now
             return lease
 
-        try:
-            self.server.guaranteed_update(
-                "leases", NODE_LEASE_NS, self.node_name, renew
-            )
-        except (NotFound, Conflict):
-            pass
+        delay = 0.05
+        deadline = time.monotonic() + self.heartbeat_retry_budget_s
+        for attempt in range(self.heartbeat_retries + 1):
+            try:
+                self.server.guaranteed_update(
+                    "leases", NODE_LEASE_NS, self.node_name, renew
+                )
+                return
+            except (NotFound, Conflict):
+                return
+            except NotPrimary:
+                # fenced ex-primary: permanent for that endpoint — never
+                # retry against it (callers re-point the pool at the new
+                # leader); dropping the renewal must not kill the SHARED
+                # heartbeat thread
+                metrics.inc("kubelet_heartbeat_renewals_dropped_total")
+                return
+            except DegradedWrites:
+                gate = getattr(self.server, "write_gate", None)
+                if (
+                    attempt >= self.heartbeat_retries
+                    or time.monotonic() >= deadline
+                    or (gate is not None and getattr(gate, "degraded", False))
+                ):
+                    # store still read-only: this renewal is dropped (the
+                    # next beat retries); nodelifecycle's partial-disruption
+                    # threshold covers the fleet-wide staleness this causes
+                    metrics.inc("kubelet_heartbeat_renewals_dropped_total")
+                    return
+                metrics.inc("kubelet_heartbeat_retries_total")
+                time.sleep(delay + random.uniform(0, delay))
+                delay = min(delay * 2, 0.2)
 
     def _post_admission_failure(self, pod: v1.Pod, message: str) -> None:
         """UnexpectedAdmissionError (the reference's device-admission
@@ -664,20 +706,31 @@ class NodeAgentPool:
     def _watch_loop(self) -> None:
         from ..client.apiserver import list_and_watch
 
+        def dispatch(ev_type: str, pod: v1.Pod) -> None:
+            kl = self._kubelet_for(pod)
+            if kl is None:
+                return
+            try:
+                kl.handle_pod_event(ev_type, pod)
+            except Exception:
+                # a status write 503ing against a degraded store (or any
+                # per-pod failure) must not kill the SHARED watch loop —
+                # the PLEG relist reconciles the missed transition
+                logger.exception(
+                    "pod event %s for %s failed on node %s",
+                    ev_type, pod.metadata.key, pod.spec.node_name,
+                )
+
         def seed(pods):
             for pod in pods:
-                kl = self._kubelet_for(pod)
-                if kl is not None:
-                    kl.handle_pod_event("ADDED", pod)
+                dispatch("ADDED", pod)
 
         watcher = list_and_watch(self.server, "pods", seed)
         while not self._stop.is_set():
             ev = watcher.get(timeout=0.2)
             if ev is None:
                 continue
-            kl = self._kubelet_for(ev.object)
-            if kl is not None:
-                kl.handle_pod_event(ev.type, ev.object)
+            dispatch(ev.type, ev.object)
         watcher.stop()
 
     def _heartbeat_loop(self) -> None:
@@ -688,7 +741,15 @@ class NodeAgentPool:
             for kl in kls:
                 if self._stop.is_set():
                     return
-                kl.heartbeat(now)
+                try:
+                    kl.heartbeat(now)
+                except Exception:
+                    # one node's renewal failure (unexpected transport
+                    # error, fenced store, ...) must not kill the SHARED
+                    # heartbeat thread for the whole pool — that would
+                    # manufacture the mass-NotReady cascade this layer
+                    # exists to prevent
+                    logger.exception("heartbeat failed for %s", kl.node_name)
             self._stop.wait(self.heartbeat_interval)
 
     def _housekeeping_loop(self) -> None:

@@ -110,6 +110,12 @@ class SchedulerCache:
                     return
                 # scheduled somewhere else than assumed: undo and re-add
                 self._remove_pod_internal(key, a.node_name)
+            elif key in self._pod_to_node:
+                # re-delivered add (an informer Replace relist after a
+                # watch flap replays every listed object): treat as an
+                # update — NodeInfo/encoder appends don't dedup, so a
+                # blind re-add would double-count the pod's resources
+                self._remove_pod_internal(key, self._pod_to_node[key])
             self._add_pod_internal(pod)
 
     def update_pod(self, pod: v1.Pod) -> None:

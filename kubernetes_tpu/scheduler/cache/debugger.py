@@ -73,10 +73,19 @@ class CacheDebugger:
         lines.append("Dump of scheduling queue:")
         for section, keys in queue.pending_pods().items():
             lines.append(f"  {section}: {keys}")
+        rt = getattr(self.sched, "_ridethrough", None)
+        if rt is not None:
+            lines.append("Dump of degraded-store ride-through state:")
+            for k, v in rt.state().items():
+                lines.append(f"  {k}: {v}")
         repl = replication_health_lines()
         if repl:
             lines.append("Dump of API-store replication/consensus state:")
             lines.extend(repl)
+        ride = ridethrough_health_lines()
+        if ride:
+            lines.append("Dump of control-plane ride-through gauges:")
+            lines.extend(ride)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------
@@ -113,6 +122,34 @@ def replication_health_lines() -> List[str]:
             lines.append(f"  {name}{label_s}: {value:g} [{state}]")
         else:
             lines.append(f"  {name}{label_s}: {value:g}")
+    return lines
+
+
+def ridethrough_health_lines() -> List[str]:
+    """The degraded-mode ride-through gauges — pending-bind buffer depth
+    and breaker state (scheduler/ridethrough.py), eviction-limiter and
+    partial-disruption state (controller/nodelifecycle.py) — rendered for
+    the SIGUSR2 dump so a paused pipeline is diagnosable from one signal.
+    Empty when none of those components has published state yet."""
+    from ...utils.metrics import metrics
+
+    lines: List[str] = []
+    for prefix in ("scheduler_pending_binds", "scheduler_bind_breaker",
+                   "node_lifecycle_"):
+        for name, labels, value in metrics.snapshot_gauges(prefix):
+            label_s = (
+                "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+                if labels
+                else ""
+            )
+            if name == "scheduler_bind_breaker_state":
+                state = "OPEN (dispatch paused)" if value else "closed"
+                lines.append(f"  {name}{label_s}: {value:g} [{state}]")
+            elif name == "node_lifecycle_partial_disruption":
+                state = "HALTED (evictions paused)" if value else "normal"
+                lines.append(f"  {name}{label_s}: {value:g} [{state}]")
+            else:
+                lines.append(f"  {name}{label_s}: {value:g}")
     return lines
 
 

@@ -125,6 +125,11 @@ class KubeSchedulerConfiguration:
     # shapes the sweep didn't cover).
     wave_n_waves: int = 16
     sync_batch_bind: bool = True  # bulk bind in-cycle when no permit/prebind
+    # degraded-store ride-through (scheduler/ridethrough.py): placements
+    # whose bind 503s retryably park here (pods stay assumed, HBM snapshot
+    # stays warm) while the breaker pauses dispatch; beyond capacity the
+    # overflow unwinds through backoff like a failed bind
+    pending_bind_capacity: int = 8192
 
     def validate(self) -> None:
         if self.percentage_of_nodes_to_score < 0 or self.percentage_of_nodes_to_score > 100:
@@ -142,5 +147,7 @@ class KubeSchedulerConfiguration:
             raise ValueError("device_batch_size must be >= 1, or 0 for auto")
         if self.pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 1, or 0 for auto")
+        if self.pending_bind_capacity < 1:
+            raise ValueError("pending_bind_capacity must be >= 1")
         if self.leader_election is not None:
             self.leader_election.validate()

@@ -1,0 +1,195 @@
+"""Degraded-store ride-through for the scheduler's bind pipeline.
+
+PR 1 made the API store honest under quorum loss: writes fail fast with a
+retryable 503 (DegradedWrites — the gate refused BEFORE applying) or with
+QuorumLost (THIS write applied locally but missed its ack window: outcome
+unknown). This module makes the scheduler ride that window out instead of
+failing whole bind waves into the unschedulable queue:
+
+  * **pending-bind buffer**: placements whose bind hit a retryable store
+    error park here, keyed by pod UID, while the pods STAY assumed in the
+    scheduler cache (the assume TTL is only armed by finish_binding, so a
+    buffered assume never expires and the HBM snapshot stays warm).
+    One entry per UID — a duplicated retry can never create two bind
+    attempts for one pod.
+  * **circuit breaker**: the first buffered wave trips it; while open the
+    scheduling loop pauses batch dispatch (informers and the device
+    snapshot keep updating) and probes for recovery on a jittered
+    backoff. The scheduler's reconciler drains the buffer when writes
+    reopen: read each pod back, decide "bind landed → finish_binding" vs
+    "bind lost → retry once, uid-fenced" vs "pod gone → forget".
+
+The reference has no direct equivalent (its binds are per-pod POSTs with
+client-go retries); the closest analogue is the kubelet status manager's
+syncBatch retry loop. Here the unit of loss is a whole device wave, so the
+buffer is the difference between a blip and a storm.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.metrics import metrics
+
+# gauges (rendered by /metrics and the SIGUSR2 debugger dump)
+GAUGE_PENDING_BINDS = "scheduler_pending_binds"
+GAUGE_BREAKER_STATE = "scheduler_bind_breaker_state"  # 1 = open (paused)
+COUNTER_BUFFERED = "scheduler_pending_binds_buffered_total"
+COUNTER_OVERFLOW = "scheduler_pending_bind_overflow_total"
+COUNTER_BREAKER_TRIPS = "scheduler_bind_breaker_trips_total"
+COUNTER_RECONCILED = "scheduler_bind_reconcile_total"  # label: outcome
+HIST_PAUSED_S = "scheduler_bind_breaker_open_duration_seconds"
+
+BREAKER_OPEN = 1.0
+BREAKER_CLOSED = 0.0
+
+
+@dataclass
+class PendingBind:
+    """One buffered placement: the pod is assumed in the cache on
+    node_name. Whether the bind applied (QuorumLost: applied-but-
+    unacked) or never did (Degraded: refused up front) is NOT tracked —
+    the reconciler reads every pod back before any retry, which is the
+    only answer that survives a failover anyway."""
+
+    pi: Any  # QueuedPodInfo
+    node_name: str
+    profile: Any
+    buffered_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def uid(self) -> str:
+        return self.pi.pod.metadata.uid
+
+
+class BindRideThrough:
+    """Pending-bind buffer + dispatch circuit breaker (one lock, shared
+    by the scheduling loop and the async bind pool)."""
+
+    def __init__(
+        self,
+        capacity: int = 8192,
+        probe_initial_s: float = 0.2,
+        probe_max_s: float = 1.0,
+    ):
+        self.capacity = capacity
+        self._probe_initial = probe_initial_s
+        self._probe_max = probe_max_s
+        self._probe_delay = probe_initial_s
+        self._lock = threading.Lock()
+        self._entries: Dict[str, PendingBind] = {}  # pod UID -> entry
+        self._open = False
+        self._opened_at: Optional[float] = None
+        self._publish_locked()
+
+    # -- buffer ---------------------------------------------------------------
+
+    def buffer(
+        self, entries: List[PendingBind]
+    ) -> Tuple[List[PendingBind], List[PendingBind]]:
+        """Park entries (deduped by UID) and trip the breaker. Returns
+        (accepted, overflow) — overflow entries did NOT fit and the
+        caller must unwind them (forget + requeue)."""
+        accepted: List[PendingBind] = []
+        overflow: List[PendingBind] = []
+        with self._lock:
+            for e in entries:
+                if e.uid in self._entries:
+                    continue  # duplicate retry of an already-buffered pod
+                if len(self._entries) >= self.capacity:
+                    overflow.append(e)
+                    continue
+                self._entries[e.uid] = e
+                accepted.append(e)
+            tripped = not self._open and bool(self._entries)
+            if tripped:
+                self._open = True
+                self._opened_at = time.monotonic()
+                self._probe_delay = self._probe_initial
+            self._publish_locked()
+        if accepted:
+            metrics.inc(COUNTER_BUFFERED, by=float(len(accepted)))
+        if overflow:
+            metrics.inc(COUNTER_OVERFLOW, by=float(len(overflow)))
+        if tripped:
+            metrics.inc(COUNTER_BREAKER_TRIPS)
+        return accepted, overflow
+
+    def drain(self) -> List[PendingBind]:
+        """Atomically take every buffered entry for a reconcile pass
+        (oldest first). Un-reconciled entries come back via restore()."""
+        with self._lock:
+            out = sorted(self._entries.values(), key=lambda e: e.buffered_at)
+            self._entries.clear()
+            self._publish_locked()
+            return out
+
+    def restore(self, entries: List[PendingBind]) -> None:
+        """Put back entries a reconcile pass could not complete (store
+        still degraded). A fresh entry buffered for the same UID
+        mid-pass wins the slot — both mean the same thing (read back
+        before any retry)."""
+        with self._lock:
+            for e in entries:
+                self._entries.setdefault(e.uid, e)
+            self._publish_locked()
+
+    # -- breaker --------------------------------------------------------------
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def next_probe_delay(self) -> float:
+        """Jittered, growing probe interval while open (0.2 s → 1 s cap):
+        fast enough that recovery is noticed well inside the 5 s
+        resume-placing budget, slow enough not to hammer a down store."""
+        with self._lock:
+            d = self._probe_delay
+            self._probe_delay = min(self._probe_delay * 1.5, self._probe_max)
+        return d * (1.0 + random.uniform(-0.2, 0.2))
+
+    def reset(self) -> None:
+        """Close the breaker (buffer drained; writes are flowing). A
+        no-op while entries remain — an async binder can buffer a new
+        entry between the reconciler's drain and this reset, and closing
+        then would strand it (nothing re-probes once closed)."""
+        with self._lock:
+            if not self._open or self._entries:
+                return
+            self._open = False
+            opened_at, self._opened_at = self._opened_at, None
+            self._probe_delay = self._probe_initial
+            self._publish_locked()
+        if opened_at is not None:
+            metrics.observe(HIST_PAUSED_S, time.monotonic() - opened_at)
+
+    # -- introspection --------------------------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "breaker": "open" if self._open else "closed",
+                "pending_binds": len(self._entries),
+                "open_for_s": (
+                    round(time.monotonic() - self._opened_at, 3)
+                    if self._opened_at is not None
+                    else 0.0
+                ),
+            }
+
+    def _publish_locked(self) -> None:
+        metrics.set_gauge(GAUGE_PENDING_BINDS, float(len(self._entries)))
+        metrics.set_gauge(
+            GAUGE_BREAKER_STATE, BREAKER_OPEN if self._open else BREAKER_CLOSED
+        )

@@ -34,8 +34,9 @@ import jax
 import numpy as np
 
 from ..api import objects as v1
-from ..client.apiserver import APIServer, NotFound
+from ..client.apiserver import APIServer, NotFound, NotPrimary
 from ..client.informers import SharedInformerFactory
+from ..runtime.consensus import DegradedWrites
 from ..controller.volume_scheduling import VolumeBinder
 from ..api.objects import Binding
 from ..ops.batch import encode_pod_batch
@@ -67,6 +68,7 @@ from .framework.interface import Code, CycleState, is_success
 from .preemption import Preemptor
 from .profile import ProfileMap, new_profile_map
 from .queue import PriorityQueue, QueuedPodInfo
+from .ridethrough import COUNTER_RECONCILED, BindRideThrough, PendingBind
 from . import eventhandlers
 
 logger = logging.getLogger("kubernetes_tpu.scheduler")
@@ -257,6 +259,12 @@ class Scheduler:
             512 if jax.default_backend() == "tpu" else 256
         )
         self._busy = False  # scheduling loop mid-batch (wait_for_idle)
+        # degraded-store ride-through (ridethrough.py): binds refused with
+        # a retryable 503 park here while the pods stay assumed; the
+        # breaker pauses batch dispatch until the store reopens
+        self._ridethrough = BindRideThrough(
+            capacity=self.cfg.pending_bind_capacity
+        )
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table)
@@ -393,10 +401,15 @@ class Scheduler:
         mistaken for quiescence."""
 
         def idle() -> bool:
+            # breaker open counts as busy even at depth 0: drain() zeroes
+            # the depth for the whole reconcile pass, and entries may yet
+            # be restored — the breaker only resets after a full drain
             return (
                 len(self.queue) == 0
                 and not self._pending
                 and not self._busy
+                and not self._ridethrough.open
+                and self._ridethrough.depth == 0
                 and not self.cache.encoder.has_pending_updates
             )
 
@@ -408,7 +421,13 @@ class Scheduler:
                     return True
                 continue
             time.sleep(0.01)
-        return len(self.queue) == 0 and not self._pending and not self._busy
+        return (
+            len(self.queue) == 0
+            and not self._pending
+            and not self._busy
+            and not self._ridethrough.open
+            and self._ridethrough.depth == 0
+        )
 
     # -- the loop ------------------------------------------------------------
 
@@ -417,6 +436,13 @@ class Scheduler:
 
     def _scheduling_loop(self) -> None:
         while not self._stop.is_set():
+            # Circuit breaker: the store refused binds with a retryable
+            # 503. Pause batch dispatch (informers, queue, and the HBM
+            # snapshot stay warm) and probe for recovery; the queue keeps
+            # accumulating instead of failing waves into unschedulableQ.
+            if self._ridethrough.open:
+                self._ride_through_degraded()
+                continue
             # Batch-fill policy: the wave kernel's cycle cost is nearly
             # batch-size-independent (per-wave [TPL, N] work dominates), so
             # burst throughput = fill per kernel. With a batch in flight and
@@ -472,6 +498,183 @@ class Scheduler:
                     self.queue.add_unschedulable_if_not_present(pi, moves)
             finally:
                 self._busy = False
+
+    # -- degraded-store ride-through (ridethrough.py) -------------------------
+
+    def _ride_through_degraded(self) -> None:
+        """Breaker-open tick: flush in-flight wave batches (their binds
+        buffer too — the kernels already committed on-device), wait one
+        jittered probe interval, then try to drain the pending-bind
+        buffer. The breaker closes only when the buffer fully drains."""
+        if self._pending:
+            self._busy = True
+            try:
+                self._resolve_pending()
+            except Exception:
+                logger.exception("degraded-mode pipeline flush failed")
+            finally:
+                self._busy = False
+        if self._stop.wait(self._ridethrough.next_probe_delay()):
+            return
+        # cheap introspection first: an in-process store exposes its write
+        # gate — while it still reports degraded, skip the write probe
+        gate = getattr(self.server, "write_gate", None)
+        if gate is not None and getattr(gate, "degraded", False):
+            return
+        if self._reconcile_pending_binds():
+            self._ridethrough.reset()
+            logger.warning(
+                "store writes reopened: pending-bind buffer drained, "
+                "resuming batch dispatch"
+            )
+
+    def _buffer_pending_binds(self, entries: List[PendingBind]) -> None:
+        accepted, overflow = self._ridethrough.buffer(entries)
+        if accepted:
+            logger.warning(
+                "store degraded: buffered %d pending binds "
+                "(dispatch paused until writes reopen)", len(accepted),
+            )
+        for e in overflow:
+            # bounded buffer: past capacity the placement unwinds like a
+            # failed bind — backoff retries it once the store recovers
+            self.cache.forget_pod(e.pi.pod)
+            self._release_permits(e.pi.pod)
+            self.queue.requeue_backoff(e.pi)
+
+    def _reconcile_pending_binds(self) -> bool:
+        """Drain the pending-bind buffer against the (possibly recovered)
+        store. Each pod is read back FIRST: an applied-but-unacked bind
+        (QuorumLost) must be detected, never blindly replayed — and the
+        retry itself is uid-fenced by the store's binding check, so a
+        duplicated attempt can never double-bind. Returns True when the
+        buffer fully drained."""
+        entries = self._ridethrough.drain()
+        if not entries:
+            return True
+        still_degraded: List[PendingBind] = []
+        for e in entries:
+            if still_degraded:
+                # store went (or stayed) degraded mid-pass: keep the rest
+                # buffered untouched for the next probe
+                still_degraded.append(e)
+                continue
+            try:
+                self._reconcile_one(e, still_degraded)
+            except Exception:
+                # anything unclassified (REST connection refused mid-
+                # failover, NotPrimary, ...): the store is not usable yet.
+                # Keep the entry — and the scheduling thread — alive; the
+                # next probe retries.
+                logger.exception(
+                    "pending-bind reconcile failed for %s; retrying later",
+                    e.pi.pod.metadata.key,
+                )
+                still_degraded.append(e)
+        if still_degraded:
+            self._ridethrough.restore(still_degraded)
+            return False
+        return True
+
+    def _reconcile_one(
+        self, e: PendingBind, still_degraded: List[PendingBind]
+    ) -> None:
+        pod = e.pi.pod
+        try:
+            cur = self.server.get(
+                "pods", pod.metadata.namespace, pod.metadata.name
+            )
+        except NotFound:
+            cur = None
+        if cur is not None and cur.metadata.uid != pod.metadata.uid:
+            cur = None  # same name, different pod: ours is gone
+        if cur is None:
+            # deleted while buffered, or lost with a failed primary
+            self.cache.forget_pod(pod)
+            self._release_permits(pod)
+            metrics.inc(COUNTER_RECONCILED, {"outcome": "gone"})
+            return
+        if cur.spec.node_name:
+            if cur.spec.node_name == e.node_name:
+                # the bind LANDED — only its ack was lost
+                self._record_bound(
+                    e.pi, e.node_name, e.profile, outcome="landed"
+                )
+            else:
+                # bound elsewhere (another path won): drop our assume;
+                # the informer's scheduled-add owns the cache entry
+                self.cache.forget_pod(pod)
+                self._release_permits(pod)
+                metrics.inc(COUNTER_RECONCILED, {"outcome": "foreign"})
+            return
+        # not bound: the write never applied (or didn't survive
+        # failover) — replay once, uid-fenced
+        binding = Binding(
+            pod_name=pod.metadata.name,
+            pod_namespace=pod.metadata.namespace,
+            pod_uid=pod.metadata.uid,
+            target_node=e.node_name,
+        )
+        try:
+            errs = self.server.bind_pods([binding])
+            err = errs[0] if errs else None
+        except DegradedWrites as exc:
+            err = exc
+        if isinstance(err, DegradedWrites):
+            still_degraded.append(e)
+        elif err is None:
+            self._record_bound(
+                e.pi, e.node_name, e.profile, outcome="rebound"
+            )
+        elif isinstance(err, NotFound):
+            # deleted between the read-back and the replay: same as gone —
+            # requeueing would park a ghost in unschedulableQ forever (its
+            # informer delete already fired)
+            self.cache.forget_pod(pod)
+            self._release_permits(pod)
+            metrics.inc(COUNTER_RECONCILED, {"outcome": "gone"})
+        else:
+            self.cache.forget_pod(pod)
+            metrics.inc(COUNTER_RECONCILED, {"outcome": "lost_requeued"})
+            self._handle_failure(
+                e.pi, self.queue.moves, message=str(err), error=True
+            )
+
+    def _release_permits(self, pod: v1.Pod) -> None:
+        """Unwind paths that drop a buffered placement without a full
+        _handle_failure must still tell permit plugins the pod is gone —
+        a gang-quorum plugin may hold siblings parked on its reservation
+        (the same hook _handle_failure fires)."""
+        prof = self.profiles.for_pod(pod)
+        if prof is None:
+            return
+        for name in prof.framework.plugin_set.permit:
+            hook = getattr(
+                prof.framework.plugin(name), "handle_scheduling_failure", None
+            )
+            if hook is not None:
+                try:
+                    hook(pod)
+                except Exception:
+                    logger.exception("permit release hook %s", name)
+
+    def _record_bound(
+        self, pi: QueuedPodInfo, node_name: str, prof, outcome: Optional[str] = None
+    ) -> None:
+        """Post-bind bookkeeping shared by the in-cycle bulk path and the
+        ride-through reconciler."""
+        self.cache.finish_binding(pi.pod)
+        metrics.observe(
+            "pod_scheduling_duration_seconds",
+            time.monotonic() - pi.initial_attempt_timestamp,
+        )
+        metrics.inc("schedule_attempts_total", {"result": "scheduled"})
+        if outcome:
+            metrics.inc(COUNTER_RECONCILED, {"outcome": outcome})
+        prof.recorder.eventf(
+            pi.pod, "Normal", "Scheduled", "Binding",
+            f"Successfully assigned {pi.pod.metadata.key} to {node_name}",
+        )
 
     def schedule_pod_batch(self, pis: List[QueuedPodInfo]) -> None:
         trace = Trace("schedule_batch", pods=len(pis))
@@ -1165,31 +1368,39 @@ class Scheduler:
             for pi, node_name, _ in simple
         ]
         b0 = time.monotonic()
-        errors = self.server.bind_pods(bindings)
+        try:
+            errors = self.server.bind_pods(bindings)
+        except DegradedWrites as e:
+            # in-process store: the gate refused before applying anything
+            # (Degraded — safe to replay) or the whole batch applied but
+            # missed its quorum ack (QuorumLost — outcome unknown). Either
+            # way the wave is NOT failed: park every placement.
+            errors = [e] * len(bindings)
         bind_dur = time.monotonic() - b0
         e2e = time.monotonic() - t_start
+        to_buffer: List[PendingBind] = []
         for (pi, node_name, prof), err in zip(simple, errors):
             if err is None:
-                self.cache.finish_binding(pi.pod)
                 metrics.observe("binding_duration_seconds", bind_dur)
                 metrics.observe("e2e_scheduling_duration_seconds", e2e)
                 # queue-entry → bound, incl. queue wait (reference
                 # pod_scheduling_duration_seconds, metrics.go:51-231) — the
                 # honest per-pod number the latency bench reports
-                metrics.observe(
-                    "pod_scheduling_duration_seconds",
-                    time.monotonic() - pi.initial_attempt_timestamp,
-                )
-                metrics.inc("schedule_attempts_total", {"result": "scheduled"})
-                prof.recorder.eventf(
-                    pi.pod, "Normal", "Scheduled", "Binding",
-                    f"Successfully assigned {pi.pod.metadata.key} to {node_name}",
-                )
+                self._record_bound(pi, node_name, prof)
+            elif isinstance(err, DegradedWrites):
+                # retryable store refusal (incl. QuorumLost, where THIS
+                # bind applied but wasn't acked — the reconciler's
+                # read-back discriminates): the pod stays assumed — its
+                # assume TTL is unarmed, so the reservation holds for
+                # the whole outage
+                to_buffer.append(PendingBind(pi, node_name, prof))
             else:
                 self.cache.forget_pod(pi.pod)
                 self._handle_failure(
-                    pi, self.queue.moves, message=err, error=True
+                    pi, self.queue.moves, message=str(err), error=True
                 )
+        if to_buffer:
+            self._buffer_pending_binds(to_buffer)
 
     def _assume_and_bind_after_assume(
         self, pi: QueuedPodInfo, node_name: str, t_start: float
@@ -1372,6 +1583,20 @@ class Scheduler:
                 pod, "Normal", "Scheduled", "Binding",
                 f"Successfully assigned {pod.metadata.key} to {node_name}",
             )
+        except DegradedWrites as e:
+            if not self._pod_has_pvcs(pod):
+                # retryable store refusal mid-async-bind: park the
+                # placement (the pod stays assumed/reserved) instead of
+                # failing it — the reconciler finishes or unwinds it when
+                # writes reopen. PVC pods fall through to the generic
+                # unwind: their volume-bind writes may be half-applied
+                # and need a full fresh cycle.
+                self._buffer_pending_binds([PendingBind(pi, node_name, prof)])
+                return
+            self.cache.forget_pod(pod)
+            self.volume_binder.forget_pod_volumes(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
         except Exception as e:
             self.cache.forget_pod(pod)
             self.volume_binder.forget_pod_volumes(pod)
@@ -1414,9 +1639,17 @@ class Scheduler:
         self._set_pod_unschedulable_condition(pod, message)
         preempted = False
         if not error and not self.cfg.disable_preemption and not skip_preemption:
-            preempted = bool(
-                self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
-            )
+            try:
+                preempted = bool(
+                    self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
+                )
+            except (DegradedWrites, NotPrimary):
+                # degraded store: victim deletes / nominations can't land;
+                # the pod requeues and preemption retries after recovery
+                metrics.inc(
+                    "scheduler_degraded_write_skips_total",
+                    {"write": "preemption"},
+                )
         self.queue.add_unschedulable_if_not_present(pi, moves0)
         return preempted
 
@@ -1456,6 +1689,13 @@ class Scheduler:
             )
         except NotFound:
             pass
+        except (DegradedWrites, NotPrimary):
+            # best-effort status write: while the store is read-only the
+            # condition is skipped, not retried — failing the failure
+            # handler here would turn one outage into a requeue storm
+            metrics.inc(
+                "scheduler_degraded_write_skips_total", {"write": "condition"}
+            )
 
     def _attempt_preemption(
         self, pod, prof, fit_error, candidate_nodes: Optional[List[str]]

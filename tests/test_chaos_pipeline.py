@@ -1,0 +1,625 @@
+"""Full-pipeline chaos: degraded-store ride-through end to end.
+
+PR 1 gave the API store an honest degraded read-only mode (retryable 503 /
+QuorumLost). These scenarios exercise every consumer riding that window
+out: the scheduler's pending-bind buffer + circuit breaker
+(scheduler/ridethrough.py), the node-lifecycle controller's eviction-storm
+safeguards (rate limiter + partial-disruption halt), kubelet heartbeat
+retries, and the informer's relist-on-flap loop.
+
+The invariant checker asserts, per scenario:
+  * zero acked-bind loss  — every bind the store ACKED is still bound
+  * zero double-binds     — no pod's bind ever applied twice
+  * zero evictions during a control-plane-only outage
+  * every pod from a genuinely dead node reschedules
+"""
+
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.client.apiserver import Expired
+from kubernetes_tpu.client.informers import (
+    RELIST_BACKOFF_INITIAL,
+    SharedInformer,
+)
+from kubernetes_tpu.controller.nodelifecycle import (
+    GAUGE_PARTIAL_DISRUPTION,
+    NodeLifecycleController,
+)
+from kubernetes_tpu.kubelet.kubelet import NODE_LEASE_NS, NodeAgentPool
+from kubernetes_tpu.runtime.consensus import DegradedWrites, QuorumLost
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.utils.metrics import metrics
+
+
+def wait_until(fn, timeout=60.0, period=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def make_pod(name, cpu="100m", labels=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, labels=labels or {}),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": cpu})]),
+    )
+
+
+def _bound_count(server):
+    return server.count("pods", lambda p: bool(p.spec.node_name))
+
+
+class _GateConsensus:
+    """Minimal consensus stand-in for WriteGate.attach_consensus: flips
+    the store between healthy and degraded read-only (the same contract
+    runtime/consensus.py arms — writes 503 retryably, reads serve)."""
+
+    def __init__(self):
+        self.degraded = False
+
+    def check_writable(self):
+        if self.degraded:
+            raise DegradedWrites(
+                "chaos: store degraded read-only — retry later"
+            )
+
+
+class ChaosStore(APIServer):
+    """In-process store with chaos knobs + the bind-invariant ledger.
+
+    ``acked_binds`` maps pod uid -> node for every bind the store
+    ACKNOWLEDGED to its caller (error None returned). ``applied_binds``
+    counts every application per uid, acked or not. ``fail_next_bind``
+    injects one failure into the next bind_pods call:
+
+      "degraded"     refuse BEFORE applying anything (retryable; the
+                     store stays read-only until recover())
+      "quorum_lost"  apply locally, then lose the quorum ack — the
+                     caller sees QuorumLost (outcome unknown) while the
+                     binds are readable in the store
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.gate = _GateConsensus()
+        self.write_gate.attach_consensus(self.gate)
+        self.acked_binds = {}
+        self.applied_binds = defaultdict(int)
+        self.fail_next_bind = None
+        self._chaos_lock = threading.Lock()
+
+    def degrade(self):
+        self.gate.degraded = True
+
+    def recover(self):
+        self.gate.degraded = False
+
+    def bind_pods(self, bindings):
+        with self._chaos_lock:
+            mode, self.fail_next_bind = self.fail_next_bind, None
+        if mode == "degraded":
+            self.gate.degraded = True
+            raise DegradedWrites("chaos: bind refused, store degraded")
+        errors = super().bind_pods(bindings)
+        for b, err in zip(bindings, errors):
+            if err is None:
+                self.applied_binds[b.pod_uid] += 1
+        if mode == "quorum_lost":
+            self.gate.degraded = True
+            raise QuorumLost("chaos: bind applied locally, quorum ack lost")
+        for b, err in zip(bindings, errors):
+            if err is None:
+                self.acked_binds[b.pod_uid] = b.target_node
+        return errors
+
+
+def assert_bind_invariants(store: ChaosStore, allow_deleted=False):
+    """Zero acked-bind loss + zero double-binds against the live store."""
+    pods, _ = store.list("pods")
+    by_uid = {p.metadata.uid: p for p in pods}
+    lost = []
+    for uid, node in store.acked_binds.items():
+        cur = by_uid.get(uid)
+        if cur is None:
+            if not allow_deleted:
+                lost.append((uid, node, "pod gone"))
+            continue
+        if cur.spec.node_name != node:
+            lost.append((uid, node, f"bound to {cur.spec.node_name!r}"))
+    assert not lost, f"acked binds lost: {lost}"
+    doubles = {u: n for u, n in store.applied_binds.items() if n > 1}
+    assert not doubles, f"double-applied binds: {doubles}"
+
+
+def _watch_deletions(store, sink):
+    w = store.watch("pods")
+
+    def drain():
+        for ev in w:
+            if ev.type == "DELETED":
+                sink.append(ev.object.metadata.key)
+
+    threading.Thread(target=drain, daemon=True).start()
+    return w
+
+
+# -- scenario 1: degrade the store mid-wave, then recover ---------------------
+
+
+def test_degrade_store_mid_wave_then_recover_drains_buffer():
+    """Acceptance scenario. A wave's bulk bind hits a degraded store
+    (refused before anything applied). The wave is NOT failed: every
+    placement parks in the pending-bind buffer, the breaker pauses
+    dispatch, the partial-disruption threshold halts evictions while
+    kubelet heartbeats 503 — and within 5 s of writes reopening the
+    buffer drains and placing resumes. Zero acked-bind loss, zero
+    double-binds, zero evictions."""
+    store = ChaosStore()
+    pool = NodeAgentPool(
+        store, heartbeat_interval=0.2, housekeeping_interval=0.1
+    )
+    for i in range(8):
+        pool.add_node(f"node-{i}")
+    nlc = NodeLifecycleController(
+        store,
+        node_monitor_period=0.1,
+        node_monitor_grace_period=0.8,
+        pod_eviction_timeout=0.3,
+    )
+    n = 60
+    for i in range(n):
+        store.create("pods", make_pod(f"wave-{i}"))
+    deletions = []
+    w = _watch_deletions(store, deletions)
+    store.fail_next_bind = "degraded"
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    nlc.start()
+    try:
+        # the first bind wave trips the breaker; nothing applied
+        assert wait_until(
+            lambda: metrics.gauge("scheduler_bind_breaker_state") == 1.0, 15
+        ), "breaker never opened on the degraded bind"
+        assert _bound_count(store) == 0
+        assert metrics.gauge("scheduler_pending_binds") >= 1
+        # leases go stale while the store is read-only: the lifecycle
+        # controller must read that as a control-plane outage and halt
+        assert wait_until(
+            lambda: metrics.gauge(GAUGE_PARTIAL_DISRUPTION) == 1.0, 15
+        ), "partial-disruption mode never armed during the outage"
+        assert _bound_count(store) == 0, "read-only store accepted a bind"
+        store.recover()
+        t0 = time.monotonic()
+        assert wait_until(lambda: _bound_count(store) == n, 15), (
+            f"only {_bound_count(store)}/{n} bound after recovery"
+        )
+        assert wait_until(
+            lambda: metrics.gauge("scheduler_pending_binds") == 0.0
+            and metrics.gauge("scheduler_bind_breaker_state") == 0.0,
+            5,
+        ), "pending-bind buffer never drained / breaker never closed"
+        elapsed = time.monotonic() - t0
+        assert elapsed <= 5.0, (
+            f"resume-placing budget blown: {elapsed:.1f}s > 5s after reopen"
+        )
+        print(
+            f"\n[chaos] degrade-mid-wave: {n} pods drained+bound "
+            f"{elapsed:.2f}s after writes reopened",
+            flush=True,
+        )
+        assert not deletions, (
+            f"control-plane-only outage must evict nothing: {deletions}"
+        )
+        assert_bind_invariants(store)
+        # the fleet recovers: taints lifted once heartbeats resume
+        assert wait_until(
+            lambda: all(
+                not nd.spec.taints for nd in store.list("nodes")[0]
+            ),
+            15,
+        ), "stale taints after recovery"
+    finally:
+        nlc.stop()
+        sched.stop()
+        pool.stop()
+        w.stop()
+
+
+# -- scenario 2: quorum lost mid-bind (applied, unacked) ----------------------
+
+
+def test_quorum_lost_mid_bind_reconciles_without_double_bind():
+    """The unknown-outcome path: the wave's binds APPLY locally but the
+    quorum ack is lost. The scheduler buffers them, reads each pod back
+    on recovery, detects the landed binds, and never replays them —
+    every pod bound exactly once."""
+    store = ChaosStore()
+    pool = NodeAgentPool(store, housekeeping_interval=0.1)
+    for i in range(6):
+        pool.add_node(f"node-{i}")
+    n = 40
+    for i in range(n):
+        store.create("pods", make_pod(f"ql-{i}"))
+    store.fail_next_bind = "quorum_lost"
+    landed0 = metrics.counter(
+        "scheduler_bind_reconcile_total", {"outcome": "landed"}
+    )
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    try:
+        assert wait_until(
+            lambda: metrics.gauge("scheduler_bind_breaker_state") == 1.0, 15
+        )
+        applied = sum(store.applied_binds.values())
+        assert applied >= 1, "chaos hook never saw an applied bind"
+        time.sleep(0.4)
+        store.recover()
+        assert wait_until(lambda: _bound_count(store) == n, 15), (
+            f"only {_bound_count(store)}/{n} bound after recovery"
+        )
+        assert wait_until(
+            lambda: metrics.gauge("scheduler_pending_binds") == 0.0, 5
+        )
+        # the reconciler confirmed the applied binds from read-back
+        landed = metrics.counter(
+            "scheduler_bind_reconcile_total", {"outcome": "landed"}
+        )
+        assert landed - landed0 >= 1, "no buffered bind was confirmed landed"
+        assert all(c == 1 for c in store.applied_binds.values()), (
+            f"double-applied binds: "
+            f"{ {u: c for u, c in store.applied_binds.items() if c > 1} }"
+        )
+        assert_bind_invariants(store)
+    finally:
+        sched.stop()
+        pool.stop()
+
+
+# -- scenario 3: eviction storm halted, then rate-limited drain ---------------
+
+
+def test_eviction_storm_halts_then_drains_rate_limited():
+    """>55% of lease-managed nodes going dark in one pass is a
+    control-plane-outage signature: evictions halt. When most of the
+    fleet comes back, the genuinely dead minority drains through the
+    rate limiter and their pods are evicted."""
+    store = ChaosStore()
+    pool = NodeAgentPool(
+        store, heartbeat_interval=0.1, housekeeping_interval=0.1
+    )
+    names = [f"sn-{i}" for i in range(10)]
+    for nm in names:
+        pool.add_node(nm)
+    nlc = NodeLifecycleController(
+        store,
+        node_monitor_period=0.05,
+        node_monitor_grace_period=0.5,
+        # eviction timeout comfortably past the grace period so the
+        # partial-disruption threshold always arms BEFORE any node of the
+        # simultaneously-dying majority reaches eviction eligibility
+        pod_eviction_timeout=0.4,
+        eviction_limiter_qps=50.0,
+    )
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    nlc.start()
+    try:
+        for i in range(20):
+            store.create("pods", make_pod(f"victim-{i}"))
+        assert wait_until(lambda: _bound_count(store) == 20, 30)
+        ev0 = metrics.counter("node_lifecycle_evictions_total")
+        # 7 of 10 kubelets die at once (their node objects stay)
+        dead = names[:7]
+        for nm in dead:
+            pool.remove_node(nm)
+        assert wait_until(
+            lambda: metrics.gauge(GAUGE_PARTIAL_DISRUPTION) == 1.0, 10
+        ), "mass unhealthiness never armed partial-disruption mode"
+        time.sleep(1.0)  # well past grace + eviction timeout
+        assert metrics.counter("node_lifecycle_evictions_total") == ev0, (
+            "evictions ran during the halted (partial-disruption) window"
+        )
+        # 5 of the 7 come back: fraction drops to 2/10 — the halt lifts
+        # and ONLY the genuinely dead pair drains (rate-limited)
+        for nm in dead[:5]:
+            pool.add_node(nm, register=False)
+        still_dead = set(dead[5:])
+        assert wait_until(
+            lambda: store.count(
+                "pods", lambda p: p.spec.node_name in still_dead
+            )
+            == 0,
+            20,
+        ), "pods on genuinely dead nodes were never evicted"
+        assert metrics.counter("node_lifecycle_evictions_total") > ev0
+        # the replaced kubelets' nodes recover (no lingering taints)
+        assert wait_until(
+            lambda: all(
+                not nd.spec.taints
+                for nd in store.list("nodes")[0]
+                if nd.metadata.name not in still_dead
+            ),
+            15,
+        )
+    finally:
+        nlc.stop()
+        sched.stop()
+        pool.stop()
+
+
+# -- scenario 4: kill a kubelet mid-bind; everything reschedules --------------
+
+
+def test_kill_kubelet_mid_bind_reschedules_everything():
+    """One node dies with binds in flight. The lifecycle controller
+    (rate-limited, below the disruption threshold) evicts its pods and
+    the workload controller replaces them on survivors — every pod from
+    the dead node reschedules, and no acked bind is lost on the
+    survivors."""
+    from kubernetes_tpu.controller.replicaset import ReplicaSetController
+
+    store = ChaosStore()
+    pool = NodeAgentPool(
+        store, heartbeat_interval=0.1, housekeeping_interval=0.1
+    )
+    names = [f"kn-{i}" for i in range(4)]
+    for nm in names:
+        pool.add_node(nm)
+    nlc = NodeLifecycleController(
+        store,
+        node_monitor_period=0.1,
+        node_monitor_grace_period=0.8,
+        pod_eviction_timeout=0.3,
+        eviction_limiter_qps=50.0,
+    )
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    rs = ReplicaSetController(store)
+    pool.start()
+    sched.start()
+    rs.start()
+    nlc.start()
+    try:
+        store.create(
+            "replicasets",
+            v1.ReplicaSet(
+                metadata=v1.ObjectMeta(name="web"),
+                spec=v1.ReplicaSetSpec(
+                    replicas=10,
+                    selector={"app": "web"},
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "web"}),
+                        spec=v1.PodSpec(
+                            containers=[v1.Container(requests={"cpu": "100m"})]
+                        ),
+                    ),
+                ),
+            ),
+        )
+        # kill mid-burst: some replicas bound, some still binding
+        assert wait_until(lambda: _bound_count(store) >= 3, 30)
+        pool.remove_node("kn-0")
+
+        def converged():
+            pods, _ = store.list("pods")
+            live = [
+                p
+                for p in pods
+                if p.metadata.labels.get("app") == "web"
+                and p.metadata.deletion_timestamp is None
+                and p.spec.node_name
+                and p.spec.node_name != "kn-0"
+            ]
+            return len(live) >= 10
+
+        assert wait_until(converged, 90), (
+            "replicas never re-landed on surviving nodes"
+        )
+        # the dead node's pods were evicted, not stranded
+        assert wait_until(
+            lambda: store.count(
+                "pods",
+                lambda p: p.spec.node_name == "kn-0"
+                and p.metadata.deletion_timestamp is None,
+            )
+            == 0,
+            30,
+        )
+        assert_bind_invariants(store, allow_deleted=True)
+    finally:
+        nlc.stop()
+        rs.stop()
+        sched.stop()
+        pool.stop()
+
+
+# -- scenario 5: kubelet heartbeat rides through transient 503s ---------------
+
+
+def test_kubelet_heartbeat_retries_transient_503():
+    class FlakyLeaseStore(APIServer):
+        def __init__(self):
+            super().__init__()
+            self.fail_renewals = 0
+
+        def guaranteed_update(self, kind, namespace, name, mutate):
+            if kind == "leases" and self.fail_renewals > 0:
+                self.fail_renewals -= 1
+                raise DegradedWrites("chaos: transient 503")
+            return super().guaranteed_update(kind, namespace, name, mutate)
+
+    store = FlakyLeaseStore()
+    pool = NodeAgentPool(store)
+    kl = pool.add_node("hb-node")
+    t = store.get("leases", NODE_LEASE_NS, "hb-node").renew_time + 5.0
+    store.fail_renewals = 2
+    r0 = metrics.counter("kubelet_heartbeat_retries_total")
+    kl.heartbeat(now=t)
+    assert store.get("leases", NODE_LEASE_NS, "hb-node").renew_time == t, (
+        "renewal dropped despite transient 503s"
+    )
+    assert metrics.counter("kubelet_heartbeat_retries_total") - r0 == 2
+
+
+def test_kubelet_heartbeat_fast_drops_during_persistent_outage():
+    """A persistent outage (write gate reports degraded) must not stall
+    the shared heartbeat loop in retry sleeps: the renewal drops fast
+    and the NEXT beat retries."""
+    store = ChaosStore()
+    pool = NodeAgentPool(store)
+    kl = pool.add_node("hb2-node")
+    before = store.get("leases", NODE_LEASE_NS, "hb2-node").renew_time
+    store.degrade()
+    d0 = metrics.counter("kubelet_heartbeat_renewals_dropped_total")
+    t0 = time.monotonic()
+    kl.heartbeat(now=before + 5.0)
+    assert time.monotonic() - t0 < 0.2, "heartbeat stalled in retries"
+    assert metrics.counter("kubelet_heartbeat_renewals_dropped_total") > d0
+    assert store.get("leases", NODE_LEASE_NS, "hb2-node").renew_time == before
+    store.recover()
+    kl.heartbeat(now=before + 6.0)
+    assert (
+        store.get("leases", NODE_LEASE_NS, "hb2-node").renew_time
+        == before + 6.0
+    )
+
+
+# -- scenario 6: informer watch flap / Expired relist -------------------------
+
+
+def test_informer_expired_relist_backoff_grows_and_resets():
+    """Consecutive Expired (410) watch attempts grow the relist backoff;
+    the first event through a recovered watch resets it to the floor."""
+
+    class ExpiringStore(APIServer):
+        def __init__(self):
+            super().__init__()
+            self.expired_budget = 0
+
+        def watch(self, kind, from_version=0):
+            if self.expired_budget > 0:
+                self.expired_budget -= 1
+                raise Expired("chaos: resourceVersion too old")
+            return super().watch(kind, from_version)
+
+    store = ExpiringStore()
+    store.create("pods", make_pod("seed"))
+    inf = SharedInformer(store, "pods")
+    seen = []
+    inf.add_handler(on_add=lambda p: seen.append(p.metadata.name))
+    e0 = metrics.counter(
+        "informer_relists_total", {"kind": "pods", "reason": "expired"}
+    )
+    store.expired_budget = 3
+    inf.start()
+    try:
+        assert wait_until(lambda: inf._watcher is not None, 10), (
+            "informer never established a watch through the Expired storm"
+        )
+        assert (
+            metrics.counter(
+                "informer_relists_total", {"kind": "pods", "reason": "expired"}
+            )
+            - e0
+            == 3
+        )
+        assert inf._relist_backoff > RELIST_BACKOFF_INITIAL, (
+            "backoff did not grow across consecutive Expired relists"
+        )
+        # reset-on-success: a delivered event proves the stream is healthy
+        store.create("pods", make_pod("after-recovery"))
+        assert wait_until(lambda: "after-recovery" in seen, 5)
+        assert inf._relist_backoff == RELIST_BACKOFF_INITIAL
+    finally:
+        inf.stop()
+
+
+def test_informer_watch_flap_relists_and_recovers():
+    """A watch stream dying WITHOUT stop() (connection flap) re-enters
+    the ListAndWatch loop: relist with Replace semantics, re-watch, and
+    keep delivering — nothing created during the gap is missed."""
+    store = APIServer()
+    store.create("pods", make_pod("a"))
+    inf = SharedInformer(store, "pods")
+    seen = []
+    inf.add_handler(on_add=lambda p: seen.append(p.metadata.name))
+    inf.start()
+    try:
+        assert wait_until(lambda: inf.has_synced(), 5)
+        store.create("pods", make_pod("b"))
+        assert wait_until(lambda: "b" in seen, 5)
+        c0 = metrics.counter(
+            "informer_relists_total",
+            {"kind": "pods", "reason": "watch-closed"},
+        )
+        flapped = inf._watcher
+        flapped.stop()  # the stream dies under the informer
+        assert wait_until(
+            lambda: metrics.counter(
+                "informer_relists_total",
+                {"kind": "pods", "reason": "watch-closed"},
+            )
+            > c0
+            and inf._watcher is not None
+            and inf._watcher is not flapped,
+            10,
+        ), "informer never relisted after the watch flap"
+        store.create("pods", make_pod("c"))
+        assert wait_until(lambda: "c" in seen, 5), (
+            "event after the flap never delivered"
+        )
+        assert inf.get("default/c") is not None
+    finally:
+        inf.stop()
+
+
+# -- soak: repeated degrade/recover cycles (slow tier) ------------------------
+
+
+@pytest.mark.slow
+def test_soak_degrade_recover_cycles_no_loss_no_double_bind():
+    """Alternating Degraded / QuorumLost outages across several burst
+    waves: after every recovery the invariants hold and the cluster
+    fully converges."""
+    store = ChaosStore()
+    pool = NodeAgentPool(store, housekeeping_interval=0.1)
+    for i in range(8):
+        pool.add_node(f"soak-{i}")
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    pool.start()
+    sched.start()
+    total = 0
+    try:
+        for cycle in range(4):
+            store.fail_next_bind = (
+                "quorum_lost" if cycle % 2 else "degraded"
+            )
+            for i in range(50):
+                store.create("pods", make_pod(f"soak-c{cycle}-{i}"))
+            total += 50
+            assert wait_until(
+                lambda: metrics.gauge("scheduler_bind_breaker_state") == 1.0,
+                15,
+            ), f"cycle {cycle}: breaker never opened"
+            time.sleep(0.3)
+            store.recover()
+            assert wait_until(
+                lambda: _bound_count(store) == total, 20
+            ), f"cycle {cycle}: {_bound_count(store)}/{total} bound"
+            assert wait_until(
+                lambda: metrics.gauge("scheduler_pending_binds") == 0.0, 5
+            )
+            assert_bind_invariants(store)
+    finally:
+        sched.stop()
+        pool.stop()
