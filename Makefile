@@ -7,19 +7,30 @@
 #   make verify           test + dryrun (the pre-commit gate)
 #   make chaos            kill-primary + partition suites (slow soaks
 #                         included) + the acked-write-loss checker selftest
+#   make chaos-device     data-plane chaos only: snapshot corruption,
+#                         poisoned kernel outputs, device-loss ride-through
+#   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 
 PY ?= python
 
-.PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos
+.PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
+	chaos-device lint-slow
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
 chaos:
-	$(PY) -m pytest tests/test_consensus.py tests/test_replication_quorum.py \
+	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_consensus.py \
+		tests/test_replication_quorum.py \
 		tests/test_replication.py tests/test_chaos.py \
-		tests/test_chaos_pipeline.py -q
+		tests/test_chaos_pipeline.py tests/test_chaos_device.py -q
 	$(PY) scripts/consistency_check.py --selftest
+
+chaos-device:
+	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_device.py -q
+
+lint-slow:
+	$(PY) scripts/check_slow_markers.py
 
 bench:
 	$(PY) bench.py

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time
 from dataclasses import dataclass, replace
 from typing import Any, Dict, FrozenSet, List, NamedTuple, Optional, Tuple
@@ -370,7 +371,18 @@ class SnapshotEncoder:
         self._pods: Dict[int, Dict[str, _PodEntry]] = {}  # row -> pod-key -> entry
 
         self._alloc_masters()
+        # serializes every device entry point that can DONATE the snapshot
+        # buffers (flush's scatter, the wave launch) against concurrent
+        # readers (the anti-entropy audit's row gather): a donation racing
+        # a read deadlocks the CPU client and poisons every later jax call
+        # in the process. LEAF lock — never acquire any other lock while
+        # holding it (the cache lock, when needed, is taken FIRST).
+        self.device_lock = threading.RLock()
         self._dirty_rows: set = set()
+        # rows a failure path could not keep host/device convergent on
+        # (e.g. a mid-wave encoder exception after the kernel committed):
+        # the anti-entropy auditor audits these FIRST, every pass
+        self.suspect_rows: set = set()
         self._full_upload = True
         # device CONTENT unknowable (readback failure, kernel exception,
         # resharding): forces a true full re-upload. _full_upload alone now
@@ -899,6 +911,165 @@ class SnapshotEncoder:
             out[i] = pred.matches(namespace, labels)
         return out
 
+    # -- anti-entropy hooks (scheduler/antientropy.py) -----------------------
+    #
+    # The pod-aggregate columns are maintained INCREMENTALLY (add/remove
+    # deltas), which is exactly where a drift bug or a half-applied update
+    # accumulates silently. These hooks let the auditor re-derive a row's
+    # expected aggregates from the per-pod entries (the host source of
+    # truth) and repair the masters and/or the device row in place.
+
+    # row-major pod-aggregate fields re-derivable from _PodEntry records
+    AGGREGATE_FIELDS = (
+        "requested", "nonzero_req", "prio_req", "sel_counts", "eterm_w",
+        "port_counts",
+    )
+    # every row-major (per-node) DeviceSnapshot field, for device-vs-master
+    # audits; globals (band_prio, eterm metadata) are compared wholesale
+    ROW_FIELDS = tuple(
+        f for f in DeviceSnapshot._fields
+        if f not in ("eterm_topo_key", "eterm_kind", "band_prio")
+    )
+
+    def _master_of(self, field: str) -> np.ndarray:
+        return {
+            "valid": self.m_valid,
+            "unschedulable": self.m_unsched,
+            "allocatable": self.m_alloc,
+            "requested": self.m_req,
+            "nonzero_req": self.m_nonzero,
+            "label_vals": self.m_label_vals,
+            "label_numvals": self.m_label_num,
+            "taint_key": self.m_taint_key,
+            "taint_val": self.m_taint_val,
+            "taint_effect": self.m_taint_eff,
+            "sel_counts": self.m_sel_counts,
+            "eterm_w": self.m_eterm_w,
+            "port_counts": self.m_port_counts,
+            "image_bytes": self.m_image_bytes,
+            "avoid": self.m_avoid,
+            "prio_req": self.m_prio_req,
+        }[field]
+
+    def expected_row_aggregates(self, row: int) -> Dict[str, np.ndarray]:
+        """Re-encode the pod-aggregate columns of one row from its
+        _PodEntry records — what the masters MUST say if every
+        incremental add/remove landed exactly once."""
+        c = self.cfg
+        req = np.zeros(c.r_cap, np.int32)
+        nz = np.zeros(c.r_cap, np.int32)
+        prio = np.zeros((c.pb_cap, c.r_cap), np.int32)
+        sel = np.zeros(c.s_cap, np.int32)
+        et = np.zeros(c.t_cap, np.float32)
+        ports = np.zeros(c.pv_cap, np.int32)
+        for e in self._pods.get(row, {}).values():
+            req[: len(e.req)] += e.req
+            nz[: len(e.nonzero)] += e.nonzero
+            prio[e.prio_band, : len(e.req)] += e.req
+            mv = e.match_vec
+            sel[: len(mv)] += mv.astype(np.int32)
+            # predicates interned after this pod was added were back-filled
+            # by intern_predicate's scan (same rule remove_pod applies)
+            for sid in range(e.match_cache_len, len(self.sel_vocab)):
+                if self.sel_vocab.items[sid].matches(e.namespace, e.labels):
+                    sel[sid] += 1
+            for tid, w in zip(e.eterm_ids, e.eterm_ws):
+                et[tid] += w
+            for pid in e.port_ids:
+                ports[pid] += 1
+        return {
+            "requested": req,
+            "nonzero_req": nz,
+            "prio_req": prio,
+            "sel_counts": sel,
+            "eterm_w": et,
+            "port_counts": ports,
+        }
+
+    def verify_row_aggregates(self, row: int, repair: bool = False) -> List[str]:
+        """Column names whose master row diverges from the entry-derived
+        expectation; repair=True rewrites the masters and marks the row
+        dirty so the next flush re-scatters it to the device."""
+        expected = self.expected_row_aggregates(row)
+        bad: List[str] = []
+        for field, want in expected.items():
+            m = self._master_of(field)
+            if not np.array_equal(m[row], want):
+                bad.append(field)
+                if repair:
+                    m[row] = want
+        if bad and repair:
+            self._dirty_rows.add(row)
+            self.generation += 1
+        return bad
+
+    def drop_pod_entry(self, node_name: str, pod_key: str) -> bool:
+        """Remove a pod's entry WITHOUT subtracting its aggregates — for
+        unwinding a half-applied add_pod whose master increments may be
+        partial (subtracting would double the damage). The caller must
+        follow with repair_row()."""
+        row = self._row_by_name.get(node_name)
+        if row is None:
+            return False
+        return self._pods.get(row, {}).pop(pod_key, None) is not None
+
+    def repair_row(self, node_name: str) -> List[str]:
+        """Rebuild one row's aggregate masters from its entries, mark it
+        dirty (next flush overwrites the device row), and flag it suspect
+        for the anti-entropy auditor's next pass. Returns the repaired
+        column names."""
+        row = self._row_by_name.get(node_name)
+        if row is None:
+            return []
+        bad = self.verify_row_aggregates(row, repair=True)
+        # even when the masters were consistent, the DEVICE row may hold
+        # occupancy the masters never saw (kernel-committed, replay
+        # failed): force the re-scatter regardless
+        self._dirty_rows.add(row)
+        self.suspect_rows.add(row)
+        return bad
+
+    def fetch_device_rows(self, rows: List[int]) -> Optional[Dict[str, np.ndarray]]:
+        """Gather the sampled rows of every row-major device field to host
+        in ONE transfer (the audit's read side). None when no device
+        snapshot exists yet.
+
+        The gather index is padded to the scatter program sizes (16/1024,
+        chunking larger sets): a distinct XLA program per sample size
+        would compile on nearly every audit pass (the round-robin window
+        tail and the suspect set both vary), each compile seconds of
+        cache-lock hold."""
+        if self._device is None or not rows:
+            return None
+        out: Dict[str, np.ndarray] = {}
+        with self.device_lock:
+            # barrier before reading: the snapshot may be the output of a
+            # donation-bearing scatter still in flight, and a gather
+            # dispatched against those aliased buffers can read rows the
+            # scatter hasn't written yet (observed with persistent-cache
+            # deserialized executables on CPU: the audit's confirm fetch
+            # saw pre-repair values and escalated to a spurious rebuild)
+            jax.block_until_ready(self._device)
+            for i in range(0, len(rows), _SCATTER_PAD_BIG):
+                chunk = rows[i : i + _SCATTER_PAD_BIG]
+                pad = (
+                    _SCATTER_PAD_SMALL
+                    if len(chunk) <= _SCATTER_PAD_SMALL
+                    else _SCATTER_PAD_BIG
+                )
+                # pad rows repeat row 0 (cheap, in range); sliced off below
+                idx = np.zeros(pad, np.int32)
+                idx[: len(chunk)] = chunk
+                gathered = jax.device_get(_gather_rows(self._device, idx))
+                for name, arr in gathered.items():
+                    arr = np.asarray(arr)[: len(chunk)]
+                    out[name] = (
+                        arr
+                        if name not in out
+                        else np.concatenate([out[name], arr])
+                    )
+        return out
+
     # -- device sync ---------------------------------------------------------
 
     def _masters(self) -> DeviceSnapshot:
@@ -924,7 +1095,7 @@ class SnapshotEncoder:
             band_prio=self.m_band_prio,
         )
 
-    def flush(self) -> DeviceSnapshot:
+    def flush(self, donate: bool = True) -> DeviceSnapshot:
         """Return the device snapshot, applying pending row deltas.
 
         Dirty-row scatter indices are padded to the next power of FOUR so
@@ -935,11 +1106,16 @@ class SnapshotEncoder:
         path, SURVEY.md §5 failure recovery: device memory is a rebuildable
         cache). Global (non-row) fields changed without any dirty row
         (band allocation, eterm interning) refresh via a row-less scatter.
+
+        `donate=False` routes row scatters through the alias-free variant
+        (`_scatter_rows_safe`) — the anti-entropy audit uses it so a repair
+        can never be corrupted by the in-place update path it is auditing.
         """
         t0 = time.monotonic()
         self._flush_what = None
         try:
-            return self._flush_inner()
+            with self.device_lock:
+                return self._flush_inner(donate=donate)
         finally:
             dt = time.monotonic() - t0
             if dt > 0.2:
@@ -947,7 +1123,7 @@ class SnapshotEncoder:
                     "slow flush %.0f ms: %s", dt * 1e3, self._flush_what
                 )
 
-    def _flush_inner(self) -> DeviceSnapshot:
+    def _flush_inner(self, donate: bool = True) -> DeviceSnapshot:
         masters = self._masters()
         if self._device is None or self._content_invalid:
             self._flush_what = "full upload (first use or content invalid)"
@@ -1011,11 +1187,15 @@ class SnapshotEncoder:
             first = False
             chunk = rows[i : i + _SCATTER_PAD_BIG]
             i += _SCATTER_PAD_BIG
-            self._scatter_chunk(masters, chunk)
+            self._scatter_chunk(masters, chunk, donate=donate)
         return self._device
 
     def _scatter_chunk(
-        self, masters: DeviceSnapshot, rows: list, pad: Optional[int] = None
+        self,
+        masters: DeviceSnapshot,
+        rows: list,
+        pad: Optional[int] = None,
+        donate: bool = True,
     ) -> None:
         if pad is None:
             pad = (
@@ -1045,17 +1225,29 @@ class SnapshotEncoder:
             idx_d, updates_d = jax.device_put((idx, updates), sh)
         else:
             idx_d, updates_d = jax.device_put((idx, updates))
-        self._device = _scatter_rows(self._device, idx_d, updates_d)
+        scatter = _scatter_rows if donate else _scatter_rows_safe
+        self._device = scatter(self._device, idx_d, updates_d)
 
     def warm_scatter_programs(self) -> None:
-        """Compile both scatter pad variants out-of-window (no-op scatters:
-        all indices OOB-dropped). Call at component start, after the
-        snapshot exists — 2 compiles at bring-up instead of mid-burst."""
+        """Compile the scatter pad variants out-of-window (no-op scatters:
+        all indices OOB-dropped), donating AND alias-free, plus the two
+        padded audit gather programs — 6 compiles at bring-up instead of
+        mid-burst (or mid-audit under the cache lock: the first audit
+        pass would otherwise pay the gather compiles while holding it).
+        Call at component start, after the snapshot exists."""
         if self._device is None:
             self.flush()
-        masters = self._masters()
-        self._scatter_chunk(masters, [], pad=_SCATTER_PAD_SMALL)
-        self._scatter_chunk(masters, [], pad=_SCATTER_PAD_BIG)
+        with self.device_lock:
+            masters = self._masters()
+            for donate in (True, False):
+                self._scatter_chunk(
+                    masters, [], pad=_SCATTER_PAD_SMALL, donate=donate
+                )
+                self._scatter_chunk(
+                    masters, [], pad=_SCATTER_PAD_BIG, donate=donate
+                )
+            for pad in (_SCATTER_PAD_SMALL, _SCATTER_PAD_BIG):
+                _gather_rows(self._device, np.zeros(pad, np.int32))
 
     def set_sharding(self, snap_shardings, replicated_sharding) -> None:
         """Adopt multi-chip placement (parallel/mesh.snapshot_shardings):
@@ -1116,8 +1308,20 @@ _SCATTER_PAD_SMALL = 16
 _SCATTER_PAD_BIG = 1024
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows(snap: DeviceSnapshot, idx, updates: DeviceSnapshot) -> DeviceSnapshot:
+@jax.jit
+def _gather_rows(snap: DeviceSnapshot, idx) -> dict:
+    """Row gather of every row-major field (the anti-entropy audit's read
+    side). idx is padded to one of the two scatter program sizes, so at
+    most two gather programs ever compile."""
+    return {
+        name: jnp.take(getattr(snap, name), idx, axis=0)
+        for name in SnapshotEncoder.ROW_FIELDS
+    }
+
+
+def _scatter_rows_impl(
+    snap: DeviceSnapshot, idx, updates: DeviceSnapshot
+) -> DeviceSnapshot:
     out = {}
     for name in DeviceSnapshot._fields:
         dst = getattr(snap, name)
@@ -1127,3 +1331,16 @@ def _scatter_rows(snap: DeviceSnapshot, idx, updates: DeviceSnapshot) -> DeviceS
         else:
             out[name] = dst.at[idx].set(src, mode="drop")
     return DeviceSnapshot(**out)
+
+
+# hot path: donation lets XLA update the snapshot in place (no O(snapshot)
+# copy per flush — the wave cadence depends on it)
+_scatter_rows = functools.partial(jax.jit, donate_argnums=(0,))(_scatter_rows_impl)
+
+# repair path: NO donation. The anti-entropy auditor's settle/repair
+# scatters go through this variant: a donating executable deserialized
+# from a persistent compilation cache (JAX_COMPILATION_CACHE_DIR) has been
+# observed writing garbage into non-targeted rows on the CPU backend —
+# the repairer must not be able to corrupt the very state it is fixing,
+# so it pays the copy and gets fresh, alias-free output buffers.
+_scatter_rows_safe = jax.jit(_scatter_rows_impl)

@@ -559,3 +559,39 @@ def _preempt_whatif(
 
 
 preempt_whatif = jax.jit(_preempt_whatif)
+
+
+# -- kernel-output guards (scheduler data-plane self-defense) ----------------
+
+GUARD_ROW_RANGE = "row_out_of_range"
+GUARD_NONFINITE = "nonfinite_score"
+
+
+class KernelGuardTrip(RuntimeError):
+    """A batch's read-back results failed validation: the whole batch must
+    be quarantined to the host fallback path and the device snapshot
+    rebuilt (its commits for this batch are suspect)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"kernel guard trip: {reason} {detail}".rstrip())
+        self.reason = reason
+
+
+def validate_batch_outputs(chosen, placed, score, n_rows: int):
+    """Cheap structural validation of a read-back batch result BEFORE any
+    placement is acted on: every placed pod's chosen row must name a live
+    node row (negative or past-capacity indices would mis-index
+    row_names — numpy's negative wrap silently picks the WRONG node), and
+    its score must be finite (a NaN/Inf in the score matrix poisons the
+    argmax for the whole column). Returns a trip reason or None."""
+    placed = np.asarray(placed, dtype=bool)
+    if not placed.any():
+        return None
+    rows = np.asarray(chosen)[placed]
+    if rows.size and (int(rows.min()) < 0 or int(rows.max()) >= n_rows):
+        return GUARD_ROW_RANGE
+    if score is not None:
+        s = np.asarray(score)[placed]
+        if not np.isfinite(s).all():
+            return GUARD_NONFINITE
+    return None

@@ -35,6 +35,55 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _default_probe(device) -> bool:
+    """One tiny put/get round-trip: the cheapest 'is this chip alive'
+    signal that exercises both transfer directions."""
+    import numpy as np
+
+    x = jax.device_put(np.zeros(1, np.float32), device)
+    return jax.device_get(x).shape == (1,)
+
+
+def surviving_devices(devices: Sequence, probe=None) -> list:
+    """Probe each device and return the ones that still respond — the
+    device-loss ride-through's mesh-shrink input. `probe` is injectable
+    so chaos tests can declare deaths deterministically."""
+    probe = probe or _default_probe
+    out = []
+    for d in devices:
+        try:
+            if probe(d):
+                out.append(d)
+        except Exception:  # noqa: BLE001 — a dead device throws anything
+            continue
+    return out
+
+
+def largest_pow2_prefix(devices: Sequence) -> list:
+    """The usable shrink target: snapshot row counts are power-of-two
+    padded, so the node axis only divides evenly over a power-of-two
+    device count. 5 survivors → a 4-device mesh; 0 survivors → []."""
+    n = len(devices)
+    if n == 0:
+        return []
+    k = 1
+    while k * 2 <= n:
+        k *= 2
+    return list(devices[:k])
+
+
+def single_device_shardings(device) -> tuple:
+    """Pin every snapshot field (and the replicated update scatters) to ONE
+    specific device: the shrink-to-one-survivor target. `set_sharding(None,
+    None)` would fall back to the JAX default device — which after a device
+    loss may be exactly the dead chip."""
+    from jax.sharding import SingleDeviceSharding
+
+    one = SingleDeviceSharding(device)
+    snap = DeviceSnapshot(**{f: one for f in DeviceSnapshot._fields})
+    return snap, one
+
+
 def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
     """Sharding pytree for DeviceSnapshot: row-major arrays shard on the node
     axis; [T]-shaped eterm metadata replicates."""

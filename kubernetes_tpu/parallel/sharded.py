@@ -16,7 +16,9 @@ jax.distributed.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import random
+import time
+from typing import Callable, Optional
 
 import jax
 import numpy as np
@@ -27,6 +29,79 @@ from ..ops.lattice import BatchResult, make_schedule_batch_raw
 from ..ops.templates import PairTable, TemplateBatch
 from ..ops.wavelattice import WaveResult, make_wave_kernel
 from .mesh import NODES_AXIS, replicated, snapshot_shardings
+
+
+# -- device-loss classification + bounded retry ------------------------------
+
+
+class DeviceLossError(RuntimeError):
+    """Raised (or re-classified) when a kernel launch/readback failed
+    because the device itself is gone or unreachable — as opposed to a
+    program bug. The fault injector (testing/device_faults.py) raises this
+    directly; real XLA surfaces jaxlib.XlaRuntimeError, matched below."""
+
+
+# substrings (lowercased) that mark an XLA runtime error as device loss
+# rather than a program error; deliberately conservative — a false
+# negative costs a wave (requeued, zero pod loss), a false positive would
+# retry/reshard on a genuine kernel bug and mask it
+_DEVICE_LOSS_MARKERS = (
+    "device unavailable",
+    "device is unavailable",
+    "device lost",
+    "device not found",
+    "unable to reach device",
+    "failed to connect",
+    "connection reset",
+    "socket closed",
+    "deadline exceeded",
+    "data transfer failed",
+    "halted",
+    "unavailable:",
+)
+
+
+def is_device_loss_error(exc: BaseException) -> bool:
+    if isinstance(exc, DeviceLossError):
+        return True
+    if type(exc).__name__ != "XlaRuntimeError" and not isinstance(
+        exc, RuntimeError
+    ):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+def device_retry_delay(attempts: int, base_delay_s: float = 0.05) -> float:
+    """Jittered exponential backoff for device-loss retries — ONE policy
+    shared by this helper and the scheduler's launch/serial retry loops
+    (which can't use call_with_device_retry itself: each of their retries
+    must re-encode/re-flush first)."""
+    return base_delay_s * (2 ** attempts) * (1.0 + random.uniform(-0.3, 0.3))
+
+
+def call_with_device_retry(
+    fn: Callable,
+    attempts: int,
+    base_delay_s: float = 0.05,
+    on_retry: Optional[Callable] = None,
+):
+    """Run fn(), retrying device-loss errors up to `attempts` times with
+    jittered exponential backoff (a tunnel blip heals in tens of ms; a
+    dead chip won't, and the caller's ride-through takes over). Only safe
+    for repeatable calls — a launch that DONATED its inputs must re-flush
+    before retrying and cannot use this helper."""
+    n = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classifier filters
+            if not is_device_loss_error(e) or n >= attempts:
+                raise
+            n += 1
+            if on_retry is not None:
+                on_retry(n, e)
+            time.sleep(device_retry_delay(n, base_delay_s))
 
 
 def shard_snapshot(snap: DeviceSnapshot, mesh: Mesh) -> DeviceSnapshot:

@@ -53,6 +53,11 @@ class SchedulerCache:
         self._ttl = ttl_seconds
         self.encoder = encoder or SnapshotEncoder(encoding_config)
         self._generation = 0
+        # informer-driven mutations only (node spec changes, foreign pod
+        # add/remove) — scheduler assumes don't move it. The oracle guard
+        # compares per-node ext_generation against its launch capture to
+        # tell post-launch churn from kernel corruption.
+        self._ext_generation = 0
         # name -> last handed-out clone (generation-tagged) for the
         # incremental update_snapshot below
         self._snap_clones: Dict[str, NodeInfo] = {}
@@ -71,6 +76,7 @@ class SchedulerCache:
             else:
                 ni.set_node(node)
             self._bump(ni)
+            self._bump_ext(name)
             self.encoder.add_node(node)
             # replay pods that arrived before their node did
             for pod in self._orphans.pop(name, {}).values():
@@ -110,12 +116,15 @@ class SchedulerCache:
                     return
                 # scheduled somewhere else than assumed: undo and re-add
                 self._remove_pod_internal(key, a.node_name)
+                self._bump_ext(a.node_name)
             elif key in self._pod_to_node:
                 # re-delivered add (an informer Replace relist after a
                 # watch flap replays every listed object): treat as an
                 # update — NodeInfo/encoder appends don't dedup, so a
                 # blind re-add would double-count the pod's resources
                 self._remove_pod_internal(key, self._pod_to_node[key])
+            # _add_pod_internal stamps ext_generation (device_synced
+            # defaults False) — it is the single stamping point for adds
             self._add_pod_internal(pod)
 
     def update_pod(self, pod: v1.Pod) -> None:
@@ -136,7 +145,9 @@ class SchedulerCache:
             old_node = self._pod_to_node.get(key)
             if old_node is not None:
                 self._remove_pod_internal(key, old_node)
+                self._bump_ext(old_node)
             if pod.spec.node_name:
+                # ext stamped inside _add_pod_internal
                 self._add_pod_internal(pod)
 
     def remove_pod(self, pod: v1.Pod) -> None:
@@ -146,6 +157,7 @@ class SchedulerCache:
             node = self._pod_to_node.get(key)
             if node is not None:
                 self._remove_pod_internal(key, node)
+                self._bump_ext(node)
 
     def _add_pod_internal(
         self,
@@ -164,6 +176,15 @@ class SchedulerCache:
             return
         ni.add_pod(pod)
         self._bump(ni)
+        if not device_synced:
+            # host-path assumes (and informer adds) are occupancy no
+            # in-flight device batch has seen: stamp ext_generation so
+            # the oracle guard skips the node (node_churn) instead of
+            # reading the unseen pod as kernel corruption and falsely
+            # latching the device path off. Device-synced (wave) assumes
+            # must NOT stamp — their chain saw the placement, so an
+            # oracle disagreement there stays a real signal.
+            self._bump_ext(node)
         self._pod_to_node[pod.metadata.key] = node
         self.encoder.add_pod(
             node, pod, device_synced=device_synced, prio_band=prio_band,
@@ -175,7 +196,14 @@ class SchedulerCache:
         if ni is not None:
             if ni.remove_pod(key) is not None:
                 self._bump(ni)
-                self.encoder.remove_pod(node, key)
+        # encoder removal is deliberately NOT gated on the NodeInfo still
+        # holding the pod: after a host/device divergence (a mid-wave
+        # encoder failure unwound the NodeInfo but the entry survived, or
+        # vice versa) the gated form leaked phantom device occupancy
+        # forever — cleanup_expired would revert the host NodeInfo while
+        # the encoder row kept counting the expired assume. remove_pod is
+        # a no-op when the encoder has no row/entry for the key.
+        self.encoder.remove_pod(node, key)
         orphans = self._orphans.get(node)
         if orphans is not None:
             orphans.pop(key, None)
@@ -256,6 +284,7 @@ class SchedulerCache:
                 self._assumed[key] = _AssumedInfo(assumed, node_name, None)
                 enc_items.append(
                     (
+                        i,
                         node_name,
                         assumed,
                         # same fallback as add_pod: an unpinned band is
@@ -268,7 +297,9 @@ class SchedulerCache:
                 )
             if enc_items:
                 try:
-                    self.encoder.add_pods_bulk(enc_items)
+                    self.encoder.add_pods_bulk(
+                        [item[1:] for item in enc_items]
+                    )
                 except Exception:
                     # bulk pass 1 raises BEFORE any master write, so the
                     # per-pod path can safely redo the whole wave — the
@@ -276,7 +307,7 @@ class SchedulerCache:
                     logger.exception(
                         "bulk encoder scatter failed; per-pod fallback"
                     )
-                    for node_name, assumed, band, proto in enc_items:
+                    for i, node_name, assumed, band, proto in enc_items:
                         try:
                             self.encoder.add_pod(
                                 node_name,
@@ -287,6 +318,33 @@ class SchedulerCache:
                             )
                         except KeyError:
                             pass  # node unknown to the encoder: row-less
+                        except Exception as exc:
+                            # a non-KeyError here used to propagate MID-WAVE
+                            # with NodeInfo/_assumed already committed for
+                            # every item: the raiser's host state kept the
+                            # pod while the encoder (and the device row the
+                            # kernel committed) silently diverged, and the
+                            # remaining items never assumed at all. Unwind
+                            # THIS pod's host state, surface a per-item
+                            # error (the caller requeues it), and hand the
+                            # row to the anti-entropy repairer — the device
+                            # still holds the kernel's commit for a pod the
+                            # masters no longer carry.
+                            logger.exception(
+                                "per-pod encoder replay failed for %s on %s",
+                                assumed.metadata.key,
+                                node_name,
+                            )
+                            key = assumed.metadata.key
+                            # entry first, WITHOUT subtracting: the add may
+                            # have half-applied its master increments
+                            self.encoder.drop_pod_entry(node_name, key)
+                            self._assumed.pop(key, None)
+                            self._remove_pod_internal(key, node_name)
+                            self.encoder.repair_row(node_name)
+                            errors[i] = (
+                                f"encoder replay failed for {key}: {exc}"
+                            )
         return errors
 
     def finish_binding(self, pod: v1.Pod) -> None:
@@ -341,6 +399,18 @@ class SchedulerCache:
     def _bump(self, ni: NodeInfo) -> None:
         self._generation += 1
         ni.generation = self._generation
+
+    def _bump_ext(self, node_name: Optional[str]) -> None:
+        """Stamp a mutation NO in-flight device chain has seen (informer
+        events, host-path assumes). Kept separate from _bump:
+        device-synced wave assumes move `generation` (snapshot
+        incrementality) but must NOT move `ext_generation`, or pipelined
+        sibling-batch commits would exempt their nodes from the oracle
+        guard exactly under sustained wave load."""
+        ni = self._nodes.get(node_name) if node_name else None
+        if ni is not None:
+            self._ext_generation += 1
+            ni.ext_generation = self._ext_generation
 
     def update_snapshot(self) -> Snapshot:
         """Host snapshot for oracle/fallback/preemption paths. NodeInfos are

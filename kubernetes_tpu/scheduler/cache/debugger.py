@@ -86,6 +86,12 @@ class CacheDebugger:
         if ride:
             lines.append("Dump of control-plane ride-through gauges:")
             lines.extend(ride)
+        from ..antientropy import dataplane_health_lines
+
+        plane = dataplane_health_lines()
+        if plane:
+            lines.append("Dump of data-plane self-defense state:")
+            lines.extend(plane)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------

@@ -28,6 +28,13 @@ class NodeInfo:
         self.allocatable = node.allocatable() if node else ResourceList()
         self.used_ports: Dict[Tuple[str, str, int], int] = {}
         self.generation: int = 0
+        # bumped ONLY by informer-driven mutations (node spec change,
+        # foreign pod add/remove) — scheduler assumes leave it alone. The
+        # oracle guard keys on it: kernel placements are checked against
+        # nodes whose EXTERNAL state is unchanged since launch, while
+        # sibling-batch assumes (state the device chain already saw) do
+        # not exempt a node from the check.
+        self.ext_generation: int = 0
 
     def set_node(self, node: v1.Node) -> None:
         self.node = node
@@ -95,6 +102,7 @@ class NodeInfo:
         c.allocatable = self.allocatable.copy()
         c.used_ports = dict(self.used_ports)
         c.generation = self.generation
+        c.ext_generation = self.ext_generation
         return c
 
 

@@ -130,6 +130,31 @@ class KubeSchedulerConfiguration:
     # stays warm) while the breaker pauses dispatch; beyond capacity the
     # overflow unwinds through backoff like a failed bind
     pending_bind_capacity: int = 8192
+    # --- data-plane self-defense (scheduler/antientropy.py, guards) ---------
+    # validate every read-back batch before assume: chosen rows in range,
+    # scores finite, plus the sampled host-oracle feasibility re-check
+    # below; a violation quarantines the batch to the host fallback path
+    # and forces a device snapshot rebuild (wrong placements become
+    # structurally impossible — at worst a wave runs at host speed)
+    kernel_output_guards: bool = True
+    # pods per committed wave re-checked against the host filter chain's
+    # pre-batch-sound subset (the online analogue of the differential
+    # fuzz's oracle); 0 disables the sampled oracle (range/finite checks
+    # stay on)
+    guard_sample_per_wave: int = 4
+    # snapshot anti-entropy: background auditor period (0 disables),
+    # sampled rows per pass, and the consecutive-drifting-pass count that
+    # escalates targeted re-scatter repair to a full snapshot rebuild
+    antientropy_period_s: float = 5.0
+    antientropy_sample_rows: int = 64
+    antientropy_rebuild_after: int = 3
+    # device-loss ride-through: bounded jittered retries for kernel
+    # launches/readbacks that die with a device-loss error, and the
+    # consecutive-loss count after which the device path is abandoned for
+    # the host path (a chip that passes probes but fails every kernel
+    # must not retry forever)
+    device_retry_attempts: int = 2
+    device_loss_disable_after: int = 3
 
     def validate(self) -> None:
         if self.percentage_of_nodes_to_score < 0 or self.percentage_of_nodes_to_score > 100:
@@ -149,5 +174,17 @@ class KubeSchedulerConfiguration:
             raise ValueError("pipeline_depth must be >= 1, or 0 for auto")
         if self.pending_bind_capacity < 1:
             raise ValueError("pending_bind_capacity must be >= 1")
+        if self.guard_sample_per_wave < 0:
+            raise ValueError("guard_sample_per_wave must be >= 0")
+        if self.antientropy_period_s < 0:
+            raise ValueError("antientropy_period_s must be >= 0 (0 disables)")
+        if self.antientropy_sample_rows < 1:
+            raise ValueError("antientropy_sample_rows must be >= 1")
+        if self.antientropy_rebuild_after < 1:
+            raise ValueError("antientropy_rebuild_after must be >= 1")
+        if self.device_retry_attempts < 0:
+            raise ValueError("device_retry_attempts must be >= 0")
+        if self.device_loss_disable_after < 1:
+            raise ValueError("device_loss_disable_after must be >= 1")
         if self.leader_election is not None:
             self.leader_election.validate()

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -44,6 +45,7 @@ from ..ops.encoding import ETERM_ANTI_REQ as _ETERM_ANTI_REQ
 from ..ops.templates import TemplateCache, build_pair_table
 from ..ops.wavelattice import make_wave_kernel_jit
 from ..ops.lattice import (
+    KernelGuardTrip,
     NUM_SCORE_COMPONENTS,
     SC_BALANCED,
     SC_IMAGE,
@@ -57,6 +59,12 @@ from ..ops.lattice import (
     SC_TAINT,
     SC_TOPO_SPREAD,
     make_schedule_batch,
+    validate_batch_outputs,
+)
+from ..parallel.sharded import (
+    call_with_device_retry,
+    device_retry_delay,
+    is_device_loss_error,
 )
 from ..utils.metrics import metrics
 from ..utils.trace import Trace
@@ -105,11 +113,12 @@ class _InFlightBatch:
 
     __slots__ = (
         "pis", "eb", "row_names", "res", "moves0", "trace", "t_start",
-        "snapshot",
+        "snapshot", "launch_gen",
     )
 
     def __init__(
-        self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None
+        self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None,
+        launch_gen=0,
     ):
         self.pis = pis
         self.eb = eb
@@ -122,6 +131,13 @@ class _InFlightBatch:
         # the device encoding was built from — verifying against resolve-
         # time state would report informer churn as device/host mismatches
         self.snapshot = snapshot
+        # cache EXTERNAL generation at launch: the oracle guard skips nodes
+        # whose ext_generation moved past this (informer churn after the
+        # encoding was captured is not a kernel-correctness signal).
+        # Scheduler assumes don't move ext_generation, so sibling-batch
+        # commits — state the device chain already saw — keep their nodes
+        # eligible for the check
+        self.launch_gen = launch_gen
 
 
 _SCORE_NAME_TO_COMPONENT = {
@@ -265,6 +281,14 @@ class Scheduler:
         self._ridethrough = BindRideThrough(
             capacity=self.cfg.pending_bind_capacity
         )
+        # data-plane self-defense state: the anti-entropy auditor
+        # (started in start()), the device-down latch (host-path fallback
+        # after unrecoverable device loss), and the consecutive-failure
+        # counters that decide when retrying stops being worth it
+        self._auditor = None
+        self._device_down = False
+        self._consecutive_device_loss = 0
+        self._consecutive_guard_trips = 0
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table)
@@ -336,6 +360,29 @@ class Scheduler:
                     self.cache.encoder.warm_scatter_programs()
             except Exception:
                 logger.exception("scatter warmup failed")
+        if self.cfg.use_device and self.cfg.antientropy_period_s > 0:
+            from .antientropy import SnapshotAntiEntropy
+
+            # quiescence gate: an in-flight wave batch legitimately holds
+            # device commits the masters haven't replayed yet — auditing
+            # then would "repair" the kernel's own work away. _busy is set
+            # under the queue lock BEFORE the first pod leaves the queue
+            # and cleared only after the batch fully resolves, so a
+            # lock-held re-check of these flags is race-free against the
+            # launch path (which takes the cache lock after _busy is set).
+            self._auditor = SnapshotAntiEntropy(
+                self.cache.encoder,
+                lock=self.cache.lock,
+                quiesced=lambda: (
+                    not self._pending
+                    and not self._busy
+                    and not self._device_down
+                ),
+                period_s=self.cfg.antientropy_period_s,
+                sample_rows=self.cfg.antientropy_sample_rows,
+                rebuild_after=self.cfg.antientropy_rebuild_after,
+            )
+            self._auditor.start()
         self.queue.run()
         self.cache.start_janitor()
         self._sched_thread = threading.Thread(
@@ -371,6 +418,8 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._auditor is not None:
+            self._auditor.stop()
         self.queue.close()
         self.cache.stop()
         self.informer_factory.stop()
@@ -702,10 +751,13 @@ class Scheduler:
             self._schedule_one_host(pi, moves0)
         if not known:
             return
+        # the device-down latch (unrecoverable device loss) degrades every
+        # batch to the host path — correctness over throughput
+        use_device = self.cfg.use_device and not self._device_down
         if (
             0 < len(known) <= self.cfg.small_batch_host_max
             and self.cache.node_count <= self.cfg.small_batch_host_node_max
-            and self.cfg.use_device
+            and use_device
         ):
             # low-load latency path for SMALL clusters: a tiny batch on the
             # device path pays a full cycle (kernel + >=1 readback RTT) for
@@ -721,9 +773,9 @@ class Scheduler:
                 self._schedule_one_host(pi, moves0)
             trace.log_if_long(0.1)
             return
-        if self.cfg.use_device and self.cfg.use_wave:
+        if use_device and self.cfg.use_wave:
             self._schedule_batch_wave(known, moves0, trace, t_start)
-        elif self.cfg.use_device:
+        elif use_device:
             self._resolve_pending()
             self._schedule_batch_device(known, moves0, trace, t_start)
             trace.log_if_long(0.1)
@@ -746,23 +798,115 @@ class Scheduler:
     def _schedule_batch_device(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
-        with self.cache.lock, _stage_timer("encode"):
-            eb = encode_pod_batch(
-                self.cache.encoder,
-                [pi.pod for pi in pis],
-                pad_to=self._pad(len(pis)),
+        # device-loss ride-through, serial-path edition (launch+readback
+        # are one synchronous call here): bounded jittered retries, then
+        # the _handle_device_loss ladder (transient re-upload / mesh
+        # shrink / latch off) and the host path for this batch — nothing
+        # is assumed before the readback succeeds, so quarantining loses
+        # zero pods. Each attempt re-encodes AND re-flushes under the
+        # lock: informer churn during the retry sleep can remap encoder
+        # rows, and a stale eb/row_names would decode the kernel's row
+        # choices against the wrong nodes (same reason the wave wrapper
+        # re-encodes per retry).
+        attempts = 0
+        while True:
+            with self.cache.lock, _stage_timer("encode"):
+                eb = encode_pod_batch(
+                    self.cache.encoder,
+                    [pi.pod for pi in pis],
+                    pad_to=self._pad(len(pis)),
+                )
+                snap = self.cache.encoder.flush()
+                enc_cfg = self.cache.encoder.cfg
+                row_names = list(self.cache.encoder.row_names)
+            trace.step("encoded+flushed")
+            kern = make_schedule_batch(
+                enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight
             )
-            snap = self.cache.encoder.flush()
-            enc_cfg = self.cache.encoder.cfg
-            row_names = list(self.cache.encoder.row_names)
-        trace.step("encoded+flushed")
-        kern = make_schedule_batch(enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight)
-        self._rng_key, sub = jax.random.split(self._rng_key)
-        with _stage_timer("kernel"):
-            res = kern(snap, eb.batch, np.asarray(self._weights), sub)
-            chosen = jax.device_get(res.chosen)
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            try:
+                with _stage_timer("kernel"):
+                    res, chosen, score = self._run_serial_kernel(
+                        kern, snap, eb.batch, sub
+                    )
+                self._consecutive_device_loss = 0
+                break
+            except Exception as e:  # noqa: BLE001 — classifier filters
+                if not is_device_loss_error(e):
+                    raise
+                with self.cache.lock:
+                    self.cache.encoder.invalidate_device()
+                # same metric semantics as launch/readback: recovered
+                # blips count as retries, loss_total only on terminal
+                if attempts < self.cfg.device_retry_attempts:
+                    attempts += 1
+                    metrics.inc(
+                        "scheduler_device_retries_total",
+                        {"stage": "serial"},
+                    )
+                    delay = device_retry_delay(attempts)
+                    logger.warning(
+                        "device loss on serial batch kernel (%s); retry "
+                        "%d/%d in %.0f ms with a fresh encode + snapshot "
+                        "upload",
+                        e, attempts, self.cfg.device_retry_attempts,
+                        delay * 1e3,
+                    )
+                    time.sleep(delay)
+                    continue
+                metrics.inc(
+                    "scheduler_device_loss_total", {"stage": "serial"}
+                )
+                logger.error(
+                    "device loss on serial batch kernel persists after "
+                    "%d retries (%s): batch of %d pods degrades to the "
+                    "host path", attempts, e, len(pis),
+                )
+                self._handle_device_loss(e)
+                self._snapshot = self.cache.update_snapshot()
+                for pi in pis:
+                    self._schedule_one_host(pi, moves0)
+                return
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
+        if self.cfg.kernel_output_guards:
+            # mask with `!= -1`, not `>= 0`: -1 is the kernel's ONLY
+            # legitimate unplaced sentinel, so any other negative index
+            # is corruption that must trip GUARD_ROW_RANGE — a `>= 0`
+            # mask would silently route a sign-flipped row (and its
+            # poisoned score) into the unschedulable/preemption path
+            reason = validate_batch_outputs(
+                chosen, np.asarray(chosen) != -1, score, len(row_names)
+            )
+            if reason:
+                # serial path (no pipeline): quarantine this batch to the
+                # host path and rebuild the snapshot — nothing assumed yet
+                metrics.inc("kernel_guard_trips_total", {"reason": reason})
+                logger.error(
+                    "kernel output guard tripped (%s) on the serial device "
+                    "path: batch of %d pods degrades to the host path",
+                    reason, len(pis),
+                )
+                with self.cache.lock:
+                    self.cache.encoder.invalidate_device()
+                # a persistently poisoned device must latch OFF here too,
+                # not loop launch → trip → full re-upload per batch forever
+                self._consecutive_guard_trips += 1
+                if (
+                    self._consecutive_guard_trips
+                    >= self.cfg.device_loss_disable_after
+                ):
+                    logger.error(
+                        "%d consecutive kernel guard trips: abandoning the "
+                        "device path for the host path",
+                        self._consecutive_guard_trips,
+                    )
+                    self._set_device_down()
+                self._snapshot = self.cache.update_snapshot()
+                for pi in pis:
+                    self._schedule_one_host(pi, moves0)
+                return
+            self._consecutive_guard_trips = 0
 
         fallback_pis: List[QueuedPodInfo] = []
         failed: List = []  # (pi, batch_index or -1)
@@ -891,6 +1035,79 @@ class Scheduler:
     def _schedule_batch_wave(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
+        """Device-loss ride-through wrapper around the wave launch:
+        a launch that dies with a device-loss error gets bounded jittered
+        retries — each retry re-encodes and re-flushes from the host
+        masters (the failed launch may have consumed the donated snapshot,
+        and node rows can move between attempts) — then falls through to
+        _handle_device_loss (mesh shrink to survivors, or the host path).
+        Nothing is assumed before a launch succeeds, so the requeue on
+        give-up loses zero pods."""
+        attempts = 0
+        while True:
+            try:
+                self._schedule_batch_wave_once(pis, moves0, trace, t_start)
+                self._consecutive_device_loss = 0
+                return
+            except Exception as e:  # noqa: BLE001 — classifier filters
+                if not is_device_loss_error(e):
+                    raise
+                with self.cache.lock:
+                    self.cache.encoder.invalidate_device()
+                # metric semantics match the readback wrapper: a blip a
+                # retry recovers from counts as a RETRY; loss_total is
+                # reserved for terminal (ladder-escalating) losses
+                if attempts < self.cfg.device_retry_attempts:
+                    attempts += 1
+                    metrics.inc(
+                        "scheduler_device_retries_total",
+                        {"stage": "launch"},
+                    )
+                    delay = device_retry_delay(attempts)
+                    logger.warning(
+                        "device loss on wave launch (%s); retry %d/%d "
+                        "in %.0f ms with a fresh snapshot upload",
+                        e, attempts, self.cfg.device_retry_attempts,
+                        delay * 1e3,
+                    )
+                    time.sleep(delay)
+                    continue
+                metrics.inc(
+                    "scheduler_device_loss_total", {"stage": "launch"}
+                )
+                logger.error(
+                    "wave launch failed with device loss after %d retries: %s",
+                    attempts, e,
+                )
+                self._handle_device_loss(e)
+                for pi in pis:
+                    self.queue.requeue_backoff(pi)
+                return
+
+    def _launch_wave_kernel(self, kern, snap, batch, ptab, weights, key):
+        """Seam for the deterministic fault injector
+        (testing/device_faults.py): every wave launch goes through here.
+
+        Under the encoder's device_lock: the launch DONATES the snapshot
+        buffers, and a donation racing the anti-entropy audit's row
+        gather (which passed its quiesced gate before this batch went
+        busy) deadlocks the CPU client process-wide."""
+        with self.cache.encoder.device_lock:
+            return kern(snap, batch, ptab, weights, key)
+
+    def _fetch_wave_results(self, batches: List["_InFlightBatch"]):
+        """Seam for the fault injector: the combined device->host readback
+        for k in-flight batches."""
+        return jax.device_get(
+            [
+                (b.res.chosen, b.res.placed, b.res.deferred, b.res.score)
+                for b in batches
+            ]
+        )
+
+    def _schedule_batch_wave_once(
+        self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
+    ) -> None:
         """Launch the wave kernel for this batch; resolve the PREVIOUS
         in-flight batch while this one computes (depth-1 pipeline)."""
         # two padded-batch buckets: ragged tails use a small lattice, bursts
@@ -948,6 +1165,7 @@ class Scheduler:
                         if self.cfg.verify_cycles
                         else None
                     )
+                    launch_gen = self.cache._ext_generation
                     break
             self._resolve_pending()
         trace.step("flush")
@@ -988,8 +1206,8 @@ class Scheduler:
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:
-            new_snap, res = kern(
-                snap, eb.batch, ptab, np.asarray(self._weights), sub
+            new_snap, res = self._launch_wave_kernel(
+                kern, snap, eb.batch, ptab, np.asarray(self._weights), sub
             )
         except Exception:
             self.cache.encoder.invalidate_device()
@@ -999,7 +1217,8 @@ class Scheduler:
             self.cache.encoder.set_device_snapshot(new_snap)
         self._pending.append(
             _InFlightBatch(
-                pis, eb, row_names, res, moves0, trace, t_start, verify_snap
+                pis, eb, row_names, res, moves0, trace, t_start, verify_snap,
+                launch_gen,
             )
         )
         metrics.inc("scheduler_wave_batches_total")
@@ -1025,27 +1244,75 @@ class Scheduler:
         batches, self._pending = self._pending[:k], self._pending[k:]
         with _stage_timer("kernel"):
             try:
-                fetched = jax.device_get(
-                    [(b.res.chosen, b.res.placed, b.res.deferred) for b in batches]
+                # transient device/tunnel blips get bounded jittered
+                # retries (the fetched refs are re-gettable — no donation
+                # on the read side) before the loss path takes over
+                fetched = call_with_device_retry(
+                    lambda: self._fetch_wave_results(batches),
+                    attempts=self.cfg.device_retry_attempts,
+                    on_retry=lambda n, e: metrics.inc(
+                        "scheduler_device_retries_total",
+                        {"stage": "readback"},
+                    ),
                 )
                 metrics.inc("scheduler_wave_readbacks_total")
-            except Exception:
+                self._consecutive_device_loss = 0
+            except Exception as e:
                 # device/tunnel error: the kernels' on-device commits are
                 # unknowable — rebuild HBM from the host masters and retry
                 self.cache.encoder.invalidate_device()
                 logger.exception(
                     "wave pipeline readback failed (%d batches)", len(batches)
                 )
+                lost = is_device_loss_error(e)
+                if lost:
+                    metrics.inc(
+                        "scheduler_device_loss_total", {"stage": "readback"}
+                    )
+                    self._handle_device_loss(e)
                 moves = self.queue.moves
                 for b in batches:
                     for pi in b.pis:
-                        if not self.cache.has_pod(pi.pod.metadata.key):
+                        if self.cache.has_pod(pi.pod.metadata.key):
+                            continue
+                        if lost:
+                            # infrastructure failure, not pod
+                            # unschedulability: backoff retries in 1-10 s
+                            # instead of sitting out unschedulableQ's
+                            # 30-60 s leftover flush
+                            self.queue.requeue_backoff(pi)
+                        else:
                             self.queue.add_unschedulable_if_not_present(pi, moves)
                 return
         tails = []
+        quarantined = False
         for b, arrays in zip(batches, fetched):
+            if quarantined:
+                # an older sibling's output failed validation: this
+                # batch's kernel chained on the same suspect snapshot —
+                # don't act on its results, just reschedule the pods
+                # (same accounting as the still-pending batches
+                # _on_guard_trip pulls, or the blast-radius counters
+                # undercount exactly under sustained pipelined load)
+                metrics.inc(
+                    "kernel_guard_trips_total",
+                    {"reason": "sibling_quarantine"},
+                )
+                tails.append(None)
+                for pi in b.pis:
+                    self.queue.readd(pi)
+                continue
             try:
                 tails.append(self._commit_batch(b, arrays))
+                self._consecutive_guard_trips = 0
+            except KernelGuardTrip as trip:
+                quarantined = True
+                self._on_guard_trip(trip)
+                # the violating batch degrades to the host path (nothing
+                # was assumed for it): _finish_batch host-schedules every
+                # pod — at worst the wave runs at host speed, wrong
+                # placements are structurally impossible
+                tails.append((list(b.pis), []))
             except Exception:
                 logger.exception("committing wave batch failed")
                 tails.append(None)
@@ -1069,13 +1336,25 @@ class Scheduler:
 
     def _commit_batch(self, p: "_InFlightBatch", arrays) -> tuple:
         """Act on one read-back batch's placements: assume + bind, re-add
-        deferred pods. Returns (fallback_pis, failed) for _finish_batch."""
+        deferred pods. Returns (fallback_pis, failed) for _finish_batch.
+        Raises KernelGuardTrip when the batch's outputs fail validation —
+        BEFORE any placement is assumed or any pod requeued."""
         pis, eb, row_names = p.pis, p.eb, p.row_names
-        chosen, placed, deferred = arrays
+        chosen, placed, deferred, score = arrays
         trace, t_start = p.trace, p.t_start
         trace.step("kernel")
         algo_dur = time.monotonic() - t_start
         metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
+        if self.cfg.kernel_output_guards:
+            # structural validation first: the decode loop below indexes
+            # row_names[chosen[i]] — a wild index from a corrupted kernel
+            # would either crash the commit or (negative wrap) silently
+            # pick the WRONG node
+            reason = validate_batch_outputs(
+                chosen, placed, score, len(row_names)
+            )
+            if reason:
+                raise KernelGuardTrip(reason)
 
         to_bind: List = []  # (pi, node_name, prio_band, proto)
         protos: dict = {}  # template -> shared encoder proto
@@ -1112,6 +1391,15 @@ class Scheduler:
                 deferred_pis.append(pi)
             else:
                 failed.append((pi, i))
+        if self.cfg.kernel_output_guards and self.cfg.guard_sample_per_wave:
+            # sampled host-oracle re-check (the online analogue of
+            # tests/test_fuzz_differential.py's oracle): a sample of this
+            # wave's placements must pass the pre-batch-sound host filter
+            # subset against the live cache. Runs BEFORE any queue/assume
+            # side effect so a trip quarantines a fully-unacted batch.
+            bad = self._guard_oracle_sample(to_bind, p.launch_gen)
+            if bad is not None:
+                raise KernelGuardTrip("oracle_infeasible", bad)
         # stall breaker: a batch that placed NOTHING but deferred pods is
         # structurally contended (e.g. a hard-spread burst whose every
         # candidate domain is serialized) — an immediate readd would hot-
@@ -1253,25 +1541,226 @@ class Scheduler:
             ni = snapshot.node_info_map.get(node_name)
             if ni is None:
                 continue
-            prof = self.profiles.for_pod(pi.pod)
-            fw = prof.framework
-            state = CycleState()
-            for name in self._VERIFY_PLUGINS:
-                if not fw.has_filter_plugin(name):
+            fail = self._check_placement(pi, ni)
+            if fail is not None:
+                name, st = fail
+                metrics.inc(
+                    "scheduler_verify_mismatch_total", {"plugin": name}
+                )
+                logger.error(
+                    "verify_cycles: device placed %s on %s but host "
+                    "plugin %s says %s",
+                    pi.pod.metadata.key,
+                    node_name,
+                    name,
+                    st.message or st.code,
+                )
+
+    def _check_placement(self, pi, ni):
+        """Run the pre-batch-sound host filter subset (_VERIFY_PLUGINS)
+        for one kernel placement. Returns (plugin_name, status) on the
+        first failure, else None. Shared by the diagnostic cross-check
+        (_verify_placements) and the acting oracle guard."""
+        prof = self.profiles.for_pod(pi.pod)
+        if prof is None:
+            return None
+        fw = prof.framework
+        state = CycleState()
+        for name in self._VERIFY_PLUGINS:
+            if not fw.has_filter_plugin(name):
+                continue
+            st = fw.plugin(name).filter(state, pi.pod, ni)
+            if not is_success(st):
+                return name, st
+        return None
+
+    def _guard_oracle_sample(
+        self, to_bind: List, launch_gen: int
+    ) -> Optional[str]:
+        """Re-check a deterministic sample of this wave's placements
+        against the host filter chain's pre-batch-sound subset
+        (_VERIFY_PLUGINS), on the LIVE cache NodeInfos under the cache
+        lock. By the time a batch commits, every older batch's placements
+        have been replayed into the cache, so the cache equals the state
+        this batch's kernel encoding saw — EXCEPT for mutations no device
+        chain saw: nodes the informer touched after launch (cordon,
+        taint, external bind) AND host-path assumes (fallback pods
+        scheduled between this batch's launch and commit). Both stamp
+        ext_generation past `launch_gen` and are skipped, because a
+        placement that was sound at encode time failing against NEWER
+        node state is churn, not kernel corruption — acting on it would
+        quarantine a correct batch and (after device_loss_disable_after
+        consecutive waves) falsely latch the device path off.
+        Sibling-batch DEVICE assumes deliberately do NOT move
+        ext_generation: the device chain saw those placements, so a
+        disagreement there is a real kernel signal.
+        Returns a human-readable detail string on violation, else None."""
+        k = min(self.cfg.guard_sample_per_wave, len(to_bind))
+        if k <= 0:
+            return None
+        step = max(1, len(to_bind) // k)
+        sample = to_bind[::step][:k]
+        with self.cache.lock:
+            for pi, node_name, _band, _proto in sample:
+                ni = self.cache._nodes.get(node_name)
+                if ni is None:
+                    # node vanished mid-flight (informer remove): the
+                    # assume path parks this as an orphan — not a kernel
+                    # correctness signal
                     continue
-                st = fw.plugin(name).filter(state, pi.pod, ni)
-                if not is_success(st):
+                if ni.ext_generation > launch_gen:
                     metrics.inc(
-                        "scheduler_verify_mismatch_total", {"plugin": name}
+                        "kernel_guard_oracle_skips_total",
+                        {"reason": "node_churn"},
                     )
-                    logger.error(
-                        "verify_cycles: device placed %s on %s but host "
-                        "plugin %s says %s",
-                        pi.pod.metadata.key,
-                        node_name,
-                        name,
-                        st.message or st.code,
+                    continue
+                fail = self._check_placement(pi, ni)
+                if fail is not None:
+                    name, st = fail
+                    return (
+                        f"{pi.pod.metadata.key} on {node_name}: "
+                        f"{name} says {st.message or st.code}"
                     )
+        return None
+
+    def _on_guard_trip(self, trip: KernelGuardTrip) -> None:
+        """A batch's outputs failed validation: count it, force a device
+        snapshot rebuild (its commits are suspect), and pull every NEWER
+        in-flight batch out of the pipeline unread — their kernels
+        chained on the same suspect snapshot. Their pods requeue
+        un-assumed (zero loss); repeated trips latch the device down."""
+        metrics.inc("kernel_guard_trips_total", {"reason": trip.reason})
+        logger.error(
+            "kernel output guard tripped (%s): batch quarantined to the "
+            "host path, snapshot rebuild forced", trip
+        )
+        with self.cache.lock:
+            self.cache.encoder.invalidate_device()
+        pending, self._pending = self._pending, []
+        for b in pending:
+            metrics.inc(
+                "kernel_guard_trips_total", {"reason": "sibling_quarantine"}
+            )
+            for pi in b.pis:
+                self.queue.readd(pi)
+        self._consecutive_guard_trips += 1
+        if self._consecutive_guard_trips >= self.cfg.device_loss_disable_after:
+            logger.error(
+                "%d consecutive kernel guard trips: abandoning the device "
+                "path for the host path", self._consecutive_guard_trips,
+            )
+            self._set_device_down()
+
+    def _set_device_down(self) -> None:
+        self._device_down = True
+        metrics.set_gauge("scheduler_device_down", 1.0)
+
+    def _handle_device_loss(self, exc: BaseException) -> None:
+        """Unrecoverable-by-retry device loss. Escalation ladder: shrink
+        the mesh to the surviving devices (re-shard the snapshot, drop the
+        jit caches keyed on the dead mesh), ride out a fully-transient
+        blip with just the forced re-upload, or — nothing usable, or
+        losses keep repeating — latch the device path off and serve from
+        the host path."""
+        self._consecutive_device_loss += 1
+        metrics.set_gauge(
+            "scheduler_device_consecutive_loss",
+            float(self._consecutive_device_loss),
+        )
+        if self._consecutive_device_loss >= self.cfg.device_loss_disable_after:
+            logger.error(
+                "%d consecutive device-loss events without a successful "
+                "launch: abandoning the device path",
+                self._consecutive_device_loss,
+            )
+            self._set_device_down()
+            return
+        if self._mesh is not None:
+            from ..parallel import sharded
+            from ..parallel.mesh import (
+                largest_pow2_prefix,
+                make_mesh,
+                replicated,
+                single_device_shardings,
+                snapshot_shardings,
+                surviving_devices,
+            )
+
+            devices = list(self._mesh.devices.flat)
+            survivors = surviving_devices(devices, probe=self._device_probe)
+            usable = largest_pow2_prefix(survivors)
+            if len(survivors) == len(devices):
+                # every chip answers: a transient transfer failure — the
+                # invalidate already queued a full re-upload
+                logger.warning(
+                    "device loss looks transient (%d/%d devices respond): "
+                    "keeping the mesh, snapshot re-uploads",
+                    len(survivors), len(devices),
+                )
+                return
+            if usable:
+                # the jit caches hold kernels compiled for the DEAD mesh:
+                # clear them before any launch against the new one
+                sharded.make_sharded_wave_kernel.cache_clear()
+                sharded.make_sharded_schedule_batch.cache_clear()
+                new_mesh = make_mesh(usable) if len(usable) > 1 else None
+                with self.cache.lock:
+                    if new_mesh is not None:
+                        self.cache.encoder.set_sharding(
+                            snapshot_shardings(new_mesh),
+                            replicated(new_mesh),
+                        )
+                    else:
+                        # one survivor: pin uploads to IT — unpinned
+                        # (None, None) device_puts go to the JAX default
+                        # device, which may be the dead one
+                        self.cache.encoder.set_sharding(
+                            *single_device_shardings(usable[0])
+                        )
+                self._mesh = new_mesh
+                self._pair_cache = None
+                metrics.inc("scheduler_mesh_shrinks_total")
+                metrics.set_gauge(
+                    "scheduler_mesh_devices", float(max(len(usable), 1))
+                )
+                logger.error(
+                    "mesh shrunk to %d surviving device(s) after device "
+                    "loss (%s); snapshot re-sharded", len(usable), exc,
+                )
+                return
+            logger.error(
+                "no surviving devices after device loss (%s): host path", exc
+            )
+            self._set_device_down()
+            return
+        # single-device: probe it once — if even a trivial round-trip
+        # fails the device is gone
+        try:
+            if self._device_probe(None):
+                logger.warning(
+                    "device loss looks transient (probe ok): snapshot "
+                    "re-uploads on the next flush"
+                )
+                return
+        except Exception:
+            pass
+        self._set_device_down()
+
+    def _run_serial_kernel(self, kern, snap, batch, key):
+        """Launch + readback of the serial batch kernel — one synchronous
+        call, split out as an injectable seam for the chaos fault
+        injector (mirrors _launch_wave_kernel/_fetch_wave_results)."""
+        res = kern(snap, batch, np.asarray(self._weights), key)
+        chosen, score = jax.device_get((res.chosen, res.score))
+        return res, chosen, score
+
+    @staticmethod
+    def _device_probe(device) -> bool:
+        """One tiny put/get round-trip (injectable via monkeypatching for
+        chaos tests; device=None probes the default device)."""
+        from ..parallel.mesh import _default_probe
+
+        return _default_probe(device)
 
     def _preempt_whatif_tpl(self, eb, failed: List, pod_tpl: np.ndarray):
         """[TPL, N] optimistic preemption mask for the batch's templates

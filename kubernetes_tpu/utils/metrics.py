@@ -105,15 +105,34 @@ class Metrics:
         with self._lock:
             return self._gauges.get(self._k(name, labels))
 
-    def snapshot_gauges(self, prefix: str = "") -> List[Tuple[str, dict, float]]:
-        """(name, labels, value) for every gauge under prefix, sorted —
-        the debugger's replication section renders exactly this."""
-        with self._lock:
-            return sorted(
-                (name, dict(labels), v)
-                for (name, labels), v in self._gauges.items()
-                if name.startswith(prefix)
+    def _snapshot_series(
+        self, series: dict, prefix: str
+    ) -> List[Tuple[str, dict, float]]:
+        """(name, labels, value) for every series under prefix, sorted by
+        the (name, labels) KEY tuple — sorting the dict-carrying rows
+        directly raises once two series share a name (dicts don't
+        order). Caller must hold self._lock."""
+        return [
+            (name, dict(labels), v)
+            for (name, labels), v in sorted(
+                series.items(), key=lambda kv: kv[0]
             )
+            if name.startswith(prefix)
+        ]
+
+    def snapshot_gauges(self, prefix: str = "") -> List[Tuple[str, dict, float]]:
+        """Every gauge under prefix — the debugger's replication section
+        renders exactly this."""
+        with self._lock:
+            return self._snapshot_series(self._gauges, prefix)
+
+    def snapshot_counters(self, prefix: str = "") -> List[Tuple[str, dict, float]]:
+        """Every counter under prefix — the debugger's data-plane
+        self-defense section renders drift and guard-trip counters this
+        way (counters, unlike gauges, have no enumerable label sets a
+        caller could probe one by one)."""
+        with self._lock:
+            return self._snapshot_series(self._counters, prefix)
 
     def histogram(self, name: str, labels: Optional[dict] = None) -> Optional[Histogram]:
         with self._lock:

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Lint: no unmarked-slow tests in the chaos suites.
+
+Tier-1 (`make test`) runs `-m 'not slow'` under a wall-clock budget; a
+chaos test that creeps past a few seconds without the `slow` marker
+silently eats that budget until the suite times out. This lint runs the
+given test files with `-m 'not slow'` and `--durations=0`, parses the
+per-phase durations report, and FAILS if any test's combined
+setup+call+teardown wall-clock exceeds the threshold — by construction
+every test in that run is missing the marker (marked ones are
+deselected).
+
+Per-process one-time JAX compiles (~5-20 s of wave-kernel/encoder
+tracing) are POSITIONAL: whichever test first drives a scheduler wave
+pays them, so judging that test against the threshold plays whack-a-mole
+(mark it slow and the next test inherits the bill). The suite list
+therefore starts with `tests/test_chaos_warmup.py`, whose single
+`warmup_compile` absorber test exists to soak up those compiles, and
+absorber tests are exempt from the threshold. Everything after it is
+judged at its steady-state cost — what it actually adds to tier-1, where
+earlier files have already compiled everything.
+
+(Historical note: this lint used to warm a persistent JAX compilation
+cache (JAX_COMPILATION_CACHE_DIR) across two pytest passes instead.
+Donating executables deserialized from that cache were observed writing
+garbage rows on the CPU backend — see `_scatter_rows_safe` in
+ops/encoding.py — so the lint no longer uses a persistent cache at all.)
+
+Usage:
+    python scripts/check_slow_markers.py [--threshold 5.0] [files...]
+
+Default files: the warm-up absorber, then the chaos suites
+(test_chaos.py, test_chaos_pipeline.py, test_chaos_device.py). Exit 0 =
+clean, 1 = violations, 2 = pytest itself failed (a broken suite must not
+pass the lint vacuously).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+DEFAULT_FILES = [
+    "tests/test_chaos_warmup.py",  # MUST run first: absorbs compiles
+    "tests/test_chaos.py",
+    "tests/test_chaos_pipeline.py",
+    "tests/test_chaos_device.py",
+]
+
+# tests whose id contains this substring absorb per-process compile cost
+# by design and are never judged against the threshold
+WARMUP_EXEMPT = "warmup_compile"
+
+# "  12.34s call  tests/test_chaos.py::test_foo[param]"
+_DURATION_LINE = re.compile(
+    r"^\s*(?P<secs>\d+\.\d+)s\s+(?P<phase>setup|call|teardown)\s+"
+    r"(?P<test>\S+)\s*$"
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*", default=None)
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=5.0,
+        help="max unmarked-test wall-clock seconds (default: 5.0)",
+    )
+    args = ap.parse_args()
+    files = args.files or DEFAULT_FILES
+    files = [f for f in files if os.path.exists(f)]
+    if not files:
+        print("check_slow_markers: no chaos suite files found", file=sys.stderr)
+        return 2
+
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        *files,
+        "-q",
+        "-m",
+        "not slow",
+        "--durations=0",
+        "--durations-min=0.01",
+        "-p",
+        "no:cacheprovider",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0:
+        sys.stdout.write(out)
+        print(
+            "check_slow_markers: chaos suite itself failed "
+            f"(pytest exit {proc.returncode}); fix the suite first",
+            file=sys.stderr,
+        )
+        return 2
+
+    totals: dict = {}
+    for line in out.splitlines():
+        m = _DURATION_LINE.match(line)
+        if m:
+            totals[m.group("test")] = totals.get(m.group("test"), 0.0) + float(
+                m.group("secs")
+            )
+
+    offenders = sorted(
+        (
+            (t, s)
+            for t, s in totals.items()
+            if s > args.threshold and WARMUP_EXEMPT not in t
+        ),
+        key=lambda kv: -kv[1],
+    )
+    if offenders:
+        print(
+            f"check_slow_markers: {len(offenders)} chaos test(s) over "
+            f"{args.threshold:.1f}s wall-clock without @pytest.mark.slow:"
+        )
+        for test, secs in offenders:
+            print(f"  {secs:7.2f}s  {test}")
+        print("mark them slow (tier-1 runs -m 'not slow' under a timeout).")
+        return 1
+    judged = sum(1 for t in totals if WARMUP_EXEMPT not in t)
+    print(
+        f"check_slow_markers: OK — {judged} unmarked chaos tests all "
+        f"within {args.threshold:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Same-process A/B: steady-state cost of the data-plane self-defense.
+
+Arm A runs `run_latency_benchmark` (the same steady-state probe bench.py
+reports as `steady_state_latency`) with the defenses at their defaults
+(kernel-output guards + sampled oracle + anti-entropy auditor); arm B
+disables all three. Both arms share one process (XLA compile caches are
+warm for the second arm; A runs first so its numbers are the
+conservative ones) and inject at the same fixed rate, so the delta is
+the defense overhead, not machine drift.
+
+Also measures guard-trip recovery: a poisoned first readback (NaN score)
+quarantines the wave to the host path — reported as the wall-clock from
+scheduler start to every pod bound, with and without the injected trip.
+
+Usage: python scripts/dataplane_overhead_ab.py [--rate 300] [--pods 400]
+Emits one JSON line; CPU-forced unless BENCH_AB_TPU=1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("BENCH_AB_TPU", "") not in ("1", "true"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def steady_state_arm(defenses: bool, rate: float, n_pods: int):
+    from kubernetes_tpu.perf.harness import run_latency_benchmark
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration
+
+    if defenses:
+        scfg = KubeSchedulerConfiguration()  # defaults: everything on
+    else:
+        scfg = KubeSchedulerConfiguration(
+            kernel_output_guards=False,
+            guard_sample_per_wave=0,
+            antientropy_period_s=0.0,
+        )
+    cfg = WORKLOADS["SchedulingPodAffinity/5000"]
+    lat = run_latency_benchmark(cfg, rate, n_pods=n_pods, sched_config=scfg)
+    return {
+        "rate_pods_per_s": round(lat.rate_pods_per_s, 1),
+        "scheduled": lat.scheduled,
+        "pod_p50_ms": round(lat.pod_p50_ms, 3),
+        "pod_p90_ms": round(lat.pod_p90_ms, 3),
+        "pod_p99_ms": round(lat.pod_p99_ms, 3),
+        "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
+    }
+
+
+def burst_arm(defenses: bool):
+    """Burst throughput (the bench.py headline) with defenses on/off —
+    isolates the defenses' share of any headline drift vs older BENCH
+    checkpoints (the steady_state rate bench.py probes is derived from
+    burst throughput, so box-load drift moves both)."""
+    from kubernetes_tpu.perf.harness import run_benchmark
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration
+
+    if defenses:
+        scfg = KubeSchedulerConfiguration()
+    else:
+        scfg = KubeSchedulerConfiguration(
+            kernel_output_guards=False,
+            guard_sample_per_wave=0,
+            antientropy_period_s=0.0,
+        )
+    res = run_benchmark(WORKLOADS["SchedulingPodAffinity/5000"], sched_config=scfg)
+    return {
+        "pods_per_s": round(res.throughput_pods_per_s, 1),
+        "scheduled": res.scheduled,
+        "unscheduled": res.unscheduled,
+    }
+
+
+def guard_trip_recovery(poison: bool):
+    """Wall-clock for a 30-pod wave to fully bind on a 6-node cluster,
+    with (poison=True) the first readback's score NaN'd — the guard
+    quarantines the wave to the host path — vs a clean run."""
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.client import APIServer
+    from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
+    from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+    from kubernetes_tpu.testing.device_faults import DeviceFaultInjector
+    from kubernetes_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    server = APIServer()
+    pool = NodeAgentPool(server, housekeeping_interval=0.1)
+    for i in range(6):
+        pool.add_node(f"ab-{i}")
+    n = 30
+    for i in range(n):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name=f"ab-pod-{i}"),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "100m"})]
+                ),
+            ),
+        )
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    inj = None
+    if poison:
+        inj = DeviceFaultInjector(nan_scores_on_readbacks={0}).install(sched)
+    pool.start()
+    t0 = time.monotonic()
+    sched.start()
+    try:
+        deadline = t0 + 60.0
+        while time.monotonic() < deadline:
+            if server.count("pods", lambda p: bool(p.spec.node_name)) >= n:
+                break
+            time.sleep(0.01)
+        bound = server.count("pods", lambda p: bool(p.spec.node_name))
+        dt = time.monotonic() - t0
+        trips = metrics.counter(
+            "kernel_guard_trips_total", {"reason": "nonfinite_score"}
+        )
+    finally:
+        sched.stop()
+        pool.stop()
+        if inj is not None:
+            inj.uninstall()
+    return {"bound": bound, "wall_s": round(dt, 3), "guard_trips": trips}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rate", type=float, default=300.0)
+    ap.add_argument("--pods", type=int, default=400)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--burst",
+        action="store_true",
+        help="also A/B the burst-throughput headline (adds ~2 min)",
+    )
+    args = ap.parse_args()
+
+    out = {"metric": "dataplane_defense_overhead_ab"}
+    # warm-up: the first wave in the process pays the XLA kernel
+    # compiles (~10 s) — without this the clean arm measures compile
+    # time, not recovery time
+    guard_trip_recovery(poison=False)
+    out["guard_trip_clean"] = guard_trip_recovery(poison=False)
+    out["guard_trip_poisoned"] = guard_trip_recovery(poison=True)
+    # alternating repeated arms, best-of per arm: single-shot p99 on a
+    # shared box swings ±50% run-to-run, far above the effect being
+    # measured; noise only ever ADDS latency, so min-of-reps isolates
+    # the systematic defense overhead (order alternates to cancel any
+    # warm-cache drift)
+    on_runs, off_runs = [], []
+    for rep in range(max(1, args.reps)):
+        order = [(True, on_runs), (False, off_runs)]
+        if rep % 2:
+            order.reverse()
+        for defenses, runs in order:
+            runs.append(steady_state_arm(defenses, args.rate, args.pods))
+    best = lambda runs: min(runs, key=lambda r: r["pod_p99_ms"])  # noqa: E731
+    out["defenses_on"] = best(on_runs)
+    out["defenses_off"] = best(off_runs)
+    out["defenses_on_runs"] = on_runs
+    out["defenses_off_runs"] = off_runs
+    on, off = out["defenses_on"], out["defenses_off"]
+    if off["pod_p99_ms"]:
+        out["p99_overhead_pct"] = round(
+            100.0 * (on["pod_p99_ms"] / off["pod_p99_ms"] - 1.0), 2
+        )
+    if off["rate_pods_per_s"]:
+        out["rate_delta_pct"] = round(
+            100.0 * (on["rate_pods_per_s"] / off["rate_pods_per_s"] - 1.0), 2
+        )
+    if args.burst:
+        bon, boff = [], []
+        for rep in range(max(1, args.reps)):
+            order = [(True, bon), (False, boff)]
+            if rep % 2:
+                order.reverse()
+            for defenses, runs in order:
+                runs.append(burst_arm(defenses))
+        out["burst_on"] = max(bon, key=lambda r: r["pods_per_s"])
+        out["burst_off"] = max(boff, key=lambda r: r["pods_per_s"])
+        out["burst_on_runs"] = bon
+        out["burst_off_runs"] = boff
+        if out["burst_off"]["pods_per_s"]:
+            out["burst_delta_pct"] = round(
+                100.0
+                * (
+                    out["burst_on"]["pods_per_s"]
+                    / out["burst_off"]["pods_per_s"]
+                    - 1.0
+                ),
+                2,
+            )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

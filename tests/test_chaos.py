@@ -11,6 +11,8 @@ dropped, and a fresh control plane recovers from the WAL.
 
 import time
 
+import pytest
+
 from kubernetes_tpu.api import objects as v1
 from kubernetes_tpu.client import APIServer
 from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
@@ -38,6 +40,7 @@ def _bound_count(server):
     return server.count("pods", lambda p: bool(p.spec.node_name))
 
 
+@pytest.mark.slow
 def test_kill_scheduler_mid_burst_recovery_converges(tmp_path):
     """Burst 200 pods; kill scheduler+kubelets after ~a third have bound;
     recover the store from the WAL, start a FRESH control plane, and
@@ -102,6 +105,7 @@ def test_kill_scheduler_mid_burst_recovery_converges(tmp_path):
         pool2.stop()
 
 
+@pytest.mark.slow
 def test_kill_kubelet_node_evicts_and_reschedules(tmp_path):
     """Kill one kubelet (node stops heartbeating): nodelifecycle must taint/
     evict, and the workload controller must replace the pods elsewhere —
@@ -174,6 +178,7 @@ def test_kill_kubelet_node_evicts_and_reschedules(tmp_path):
         cluster.stop()
 
 
+@pytest.mark.slow
 def test_upgrade_apply_mid_burst_does_not_disrupt(tmp_path):
     """The chaosmonkey upgrade-suite shape (test/e2e/chaosmonkey): run an
     upgrade WHILE a scheduling burst is in flight — every pod still lands

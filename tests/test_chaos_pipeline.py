@@ -154,6 +154,7 @@ def _watch_deletions(store, sink):
 # -- scenario 1: degrade the store mid-wave, then recover ---------------------
 
 
+@pytest.mark.slow
 def test_degrade_store_mid_wave_then_recover_drains_buffer():
     """Acceptance scenario. A wave's bulk bind hits a degraded store
     (refused before anything applied). The wave is NOT failed: every
@@ -237,6 +238,7 @@ def test_degrade_store_mid_wave_then_recover_drains_buffer():
 # -- scenario 2: quorum lost mid-bind (applied, unacked) ----------------------
 
 
+@pytest.mark.slow
 def test_quorum_lost_mid_bind_reconciles_without_double_bind():
     """The unknown-outcome path: the wave's binds APPLY locally but the
     quorum ack is lost. The scheduler buffers them, reads each pod back
@@ -288,6 +290,7 @@ def test_quorum_lost_mid_bind_reconciles_without_double_bind():
 # -- scenario 3: eviction storm halted, then rate-limited drain ---------------
 
 
+@pytest.mark.slow
 def test_eviction_storm_halts_then_drains_rate_limited():
     """>55% of lease-managed nodes going dark in one pass is a
     control-plane-outage signature: evictions halt. When most of the
@@ -361,6 +364,7 @@ def test_eviction_storm_halts_then_drains_rate_limited():
 # -- scenario 4: kill a kubelet mid-bind; everything reschedules --------------
 
 
+@pytest.mark.slow
 def test_kill_kubelet_mid_bind_reschedules_everything():
     """One node dies with binds in flight. The lifecycle controller
     (rate-limited, below the disruption threshold) evicts its pods and

@@ -339,6 +339,163 @@ def test_fuzz_device_mask_matches_host_filters(seed):
     np.testing.assert_allclose(enc.m_eterm_w, new_snap_h.eterm_w, rtol=1e-6)
 
 
+# ---------------------------------------------------------------------------
+# Corruption-injection corpus (data-plane self-defense): every entry below
+# injects a corruption into the DEVICE state or the kernel's read-back
+# outputs and asserts it is caught by either the batch guards
+# (ops/lattice.validate_batch_outputs) or ONE anti-entropy audit pass
+# (scheduler/antientropy.py) — the online analogue of the oracle above.
+# ---------------------------------------------------------------------------
+
+from kubernetes_tpu.ops.lattice import (  # noqa: E402
+    GUARD_NONFINITE,
+    GUARD_ROW_RANGE,
+    validate_batch_outputs,
+)
+from kubernetes_tpu.scheduler.antientropy import SnapshotAntiEntropy  # noqa: E402
+from kubernetes_tpu.testing.device_faults import corrupt_device_rows  # noqa: E402
+
+
+def _flip_taint_effect(a):
+    return ((a + 1) % 3).astype(a.dtype)
+
+
+def _swap_label_ids(a):
+    # shift every present value-id to a sibling id and ghost absent slots:
+    # the exact shape of a vocab-id mixup (selector matching silently
+    # matches the WRONG label values)
+    return np.where(a >= 0, a + 1, 0).astype(a.dtype)
+
+
+def _clamp_rows(a):
+    return np.zeros_like(a)
+
+
+def _inflate_alloc(a):
+    return (a * 2 + 1000).astype(a.dtype)
+
+
+def _flip_bool(a):
+    return ~a
+
+
+# (name, DeviceSnapshot field, mutator applied to the corrupted rows)
+SNAPSHOT_CORRUPTIONS = [
+    ("taint_effect_flip", "taint_effect", _flip_taint_effect),
+    ("label_vocab_id_swap", "label_vals", _swap_label_ids),
+    ("requested_clamped_to_zero", "requested", _clamp_rows),
+    ("allocatable_inflated", "allocatable", _inflate_alloc),
+    ("sel_counts_zeroed", "sel_counts", _clamp_rows),
+    ("unschedulable_flip", "unschedulable", _flip_bool),
+]
+
+
+@pytest.mark.parametrize(
+    "name,field,mutate",
+    SNAPSHOT_CORRUPTIONS,
+    ids=[c[0] for c in SNAPSHOT_CORRUPTIONS],
+)
+@pytest.mark.parametrize("seed", [11, 12])
+def test_fuzz_snapshot_corruption_caught_within_one_audit_pass(
+    seed, name, field, mutate
+):
+    """Device-state corruption (host masters untouched — the drift a
+    scatter bug or bit flip leaves) must be detected, attributed to the
+    right column, and repaired back to the masters by a single
+    anti-entropy pass, without escalating to a full rebuild."""
+    from kubernetes_tpu.api.selectors import selector_from_match_labels
+
+    rng = random.Random(seed)
+    enc, _infos, _nodes = _build_random_cluster(rng, rng.randrange(8, 17))
+    # service predicates populate sel_counts (intern backfills placed pods)
+    for app in APPS:
+        enc.register_service_predicate(
+            "default", selector_from_match_labels({"app": app})
+        )
+    enc.flush()
+    aud = SnapshotAntiEntropy(enc, sample_rows=enc.cfg.n_cap)
+    clean = aud.audit_once()
+    assert clean["device_drift"] == {} and not clean["master_repaired"], (
+        "audit flagged drift on an uncorrupted snapshot (false positive)"
+    )
+
+    master = np.array(enc._master_of(field))
+    live = [r for r, nm in enumerate(enc.row_names) if nm is not None]
+    rows = [
+        r
+        for r in live
+        if not np.array_equal(mutate(master[r : r + 1])[0], master[r])
+    ][:4]
+    assert rows, f"corpus entry {name!r} mutated nothing (vacuous)"
+    corrupt_device_rows(enc, rows, field=field, mutate=mutate)
+
+    report = aud.audit_once()
+    assert set(report["device_drift"].get(field, [])) == set(rows), (
+        f"{name}: audit missed corrupted rows — "
+        f"drift={report['device_drift']}, injected rows={rows}"
+    )
+    assert not report["rebuilt"], "targeted re-scatter should have sufficed"
+    # repaired: every device row equals the (untouched) host masters again
+    fetched = enc.fetch_device_rows(live)
+    for f in enc.ROW_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(fetched[f]),
+            enc._master_of(f)[np.asarray(live)],
+            err_msg=f"{name}: device field {f!r} not repaired",
+        )
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_fuzz_poisoned_readback_corpus_caught_by_guards(seed):
+    """Kernel-output corruption (NaN/Inf scores, wild or negative chosen
+    rows) must trip validate_batch_outputs with the right reason — and
+    the clean outputs of a healthy kernel must never trip it (a false
+    positive would needlessly degrade waves to host speed)."""
+    rng = random.Random(seed)
+    n_nodes = rng.randrange(8, 17)
+    enc, _infos, _nodes = _build_random_cluster(rng, n_nodes)
+    pods = [_rand_pod(rng, f"g{i}") for i in range(rng.randrange(4, 9))]
+    tc = TemplateCache(enc)
+    P = 1
+    while P < len(pods):
+        P *= 2
+    eb = tc.encode(pods, pad_to=P)
+    ptab, _ = build_pair_table(enc, eb.tpl_np, eb.num_templates)
+    snap = enc.flush()
+    kern = make_wave_kernel_jit(enc.cfg.v_cap, 64, 8)
+    _new_snap, res = kern(
+        snap, eb.batch, ptab, np.asarray(DEFAULT_WEIGHTS), jax.random.PRNGKey(seed)
+    )
+    chosen, placed, score = jax.device_get((res.chosen, res.placed, res.score))
+    enc.invalidate_device()
+    n_rows = len(enc.row_names)
+
+    assert validate_batch_outputs(chosen, placed, score, n_rows) is None, (
+        "guard tripped on a healthy kernel's outputs (false positive)"
+    )
+    assert placed.any(), f"seed {seed} placed nothing — corpus is vacuous"
+    victim = int(np.nonzero(placed)[0][0])
+
+    poisoned = np.array(score)
+    poisoned[victim] = np.nan
+    assert (
+        validate_batch_outputs(chosen, placed, poisoned, n_rows)
+        == GUARD_NONFINITE
+    )
+    poisoned[victim] = np.inf
+    assert (
+        validate_batch_outputs(chosen, placed, poisoned, n_rows)
+        == GUARD_NONFINITE
+    )
+    for wild in (n_rows, 2**30, -1, -(2**30)):
+        bad = np.array(chosen)
+        bad[victim] = wild
+        assert (
+            validate_batch_outputs(bad, placed, score, n_rows)
+            == GUARD_ROW_RANGE
+        ), f"wild row {wild} not caught"
+
+
 @pytest.mark.parametrize("seed", [21, 22, 23, 24])
 def test_fuzz_selector_spread_device_picks_min_service_count(seed):
     """Score-differential for the device DefaultPodTopologySpread: with the
