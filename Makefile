@@ -9,12 +9,14 @@
 #                         included) + the acked-write-loss checker selftest
 #   make chaos-device     data-plane chaos only: snapshot corruption,
 #                         poisoned kernel outputs, device-loss ride-through
+#   make chaos-autoscaler autoscaler e2e only: scale-up bind budget, drain
+#                         simulation gating, zero-eviction guarantee
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 
 PY ?= python
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
-	chaos-device lint-slow
+	chaos-device chaos-autoscaler lint-slow
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -23,11 +25,16 @@ chaos:
 	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_consensus.py \
 		tests/test_replication_quorum.py \
 		tests/test_replication.py tests/test_chaos.py \
-		tests/test_chaos_pipeline.py tests/test_chaos_device.py -q
+		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
+		tests/test_chaos_autoscaler.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
 	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_device.py -q
+
+chaos-autoscaler:
+	$(PY) -m pytest tests/test_chaos_warmup.py \
+		tests/test_chaos_autoscaler.py -q
 
 lint-slow:
 	$(PY) scripts/check_slow_markers.py

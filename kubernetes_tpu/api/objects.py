@@ -83,6 +83,14 @@ TOLERATION_OP_EQUAL = "Equal"
 
 TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 
+# cluster-autoscaler contract annotations/labels (autoscaler/):
+# a pod with no controller owner blocks scale-down of its node unless it
+# carries the safe-to-evict annotation; nodes provisioned by the autoscaler
+# carry the nodegroup label so scale-down knows which catalog entry (and
+# min-size floor) they count against.
+ANN_SAFE_TO_EVICT = "cluster-autoscaler.kubernetes.io/safe-to-evict"
+LABEL_NODEGROUP = "autoscaler.kubernetes-tpu.io/nodegroup"
+
 
 @dataclass(frozen=True)
 class Taint:

@@ -82,9 +82,14 @@ def run(
     config: Optional[KubeSchedulerConfiguration] = None,
     healthz_port: int = 10251,
     block: bool = True,
+    autoscaler_catalog=None,
+    autoscaler_kwargs: Optional[dict] = None,
 ) -> Scheduler:
     """app.Run (server.go:142): health endpoints → informers → leader
-    election (optional) → scheduling loops."""
+    election (optional) → scheduling loops. autoscaler_catalog (a
+    NodeGroupCatalog) additionally runs the kernel-driven cluster
+    autoscaler against this scheduler's snapshot — it follows the
+    scheduler's leadership (starts with scheduling, stops with it)."""
     server = server or APIServer()
     cfg = config or KubeSchedulerConfiguration()
     sched = Scheduler(server, cfg)
@@ -94,9 +99,19 @@ def run(
     CacheDebugger(sched).listen_for_signal()
 
     stop = threading.Event()
+    autoscaler = None
+    if autoscaler_catalog is not None:
+        from ..autoscaler import ClusterAutoscaler
+
+        autoscaler = ClusterAutoscaler(
+            server, sched, autoscaler_catalog, **(autoscaler_kwargs or {})
+        )
+        sched._autoscaler = autoscaler
 
     def start_scheduling():
         sched.start()
+        if autoscaler is not None:
+            autoscaler.start()
         healthy.set()
 
     if cfg.leader_election is not None:
@@ -104,6 +119,8 @@ def run(
             # leaderelection.go: losing the lease is fatal for the process
             logger.error("leader election lost; shutting down scheduling")
             healthy.clear()
+            if autoscaler is not None:
+                autoscaler.stop()
             sched.stop()
             stop.set()
 
@@ -125,6 +142,8 @@ def run(
         except KeyboardInterrupt:
             pass
         finally:
+            if autoscaler is not None:
+                autoscaler.stop()
             sched.stop()
     return sched
 
@@ -142,6 +161,13 @@ def main(argv=None) -> int:
         help="force a JAX platform (e.g. 'cpu' to run without the TPU — "
         "the device-failure fallback path)",
     )
+    parser.add_argument(
+        "--autoscale-shapes",
+        default="",
+        help="enable the kernel-driven cluster autoscaler with a shape "
+        "catalog: semicolon-separated 'name:cpu,memory,maxPods,maxSize' "
+        "entries (e.g. 'small:4,32Gi,110,100;big:32,256Gi,110,20')",
+    )
     parser.add_argument("-v", "--verbosity", type=int, default=1)
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -158,7 +184,27 @@ def main(argv=None) -> int:
     )
     if args.leader_elect and cfg.leader_election is None:
         cfg.leader_election = LeaderElectionConfig()
-    run(config=cfg, healthz_port=args.healthz_port)
+    catalog = None
+    if args.autoscale_shapes:
+        from ..autoscaler import NodeGroup, NodeGroupCatalog, machine_shape
+
+        groups = []
+        for entry in filter(None, args.autoscale_shapes.split(";")):
+            name, spec = entry.split(":", 1)
+            cpu, memory, max_pods, max_size = spec.split(",")
+            groups.append(
+                NodeGroup(
+                    name=name.strip(),
+                    template=machine_shape(
+                        cpu=cpu.strip(),
+                        memory=memory.strip(),
+                        pods=int(max_pods),
+                    ),
+                    max_size=int(max_size),
+                )
+            )
+        catalog = NodeGroupCatalog(groups)
+    run(config=cfg, healthz_port=args.healthz_port, autoscaler_catalog=catalog)
     return 0
 
 

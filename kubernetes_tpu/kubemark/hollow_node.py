@@ -79,8 +79,8 @@ class HollowCluster(NodeAgentPool):
         for i in range(num_nodes):
             self.add_node(f"{name_prefix}-{i}")
 
-    def add_node(self, name: str, **kw) -> HollowNode:
-        node = self._template(name, **kw)
+    def add_node(self, name: str, template=None, **kw) -> HollowNode:
+        node = (template or self._template)(name, **kw)
         self.server.create("nodes", node)
         try:
             from ..client.leaderelection import Lease
@@ -107,3 +107,19 @@ class HollowCluster(NodeAgentPool):
         detect and evict."""
         self.nodes.pop(name, None)
         self.remove_node(name)
+
+    def provisioner_for(self, node_template):
+        """(provision, deprovision) hooks for an autoscaler NodeGroup: a
+        scale-up creates the Node object AND starts a hollow kubelet for
+        it (heartbeats, leases, pod sync — a full fleet member), and a
+        scale-down tears the kubelet back down after the node object is
+        deleted. node_template: name -> v1.Node (the group's
+        `make_node`, so the nodegroup label rides along)."""
+
+        def provision(name: str):
+            return self.add_node(name, template=node_template)
+
+        def deprovision(name: str):
+            self.kill_node(name)
+
+        return provision, deprovision

@@ -643,47 +643,96 @@ class SnapshotEncoder:
         self._write_node_row(row, node)
         return row
 
-    def _write_node_row(self, row: int, node: v1.Node) -> None:
-        c = self.cfg
-        self.m_valid[row] = True
-        self.m_unsched[row] = node.spec.unschedulable
+    def encode_node_row_values(self, node: v1.Node) -> Dict[str, np.ndarray]:
+        """Encode one node's NODE-STATIC columns (no pod aggregates) into a
+        standalone row-values dict keyed by DeviceSnapshot field name. This
+        is the single row encoding shared by `_write_node_row` (live
+        masters) and the autoscaler's what-if overlay (virtual candidate
+        rows appended to a COPY of the snapshot — the values never touch
+        the live masters there). Interning happens first so every capacity
+        is final before the row arrays are allocated (a mid-encode `_grow`
+        would otherwise orphan the half-filled arrays)."""
         alloc = self.encode_resources(node.allocatable(), ceil=False)
-        self.m_alloc[row, : len(alloc)] = alloc
         # labels — metadata.name is matchable as a field selector; expose it
         # as a pseudo-label so matchFields shares the label path.
-        self.m_label_vals[row, :] = -1
-        self.m_label_num[row, :] = np.iinfo(np.int32).min
         labels = dict(node.metadata.labels)
         labels.setdefault("kubernetes.io/hostname", node.metadata.name)
-        for k, v in labels.items():
-            ki = self.intern_key(k)
-            vi = self.intern_val(v)
-            self.m_label_vals[row, ki] = vi
-            try:
-                self.m_label_num[row, ki] = int(v)
-            except ValueError:
-                pass
-        # taints
-        taints = node.spec.taints[: c.taints_max]
-        self.m_taint_key[row, :] = -1
-        for i, t in enumerate(taints):
-            self.m_taint_key[row, i] = self.intern_key(t.key)
-            self.m_taint_val[row, i] = self.intern_val(t.value)
-            self.m_taint_eff[row, i] = _EFFECT_CODES.get(t.effect, EFFECT_NO_SCHEDULE)
-        # images
-        self.m_image_bytes[row, :] = 0.0
-        for img in node.status.images:
-            for nm in img.names:
-                ii = self.intern_image(nm)
-                self.m_image_bytes[row, ii] = float(img.size_bytes)
+        lab = [
+            (self.intern_key(k), self.intern_val(v), v)
+            for k, v in labels.items()
+        ]
+        taints = [
+            (
+                self.intern_key(t.key),
+                self.intern_val(t.value),
+                _EFFECT_CODES.get(t.effect, EFFECT_NO_SCHEDULE),
+            )
+            for t in node.spec.taints[: self.cfg.taints_max]
+        ]
+        images = [
+            (self.intern_image(nm), float(img.size_bytes))
+            for img in node.status.images
+            for nm in img.names
+        ]
         # avoid-pods annotation: comma-separated "Kind/name" controller refs
         # (simplified AvoidPods encoding; reference uses a JSON annotation,
         # v1helper.GetAvoidPodsFromNodeAnnotations).
-        self.m_avoid[row, :] = False
-        ann = node.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods", "")
-        for ref in filter(None, (r.strip() for r in ann.split(","))):
-            ai = self.intern_avoid(ref)
-            self.m_avoid[row, ai] = True
+        ann = node.metadata.annotations.get(
+            "scheduler.alpha.kubernetes.io/preferAvoidPods", ""
+        )
+        avoids = [
+            self.intern_avoid(ref)
+            for ref in filter(None, (r.strip() for r in ann.split(",")))
+        ]
+        c = self.cfg  # re-read: interning above may have grown capacities
+        label_vals = np.full(c.k_cap, -1, np.int32)
+        label_num = np.full(c.k_cap, np.iinfo(np.int32).min, np.int32)
+        for ki, vi, raw in lab:
+            label_vals[ki] = vi
+            try:
+                label_num[ki] = int(raw)
+            except ValueError:
+                pass
+        taint_key = np.full(c.taints_max, -1, np.int32)
+        taint_val = np.zeros(c.taints_max, np.int32)
+        taint_eff = np.zeros(c.taints_max, np.int32)
+        for i, (ki, vi, eff) in enumerate(taints):
+            taint_key[i] = ki
+            taint_val[i] = vi
+            taint_eff[i] = eff
+        image_bytes = np.zeros(c.im_cap, np.float32)
+        for ii, sz in images:
+            image_bytes[ii] = sz
+        avoid = np.zeros(c.av_cap, np.bool_)
+        for ai in avoids:
+            avoid[ai] = True
+        return {
+            "valid": np.bool_(True),
+            "unschedulable": np.bool_(node.spec.unschedulable),
+            "allocatable": zpad(alloc, c.r_cap),
+            "label_vals": label_vals,
+            "label_numvals": label_num,
+            "taint_key": taint_key,
+            "taint_val": taint_val,
+            "taint_effect": taint_eff,
+            "image_bytes": image_bytes,
+            "avoid": avoid,
+        }
+
+    def _write_node_row(self, row: int, node: v1.Node) -> None:
+        vals = self.encode_node_row_values(node)
+        # masters re-fetched AFTER the encode: interning can _grow (which
+        # reallocates every master array)
+        self.m_valid[row] = vals["valid"]
+        self.m_unsched[row] = vals["unschedulable"]
+        self.m_alloc[row, :] = vals["allocatable"]
+        self.m_label_vals[row, :] = vals["label_vals"]
+        self.m_label_num[row, :] = vals["label_numvals"]
+        self.m_taint_key[row, :] = vals["taint_key"]
+        self.m_taint_val[row, :] = vals["taint_val"]
+        self.m_taint_eff[row, :] = vals["taint_effect"]
+        self.m_image_bytes[row, :] = vals["image_bytes"]
+        self.m_avoid[row, :] = vals["avoid"]
         self._dirty_rows.add(row)
         self.generation += 1
 
@@ -1296,6 +1345,105 @@ class SnapshotEncoder:
         delta-add protocol, as long as replay happens before the next flush
         (the synchronous cycle guarantees it)."""
         self._device = snap
+
+    # -- what-if simulation overlay (autoscaler) -----------------------------
+
+    def free_row_indices(self) -> List[int]:
+        """Row indices holding no live node (freed or never allocated), in
+        ascending order — the rows a what-if overlay may claim for virtual
+        candidate nodes without perturbing any live row."""
+        used = {r for r, n in enumerate(self.row_names) if n is not None}
+        return [r for r in range(self.cfg.n_cap) if r not in used]
+
+    def whatif_overlay(
+        self,
+        virtual_nodes: List[v1.Node],
+        mask_rows: Optional[List[int]] = None,
+    ) -> Optional[Tuple[DeviceSnapshot, List[int]]]:
+        """Copy-on-append simulation view of the snapshot: K VIRTUAL node
+        rows (candidate machine shapes from the autoscaler's NodeGroup
+        catalog) written into currently-free rows of a COPY of the live
+        snapshot, plus `mask_rows` (scale-down drain what-if) flipped
+        invalid. Returns (overlay_snapshot, rows) with rows[i] the row
+        index assigned to virtual_nodes[i]; None when n_cap has no room
+        for K more rows (the caller falls back to skipping the pass —
+        growing n_cap here would recompile every kernel variant mid-run).
+
+        Isolation contract (the PR-4 donation discipline): the live
+        snapshot is never mutated and never donated — the overlay is
+        produced by the alias-free `_scatter_rows_safe` program, so every
+        buffer of the returned snapshot is fresh; the overlay is never
+        installed as the live snapshot (`set_device_snapshot` is not
+        called on it) and must never be handed to a donating program. The
+        device section holds `device_lock`: the scatter READS the live
+        buffers, and a read racing a wave launch's donation deadlocks the
+        CPU client process-wide.
+
+        Caller must hold the cache lock (vocab interning + the masters
+        read must be consistent with row_names)."""
+        mask_rows = list(mask_rows or [])
+        free = self.free_row_indices()
+        if len(virtual_nodes) > len(free):
+            return None
+        rows = free[: len(virtual_nodes)]
+        # intern first: virtual labels/taints can grow capacities (shapes
+        # change), which must settle before the base snapshot is chosen
+        encoded = [self.encode_node_row_values(n) for n in virtual_nodes]
+        masters = self._masters()
+        with self.device_lock:
+            if self._device is not None and not self.has_pending_updates:
+                # steady state: the live snapshot is current — the overlay
+                # costs one padded row scatter, not a full upload. (When a
+                # wave pipeline is in flight the device may additionally
+                # hold kernel commits the masters haven't replayed yet;
+                # the device view is then the MORE current base.)
+                base = self._device
+            elif self._snap_shardings is not None:
+                base = jax.device_put(masters, self._snap_shardings)
+            else:
+                base = jax.device_put(jax.tree.map(jnp.asarray, masters))
+            all_rows = rows + mask_rows
+            out = base
+            for i0 in range(0, max(len(all_rows), 1), _SCATTER_PAD_BIG):
+                chunk = all_rows[i0 : i0 + _SCATTER_PAD_BIG]
+                pad = (
+                    _SCATTER_PAD_SMALL
+                    if len(chunk) <= _SCATTER_PAD_SMALL
+                    else _SCATTER_PAD_BIG
+                )
+                idx = np.full(pad, self.cfg.n_cap, np.int32)  # OOB dropped
+                idx[: len(chunk)] = chunk
+                upd = {}
+                for name in DeviceSnapshot._fields:
+                    m = getattr(masters, name)
+                    if name in _GLOBAL_FIELDS:
+                        upd[name] = m
+                        continue
+                    arr = np.zeros((pad,) + m.shape[1:], m.dtype)
+                    for j, row in enumerate(chunk):
+                        vi = i0 + j
+                        if vi < len(rows):
+                            # virtual row: node-static encoded values; the
+                            # pod-aggregate columns stay zero (empty node)
+                            v = encoded[vi].get(name)
+                            if v is not None:
+                                arr[j] = v
+                        else:
+                            # masked row: live values with valid cleared
+                            arr[j] = m[row]
+                            if name == "valid":
+                                arr[j] = False
+                    upd[name] = arr
+                updates = DeviceSnapshot(**upd)
+                if self._rep_sharding is not None:
+                    sh = jax.tree.map(
+                        lambda _: self._rep_sharding, (idx, updates)
+                    )
+                    idx_d, updates_d = jax.device_put((idx, updates), sh)
+                else:
+                    idx_d, updates_d = jax.device_put((idx, updates))
+                out = _scatter_rows_safe(out, idx_d, updates_d)
+        return out, rows
 
 
 # Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata

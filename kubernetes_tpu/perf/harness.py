@@ -296,6 +296,104 @@ def run_latency_benchmark(
     )
 
 
+@dataclass
+class AutoscalerBenchResult:
+    """The `autoscaler` bench workload: N pending pods against an empty
+    cluster with a candidate-shape catalog — how long until the
+    scale-up→provision→flush→bind loop has EVERY pod bound."""
+
+    num_pods: int
+    num_shapes: int
+    scheduled: int
+    time_to_all_bound_s: float
+    nodes_provisioned: int
+    nodes_by_group: Dict[str, int]
+    simulation_passes: int
+    simulation_p50_ms: float
+    simulation_p99_ms: float
+
+
+def run_autoscaler_benchmark(
+    n_pods: int = 1000,
+    pod_cpu: str = "500m",
+    timeout_s: float = 300.0,
+    period_s: float = 0.5,
+    max_provision_per_cycle: int = 16,
+) -> AutoscalerBenchResult:
+    """Time-to-all-bound for a pending-pod burst served entirely by
+    autoscaler-provisioned capacity (store-acked hollow nodes, like the
+    throughput harness)."""
+    from ..api.objects import Container, ObjectMeta, PodSpec
+    from ..autoscaler import ClusterAutoscaler, NodeGroupCatalog
+    from .workloads import autoscaler_candidate_shapes
+
+    metrics.reset()
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    groups = autoscaler_candidate_shapes()
+    auto = ClusterAutoscaler(
+        server,
+        sched,
+        NodeGroupCatalog(groups),
+        period_s=period_s,
+        max_provision_per_cycle=max_provision_per_cycle,
+        scale_down_enabled=False,
+    )
+    for i in range(n_pods):
+        server.create(
+            "pods",
+            Pod(
+                metadata=ObjectMeta(name=f"asc-{i}"),
+                spec=PodSpec(
+                    containers=[Container(requests={"cpu": pod_cpu})]
+                ),
+            ),
+        )
+    sched.start()
+    t0 = time.monotonic()
+    auto.start()
+    try:
+        deadline = time.monotonic() + timeout_s
+        scheduled = 0
+        while time.monotonic() < deadline:
+            scheduled = _count_scheduled(server)
+            if scheduled >= n_pods:
+                break
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+    finally:
+        auto.stop()
+        sched.stop()
+    nodes, _ = server.list("nodes")
+    by_group = {
+        g.name: int(
+            metrics.counter(
+                "autoscaler_nodes_provisioned_total", {"group": g.name}
+            )
+        )
+        for g in groups
+    }
+    sim_h = metrics.histogram("autoscaler_simulation_duration_seconds")
+    passes = sum(
+        v
+        for _n, _l, v in metrics.snapshot_counters(
+            "autoscaler_simulation_passes_total"
+        )
+    )
+    p50, p99 = sim_h.quantiles((0.5, 0.99)) if sim_h else (0.0, 0.0)
+    return AutoscalerBenchResult(
+        num_pods=n_pods,
+        num_shapes=len(groups),
+        scheduled=scheduled,
+        time_to_all_bound_s=elapsed,
+        nodes_provisioned=len(nodes),
+        nodes_by_group=by_group,
+        simulation_passes=int(passes),
+        simulation_p50_ms=p50 * 1e3,
+        simulation_p99_ms=p99 * 1e3,
+    )
+
+
 def _count_scheduled(server: APIServer) -> int:
     return server.count("pods", lambda p: bool(p.spec.node_name))
 

@@ -243,6 +243,34 @@ def build_workload(
     raise KeyError(f"unknown workload {cfg.name}")
 
 
+def autoscaler_candidate_shapes():
+    """The 4-shape NodeGroup catalog of the `autoscaler` bench workload
+    (bench.py): 1k pending 500m-cpu pods against an EMPTY cluster; the
+    what-if planner must mix shapes to bring them all bound. Max sizes
+    give the catalog ~4x the needed capacity so shape CHOICE (not a
+    capacity wall) is what's measured."""
+    from ..autoscaler import NodeGroup, machine_shape
+
+    return [
+        NodeGroup(
+            name="c4", template=machine_shape(cpu="4", memory="16Gi"),
+            max_size=64,
+        ),
+        NodeGroup(
+            name="c8", template=machine_shape(cpu="8", memory="32Gi"),
+            max_size=32,
+        ),
+        NodeGroup(
+            name="c16", template=machine_shape(cpu="16", memory="64Gi"),
+            max_size=16,
+        ),
+        NodeGroup(
+            name="c32", template=machine_shape(cpu="32", memory="128Gi"),
+            max_size=8,
+        ),
+    ]
+
+
 WORKLOADS: Dict[str, WorkloadConfig] = {
     "SchedulingBasic/500": WorkloadConfig("SchedulingBasic", 500, 250, 1000),
     "SchedulingBasic/5000": WorkloadConfig("SchedulingBasic", 5000, 1000, 5000),

@@ -459,6 +459,13 @@ class SchedulerCache:
         with self.lock:
             return self._nodes.get(name)
 
+    def node_infos(self) -> Dict[str, NodeInfo]:
+        """One-lock snapshot of the NodeInfo map (references, not clones)
+        — the autoscaler's per-pass utilization scan takes the cache lock
+        once instead of once per node."""
+        with self.lock:
+            return dict(self._nodes)
+
     def dump(self) -> dict:
         """Debugger support (internal/cache/debugger): cache contents."""
         with self.lock:

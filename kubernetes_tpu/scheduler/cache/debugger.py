@@ -92,6 +92,12 @@ class CacheDebugger:
         if plane:
             lines.append("Dump of data-plane self-defense state:")
             lines.extend(plane)
+        from ...autoscaler.controller import autoscaler_health_lines
+
+        auto = autoscaler_health_lines()
+        if auto:
+            lines.append("Dump of cluster-autoscaler state:")
+            lines.extend(auto)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------

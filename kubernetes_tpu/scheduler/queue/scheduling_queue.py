@@ -123,8 +123,18 @@ class PriorityQueue:
                 self._unschedulable[key] = pi
 
     def _backoff_time(self, pi: QueuedPodInfo) -> float:
+        """Backoff expiry relative to the pod's LAST FAILURE (pi.timestamp
+        — every failure path stamps it), not to "now". The reference's
+        podBackoffQ keys expiry on lastFailure + backoffDuration
+        (scheduling_queue.go isPodBackingoff): a move event must flush a
+        pod whose backoff already elapsed straight to activeQ. The old
+        now-relative form re-armed the full backoff on every
+        MoveAllToActiveOrBackoffQueue, so a pod that had sat in
+        unschedulableQ for minutes still waited out a fresh 1-10 s after
+        the node-add that could place it — breaking the autoscaler's
+        "pending pods bind within one period" guarantee."""
         d = self._initial_backoff * (2 ** max(pi.attempts - 1, 0))
-        return time.monotonic() + min(d, self._max_backoff)
+        return pi.timestamp + min(d, self._max_backoff)
 
     def requeue_backoff(self, pi: QueuedPodInfo) -> None:
         """Re-queue a RETRYABLE pod through backoffQ (not unschedulableQ):
@@ -300,6 +310,14 @@ class PriorityQueue:
             return sorted(self._nominated_by_node.get(node_name, set()))
 
     # -- introspection -------------------------------------------------------
+
+    def unschedulable_pod_infos(self) -> List[QueuedPodInfo]:
+        """Snapshot of unschedulableQ (the autoscaler's scale-up input):
+        pods the scheduler proved don't fit the CURRENT cluster. Read-only
+        — entries stay queued; the autoscaler's node-add events flush them
+        back to activeQ through the normal move machinery."""
+        with self._lock:
+            return list(self._unschedulable.values())
 
     def pending_pods(self) -> dict:
         with self._lock:

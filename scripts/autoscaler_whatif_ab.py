@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Same-process A/B: what-if simulation cost + autoscaler overhead.
+
+Per the PR-2/PR-4 benchmarking caveat (this box's CPU walls swing 1.5-3x
+across runs on identical code), only same-process comparisons are
+meaningful. Two measurements:
+
+  1. **What-if pass cost**: warm p50/p99 wall of one overlay kernel pass
+     (P pending pods x K virtual rows on an N-node snapshot) — the unit
+     of work every autoscaler period may spend. Also splits out the
+     overlay build (copy-on-append scatter) from the kernel itself.
+
+  2. **Scheduler overhead A/B**: burst throughput with the autoscaler
+     loop IDLE-RUNNING against the live scheduler (nothing pending, so
+     every pass is queue snapshot + scale-down scan) vs stopped —
+     interleaved arms in ONE process. The claim to verify: an idle
+     autoscaler costs the data plane ~nothing, because what-if passes
+     only run when unschedulableQ is non-empty or a node goes
+     under-threshold.
+
+Usage: JAX_PLATFORMS=cpu python scripts/autoscaler_whatif_ab.py
+       [--nodes 1000] [--pods 256] [--virtual 16] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def measure_whatif(n_nodes: int, n_pods: int, n_virtual: int, reps: int):
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.autoscaler import WhatIfSimulator, machine_shape
+    from kubernetes_tpu.scheduler.cache.cache import SchedulerCache
+
+    cache = SchedulerCache()
+    cache.encoder.presize_for_cluster(n_nodes)
+    shape = machine_shape(cpu="4", memory="32Gi")
+    for i in range(n_nodes):
+        cache.add_node(shape(f"node-{i}"))
+    for i in range(n_nodes // 2):
+        cache.add_pod(
+            v1.Pod(
+                metadata=v1.ObjectMeta(name=f"pod-{i}"),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "1"})],
+                    node_name=f"node-{i * 2}",
+                ),
+            )
+        )
+    with cache.lock:
+        cache.encoder.flush()
+    sim = WhatIfSimulator(cache, max_pods_per_pass=max(256, n_pods))
+    pending = [
+        v1.Pod(
+            metadata=v1.ObjectMeta(name=f"pend-{i}"),
+            spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": "2"})]),
+        )
+        for i in range(n_pods)
+    ]
+    virtual = [shape(f"virt-{i}") for i in range(n_virtual)]
+
+    # overlay build alone (copy-on-append scatter, no kernel)
+    overlay_walls = []
+    for _ in range(reps + 1):
+        t0 = time.monotonic()
+        with cache.lock:
+            ov = cache.encoder.whatif_overlay(virtual)
+        assert ov is not None
+        overlay_walls.append(time.monotonic() - t0)
+    overlay_walls = overlay_walls[1:]  # first may compile
+
+    walls = []
+    for _ in range(reps + 1):
+        t0 = time.monotonic()
+        res = sim.simulate(pending, virtual)
+        assert res is not None
+        walls.append(time.monotonic() - t0)
+    walls = walls[1:]  # drop the compile-laden first pass
+
+    return {
+        "n_nodes": n_nodes,
+        "n_pods": n_pods,
+        "n_virtual": n_virtual,
+        "overlay_build_ms_p50": round(
+            statistics.median(overlay_walls) * 1e3, 2
+        ),
+        "whatif_pass_ms_p50": round(statistics.median(walls) * 1e3, 2),
+        "whatif_pass_ms_max": round(max(walls) * 1e3, 2),
+        "reps": reps,
+    }
+
+
+def measure_overhead_ab(n_nodes: int = 200, burst: int = 1000, arms: int = 2):
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.autoscaler import (
+        ClusterAutoscaler,
+        NodeGroup,
+        NodeGroupCatalog,
+        machine_shape,
+    )
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.scheduler import (
+        KubeSchedulerConfiguration,
+        Scheduler,
+    )
+
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    shape = machine_shape(cpu="64", memory="256Gi", pods=1000)
+    for i in range(n_nodes):
+        server.create("nodes", shape(f"node-{i}"))
+    group = NodeGroup(name="std", template=shape, max_size=n_nodes + 10)
+    auto = ClusterAutoscaler(
+        server,
+        sched,
+        NodeGroupCatalog([group]),
+        period_s=0.2,  # 5x the default cadence — overhead upper bound
+        # scale-down SCAN stays in the measured loop, but no node can
+        # qualify (util >= 0 > -1 always): a 0.0 threshold looked
+        # equivalent but let EMPTY nodes (util exactly 0.0) accrue
+        # streaks and actually drain mid-measurement, so the "idle" arm
+        # was measuring scale-down churn
+        scale_down_util_threshold=-1.0,
+    )
+    sched.start()
+    counter = [0]
+
+    def one_burst():
+        base = counter[0]
+        counter[0] += burst
+        t0 = time.monotonic()
+        for i in range(burst):
+            server.create(
+                "pods",
+                v1.Pod(
+                    metadata=v1.ObjectMeta(name=f"b-{base + i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "100m"})]
+                    ),
+                ),
+            )
+        target = base + burst
+        while (
+            server.count("pods", lambda p: bool(p.spec.node_name)) < target
+        ):
+            time.sleep(0.01)
+        return burst / (time.monotonic() - t0)
+
+    one_burst()  # warm compiles out of the measured arms
+    off, on = [], []
+    try:
+        for _ in range(arms):
+            off.append(one_burst())
+            auto.start()
+            time.sleep(0.2)  # let idle passes establish
+            on.append(one_burst())
+            auto.stop()
+            auto._thread = None
+    finally:
+        sched.stop()
+    return {
+        "n_nodes": n_nodes,
+        "burst": burst,
+        "arms": arms,
+        "off_pods_per_s": [round(x, 1) for x in off],
+        "on_pods_per_s": [round(x, 1) for x in on],
+        "overhead_pct": round(
+            (1.0 - statistics.mean(on) / statistics.mean(off)) * 100.0, 1
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=256)
+    ap.add_argument("--virtual", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--skip-overhead", action="store_true")
+    args = ap.parse_args()
+    out = {
+        "whatif": measure_whatif(
+            args.nodes, args.pods, args.virtual, args.reps
+        )
+    }
+    if not args.skip_overhead:
+        out["overhead_ab"] = measure_overhead_ab()
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,6 +48,7 @@ DEFAULT_FILES = [
     "tests/test_chaos.py",
     "tests/test_chaos_pipeline.py",
     "tests/test_chaos_device.py",
+    "tests/test_chaos_autoscaler.py",
 ]
 
 # tests whose id contains this substring absorb per-process compile cost

@@ -1,0 +1,333 @@
+"""Kernel-driven cluster autoscaler: planner + overlay unit/regression tests.
+
+The e2e loop (scale-up → bind, drain simulation → rate-limited scale-down)
+lives in tests/test_chaos_autoscaler.py on the ChaosStore invariant ledger;
+this file pins the simulation machinery itself:
+
+  * overlay ISOLATION — a what-if pass leaves every live snapshot tensor
+    bit-identical (the acceptance criterion's regression test)
+  * the what-if planner's decisions (fewest-nodes packing, shape choice,
+    drain feasibility) through the production kernel
+  * the queue satellite: node-add flushes unschedulableQ with
+    failure-relative backoff (MoveAllToActiveOrBackoffQueue semantics)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.autoscaler import (
+    NodeGroup,
+    NodeGroupCatalog,
+    WhatIfSimulator,
+    machine_shape,
+    plan_scale_up,
+    simulate_drain,
+)
+from kubernetes_tpu.autoscaler.controller import autoscaler_health_lines
+from kubernetes_tpu.scheduler.cache.cache import SchedulerCache
+from kubernetes_tpu.scheduler.queue import PriorityQueue, QueuedPodInfo
+from kubernetes_tpu.utils.metrics import metrics
+
+
+def make_node(name, cpu="4", memory="32Gi", pods=110, labels=None):
+    return machine_shape(cpu=cpu, memory=memory, pods=pods, labels=labels)(
+        name
+    )
+
+
+def make_pod(name, cpu="100m", node=None, node_selector=None, owners=None):
+    p = v1.Pod(
+        metadata=v1.ObjectMeta(
+            name=name, owner_references=list(owners or [])
+        ),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": cpu})],
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+    if node:
+        p.spec.node_name = node
+    return p
+
+
+def fill_cache(n_nodes=3, pods_per_node=2, cpu="4"):
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"node-{i}", cpu=cpu))
+    for i in range(n_nodes):
+        for j in range(pods_per_node):
+            cache.add_pod(make_pod(f"pod-{i}-{j}", node=f"node-{i}"))
+    return cache
+
+
+def snapshot_host_copy(enc):
+    """Every live device tensor pulled to host (field -> np.ndarray)."""
+    dev = enc._device
+    return {
+        name: np.asarray(jax.device_get(getattr(dev, name)))
+        for name in dev._fields
+    }
+
+
+# -- overlay isolation (acceptance criterion) --------------------------------
+
+
+def test_whatif_overlay_leaves_live_tensors_bit_identical():
+    cache = fill_cache()
+    with cache.lock:
+        cache.encoder.flush()
+    live_before = cache.encoder._device
+    before = snapshot_host_copy(cache.encoder)
+
+    sim = WhatIfSimulator(cache)
+    res = sim.simulate(
+        [make_pod(f"pend-{i}", cpu="2") for i in range(8)],
+        [make_node("virt-0", cpu="8"), make_node("virt-1", cpu="8")],
+        mask_node="node-1",
+    )
+    assert res is not None
+    assert (res.chosen >= 0).any()
+
+    # the live snapshot OBJECT was never replaced...
+    assert cache.encoder._device is live_before
+    # ...and every tensor is bit-identical to its pre-pass copy
+    after = snapshot_host_copy(cache.encoder)
+    for name, b in before.items():
+        assert np.array_equal(b, after[name]), (
+            f"what-if pass perturbed live snapshot field {name}"
+        )
+
+
+def test_whatif_overlay_appends_virtual_rows_and_masks():
+    cache = fill_cache(n_nodes=2)
+    enc = cache.encoder
+    with cache.lock:
+        enc.flush()
+        ov = enc.whatif_overlay(
+            [make_node("virt-0", cpu="8")], mask_rows=[enc.row_of("node-0")]
+        )
+    assert ov is not None
+    snap, rows = ov
+    valid = np.asarray(jax.device_get(snap.valid))
+    assert valid[rows[0]], "virtual row not marked valid in the overlay"
+    assert not valid[enc.row_of("node-0")], "masked row still valid"
+    # live row untouched
+    live_valid = np.asarray(jax.device_get(enc._device.valid))
+    assert live_valid[enc.row_of("node-0")]
+    # virtual rows landed on FREE rows only
+    assert rows[0] not in (enc.row_of("node-0"), enc.row_of("node-1"))
+
+
+def test_whatif_overlay_refuses_when_no_free_rows():
+    cache = SchedulerCache()
+    enc = cache.encoder
+    with cache.lock:
+        for i in range(enc.cfg.n_cap):
+            cache.add_node(make_node(f"n-{i}"))
+        enc.flush()
+        assert enc.whatif_overlay([make_node("v-0")]) is None
+
+
+def test_encode_node_row_values_matches_write_node_row():
+    """Refactor guard: the shared row encoding and the live masters agree."""
+    cache = SchedulerCache()
+    node = make_node(
+        "n-0", cpu="8", memory="16Gi", labels={"zone": "z1", "rank": "3"}
+    )
+    node.spec.taints = [v1.Taint("dedicated", "infra", v1.TAINT_NO_SCHEDULE)]
+    cache.add_node(node)
+    enc = cache.encoder
+    row = enc.row_of("n-0")
+    vals = enc.encode_node_row_values(node)
+    assert bool(enc.m_valid[row]) is True
+    np.testing.assert_array_equal(enc.m_alloc[row], vals["allocatable"])
+    np.testing.assert_array_equal(enc.m_label_vals[row], vals["label_vals"])
+    np.testing.assert_array_equal(enc.m_taint_key[row], vals["taint_key"])
+    np.testing.assert_array_equal(enc.m_taint_eff[row], vals["taint_effect"])
+
+
+# -- scale-up planning --------------------------------------------------------
+
+
+def test_plan_scale_up_packs_fewest_virtual_nodes():
+    # 3 full nodes (4-cpu, 2x 1900m pods); 8 pending 1-cpu pods need
+    # exactly 2 fresh 4-cpu nodes — the kernel pass must not ask for more
+    cache = fill_cache(n_nodes=3, pods_per_node=2, cpu="4")
+    for i in range(3):
+        for j in range(2):
+            cache.remove_pod(make_pod(f"pod-{i}-{j}", node=f"node-{i}"))
+            cache.add_pod(
+                make_pod(f"big-{i}-{j}", cpu="1900m", node=f"node-{i}")
+            )
+    sim = WhatIfSimulator(cache)
+    catalog = NodeGroupCatalog(
+        [NodeGroup(name="std", template=machine_shape(cpu="4"), max_size=20)]
+    )
+    pending = [make_pod(f"pend-{i}", cpu="1") for i in range(8)]
+    plan = plan_scale_up(
+        sim, catalog, pending, {"std": 0}, {"node-0", "node-1", "node-2"}
+    )
+    assert plan.placed == 8
+    assert plan.unplaced == 0
+    assert sorted(plan.nodes) == ["std"]
+    assert len(plan.nodes["std"]) == 2, (
+        f"expected 2 nodes for 8x1cpu on 4-cpu shapes, got {plan.nodes}"
+    )
+
+
+def test_plan_scale_up_no_nodes_when_pods_fit_existing():
+    cache = fill_cache(n_nodes=2, pods_per_node=0)
+    sim = WhatIfSimulator(cache)
+    catalog = NodeGroupCatalog(
+        [NodeGroup(name="std", template=machine_shape(cpu="4"), max_size=20)]
+    )
+    plan = plan_scale_up(
+        sim,
+        catalog,
+        [make_pod("pend-0", cpu="1")],
+        {"std": 0},
+        {"node-0", "node-1"},
+    )
+    assert plan.total_nodes == 0
+    assert plan.placed == 1
+
+
+def test_plan_scale_up_respects_max_size():
+    cache = SchedulerCache()
+    cache.add_node(make_node("node-0", cpu="1"))
+    sim = WhatIfSimulator(cache)
+    catalog = NodeGroupCatalog(
+        [NodeGroup(name="std", template=machine_shape(cpu="4"), max_size=1)]
+    )
+    pending = [make_pod(f"pend-{i}", cpu="3") for i in range(6)]
+    plan = plan_scale_up(sim, catalog, pending, {"std": 1}, {"node-0"})
+    # group already at max: nothing to provision, pods stay unplaced
+    assert plan.total_nodes == 0
+    assert plan.skipped
+
+
+def test_plan_scale_up_picks_shape_that_fits():
+    """A pod too big for the small shape must land on the big shape."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("node-0", cpu="1"))
+    sim = WhatIfSimulator(cache)
+    catalog = NodeGroupCatalog(
+        [
+            NodeGroup(
+                name="small", template=machine_shape(cpu="2"), max_size=10
+            ),
+            NodeGroup(
+                name="big", template=machine_shape(cpu="16"), max_size=10
+            ),
+        ]
+    )
+    pending = [make_pod("huge", cpu="8")]
+    plan = plan_scale_up(
+        sim, catalog, pending, {"small": 0, "big": 0}, {"node-0"}
+    )
+    assert plan.placed == 1
+    assert list(plan.nodes) == ["big"]
+    assert len(plan.nodes["big"]) == 1
+
+
+# -- drain simulation ---------------------------------------------------------
+
+
+def test_simulate_drain_ok_when_pods_replace():
+    cache = fill_cache(n_nodes=3, pods_per_node=1, cpu="4")
+    sim = WhatIfSimulator(cache)
+    resident = list(cache.get_node_info("node-0").pods)
+    verdict = simulate_drain(sim, "node-0", resident)
+    assert verdict.ok
+    assert verdict.replaced == 1
+
+
+def test_simulate_drain_blocked_when_pod_cannot_replace():
+    cache = SchedulerCache()
+    cache.add_node(make_node("pinned", cpu="4", labels={"pin": "yes"}))
+    cache.add_node(make_node("other", cpu="4"))
+    pod = make_pod(
+        "stuck", cpu="100m", node="pinned", node_selector={"pin": "yes"}
+    )
+    cache.add_pod(pod)
+    sim = WhatIfSimulator(cache)
+    verdict = simulate_drain(sim, "pinned", [pod])
+    assert not verdict.ok
+    assert "do not re-place" in verdict.reason
+
+
+def test_simulate_drain_empty_node_is_ok():
+    cache = fill_cache(n_nodes=2, pods_per_node=0)
+    sim = WhatIfSimulator(cache)
+    assert simulate_drain(sim, "node-0", []).ok
+
+
+# -- queue satellite: node-add flushes unschedulableQ -------------------------
+
+
+def test_move_all_flushes_expired_backoff_straight_to_active():
+    """Regression (autoscaler period guarantee): a pod whose backoff
+    already elapsed must land in ACTIVE on a move event — the old
+    now-relative backoff re-armed 1-10 s on every flush, so a node-add
+    never made pods immediately poppable."""
+    q = PriorityQueue()
+    pi = QueuedPodInfo(make_pod("p-0"))
+    pi.attempts = 1
+    q.add_unschedulable_if_not_present(pi, q.moves)
+    assert q.pending_pods()["unschedulable"] == [pi.key]
+    # failure happened 30 s ago (initial backoff is 1 s)
+    pi.timestamp = time.monotonic() - 30.0
+    q.move_all_to_active_or_backoff("NodeAdd")
+    pending = q.pending_pods()
+    assert pending["active"] == [pi.key], f"not flushed to active: {pending}"
+    assert pending["backoff"] == []
+
+
+def test_move_all_still_backs_off_fresh_failures():
+    q = PriorityQueue(pod_initial_backoff=5.0)
+    pi = QueuedPodInfo(make_pod("p-0"))
+    pi.attempts = 1
+    q.add_unschedulable_if_not_present(pi, q.moves)
+    q.move_all_to_active_or_backoff("NodeAdd")  # failed just now
+    pending = q.pending_pods()
+    assert pending["backoff"] == [pi.key]
+    assert pending["active"] == []
+
+
+def test_unschedulable_pod_infos_snapshot_is_nonconsuming():
+    q = PriorityQueue()
+    pi = QueuedPodInfo(make_pod("p-0"))
+    q.add_unschedulable_if_not_present(pi, q.moves)
+    snap = q.unschedulable_pod_infos()
+    assert [p.pod.metadata.name for p in snap] == ["p-0"]
+    assert q.pending_pods()["unschedulable"] == [pi.key]  # still queued
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_autoscaler_metrics_and_health_lines():
+    cache = fill_cache(n_nodes=2, pods_per_node=0)
+    sim = WhatIfSimulator(cache)
+    base = metrics.counter(
+        "autoscaler_simulation_passes_total", {"kind": "scale_up"}
+    )
+    sim.simulate([make_pod("p-0")], [make_node("v-0")])
+    assert (
+        metrics.counter(
+            "autoscaler_simulation_passes_total", {"kind": "scale_up"}
+        )
+        == base + 1
+    )
+    metrics.set_gauge("autoscaler_pending_pods", 3.0)
+    lines = autoscaler_health_lines()
+    joined = "\n".join(lines)
+    assert "autoscaler_pending_pods" in joined
+    assert "autoscaler_simulation_duration_seconds" in joined
+    # rendered by /metrics exposition too (the gauge family lands there)
+    assert "autoscaler_pending_pods" in metrics.render_prometheus()

@@ -1,0 +1,380 @@
+"""Autoscaler e2e chaos: scale-up/scale-down on the ChaosStore ledger.
+
+Acceptance scenarios for the kernel-driven cluster autoscaler:
+
+  * pending unschedulable pods → one what-if overlay pass → nodes
+    provisioned (hollow kubelets pick them up) → ALL pods bind within one
+    autoscaler period of the capacity registering; zero evictions, zero
+    acked-bind loss, zero double-binds
+  * scale-down only after the drain simulation proves every resident pod
+    re-places: evictions flow through the token bucket, the controller-
+    owned pods are recreated and re-bind on surviving nodes, the empty
+    node is deleted and its hollow kubelet torn down
+  * a node whose resident pod CANNOT re-place (simulation-infeasible) is
+    never cordoned and never loses a pod — the zero-eviction guarantee
+  * a degraded (read-only) store pauses provisioning without killing the
+    loop; scale-up completes after recovery
+"""
+
+import time
+
+import pytest
+
+from test_chaos_pipeline import (
+    ChaosStore,
+    _watch_deletions,
+    assert_bind_invariants,
+    wait_until,
+)
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.autoscaler import (
+    ClusterAutoscaler,
+    NodeGroup,
+    NodeGroupCatalog,
+    machine_shape,
+)
+from kubernetes_tpu.controller.replicaset import ReplicaSetController
+from kubernetes_tpu.kubemark.hollow_node import HollowCluster
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.utils.metrics import metrics
+
+
+def make_pod(name, cpu="1", node_selector=None, owners=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, owner_references=list(owners or [])),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": cpu})],
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+
+
+def _bound_count(store):
+    return store.count("pods", lambda p: bool(p.spec.node_name))
+
+
+def _get_or_none(store, kind, ns, name):
+    from kubernetes_tpu.client.apiserver import NotFound
+
+    try:
+        return store.get(kind, ns, name)
+    except NotFound:
+        return None
+
+
+def _rig(store, groups, **auto_kw):
+    """hollow kubelet pool + scheduler + autoscaler, wired together."""
+    hollow = HollowCluster(
+        store, heartbeat_interval=0.5, housekeeping_interval=0.1
+    )
+    for g in groups:
+        g.provision, g.deprovision = hollow.provisioner_for(g.make_node)
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    auto = ClusterAutoscaler(
+        store, sched, NodeGroupCatalog(groups), **auto_kw
+    )
+    return hollow, sched, auto
+
+
+def test_warmup_compile_autoscaler_kernels():
+    """Lint-exempt compile absorber (`warmup_compile` substring — see
+    scripts/check_slow_markers.py): the first what-if pass in this process
+    pays the serial lattice kernel + overlay scatter XLA compiles, which
+    are positional, not per-test. The pass runs against a REAL scheduler's
+    cache (8-virtual-device mesh ⇒ sharded snapshot), because the sharded
+    variants are distinct executables from the bare-cache ones — an
+    unsharded warmup absorbs nothing the scenarios below use."""
+    from kubernetes_tpu.autoscaler import WhatIfSimulator
+
+    store = ChaosStore()
+    sched = Scheduler(store, KubeSchedulerConfiguration())
+    sched.start()
+    try:
+        store.create(
+            "nodes", machine_shape(cpu="4", memory="32Gi", pods=32)("warm-n0")
+        )
+        assert wait_until(
+            lambda: sched.cache.get_node_info("warm-n0") is not None, 10
+        )
+        sim = WhatIfSimulator(sched.cache)
+        res = sim.simulate(
+            [make_pod("warm-p0")],
+            [machine_shape(cpu="4", memory="32Gi", pods=32)("warm-v0")],
+            mask_node="warm-n0",
+        )
+        assert res is not None
+        # drive one pod through the UNSCHEDULABLE path too: the failure
+        # handler's preempt-whatif kernel is yet another positional
+        # compile the scale-up scenario would otherwise pay
+        for i in range(5):
+            store.create("pods", make_pod(f"warm-big-{i}", cpu="64"))
+        assert wait_until(
+            lambda: len(sched.queue.unschedulable_pod_infos()) == 5, 30
+        )
+    finally:
+        sched.stop()
+
+
+def test_scale_up_pending_pods_bind_within_one_period():
+    """Acceptance: unschedulable pods drive a what-if pass, the kernel's
+    chosen virtual rows become real nodes, and the queue's node-add flush
+    (failure-relative backoff) gets every pod bound within one autoscaler
+    period of the capacity registering — with zero evictions anywhere."""
+    store = ChaosStore()
+    period = 0.3
+    group = NodeGroup(
+        name="std",
+        template=machine_shape(cpu="4", memory="32Gi", pods=32),
+        max_size=16,
+    )
+    hollow, sched, auto = _rig(
+        store, [group], period_s=period, scale_down_enabled=False
+    )
+    n = 12  # 1-cpu pods on 4-cpu shapes: 3 nodes
+    for i in range(n):
+        store.create("pods", make_pod(f"pend-{i}"))
+    deletions = []
+    w = _watch_deletions(store, deletions)
+    hollow.start()
+    sched.start()
+    try:
+        # no nodes at all: the whole burst lands in unschedulableQ
+        assert wait_until(
+            lambda: len(sched.queue.unschedulable_pod_infos()) == n, 30
+        ), "pods never reached unschedulableQ"
+        assert _bound_count(store) == 0
+        auto.start()
+        assert wait_until(lambda: store.count("nodes") > 0, 15), (
+            "autoscaler never provisioned"
+        )
+        t_nodes = time.monotonic()
+        assert wait_until(lambda: _bound_count(store) == n, 20), (
+            f"only {_bound_count(store)}/{n} bound after scale-up"
+        )
+        elapsed = time.monotonic() - t_nodes
+        # one autoscaler period + scheduling slack — NOT the 30-60 s
+        # unschedulableQ leftover sweep the queue satellite bypasses
+        assert elapsed <= period + 4.5, (
+            f"bind-after-capacity budget blown: {elapsed:.1f}s"
+        )
+        nodes, _ = store.list("nodes")
+        assert 3 <= len(nodes) <= 4, (
+            f"expected ~3 nodes for 12x1cpu on 4-cpu shapes, got {len(nodes)}"
+        )
+        assert not deletions, f"scale-up must evict nothing: {deletions}"
+        assert_bind_invariants(store)
+        print(
+            f"\n[chaos-autoscaler] scale-up: {n} pods bound {elapsed:.2f}s "
+            f"after capacity registered ({len(nodes)} nodes provisioned)",
+            flush=True,
+        )
+    finally:
+        auto.stop()
+        sched.stop()
+        hollow.stop()
+        w.stop()
+
+
+@pytest.mark.slow
+def test_scale_down_drains_only_after_simulation_proves_replacement():
+    """Underutilized node → drain simulation proves re-placement → cordon
+    → rate-limited eviction → ReplicaSet recreates the pods → they re-bind
+    on surviving nodes → empty node deleted + kubelet deprovisioned. The
+    fleet converges to min_size with every replica bound."""
+    store = ChaosStore()
+    group = NodeGroup(
+        name="pool",
+        template=machine_shape(cpu="4", memory="32Gi", pods=32),
+        min_size=2,
+        max_size=8,
+    )
+    hollow, sched, auto = _rig(
+        store,
+        [group],
+        period_s=0.2,
+        scale_down_util_threshold=0.3,
+        scale_down_unneeded_passes=2,
+    )
+    for i in range(3):
+        hollow.add_node(f"pool-n{i}", template=group.make_node)
+    rsc = ReplicaSetController(store, resync_period=0.5)
+    rs = v1.ReplicaSet(
+        metadata=v1.ObjectMeta(name="web"),
+        spec=v1.ReplicaSetSpec(
+            replicas=4,
+            selector={"app": "web"},
+            template=v1.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": "web"}),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "1"})]
+                ),
+            ),
+        ),
+    )
+    store.create("replicasets", rs)
+    hollow.start()
+    sched.start()
+    rsc.start()
+    try:
+        assert wait_until(lambda: _bound_count(store) == 4, 30), (
+            f"RS pods never all bound: {_bound_count(store)}/4"
+        )
+        evictions0 = metrics.counter("autoscaler_evictions_total")
+        auto.start()
+        # at least one of the 3 nodes is under 30% cpu (4 pods can at
+        # most fill two nodes half-full) — it must drain and disappear
+        assert wait_until(lambda: store.count("nodes") == 2, 30), (
+            f"fleet never converged to min_size: {store.count('nodes')} nodes"
+        )
+        # every replica re-bound on the survivors
+        assert wait_until(
+            lambda: _bound_count(store) == 4
+            and all(
+                p.spec.node_name != ""
+                and _get_or_none(store, "nodes", "", p.spec.node_name)
+                is not None
+                for p in store.list("pods")[0]
+            ),
+            30,
+        ), "replicas did not re-place on surviving nodes"
+        assert metrics.counter("autoscaler_evictions_total") > evictions0
+        assert (
+            metrics.counter("autoscaler_nodes_removed_total", {"group": "pool"})
+            >= 1.0
+        )
+        # min_size floor holds even though survivors are under-threshold
+        time.sleep(1.0)
+        assert store.count("nodes") == 2
+        # evicted (deleted) RS pods are expected; bound-exactly-once and
+        # zero acked-loss still hold for every live pod
+        assert_bind_invariants(store, allow_deleted=True)
+        # the drained node's hollow kubelet was torn down with it
+        live_nodes = {n.metadata.name for n in store.list("nodes")[0]}
+        assert set(hollow.nodes) == live_nodes
+    finally:
+        auto.stop()
+        rsc.stop()
+        sched.stop()
+        hollow.stop()
+
+
+def test_simulation_infeasible_node_is_never_drained():
+    """Zero-eviction guarantee: a node whose resident pod can re-place
+    NOWHERE (nodeSelector pins it) is never cordoned and never loses the
+    pod, no matter how underutilized it is."""
+    store = ChaosStore()
+    pinned_shape = machine_shape(
+        cpu="4", memory="32Gi", pods=32, labels={"pin": "yes"}
+    )
+    group = NodeGroup(
+        name="pool",
+        template=machine_shape(cpu="4", memory="32Gi", pods=32),
+        min_size=0,
+        max_size=8,
+    )
+    hollow, sched, auto = _rig(
+        store,
+        [group],
+        period_s=0.1,
+        scale_down_util_threshold=0.5,
+        scale_down_unneeded_passes=2,
+    )
+
+    def pinned_template(name):
+        node = pinned_shape(name)
+        node.metadata.labels[v1.LABEL_NODEGROUP] = group.name
+        return node
+
+    hollow.add_node("pool-pinned", template=pinned_template)
+    hollow.add_node("pool-other", template=group.make_node)
+    # owner-ref'd (movable) but nodeSelector-pinned: only the simulation
+    # can prove the drain is unsafe
+    store.create(
+        "pods",
+        make_pod(
+            "stuck",
+            cpu="100m",
+            node_selector={"pin": "yes"},
+            owners=[v1.OwnerReference(kind="ReplicaSet", name="ghost")],
+        ),
+    )
+    deletions = []
+    w = _watch_deletions(store, deletions)
+    blocked0 = metrics.counter(
+        "autoscaler_scale_down_blocked_total",
+        {"reason": "simulation_infeasible"},
+    )
+    hollow.start()
+    sched.start()
+    try:
+        assert wait_until(lambda: _bound_count(store) == 1, 30)
+        auto.start()
+        # give the controller many passes to (wrongly) act
+        assert wait_until(
+            lambda: metrics.counter(
+                "autoscaler_scale_down_blocked_total",
+                {"reason": "simulation_infeasible"},
+            )
+            > blocked0,
+            15,
+        ), "drain simulation never evaluated the pinned node"
+        time.sleep(1.0)
+        node = _get_or_none(store, "nodes", "", "pool-pinned")
+        assert node is not None, "infeasible node was deleted"
+        assert not node.spec.unschedulable, "infeasible node was cordoned"
+        assert not deletions, (
+            f"zero-eviction guarantee broken: {deletions}"
+        )
+        # the empty OTHER node may legally drain (nothing resident)
+        assert_bind_invariants(store)
+    finally:
+        auto.stop()
+        sched.stop()
+        hollow.stop()
+        w.stop()
+
+
+def test_degraded_store_pauses_provisioning_until_recovery():
+    """PR-1/PR-3 discipline: a read-only store makes provisioning a
+    counted skip, not a crash; scale-up completes once writes reopen."""
+    store = ChaosStore()
+    group = NodeGroup(
+        name="std",
+        template=machine_shape(cpu="4", memory="32Gi", pods=32),
+        max_size=8,
+    )
+    hollow, sched, auto = _rig(
+        store, [group], period_s=0.2, scale_down_enabled=False
+    )
+    for i in range(4):
+        store.create("pods", make_pod(f"pend-{i}"))
+    hollow.start()
+    sched.start()
+    try:
+        assert wait_until(
+            lambda: len(sched.queue.unschedulable_pod_infos()) == 4, 30
+        )
+        store.degrade()
+        skips0 = metrics.counter(
+            "autoscaler_degraded_write_skips_total", {"write": "provision"}
+        )
+        auto.start()
+        assert wait_until(
+            lambda: metrics.counter(
+                "autoscaler_degraded_write_skips_total",
+                {"write": "provision"},
+            )
+            > skips0,
+            15,
+        ), "degraded store never produced a counted provision skip"
+        assert store.count("nodes") == 0, "provisioned against a read-only store"
+        store.recover()
+        assert wait_until(lambda: _bound_count(store) == 4, 20), (
+            "scale-up never completed after store recovery"
+        )
+        assert_bind_invariants(store)
+    finally:
+        auto.stop()
+        sched.stop()
+        hollow.stop()
