@@ -11,12 +11,15 @@
 #                         poisoned kernel outputs, device-loss ride-through
 #   make chaos-autoscaler autoscaler e2e only: scale-up bind budget, drain
 #                         simulation gating, zero-eviction guarantee
+#   make chaos-readpath   read-path chaos only: hollow-informer storms on
+#                         the watch cache (one store watch per kind, zero
+#                         relists after a flap, zero bind starvation)
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 
 PY ?= python
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
-	chaos-device chaos-autoscaler lint-slow
+	chaos-device chaos-autoscaler chaos-readpath lint-slow
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -26,7 +29,8 @@ chaos:
 		tests/test_replication_quorum.py \
 		tests/test_replication.py tests/test_chaos.py \
 		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
-		tests/test_chaos_autoscaler.py -q
+		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
+		tests/test_watchcache.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -35,6 +39,9 @@ chaos-device:
 chaos-autoscaler:
 	$(PY) -m pytest tests/test_chaos_warmup.py \
 		tests/test_chaos_autoscaler.py -q
+
+chaos-readpath:
+	$(PY) -m pytest tests/test_chaos_readpath.py tests/test_watchcache.py -q
 
 lint-slow:
 	$(PY) scripts/check_slow_markers.py

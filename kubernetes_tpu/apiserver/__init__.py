@@ -8,3 +8,4 @@ versioned store, `/api/v1` paths, JSON wire format.
 
 from .rest import APIServerHTTP, serve  # noqa: F401
 from .client import RESTClient  # noqa: F401
+from .cacher import Cacher  # noqa: F401

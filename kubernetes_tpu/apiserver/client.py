@@ -242,6 +242,8 @@ class RESTClient:
             # and the consumer's relist path recovers
 
         def pump():
+            from ..runtime.watch import BOOKMARK
+
             try:
                 with resp:
                     for line in resp:
@@ -251,6 +253,21 @@ class RESTClient:
                         if not line:
                             continue
                         msg = json.loads(line)
+                        if msg["type"] == BOOKMARK:
+                            # rv-only progress notify from the watch cache
+                            # (idle heartbeat / window keep-alive): carry
+                            # the rv through; informers advance their
+                            # resume position on it, other consumers skip
+                            # unknown event types
+                            rv = int(
+                                (msg.get("object") or {})
+                                .get("metadata", {})
+                                .get("resourceVersion", 0)
+                            )
+                            from .cacher import bookmark_object
+
+                            w.push(Event(BOOKMARK, bookmark_object(kind, rv), rv))
+                            continue
                         obj = codec.decode(kind, msg["object"])
                         w.push(
                             Event(

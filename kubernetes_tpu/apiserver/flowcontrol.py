@@ -23,22 +23,44 @@ from ..utils.metrics import metrics
 logger = logging.getLogger("kubernetes_tpu.apiserver.flowcontrol")
 
 
+GAUGE_SEATS_IN_USE = "apiserver_flowcontrol_seats_in_use"  # {priority_level}
+GAUGE_SEATS_TOTAL = "apiserver_flowcontrol_seats_total"    # {priority_level}
+
+
 @dataclass
 class PriorityLevel:
     """One isolated concurrency pool (flowcontrol.PriorityLevelConfiguration:
-    assured concurrency shares)."""
+    assured concurrency shares). Seat occupancy is published as gauges so
+    a read storm's pressure — and the isolation protecting heartbeats and
+    binds from it — is visible in /metrics and the SIGUSR2 dump."""
 
     name: str
     shares: int = 20
     exempt: bool = False
     _sem: Optional[threading.Semaphore] = field(default=None, repr=False)
+    _seats: int = field(default=0, repr=False)
+    _in_use: int = field(default=0, repr=False)
+    _mu: Optional[threading.Lock] = field(default=None, repr=False)
 
     def setup(self, total_concurrency: int, total_shares: int) -> None:
+        self._mu = threading.Lock()
         if self.exempt:
             self._sem = None
             return
         n = max(1, round(total_concurrency * self.shares / max(1, total_shares)))
+        self._seats = n
         self._sem = threading.BoundedSemaphore(n)
+        metrics.set_gauge(GAUGE_SEATS_TOTAL, n, {"priority_level": self.name})
+        metrics.set_gauge(GAUGE_SEATS_IN_USE, 0, {"priority_level": self.name})
+
+    def _occupy(self, delta: int) -> None:
+        if self._mu is None:
+            return
+        with self._mu:
+            self._in_use += delta
+            metrics.set_gauge(
+                GAUGE_SEATS_IN_USE, self._in_use, {"priority_level": self.name}
+            )
 
 
 @dataclass
@@ -61,17 +83,25 @@ def _is_system_user(user) -> bool:
 
 def default_levels() -> List[PriorityLevel]:
     # bootstrap levels (apiserver/pkg/apis/flowcontrol/bootstrap): shares
-    # proportioned like the reference's defaults
+    # proportioned like the reference's defaults, plus a dedicated pool
+    # for watch INITIALIZATION (list-from-cache replay + window replay):
+    # 10k cold informers connecting at once contend for watch-init seats
+    # against each other, never against the system pool serving kubelet
+    # heartbeats and scheduler binds
     return [
         PriorityLevel("exempt", exempt=True),
         PriorityLevel("system", shares=30),
         PriorityLevel("leader-election", shares=10),
+        PriorityLevel("watch-init", shares=10),
         PriorityLevel("workload-high", shares=40),
         PriorityLevel("global-default", shares=20),
     ]
 
 
 def default_schemas() -> List[FlowSchema]:
+    # watch-init sits AFTER the system schemas: a system component's watch
+    # re-establishment rides its protected pool, while workload informers'
+    # watch inits — the storm-shaped traffic — are penned into watch-init
     return [
         FlowSchema(
             "exempt",
@@ -84,6 +114,7 @@ def default_schemas() -> List[FlowSchema]:
             lambda u, r, v: r == "leases" and _is_system_user(u),
         ),
         FlowSchema("system-nodes", "system", lambda u, r, v: _is_system_user(u)),
+        FlowSchema("watch-init", "watch-init", lambda u, r, v: v == "watch"),
         FlowSchema(
             "service-accounts",
             "workload-high",
@@ -163,8 +194,10 @@ class FlowController:
             "apiserver_flowcontrol_dispatched_requests_total",
             {"priority_level": lv.name},
         )
+        lv._occupy(+1)
         return lv
 
     def end(self, level: PriorityLevel) -> None:
         if not level.exempt and level._sem is not None:
             level._sem.release()
+            level._occupy(-1)

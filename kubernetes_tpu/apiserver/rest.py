@@ -529,32 +529,72 @@ class _Handler(BaseHTTPRequestHandler):
             finally:
                 self._request_user = None
 
+    _watch_seat = None  # (flow, level) held during watch INITIALIZATION
+
+    def _release_watch_seat(self) -> None:
+        """Release the APF seat a watch held for its init phase (list/
+        window replay). Idempotent — called by _serve_watch as soon as
+        the replay drains, and again by _limited's finally as a backstop
+        for error paths that never reached the drain point."""
+        seat = self._watch_seat
+        if seat is not None:
+            self._watch_seat = None
+            fc, lv = seat
+            fc.end(lv)
+
+    def _flow_admit(self, fc, verb: str):
+        """authn → classify → admit for APF. Returns the admitted level,
+        or None when a response (401/429) was already written. Memoizes
+        the classification's identity for this one request: the handler's
+        _authorize and _audited's event reuse it instead of re-resolving
+        the token. Cleared by _audited's outer finally (keep-alive
+        connections reuse the handler across requests); when no audit is
+        configured the caller's finally clears it."""
+        from .flowcontrol import RequestRejected
+
+        user, ok = self._authenticate()
+        if not ok:
+            return None
+        resource, _, _, _ = self._parse()
+        try:
+            lv = fc.begin(user, resource or "", verb)
+        except RequestRejected as e:
+            self._status_error(429, "TooManyRequests", str(e))
+            return None
+        self._request_user = (user, True)
+        return lv
+
     def _limited(self, handler):
         """WithPriorityAndFairness when a FlowController is configured,
         else WithMaxInFlightLimit, else unlimited (insecure dev port).
         Request order through the chain matches DefaultBuildHandlerChain:
-        authn happens before flow classification, authz after."""
+        authn happens before flow classification, authz after.
+
+        Watch streams are exempt from the per-request limiters for their
+        LIFETIME, but their INITIALIZATION — the cache replay that makes a
+        cold informer expensive — occupies a seat (watch-init seat
+        accounting, the reference's APF watch-init cost): 10k informers
+        reconnecting at once queue behind the watch-init pool instead of
+        monopolizing the server. The seat is handed to _serve_watch via
+        _watch_seat so it can release the moment the replay drains."""
         fc = getattr(self.server, "flow", None)
         if self._is_long_running():
-            return handler()
-        if fc is not None:
-            from .flowcontrol import RequestRejected
-
-            user, ok = self._authenticate()
-            if not ok:
+            if fc is None:
+                return handler()
+            lv = self._flow_admit(fc, "watch")
+            if lv is None:
                 return
-            resource, _, _, _ = self._parse()
+            self._watch_seat = (fc, lv)
             try:
-                lv = fc.begin(user, resource or "", self.command.lower())
-            except RequestRejected as e:
-                return self._status_error(429, "TooManyRequests", str(e))
-            # memo the classification's identity for this one request: the
-            # handler's _authorize and _audited's event reuse it instead of
-            # re-resolving the token. Cleared by _audited's outer finally
-            # (keep-alive connections reuse the handler across requests);
-            # when no audit is configured there is no outer finally, so
-            # clear here
-            self._request_user = (user, True)
+                return handler()
+            finally:
+                if getattr(self.server, "audit", None) is None:
+                    self._request_user = None
+                self._release_watch_seat()
+        if fc is not None:
+            lv = self._flow_admit(fc, self.command.lower())
+            if lv is None:
+                return
             try:
                 return handler()
             finally:
@@ -643,6 +683,62 @@ class _Handler(BaseHTTPRequestHandler):
                 pred = _list_options_predicate(query)
             except ValueError as e:
                 return self._status_error(400, "BadRequest", str(e))
+            cacher = getattr(self.server, "cacher", None)
+            limit_s = query.get("limit")
+            try:
+                limit = int(limit_s) if limit_s is not None else 0
+            except ValueError:
+                limit = -1
+            if limit < 0:
+                # negative limits would hit Python slice semantics in the
+                # paginator (an endless 0-item continuation loop); the
+                # reference rejects them too
+                return self._status_error(
+                    400, "BadRequest", f"invalid limit {limit_s!r}"
+                )
+            cont = query.get("continue")
+            # list-from-cache (reference GetList via cacher): paginated
+            # lists and resourceVersion=0 lists serve from the watch cache
+            # at one consistent rv; a plain list stays a store quorum read
+            if cacher is not None and (limit or cont or
+                                       query.get("resourceVersion") == "0"):
+                try:
+                    items, rv, next_token = cacher.list_page(
+                        resource,
+                        namespace=ns,
+                        pred=pred,
+                        limit=limit,
+                        continue_token=cont,
+                        # a limit list without rv=0 is still a consistent
+                        # read: wait for the cache to consume THIS KIND's
+                        # newest event (the global rv would never converge
+                        # for a quiet kind — other kinds keep advancing it)
+                        fresh_rv=(
+                            None
+                            if query.get("resourceVersion") == "0" or cont
+                            else self.store.kind_resource_version(resource)
+                        ),
+                    )
+                except Expired as e:
+                    return self._status_error(410, "Expired", str(e))
+                except TimeoutError as e:
+                    # cache could not catch the kind's newest event up in
+                    # time — retryable, never a silent stale 200
+                    return self._status_error(
+                        504, "Timeout", str(e), retry_after_s=1.0
+                    )
+                meta = {"resourceVersion": str(rv)}
+                if next_token:
+                    meta["continue"] = next_token
+                return self._json(
+                    200,
+                    {
+                        "kind": "List",
+                        "apiVersion": "v1",
+                        "metadata": meta,
+                        "items": [codec.encode(o) for o in items],
+                    },
+                )
             objs, rv = self.store.list(resource, namespace=ns)
             if pred is not None:
                 objs = [o for o in objs if pred(o)]
@@ -661,9 +757,18 @@ class _Handler(BaseHTTPRequestHandler):
             return self._status_error(404, "NotFound", str(e))
 
     def _serve_watch(self, resource: str, ns: Optional[str], query: dict):
+        from ..runtime.watch import BOOKMARK
+
         from_rv = int(query.get("resourceVersion", 0) or 0)
+        cacher = getattr(self.server, "cacher", None)
         try:
-            watcher = self.store.watch(resource, from_version=from_rv)
+            if cacher is not None:
+                # the watch cache absorbs the fan-out: this stream is one
+                # of N queue consumers on ONE store watch per kind, and a
+                # from_rv inside the event window replays from memory
+                watcher = cacher.watch(resource, from_version=from_rv)
+            else:
+                watcher = self.store.watch(resource, from_version=from_rv)
         except Expired as e:
             # 410 Gone ("resourceVersion too old"): the client must
             # re-list, exactly like the reference's etcd3 watcher
@@ -673,34 +778,91 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             watcher.stop()
             return self._status_error(400, "BadRequest", str(e))
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
+        from ..utils.metrics import metrics
+
+        metrics.inc("apiserver_watch_streams_started_total",
+                    {"resource": resource})
+        self.server.watch_streams_adjust(resource, +1)
+        import time as _time
+
+        bookmark_period = getattr(self.server, "bookmark_period_s", 2.0)
+        # seat accounting: the APF watch-init seat covers the REPLAY phase
+        # only; once the initial burst drains this stream is a cheap queue
+        # consumer and the seat goes back to the pool
+        replay_left = getattr(watcher, "replay_count", 0)
+        if replay_left == 0:
+            self._release_watch_seat()
+        last_write = _time.monotonic()
+        # rv of the last event actually WRITTEN to this stream: the idle
+        # heartbeat must never advertise an rv ahead of what the client
+        # has received — a cache rv read out-of-band can cover an event
+        # still sitting undelivered in this watcher's queue, and a client
+        # resuming past it would silently lose the event forever. RV
+        # advancement for idle clients comes from the cacher's own
+        # bookmarks, which flow queue-ordered with the events.
+        last_rv_sent = from_rv
+
+        def write_line(payload: dict) -> None:
+            nonlocal last_write
+            line = json.dumps(payload).encode() + b"\n"
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+            self.wfile.flush()
+            last_write = _time.monotonic()
+
+        def bookmark_payload(rv: int) -> dict:
+            return {
+                "type": BOOKMARK,
+                "object": {"metadata": {"resourceVersion": rv}},
+            }
+
+        # everything from the header write on lives inside the
+        # try/finally: a client that dropped before the headers flush
+        # must still unwind the watcher and the stream gauge
         try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
             while not self.server.stopping.is_set():
                 ev = watcher.get(timeout=_WATCH_POLL_S)
                 if ev is None:
                     if watcher.stopped:
                         break
+                    self._release_watch_seat()  # queue drained: init over
+                    # idle heartbeat: a stream with no events still emits
+                    # a bookmark every bookmark_period_s, so a half-open
+                    # TCP client (silently dropped connection) fails the
+                    # write and this thread is reaped instead of leaking
+                    if (
+                        bookmark_period
+                        and _time.monotonic() - last_write >= bookmark_period
+                    ):
+                        write_line(bookmark_payload(last_rv_sent))
+                    continue
+                if replay_left > 0:
+                    replay_left -= 1
+                    if replay_left == 0:
+                        self._release_watch_seat()
+                if ev.type == BOOKMARK:
+                    # cache-originated progress notify: forwarded before
+                    # the ns/selector filters (it carries no object).
+                    # Queue-ordered behind the events it covers, so its
+                    # rv is safe to advertise
+                    write_line(bookmark_payload(ev.resource_version))
+                    last_rv_sent = max(last_rv_sent, ev.resource_version)
                     continue
                 obj = ev.object
                 if ns is not None and obj.metadata.namespace != ns:
                     continue
                 if pred is not None and not pred(obj):
                     continue
-                line = (
-                    json.dumps(
-                        {"type": ev.type, "object": codec.encode(obj)}
-                    ).encode()
-                    + b"\n"
-                )
-                self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
-                self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError):
+                write_line({"type": ev.type, "object": codec.encode(obj)})
+                last_rv_sent = max(last_rv_sent, ev.resource_version)
+        except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             watcher.stop()
+            self.server.watch_streams_adjust(resource, -1)
 
     def _handle_POST(self):
         if self._maybe_proxy():
@@ -957,12 +1119,30 @@ class APIServerHTTP(ThreadingHTTPServer):
         max_in_flight: int = 400,
         priority_and_fairness: bool = True,
         audit=None,  # apiserver.audit.AuditLogger, or None
+        watch_cache: bool = True,
+        bookmark_period_s: float = 2.0,
+        watch_cache_window: int = 0,
     ):
         super().__init__(addr, _Handler)
         self.store = store
         self.authenticator = authenticator  # None = insecure port semantics
         self.authorizer = authorizer
         self.audit = audit
+        self.bookmark_period_s = bookmark_period_s
+        # the watch cache (apiserver/cacher.py): every watch stream and
+        # paginated/rv=0 list serves from it — ONE store watch per kind
+        # regardless of client count
+        self.cacher = None
+        if watch_cache:
+            from .cacher import DEFAULT_WINDOW, Cacher
+
+            self.cacher = Cacher(
+                store,
+                window=watch_cache_window or DEFAULT_WINDOW,
+                bookmark_period_s=bookmark_period_s,
+            )
+        self._watch_streams_lock = threading.Lock()
+        self._watch_streams: dict = {}
         # WithPriorityAndFairness over the same total budget; falls back to
         # WithMaxInFlightLimit (config.go:662-666) when disabled. 0/None
         # max_in_flight disables both
@@ -979,8 +1159,28 @@ class APIServerHTTP(ThreadingHTTPServer):
         )
         self.stopping = threading.Event()
 
+    def watch_streams_adjust(self, resource: str, delta: int) -> None:
+        """Track live watch-stream threads per resource: the gauge is how
+        the half-open-connection reaper is observable (a dead client's
+        thread exits on its next bookmark write and the gauge drops)."""
+        from ..utils.metrics import metrics
+
+        with self._watch_streams_lock:
+            n = self._watch_streams.get(resource, 0) + delta
+            self._watch_streams[resource] = max(0, n)
+            metrics.set_gauge(
+                "apiserver_watch_streams", self._watch_streams[resource],
+                {"resource": resource},
+            )
+
+    def watch_stream_count(self, resource: str) -> int:
+        with self._watch_streams_lock:
+            return self._watch_streams.get(resource, 0)
+
     def shutdown(self):
         self.stopping.set()
+        if self.cacher is not None:
+            self.cacher.stop()
         super().shutdown()
 
 
@@ -992,9 +1192,13 @@ def serve(
     max_in_flight: int = 400,
     priority_and_fairness: bool = True,
     audit=None,
+    watch_cache: bool = True,
+    bookmark_period_s: float = 2.0,
+    watch_cache_window: int = 0,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store).
-    max_in_flight=0 disables the in-flight limiter."""
+    max_in_flight=0 disables the in-flight limiter. watch_cache=False
+    falls back to per-client store watches (the pre-cacher read path)."""
     store = store or APIServer()
     srv = APIServerHTTP(
         ("0.0.0.0", port),
@@ -1004,6 +1208,9 @@ def serve(
         max_in_flight=max_in_flight,
         priority_and_fairness=priority_and_fairness,
         audit=audit,
+        watch_cache=watch_cache,
+        bookmark_period_s=bookmark_period_s,
+        watch_cache_window=watch_cache_window,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

@@ -491,14 +491,7 @@ def autoscaler_health_lines() -> List[str]:
         metrics.snapshot_counters("autoscaler_"),
     ):
         for name, labels, value in series:
-            label_s = (
-                "{" + ",".join(
-                    f"{k}={v}" for k, v in sorted(labels.items())
-                ) + "}"
-                if labels
-                else ""
-            )
-            lines.append(f"  {name}{label_s}: {value:g}")
+            lines.append(metrics.format_series_line(name, labels, value))
     h = metrics.histogram(HIST_SIMULATION)
     if h is not None and h.n:
         p50, p99 = h.quantiles((0.5, 0.99))

@@ -470,6 +470,24 @@ class APIServer:
             self._watchers.setdefault(kind, []).append(w)
             return w
 
+    def kind_resource_version(self, kind: str) -> int:
+        """rv of the newest event OF THIS KIND (0 when none ever).
+        The watch cache's freshness target: its per-kind rv can only
+        ever reach this, not the global counter, which advances on
+        every OTHER kind's writes too."""
+        with self._lock:
+            hist = self._history.get(kind)
+            return hist[-1].resource_version if hist else 0
+
+    def watcher_count(self, kind: str) -> int:
+        """Live store-side watchers for a kind (stopped ones pruned).
+        The watch cache's scale contract is asserted against this: N
+        clients on the read path, exactly ONE watcher here per kind."""
+        with self._lock:
+            ws = [w for w in self._watchers.get(kind, []) if not w.stopped]
+            self._watchers[kind] = ws
+            return len(ws)
+
     @property
     def resource_version(self) -> int:
         with self._lock:

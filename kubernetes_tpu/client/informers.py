@@ -16,7 +16,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..runtime.store import Indexer, IndexFunc
-from ..runtime.watch import ADDED, DELETED, MODIFIED
+from ..runtime.watch import ADDED, BOOKMARK, DELETED, MODIFIED
 from ..utils.metrics import metrics
 
 from .apiserver import APIServer, Expired
@@ -29,6 +29,11 @@ logger = logging.getLogger("kubernetes_tpu.client.informers")
 RELIST_BACKOFF_INITIAL = 0.05
 RELIST_BACKOFF_CAP = 5.0
 COUNTER_RELISTS = "informer_relists_total"  # labels: kind, reason
+# bookmark events consumed (resume position advanced, no handlers invoked)
+COUNTER_BOOKMARKS = "informer_bookmarks_total"  # labels: kind
+# watch streams resumed at last_resource_version WITHOUT a re-list (the
+# watch-cache window absorbed the flap)
+COUNTER_RESUMES = "informer_watch_resumes_total"  # labels: kind
 
 
 class ResourceEventHandler:
@@ -91,6 +96,12 @@ class SharedInformer:
         self._thread: Optional[threading.Thread] = None
         self._watcher = None
         self._relist_backoff = RELIST_BACKOFF_INITIAL
+        # resume position: the rv of the last event (or bookmark) this
+        # informer has fully processed. A dying watch stream reconnects
+        # HERE instead of re-listing; only a true 410 — the watch cache
+        # evicted events past this position — forces the relist.
+        self.last_resource_version = 0
+        self._resume = False  # True: skip the list, watch from last rv
 
     def add_handler(
         self,
@@ -145,50 +156,90 @@ class SharedInformer:
                 for h in self._handlers:
                     h.on_update(old, obj)
 
-    def _backoff_failure(self, reason: str) -> bool:
-        """Count one relist cause, sleep the current backoff, grow it.
-        Returns True when the informer is stopping."""
-        metrics.inc(COUNTER_RELISTS, {"kind": self.kind, "reason": reason})
+    def _sleep_backoff(self) -> bool:
+        """Sleep the current backoff and grow it. True when stopping."""
         if self._stop.wait(self._relist_backoff):
             return True
         self._relist_backoff = min(self._relist_backoff * 2, RELIST_BACKOFF_CAP)
         return False
 
+    def _backoff_failure(self, reason: str) -> bool:
+        """Count one relist cause, sleep the current backoff, grow it.
+        Returns True when the informer is stopping."""
+        metrics.inc(COUNTER_RELISTS, {"kind": self.kind, "reason": reason})
+        return self._sleep_backoff()
+
+    def _advance_rv(self, rv: int) -> None:
+        if rv > self.last_resource_version:
+            self.last_resource_version = rv
+
     def _run(self) -> None:
-        """The reflector's ListAndWatch restart loop: list (Replace
-        semantics) → watch from the list rv → dispatch until the stream
-        dies → relist. Every failure mode re-enters the loop instead of
-        killing the informer thread:
+        """The reflector's ListAndWatch restart loop, watch-cache aware:
+        list (Replace semantics) → watch from the list rv → dispatch until
+        the stream dies → RESUME the watch at last_resource_version. Every
+        failure mode re-enters the loop instead of killing the informer
+        thread:
 
           * list errors (transient 401/5xx) retry with backoff
-          * Expired ("resourceVersion too old", 410 Gone): the event
-            window between list and watch was already evicted — re-list
+          * Expired at the list rv ("resourceVersion too old" between the
+            list and the first watch): re-list (reason=expired)
           * a watch stream that closes WITHOUT stop() (flapping
-            connection, REST stream death): re-list — the Replace pass
-            reconciles anything missed during the gap
+            connection, REST stream death): reconnect at the last seen rv
+            — the watch cache replays the gap from its event window, so a
+            flap costs NO re-list and NO handler churn
+          * Expired on a RESUME attempt (a true 410-outside-window — the
+            cache evicted events past our position): re-list with Replace
+            semantics (reason=window_expired)
 
-        The shared backoff grows across consecutive failures and resets
-        to the floor once a re-established watch delivers an event (not
-        merely connects — an instantly-dying stream must keep growing)."""
+        BOOKMARK events advance last_resource_version WITHOUT invoking
+        handlers, so an informer on a quiet selector still rides inside
+        the replay window. The shared backoff grows across consecutive
+        failures and resets to the floor once a re-established watch
+        delivers an event (bookmarks count — they prove the stream)."""
         while not self._stop.is_set():
+            fresh_list = False
+            if not self._resume or not self.last_resource_version:
+                try:
+                    objs, rv = self._server.list(self.kind)
+                except Exception:
+                    logger.exception("list of %s failed; retrying", self.kind)
+                    if self._backoff_failure("list-error"):
+                        return
+                    continue
+                self._replace(objs)
+                self._synced.set()
+                self._advance_rv(rv)
+                fresh_list = True
+            self._resume = False
             try:
-                objs, rv = self._server.list(self.kind)
-            except Exception:
-                logger.exception("list of %s failed; retrying", self.kind)
-                if self._backoff_failure("list-error"):
-                    return
-                continue
-            self._replace(objs)
-            self._synced.set()
-            try:
-                self._watcher = self._server.watch(self.kind, from_version=rv)
-            except Expired:
-                logger.warning(
-                    "watch for %s expired at rv %d; re-listing", self.kind, rv
+                self._watcher = self._server.watch(
+                    self.kind, from_version=self.last_resource_version
                 )
-                if self._backoff_failure("expired"):
+            except Expired:
+                if fresh_list:
+                    # the gap opened between our list and the watch —
+                    # the historical relist cause
+                    logger.warning(
+                        "watch for %s expired at rv %d; re-listing",
+                        self.kind,
+                        self.last_resource_version,
+                    )
+                    reason = "expired"
+                else:
+                    # resume position fell out of the watch-cache window:
+                    # the one case that still costs a full re-list
+                    logger.warning(
+                        "watch resume for %s at rv %d outside the cache "
+                        "window; re-listing",
+                        self.kind,
+                        self.last_resource_version,
+                    )
+                    reason = "window_expired"
+                if self._backoff_failure(reason):
                     return
                 continue
+            if not fresh_list:
+                metrics.inc(COUNTER_RESUMES, {"kind": self.kind})
             delivered = False
             for ev in self._watcher:
                 if self._stop.is_set():
@@ -196,6 +247,15 @@ class SharedInformer:
                 if not delivered:
                     delivered = True
                     self._relist_backoff = RELIST_BACKOFF_INITIAL
+                if ev.type == BOOKMARK:
+                    metrics.inc(COUNTER_BOOKMARKS, {"kind": self.kind})
+                    self._advance_rv(
+                        ev.resource_version
+                        or getattr(
+                            ev.object.metadata, "resource_version", 0
+                        )
+                    )
+                    continue
                 key = ev.object.metadata.key
                 if ev.type == ADDED:
                     self.indexer.add(ev.object)
@@ -210,10 +270,18 @@ class SharedInformer:
                     self.indexer.delete(ev.object)
                     for h in self._handlers:
                         h.on_delete(ev.object)
+                self._advance_rv(
+                    ev.resource_version
+                    or ev.object.metadata.resource_version
+                    or 0
+                )
             if self._stop.is_set():
                 return
-            # stream closed under us (watch flap): relist and re-watch
-            if self._backoff_failure("watch-closed"):
+            # stream closed under us (watch flap): resume at the last rv —
+            # the cache window makes reconnects cheap; a true 410 on the
+            # reconnect falls into the window_expired relist above
+            self._resume = True
+            if self._sleep_backoff():
                 return
 
     def has_synced(self) -> bool:

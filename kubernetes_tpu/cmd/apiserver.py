@@ -16,13 +16,24 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kube-apiserver-tpu")
     parser.add_argument("--port", type=int, default=18080)
     parser.add_argument("-v", "--verbosity", type=int, default=1)
+    # the watch cache (apiserver/cacher.py): --watch-cache=0 falls back
+    # to per-client store watches; --watch-cache-window sizes the
+    # RV replay ring; --bookmark-period the progress-notify cadence
+    parser.add_argument("--watch-cache", type=int, default=1)
+    parser.add_argument("--watch-cache-window", type=int, default=0)
+    parser.add_argument("--bookmark-period", type=float, default=2.0)
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
     )
     from ..apiserver.rest import serve
 
-    srv, port, _store = serve(port=args.port)
+    srv, port, _store = serve(
+        port=args.port,
+        watch_cache=bool(args.watch_cache),
+        watch_cache_window=args.watch_cache_window,
+        bookmark_period_s=args.bookmark_period,
+    )
     logging.getLogger("kubernetes_tpu.cmd.apiserver").info(
         "serving /api/v1 on :%d", port
     )

@@ -141,6 +141,7 @@ def cmd_get(client: RESTClient, args) -> int:
         # stream subsequent changes (kubectl get -w), same filters as the
         # initial list; on 410 Gone re-list silently like the reflector
         from ..client.apiserver import Expired
+        from ..runtime.watch import BOOKMARK
 
         try:
             w = client.watch(resource, from_version=rv)
@@ -155,6 +156,8 @@ def cmd_get(client: RESTClient, args) -> int:
                         print("watch stream closed", file=sys.stderr)
                         return 1
                     continue
+                if ev.type == BOOKMARK:
+                    continue  # rv-only progress notify, nothing to print
                 if _matches(ev.object):
                     print(f"{ev.type:<9} {ev.object.metadata.key}")
         except KeyboardInterrupt:

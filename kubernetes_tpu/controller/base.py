@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..api import objects as v1
 from ..client.workqueue import RateLimitingQueue
+from ..runtime.watch import BOOKMARK
 
 logger = logging.getLogger("kubernetes_tpu.controller")
 
@@ -99,17 +100,21 @@ class WorkqueueController:
             # leave endpoints/PDB status minutes behind a pod burst
             ev = primary_watch.get(timeout=0.1)
             while ev is not None:
-                key = self.primary_key_of(ev.object)
-                if key:
-                    # falsy key = controller filtered the event out
-                    self.queue.add(key)
+                # BOOKMARK = rv-only progress notify from the watch cache;
+                # controllers track no resume position, so skip
+                if ev.type != BOOKMARK:
+                    key = self.primary_key_of(ev.object)
+                    if key:
+                        # falsy key = controller filtered the event out
+                        self.queue.add(key)
                 ev = primary_watch.get(timeout=0)
             for res, w in sec_watches:
                 sev = w.get(timeout=0)
                 while sev is not None:
-                    key = self.enqueue_for_related(res, sev.object)
-                    if key:
-                        self.queue.add(key)
+                    if sev.type != BOOKMARK:
+                        key = self.enqueue_for_related(res, sev.object)
+                        if key:
+                            self.queue.add(key)
                     sev = w.get(timeout=0)
         primary_watch.stop()
         for _, w in sec_watches:

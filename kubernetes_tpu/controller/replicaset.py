@@ -15,6 +15,7 @@ from typing import List
 
 from ..api import objects as v1
 from ..client.apiserver import AlreadyExists, NotFound
+from ..runtime.watch import BOOKMARK
 from ..client.workqueue import RateLimitingQueue
 
 logger = logging.getLogger("kubernetes_tpu.controller.replicaset")
@@ -66,7 +67,7 @@ class ReplicaSetController:
             if ev is not None and ev.type in ("ADDED", "MODIFIED"):
                 self.queue.add(ev.object.metadata.key)
             pev = pod_watch.get(timeout=0.05)
-            if pev is not None:
+            if pev is not None and pev.type != BOOKMARK:
                 owner = next(
                     (
                         r

@@ -25,6 +25,7 @@ from ..api import objects as v1
 from ..client.apiserver import Conflict, NotFound, NotPrimary
 from ..client.leaderelection import Lease
 from ..runtime.consensus import DegradedWrites
+from ..runtime.watch import BOOKMARK
 from ..utils.metrics import metrics
 from .runtime import FakeRuntime, PodRuntime
 
@@ -728,7 +729,9 @@ class NodeAgentPool:
         watcher = list_and_watch(self.server, "pods", seed)
         while not self._stop.is_set():
             ev = watcher.get(timeout=0.2)
-            if ev is None:
+            if ev is None or ev.type == BOOKMARK:
+                # bookmarks are rv-only progress notifies from the watch
+                # cache — no pod state to sync
                 continue
             dispatch(ev.type, ev.object)
         watcher.stop()

@@ -2,8 +2,11 @@
 
 Equivalent of apimachinery's watch.Interface
 (staging/src/k8s.io/apimachinery/pkg/watch/watch.go): a result channel of
-{Added, Modified, Deleted} events plus Stop. Bookmark/Error events are not
-needed by the in-memory store (no relist windows to optimise).
+{Added, Modified, Deleted, Bookmark} events plus Stop. BOOKMARK events
+carry only a resourceVersion (no object state change): the watch cache
+(apiserver/cacher.py) emits them periodically so idle watchers' resume
+positions keep advancing and a reconnect stays inside the replay window.
+The raw store never emits them — only the cacher fan-out does.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ from typing import Any, Iterator, Optional
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
 
 
 @dataclass
@@ -23,6 +27,10 @@ class Event:
     type: str
     object: Any
     resource_version: int = 0
+    # fan-out enqueue timestamp (time.monotonic), stamped by the watch
+    # cache's dispatch loop; lets consumers measure delivery latency
+    # without a side channel. 0.0 for events from the raw store.
+    ts: float = 0.0
 
 
 class Watcher:

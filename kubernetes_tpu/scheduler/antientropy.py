@@ -286,27 +286,14 @@ def dataplane_health_lines() -> List[str]:
         "scheduler_mesh_",
     ):
         for name, labels, value in metrics.snapshot_gauges(prefix):
-            label_s = (
-                "{" + ",".join(
-                    f"{k}={v}" for k, v in sorted(labels.items())
-                ) + "}"
-                if labels
-                else ""
-            )
+            annotation = ""
             if name == "scheduler_device_down":
-                state = (
+                annotation = (
                     "DOWN (host-path fallback)" if value else "serving"
                 )
-                lines.append(f"  {name}{label_s}: {value:g} [{state}]")
-            else:
-                lines.append(f"  {name}{label_s}: {value:g}")
-        for name, labels, value in metrics.snapshot_counters(prefix):
-            label_s = (
-                "{" + ",".join(
-                    f"{k}={v}" for k, v in sorted(labels.items())
-                ) + "}"
-                if labels
-                else ""
+            lines.append(
+                metrics.format_series_line(name, labels, value, annotation)
             )
-            lines.append(f"  {name}{label_s}: {value:g}")
+        for name, labels, value in metrics.snapshot_counters(prefix):
+            lines.append(metrics.format_series_line(name, labels, value))
     return lines

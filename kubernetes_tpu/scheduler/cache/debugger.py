@@ -98,6 +98,12 @@ class CacheDebugger:
         if auto:
             lines.append("Dump of cluster-autoscaler state:")
             lines.extend(auto)
+        from ...apiserver.cacher import readpath_health_lines
+
+        readpath = readpath_health_lines()
+        if readpath:
+            lines.append("Dump of read-path (watch cache / flow control) state:")
+            lines.extend(readpath)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------
@@ -124,16 +130,12 @@ def replication_health_lines() -> List[str]:
 
     lines: List[str] = []
     for name, labels, value in metrics.snapshot_gauges("apiserver_"):
-        label_s = (
-            "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
-            if labels
-            else ""
-        )
+        annotation = ""
         if name == "apiserver_quorum_state":
-            state = "healthy" if value else "DEGRADED (writes 503)"
-            lines.append(f"  {name}{label_s}: {value:g} [{state}]")
-        else:
-            lines.append(f"  {name}{label_s}: {value:g}")
+            annotation = "healthy" if value else "DEGRADED (writes 503)"
+        lines.append(
+            metrics.format_series_line(name, labels, value, annotation)
+        )
     return lines
 
 
@@ -149,19 +151,16 @@ def ridethrough_health_lines() -> List[str]:
     for prefix in ("scheduler_pending_binds", "scheduler_bind_breaker",
                    "node_lifecycle_"):
         for name, labels, value in metrics.snapshot_gauges(prefix):
-            label_s = (
-                "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
-                if labels
-                else ""
-            )
+            annotation = ""
             if name == "scheduler_bind_breaker_state":
-                state = "OPEN (dispatch paused)" if value else "closed"
-                lines.append(f"  {name}{label_s}: {value:g} [{state}]")
+                annotation = "OPEN (dispatch paused)" if value else "closed"
             elif name == "node_lifecycle_partial_disruption":
-                state = "HALTED (evictions paused)" if value else "normal"
-                lines.append(f"  {name}{label_s}: {value:g} [{state}]")
-            else:
-                lines.append(f"  {name}{label_s}: {value:g}")
+                annotation = (
+                    "HALTED (evictions paused)" if value else "normal"
+                )
+            lines.append(
+                metrics.format_series_line(name, labels, value, annotation)
+            )
     return lines
 
 

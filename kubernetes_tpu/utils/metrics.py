@@ -126,6 +126,21 @@ class Metrics:
         with self._lock:
             return self._snapshot_series(self._gauges, prefix)
 
+    @staticmethod
+    def format_series_line(name: str, labels: dict, value: float,
+                           annotation: str = "") -> str:
+        """One debug-dump line for a (name, labels, value) series — the
+        shared renderer behind every SIGUSR2 health-lines section (the
+        consensus, ride-through, data-plane, autoscaler, and read-path
+        dumps all print this exact shape)."""
+        label_s = (
+            "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+            if labels
+            else ""
+        )
+        suffix = f" [{annotation}]" if annotation else ""
+        return f"  {name}{label_s}: {value:g}{suffix}"
+
     def snapshot_counters(self, prefix: str = "") -> List[Tuple[str, dict, float]]:
         """Every counter under prefix — the debugger's data-plane
         self-defense section renders drift and guard-trip counters this

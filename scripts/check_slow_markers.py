@@ -49,6 +49,8 @@ DEFAULT_FILES = [
     "tests/test_chaos_pipeline.py",
     "tests/test_chaos_device.py",
     "tests/test_chaos_autoscaler.py",
+    "tests/test_chaos_readpath.py",
+    "tests/test_watchcache.py",
 ]
 
 # tests whose id contains this substring absorb per-process compile cost

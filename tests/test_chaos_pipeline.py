@@ -548,10 +548,11 @@ def test_informer_expired_relist_backoff_grows_and_resets():
         inf.stop()
 
 
-def test_informer_watch_flap_relists_and_recovers():
-    """A watch stream dying WITHOUT stop() (connection flap) re-enters
-    the ListAndWatch loop: relist with Replace semantics, re-watch, and
-    keep delivering — nothing created during the gap is missed."""
+def test_informer_watch_flap_resumes_without_relist():
+    """A watch stream dying WITHOUT stop() (connection flap) RESUMES at
+    last_resource_version instead of re-listing (PR-6 watch-cache
+    semantics): zero relists, zero handler churn, and nothing created
+    during the gap is missed — the event window replays it."""
     store = APIServer()
     store.create("pods", make_pod("a"))
     inf = SharedInformer(store, "pods")
@@ -562,26 +563,41 @@ def test_informer_watch_flap_relists_and_recovers():
         assert wait_until(lambda: inf.has_synced(), 5)
         store.create("pods", make_pod("b"))
         assert wait_until(lambda: "b" in seen, 5)
-        c0 = metrics.counter(
-            "informer_relists_total",
-            {"kind": "pods", "reason": "watch-closed"},
+        relists0 = sum(
+            metrics.counter(
+                "informer_relists_total", {"kind": "pods", "reason": r}
+            )
+            for r in ("watch-closed", "window_expired", "expired")
         )
+        resumes0 = metrics.counter(
+            "informer_watch_resumes_total", {"kind": "pods"}
+        )
+        adds_before_flap = len(seen)
         flapped = inf._watcher
         flapped.stop()  # the stream dies under the informer
-        assert wait_until(
-            lambda: metrics.counter(
-                "informer_relists_total",
-                {"kind": "pods", "reason": "watch-closed"},
-            )
-            > c0
-            and inf._watcher is not None
-            and inf._watcher is not flapped,
-            10,
-        ), "informer never relisted after the watch flap"
+        # created DURING the gap: the resume replays it from watch history
         store.create("pods", make_pod("c"))
-        assert wait_until(lambda: "c" in seen, 5), (
+        assert wait_until(lambda: "c" in seen, 10), (
             "event after the flap never delivered"
         )
+        assert inf._watcher is not flapped
+        assert (
+            metrics.counter(
+                "informer_watch_resumes_total", {"kind": "pods"}
+            )
+            > resumes0
+        ), "flap did not go through the resume path"
+        relists1 = sum(
+            metrics.counter(
+                "informer_relists_total", {"kind": "pods", "reason": r}
+            )
+            for r in ("watch-closed", "window_expired", "expired")
+        )
+        assert relists1 == relists0, (
+            "a watch flap must resume from the event window, not re-list"
+        )
+        # no Replace churn: exactly the one new add was delivered
+        assert len(seen) == adds_before_flap + 1
         assert inf.get("default/c") is not None
     finally:
         inf.stop()

@@ -1,0 +1,584 @@
+"""Watch cache (apiserver/cacher.py): RV-window edge cases, pagination,
+bookmarks, fan-out discipline, and the REST/flow-control integration.
+
+The window contract under test (ISSUE 6 acceptance list):
+  * reconnect at exactly the oldest buffered RV → replay, no store touch
+  * reconnect one before it → 410 Expired (outside the window)
+  * reconnect at a future RV → only events past that RV are delivered
+  * empty-cache cold start → watch works, no spurious 410
+  * continue token across a compaction → pagination stays consistent at
+    the ORIGINAL rv even as the event window and live state move on
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.apiserver.cacher import Cacher, readpath_health_lines
+from kubernetes_tpu.apiserver.flowcontrol import (
+    GAUGE_SEATS_IN_USE,
+    FlowController,
+    RequestRejected,
+)
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.client.apiserver import APIServer, Expired
+from kubernetes_tpu.client.informers import SharedInformer
+from kubernetes_tpu.runtime.watch import ADDED, BOOKMARK, DELETED
+from kubernetes_tpu.utils.metrics import metrics
+
+
+def wait_until(fn, timeout=10.0, period=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return False
+
+
+def make_pod(name, cpu="100m"):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodSpec(containers=[v1.Container(requests={"cpu": cpu})]),
+    )
+
+
+def drain(watcher, timeout=0.5):
+    """Collect queued non-bookmark events until the queue goes quiet.
+    Bounded by a wall deadline: periodic bookmarks must not keep the
+    drain alive forever."""
+    out = []
+    deadline = time.time() + max(timeout * 4, 2.0)
+    while time.time() < deadline:
+        ev = watcher.get(timeout=timeout)
+        if ev is None:
+            return out
+        if ev.type != BOOKMARK:
+            out.append(ev)
+    return out
+
+
+@pytest.fixture
+def cached_store():
+    store = APIServer()
+    cacher = Cacher(store, window=4, bookmark_period_s=0.15)
+    yield store, cacher
+    cacher.stop()
+
+
+# -- the RV window ------------------------------------------------------------
+
+
+def test_reconnect_at_exactly_oldest_buffered_rv(cached_store):
+    store, cacher = cached_store
+    kc = cacher.cache_for("pods")  # cache FIRST: the ring buffers events
+    for i in range(8):  # window=4: the first events get evicted
+        store.create("pods", make_pod(f"p{i}"))
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: len(kc._ring) == 4)
+    oldest = kc._ring[0].resource_version
+    w = cacher.watch("pods", from_version=oldest)
+    evs = drain(w)
+    assert [e.resource_version for e in evs] == list(
+        range(oldest + 1, store.resource_version + 1)
+    )
+    w.stop()
+
+
+def test_reconnect_one_before_oldest_is_410(cached_store):
+    store, cacher = cached_store
+    kc = cacher.cache_for("pods")
+    for i in range(8):
+        store.create("pods", make_pod(f"p{i}"))
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: len(kc._ring) == 4)
+    oldest = kc._ring[0].resource_version
+    x0 = metrics.counter("watch_cache_expired_total", {"kind": "pods"})
+    with pytest.raises(Expired):
+        cacher.watch("pods", from_version=oldest - 1)
+    assert (
+        metrics.counter("watch_cache_expired_total", {"kind": "pods"}) - x0
+        == 1
+    )
+
+
+def test_reconnect_at_future_rv_skips_already_seen_events(cached_store):
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    kc = cacher.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    future = store.resource_version + 2
+    w = cacher.watch("pods", from_version=future)
+    # these two land AT or BELOW the client's claimed position: skipped
+    store.create("pods", make_pod("claimed-1"))
+    store.create("pods", make_pod("claimed-2"))
+    # this one is past it: delivered
+    store.create("pods", make_pod("new"))
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    evs = drain(w)
+    assert [e.object.metadata.name for e in evs] == ["new"]
+    w.stop()
+
+
+def test_empty_cache_cold_start(cached_store):
+    store, cacher = cached_store
+    # no objects, no history: watch from 0 must neither 410 nor replay
+    w = cacher.watch("pods", from_version=0)
+    assert cacher.cache_for("pods").rv == 0
+    store.create("pods", make_pod("first"))
+    ev = w.get(timeout=2.0)
+    assert ev is not None and ev.type == ADDED
+    assert ev.object.metadata.name == "first"
+    w.stop()
+
+
+def test_replay_within_window_touches_no_store_watch(cached_store):
+    """A windowed reconnect is served purely from the buffer: the store
+    still sees exactly ONE watcher for the kind no matter how many
+    clients replay."""
+    store, cacher = cached_store
+    kc = cacher.cache_for("pods")
+    for i in range(3):
+        store.create("pods", make_pod(f"p{i}"))
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    r0 = metrics.counter("watch_cache_replays_total", {"kind": "pods"})
+    watchers = [cacher.watch("pods", from_version=1) for _ in range(20)]
+    for w in watchers:
+        assert len(drain(w, timeout=0.1)) == store.resource_version - 1
+    assert store.watcher_count("pods") == 1
+    assert (
+        metrics.counter("watch_cache_replays_total", {"kind": "pods"}) - r0
+        == 20
+    )
+    for w in watchers:
+        w.stop()
+
+
+# -- bookmarks ----------------------------------------------------------------
+
+
+def test_bookmarks_advance_idle_clients(cached_store):
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    kc = cacher.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    w = cacher.watch("pods", from_version=store.resource_version)
+    got = []
+
+    def consume():
+        while True:
+            ev = w.get(timeout=1.0)
+            if ev is None:
+                return
+            got.append(ev)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert wait_until(
+        lambda: any(ev.type == BOOKMARK for ev in got), timeout=3.0
+    ), "idle watcher never received a bookmark"
+    bm = next(ev for ev in got if ev.type == BOOKMARK)
+    assert bm.resource_version == store.resource_version
+    w.stop()
+    t.join(timeout=2.0)
+
+
+def test_informer_consumes_bookmarks_without_handler_churn(cached_store):
+    """Bookmarks advance last_resource_version but never invoke handlers
+    (informer_bookmarks_total counts them); after a long idle + window
+    churn on OTHER kinds, the informer still resumes without a relist."""
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    inf = SharedInformer(cacher, "pods")
+    calls = []
+    inf.add_handler(
+        on_add=lambda o: calls.append(("add", o.metadata.name)),
+        on_update=lambda o, n: calls.append(("upd", n.metadata.name)),
+        on_delete=lambda o: calls.append(("del", o.metadata.name)),
+    )
+    b0 = metrics.counter("informer_bookmarks_total", {"kind": "pods"})
+    inf.start()
+    try:
+        assert wait_until(inf.has_synced, 5)
+        assert wait_until(
+            lambda: metrics.counter(
+                "informer_bookmarks_total", {"kind": "pods"}
+            )
+            > b0,
+            timeout=3.0,
+        ), "informer never consumed a bookmark"
+        assert calls == [("add", "p0")], (
+            "bookmarks must not invoke event handlers"
+        )
+        assert inf.last_resource_version == store.resource_version
+    finally:
+        inf.stop()
+
+
+def test_informer_relist_reason_window_expired(cached_store):
+    """A resume attempt whose rv fell out of the window is the ONE case
+    that still re-lists — counted under reason=window_expired."""
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    inf = SharedInformer(cacher, "pods")
+    seen = []
+    inf.add_handler(on_add=lambda o: seen.append(o.metadata.name))
+    inf.start()
+    try:
+        assert wait_until(inf.has_synced, 5)
+        we0 = metrics.counter(
+            "informer_relists_total",
+            {"kind": "pods", "reason": "window_expired"},
+        )
+        # stop the informer's stream, then blow past the window while it
+        # is disconnected (window=4, 8 events): its resume rv is now
+        # outside the buffer -> true 410 -> relist
+        inf._watcher.stop()
+        for i in range(8):
+            store.create("pods", make_pod(f"storm-{i}"))
+        assert wait_until(
+            lambda: metrics.counter(
+                "informer_relists_total",
+                {"kind": "pods", "reason": "window_expired"},
+            )
+            > we0,
+            timeout=10.0,
+        ), "410-outside-window did not surface as a window_expired relist"
+        assert wait_until(lambda: "storm-7" in seen, 10.0)
+        # Replace semantics reconciled the full set
+        assert len(inf.list()) == store.count("pods")
+    finally:
+        inf.stop()
+
+
+# -- pagination ---------------------------------------------------------------
+
+
+def test_list_pagination_consistent_at_single_rv(cached_store):
+    store, cacher = cached_store
+    for i in range(7):
+        store.create("pods", make_pod(f"p{i}"))
+    kc = cacher.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    items, rv, tok = cacher.list_page("pods", limit=3)
+    assert len(items) == 3 and tok
+    items2, rv2, tok2 = cacher.list_page("pods", limit=3, continue_token=tok)
+    items3, rv3, tok3 = cacher.list_page("pods", limit=3, continue_token=tok2)
+    assert rv == rv2 == rv3
+    assert tok3 is None
+    names = [o.metadata.name for o in items + items2 + items3]
+    assert names == sorted(names) and len(names) == 7
+
+
+def test_continue_token_across_compaction(cached_store):
+    """Between page 1 and page 2, churn past the whole event window (a
+    compaction of the replay buffer) AND delete rows from live state:
+    the continuation still serves the original snapshot at the original
+    rv — pagination never tears."""
+    store, cacher = cached_store
+    for i in range(6):
+        store.create("pods", make_pod(f"p{i}"))
+    kc = cacher.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    items, rv, tok = cacher.list_page("pods", limit=2)
+    # compaction: window=4, so 8 more events evict everything page 1 saw
+    for i in range(8):
+        store.create("pods", make_pod(f"churn-{i}"))
+    store.delete("pods", "default", "p3")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    items2, rv2, tok2 = cacher.list_page("pods", limit=2, continue_token=tok)
+    assert rv2 == rv, "continuation drifted off its snapshot rv"
+    assert [o.metadata.name for o in items2] == ["p2", "p3"], (
+        "continuation must serve the original snapshot (p3 was deleted "
+        "live but belongs to the page-1 view)"
+    )
+
+
+def test_unknown_continue_token_is_410(cached_store):
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    with pytest.raises(Expired):
+        cacher.list_page("pods", limit=2, continue_token="bogus-token")
+
+
+def test_list_from_cache_waits_until_fresh(cached_store):
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    kc = cacher.cache_for("pods")
+    store.create("pods", make_pod("p1"))
+    items, rv, _ = cacher.list_page(
+        "pods", limit=10, fresh_rv=store.resource_version
+    )
+    assert rv >= store.resource_version
+    assert len(items) == 2
+
+
+# -- fan-out discipline -------------------------------------------------------
+
+
+def test_slow_watcher_terminated_not_blocking(cached_store):
+    """A client that stops draining fills its bounded queue and is
+    TERMINATED; the dispatch loop and every other client keep going."""
+    store, _ = cached_store
+    cacher = Cacher(store, window=64, bookmark_period_s=60)
+    try:
+        store.create("pods", make_pod("seed"))
+        kc = cacher.cache_for("pods")
+        assert wait_until(lambda: kc.rv == store.resource_version)
+        slow = kc.watch(from_version=0, queue_size=8)
+        healthy = cacher.watch("pods", from_version=0)
+        s0 = metrics.counter(
+            "watch_cache_slow_watchers_evicted_total", {"kind": "pods"}
+        )
+        done = []
+
+        def drain_healthy():
+            while True:
+                ev = healthy.get(timeout=2.0)
+                if ev is None:
+                    return
+                done.append(ev)
+
+        t = threading.Thread(target=drain_healthy, daemon=True)
+        t.start()
+        for i in range(20):  # queue size 8: the slow client must overflow
+            store.create("pods", make_pod(f"burst-{i}"))
+        assert wait_until(lambda: len(done) >= 20, 10.0), (
+            "healthy client starved behind a slow one"
+        )
+        assert wait_until(lambda: slow.stopped, 5.0), (
+            "slow watcher was never terminated"
+        )
+        assert slow.terminated_slow
+        assert (
+            metrics.counter(
+                "watch_cache_slow_watchers_evicted_total", {"kind": "pods"}
+            )
+            > s0
+        )
+        healthy.stop()
+        t.join(timeout=2.0)
+    finally:
+        cacher.stop()
+
+
+def test_cacher_resyncs_after_store_watch_death(cached_store):
+    """The cacher's OWN store stream dying (store restart analogue):
+    re-list, window reset, connected watchers TERMINATED (the reference's
+    terminateAllWatchers — a mid-gap synthetic diff could desync a
+    flapping client forever). An informer rides it out end to end: its
+    terminated stream reconnects (resume, or 410 → re-list when the
+    post-gap floor moved past it) and keeps delivering."""
+    store, cacher = cached_store
+    store.create("pods", make_pod("p0"))
+    inf = SharedInformer(cacher, "pods")
+    seen = []
+    inf.add_handler(on_add=lambda o: seen.append(o.metadata.name))
+    inf.start()
+    assert wait_until(inf.has_synced, 5)
+    kc = cacher.cache_for("pods")
+    w = cacher.watch("pods", from_version=store.resource_version)
+    r0 = metrics.counter("watch_cache_resyncs_total", {"kind": "pods"})
+    try:
+        kc._store_watcher.stop()  # kill the one store watch under the cache
+        assert wait_until(
+            lambda: metrics.counter(
+                "watch_cache_resyncs_total", {"kind": "pods"}
+            )
+            > r0,
+            5.0,
+        )
+        # direct watcher: terminated by the resync, not left half-synced
+        assert wait_until(lambda: w.stopped, 5.0)
+        # informer: recovers through the 410 → re-list path and keeps up
+        store.create("pods", make_pod("after-resync"))
+        assert wait_until(lambda: "after-resync" in seen, 10.0)
+        assert store.watcher_count("pods") == 1
+    finally:
+        inf.stop()
+        w.stop()
+
+
+def test_dispatch_thread_survives_resync_errors(cached_store):
+    """An exception inside the dispatch loop (here: the resync's store
+    list failing) must not silently kill the per-kind thread — it logs,
+    counts `watch_cache_dispatch_errors_total`, backs off, and retries
+    until the resync lands; clients converge afterwards."""
+    store, cacher = cached_store
+    kc = cacher.cache_for("pods")
+    store.create("pods", make_pod("p0"))
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    orig_list = store.list
+    fails = {"n": 2}  # first two re-list attempts blow up
+
+    def flaky_list(kind, namespace=None):
+        if kind == "pods" and fails["n"] > 0:
+            fails["n"] -= 1
+            raise RuntimeError("chaos: store list failed mid-resync")
+        return orig_list(kind, namespace=namespace)
+
+    store.list = flaky_list
+    d0 = metrics.counter(
+        "watch_cache_dispatch_errors_total", {"kind": "pods"}
+    )
+    kc._store_watcher.stop()  # force the resync path into the failure
+    assert wait_until(
+        lambda: metrics.counter(
+            "watch_cache_dispatch_errors_total", {"kind": "pods"}
+        )
+        > d0,
+        5.0,
+    ), "dispatch error was never counted"
+    # the loop kept retrying: a post-recovery write reaches a new client
+    store.create("pods", make_pod("after-error"))
+    assert wait_until(lambda: kc.get("default/after-error") is not None, 10.0)
+    assert fails["n"] == 0
+    w = cacher.watch("pods", from_version=0)
+    names = {ev.object.metadata.name for ev in drain(w, 0.2)}
+    assert {"p0", "after-error"} <= names
+    w.stop()
+
+
+# -- REST integration ---------------------------------------------------------
+
+
+@pytest.fixture
+def rest_server():
+    srv, port, store = serve(port=0, bookmark_period_s=0.2)
+    yield srv, port, store
+    srv.shutdown()
+
+
+def test_rest_watch_emits_bookmark_lines_and_410(rest_server):
+    srv, port, store = rest_server
+    store.create("pods", make_pod("p0"))
+    # old-rv watch over HTTP: churn past the window first
+    small = Cacher(store, window=2, bookmark_period_s=60)
+    srv.cacher.stop()
+    srv.cacher = small
+    for i in range(6):
+        store.create("pods", make_pod(f"w{i}"))
+    kc = small.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/pods?watch=1&resourceVersion=1",
+            timeout=5,
+        )
+        assert False, "expected 410"
+    except urllib.error.HTTPError as e:
+        assert e.code == 410
+    # a live watch on an idle resource still heartbeats bookmarks
+    resp = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/v1/pods?watch=1&resourceVersion="
+        f"{store.resource_version}",
+        timeout=5,
+    )
+    srv.bookmark_period_s = 0.2
+    line = resp.readline()
+    msg = json.loads(line)
+    assert msg["type"] == "BOOKMARK"
+    assert int(msg["object"]["metadata"]["resourceVersion"]) == (
+        store.resource_version
+    )
+    resp.close()
+
+
+def test_rest_half_open_watch_reaped_by_heartbeat(rest_server):
+    """A silently dropped client: the idle bookmark write fails and the
+    watcher thread exits instead of leaking (the stream gauge drops)."""
+    srv, port, store = rest_server
+    store.create("pods", make_pod("p0"))
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(
+        b"GET /api/v1/pods?watch=1&resourceVersion=0 HTTP/1.1\r\n"
+        b"Host: x\r\n\r\n"
+    )
+    s.recv(4096)  # response headers
+    assert wait_until(lambda: srv.watch_stream_count("pods") == 1, 5.0)
+    # drop the connection without closing the HTTP stream politely
+    s.setsockopt(
+        socket.SOL_SOCKET, socket.SO_LINGER,
+        __import__("struct").pack("ii", 1, 0),
+    )
+    s.close()  # RST
+    assert wait_until(lambda: srv.watch_stream_count("pods") == 0, 10.0), (
+        "half-open watch stream was never reaped by the bookmark heartbeat"
+    )
+
+
+def test_rest_list_rv0_served_from_cache(rest_server):
+    srv, port, store = rest_server
+    store.create("pods", make_pod("p0"))
+    kc = srv.cacher.cache_for("pods")
+    assert wait_until(lambda: kc.rv == store.resource_version)
+    p0 = metrics.counter("watch_cache_list_pages_total", {"kind": "pods"})
+    out = json.load(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/pods?resourceVersion=0",
+            timeout=5,
+        )
+    )
+    assert [i["metadata"]["name"] for i in out["items"]] == ["p0"]
+    assert (
+        metrics.counter("watch_cache_list_pages_total", {"kind": "pods"}) - p0
+        == 1
+    )
+
+
+# -- flow control: watch-init seats ------------------------------------------
+
+
+def test_watch_init_seats_accounted_and_released():
+    fc = FlowController(total_concurrency=20)
+    lv = fc.begin(None, "pods", "watch")
+    assert lv.name == "watch-init"
+    assert (
+        metrics.gauge(GAUGE_SEATS_IN_USE, {"priority_level": "watch-init"})
+        == 1
+    )
+    fc.end(lv)
+    assert (
+        metrics.gauge(GAUGE_SEATS_IN_USE, {"priority_level": "watch-init"})
+        == 0
+    )
+
+
+def test_watch_init_storm_cannot_starve_system_level():
+    """Saturate watch-init completely: system-level requests (kubelet
+    heartbeats, scheduler binds) still admit instantly — isolation
+    between levels is exact."""
+    from kubernetes_tpu.apiserver.auth import UserInfo
+
+    fc = FlowController(total_concurrency=20, queue_wait_s=0.01)
+    node = UserInfo("system:node:n1", ("system:nodes",))
+    held = []
+    try:
+        while True:
+            held.append(fc.begin(None, "pods", "watch"))
+    except RequestRejected as e:
+        assert e.level == "watch-init"
+    assert held, "watch-init pool admitted nothing"
+    # the storm is saturated; system traffic is untouched: heartbeats
+    # (lease renewals -> leader-election level) and binds (pod writes ->
+    # system level) both admit instantly
+    for _ in range(3):
+        lv = fc.begin(node, "leases", "update")
+        assert lv.name == "leader-election"
+        fc.end(lv)
+        lv = fc.begin(node, "pods", "create")
+        assert lv.name == "system"
+        fc.end(lv)
+    for lv in held:
+        fc.end(lv)
+
+
+def test_readpath_health_lines_render():
+    metrics.set_gauge("watch_cache_size", 3, {"kind": "pods"})
+    lines = readpath_health_lines()
+    assert any("watch_cache_size{kind=pods}: 3" in l for l in lines)
