@@ -15,16 +15,20 @@
 #                         the watch cache (one store watch per kind, zero
 #                         relists after a flap, zero bind starvation)
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
+#   make lint-static      graftlint: donation-safety, dispatch-blocking,
+#                         metrics-contract, degraded-write static passes
+#                         (scripts/graftlint/, empty suppression baseline)
+#   make lint             lint-static + lint-slow (invoked from `make chaos`)
 
 PY ?= python
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
-	chaos-device chaos-autoscaler chaos-readpath lint-slow
+	chaos-device chaos-autoscaler chaos-readpath lint-slow lint-static lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
-chaos:
+chaos: lint
 	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_consensus.py \
 		tests/test_replication_quorum.py \
 		tests/test_replication.py tests/test_chaos.py \
@@ -45,6 +49,11 @@ chaos-readpath:
 
 lint-slow:
 	$(PY) scripts/check_slow_markers.py
+
+lint-static:
+	$(PY) scripts/graftlint
+
+lint: lint-static lint-slow
 
 bench:
 	$(PY) bench.py

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..client.apiserver import Expired
 from ..runtime.watch import ADDED, BOOKMARK, DELETED, Event, Watcher
+from ..testing.lockgraph import named_lock
 from ..utils.metrics import metrics
 
 logger = logging.getLogger("kubernetes_tpu.apiserver.cacher")
@@ -128,28 +129,10 @@ class CacheWatcher(Watcher):
             self.stop()
             return False
 
-    def stop(self) -> None:
-        """Non-blocking stop: the base Watcher's sentinel put would block
-        on a FULL queue — precisely the state a terminated-slow watcher
-        is in — and wedge the dispatch thread. Consumers instead detect
-        stop via get() timeouts (see __iter__)."""
-        if not self._stopped.is_set():
-            self._stopped.set()
-            try:
-                self._q.put_nowait(None)
-            except queue.Full:
-                pass
-
-    def __iter__(self):
-        # sentinel-free termination: a dropped sentinel (full queue at
-        # stop time) must still end the iteration once the queue drains
-        while True:
-            ev = self.get(timeout=0.2)
-            if ev is None:
-                if self._stopped.is_set() and self._q.empty():
-                    return
-                continue
-            yield ev
+    # stop() and __iter__ need no overrides anymore: the non-blocking
+    # sentinel put and sentinel-free termination this class pioneered in
+    # PR 6 now live in the base Watcher (runtime/watch.py), enforced
+    # tree-wide by graftlint's blocking-call pass.
 
 
 class _Continuation:
@@ -179,7 +162,10 @@ class KindCache:
         self.kind = kind
         self.window = window
         self._watcher_queue_size = watcher_queue_size
-        self._lock = threading.Condition(threading.RLock())
+        # per-kind locks share ONE watchdog node ("cacher.kind"): the
+        # order contract is per-class, and any path ordering a kind lock
+        # against the store/cache/device locks records the same edge
+        self._lock = threading.Condition(named_lock("cacher.kind"))
         self._objects: Dict[str, Any] = {}
         self._ring: deque = deque()
         # window floor: the MINIMUM from_rv a reconnecting client may
@@ -209,7 +195,9 @@ class KindCache:
     # -- store-facing side ---------------------------------------------------
 
     def _list_and_seed(self) -> int:
-        objs, rv = self.store.list(self.kind)
+        # the seed list blocks the dispatch thread by design: the cache
+        # serves nothing until it exists, and _ready gates clients
+        objs, rv = self.store.list(self.kind)  # graftlint: allow-blocking(seed list gates readiness; cache serves nothing before it)
         with self._lock:
             self._objects = {o.metadata.key: o for o in objs}
             self.rv = max(self.rv, rv)
@@ -247,7 +235,7 @@ class KindCache:
                     rv = self._resync()
                 need_resync = True  # every path back here re-syncs
                 try:
-                    self._store_watcher = self.store.watch(
+                    self._store_watcher = self.store.watch(  # graftlint: allow-blocking(re-arming the ONE upstream watch IS this thread's job)
                         self.kind, from_version=rv
                     )
                 except Expired:
@@ -300,7 +288,7 @@ class KindCache:
         and every connected watcher is TERMINATED. Clients reconnect at
         their pre-gap rv, get a 410, and re-list — a visible, bounded
         cost instead of a silent inconsistency."""
-        objs, rv = self.store.list(self.kind)
+        objs, rv = self.store.list(self.kind)  # graftlint: allow-blocking(resync re-list: the cache is stale until it completes anyway)
         with self._lock:
             self._objects = {o.metadata.key: o for o in objs}
             self.rv = max(self.rv, rv)
@@ -675,8 +663,10 @@ def readpath_health_lines() -> List[str]:
         metrics.snapshot_counters("watch_cache_"),
         metrics.snapshot_gauges("apiserver_flowcontrol_seats"),
         metrics.snapshot_gauges("apiserver_watch_streams"),
-        metrics.snapshot_counters("informer_bookmarks_total"),
-        metrics.snapshot_counters("informer_relists_total"),
+        # the whole informer_ family (bookmarks, relists, resumes): a
+        # per-counter list here is exactly the drift the metrics lint
+        # exists to catch — resumes was missing until it did
+        metrics.snapshot_counters("informer_"),
     ):
         for name, labels, value in snap:
             lines.append(metrics.format_series_line(name, labels, value))

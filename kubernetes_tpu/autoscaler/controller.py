@@ -251,7 +251,7 @@ class ClusterAutoscaler:
             plan.unplaced, plan.capped,
         )
 
-    def _provision_one(self, group: NodeGroup, name: str) -> None:
+    def _provision_one(self, group: NodeGroup, name: str) -> None:  # graftlint: degraded-ok(every call site sits in the scale-up loop's try: DegradedWrites is counted as a store-skip and the slot retries next cycle)
         if group.provision is not None:
             group.provision(name)
         else:

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..api import serialization, validation
 from ..api.objects import event_copy
 from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
+from ..testing.lockgraph import named_lock
 
 
 class NotFound(KeyError):
@@ -73,7 +74,8 @@ class NotPrimary(RuntimeError):
 
 class APIServer:
     def __init__(self, watch_history: int = 200000, wal=None):
-        self._lock = threading.RLock()
+        # named for the lock-order watchdog (testing/lockgraph.py)
+        self._lock = named_lock("store")
         self._rv = 0
         # kind -> key -> object
         self._objects: Dict[str, Dict[str, Any]] = {}

@@ -200,7 +200,7 @@ class SharedInformer:
             fresh_list = False
             if not self._resume or not self.last_resource_version:
                 try:
-                    objs, rv = self._server.list(self.kind)
+                    objs, rv = self._server.list(self.kind)  # graftlint: allow-blocking(the pump's own re-list; only this informer's handlers wait)
                 except Exception:
                     logger.exception("list of %s failed; retrying", self.kind)
                     if self._backoff_failure("list-error"):
@@ -212,7 +212,7 @@ class SharedInformer:
                 fresh_list = True
             self._resume = False
             try:
-                self._watcher = self._server.watch(
+                self._watcher = self._server.watch(  # graftlint: allow-blocking(re-arming this informer's own stream)
                     self.kind, from_version=self.last_resource_version
                 )
             except Expired:

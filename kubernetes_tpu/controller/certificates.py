@@ -102,7 +102,7 @@ class CSRSigningController(WorkqueueController):
         except NotFound:
             pass
 
-def _set_condition(server, ns: str, name: str, cond_type: str, reason: str) -> None:
+def _set_condition(server, ns: str, name: str, cond_type: str, reason: str) -> None:  # graftlint: degraded-ok(only called from WorkqueueController sync paths: the worker loop catches and requeues rate-limited)
     def mutate(cur):
         if _condition(cur, cond_type):
             return None

@@ -37,7 +37,7 @@ class NamespaceController:
                 logger.exception("namespace sync failed")
             self._stop.wait(self.period)
 
-    def _sync_once(self) -> None:
+    def _sync_once(self) -> None:  # graftlint: degraded-ok(_run catches everything: a degraded delete aborts the pass, retried next period)
         namespaces, _ = self.server.list("namespaces")
         for ns in namespaces:
             if ns.phase != "Terminating":

@@ -216,7 +216,7 @@ class VolumeBinder:
 
     # -- bind ----------------------------------------------------------------
 
-    def bind_pod_volumes(self, pod: v1.Pod, node_name: str = "") -> None:
+    def bind_pod_volumes(self, pod: v1.Pod, node_name: str = "") -> None:  # graftlint: degraded-ok(raise discipline: the scheduler binding cycle catches, unreserves and requeues the pod; the finally forgets volume decisions and the PV rollback below keeps bindings atomic)
         """Write the planned bindings to the API (BindPodVolumes)."""
         with self._lock:
             decision = self._decisions.get(pod.metadata.key)

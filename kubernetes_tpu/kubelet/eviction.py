@@ -25,6 +25,8 @@ from typing import Callable, List, Optional
 from ..api import objects as v1
 from ..api.resources import MEMORY, parse_quantity
 from ..client.apiserver import Conflict, NotFound
+from ..runtime.consensus import DegradedWrites
+from .kubelet import skip_degraded_write
 
 logger = logging.getLogger("kubernetes_tpu.kubelet.eviction")
 
@@ -166,6 +168,8 @@ class EvictionManager:
             )
         except NotFound:
             pass
+        except DegradedWrites:
+            skip_degraded_write("evict")
 
     def _set_pressure(self, pressure: bool) -> None:
         status = "True" if pressure else "False"
@@ -205,3 +209,5 @@ class EvictionManager:
             self.server.guaranteed_update("nodes", "", self.node_name, mutate)
         except (NotFound, Conflict):
             pass
+        except DegradedWrites:
+            skip_degraded_write("memory_pressure")

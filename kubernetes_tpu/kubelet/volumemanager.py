@@ -29,6 +29,8 @@ from typing import Dict, List, Optional, Set
 
 from ..api import objects as v1
 from ..client.apiserver import NotFound
+from ..runtime.consensus import DegradedWrites
+from .kubelet import skip_degraded_write
 
 logger = logging.getLogger("kubernetes_tpu.kubelet.volumemanager")
 
@@ -181,6 +183,8 @@ class VolumeManager:
             self._last_reported = list(in_use)
         except NotFound:
             pass
+        except DegradedWrites:
+            skip_degraded_write("volumes_in_use")
 
     # -- the pod-worker wait (WaitForAttachAndMount) -------------------------
 

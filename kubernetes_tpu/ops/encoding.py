@@ -54,6 +54,7 @@ logger = logging.getLogger("kubernetes_tpu.ops.encoding")
 
 from ..api import objects as v1
 from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, ResourceList
+from ..testing.lockgraph import named_lock
 from ..api.selectors import (
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
@@ -376,8 +377,10 @@ class SnapshotEncoder:
         # readers (the anti-entropy audit's row gather): a donation racing
         # a read deadlocks the CPU client and poisons every later jax call
         # in the process. LEAF lock — never acquire any other lock while
-        # holding it (the cache lock, when needed, is taken FIRST).
-        self.device_lock = threading.RLock()
+        # holding it (the cache lock, when needed, is taken FIRST). The
+        # leaf contract is machine-checked: the named wrapper feeds the
+        # lock-order watchdog (testing/lockgraph.py) during chaos runs.
+        self.device_lock = named_lock("encoder.device_lock")
         self._dirty_rows: set = set()
         # rows a failure path could not keep host/device convergent on
         # (e.g. a mid-wave encoder exception after the kernel committed):
@@ -1172,7 +1175,7 @@ class SnapshotEncoder:
                     "slow flush %.0f ms: %s", dt * 1e3, self._flush_what
                 )
 
-    def _flush_inner(self, donate: bool = True) -> DeviceSnapshot:
+    def _flush_inner(self, donate: bool = True) -> DeviceSnapshot:  # graftlint: holds-device-lock
         masters = self._masters()
         if self._device is None or self._content_invalid:
             self._flush_what = "full upload (first use or content invalid)"
@@ -1239,13 +1242,16 @@ class SnapshotEncoder:
             self._scatter_chunk(masters, chunk, donate=donate)
         return self._device
 
-    def _scatter_chunk(
+    def _scatter_chunk(  # graftlint: holds-device-lock
         self,
         masters: DeviceSnapshot,
         rows: list,
         pad: Optional[int] = None,
         donate: bool = True,
     ) -> None:
+        # callers hold device_lock (enforced by graftlint's donation
+        # pass at every call site): the donate=True path dispatches the
+        # donating scatter against the live snapshot buffers
         if pad is None:
             pad = (
                 _SCATTER_PAD_SMALL
@@ -1490,5 +1496,7 @@ _scatter_rows = functools.partial(jax.jit, donate_argnums=(0,))(_scatter_rows_im
 # from a persistent compilation cache (JAX_COMPILATION_CACHE_DIR) has been
 # observed writing garbage into non-targeted rows on the CPU backend —
 # the repairer must not be able to corrupt the very state it is fixing,
-# so it pays the copy and gets fresh, alias-free output buffers.
-_scatter_rows_safe = jax.jit(_scatter_rows_impl)
+# so it pays the copy and gets fresh, alias-free output buffers. The
+# marker below is machine-checked: graftlint fails if a donation keyword
+# ever lands on this definition.
+_scatter_rows_safe = jax.jit(_scatter_rows_impl)  # graftlint: alias-safe

@@ -33,21 +33,48 @@ class Event:
     ts: float = 0.0
 
 
+COUNTER_OVERFLOW = "watch_queue_overflow_total"
+
+
 class Watcher:
-    """A single watch stream; the store pushes events, the consumer iterates."""
+    """A single watch stream; the store pushes events, the consumer iterates.
+
+    push() and stop() are NON-BLOCKING by contract: both run on single-
+    threaded dispatch paths (the store's write-path ``_notify`` fan-out,
+    the watch cache's per-kind dispatch thread), where one blocking
+    ``queue.put`` against a full queue wedges every watcher behind the
+    loop — the CacheWatcher variant of this bug stalled the cacher
+    dispatch thread on the stop() sentinel put until PR 6 overrode it.
+    The discipline now lives in the base class: a consumer whose queue
+    fills (maxsize events of backlog — dead, not slow) is terminated and
+    counted, and stop() drops its wake-up sentinel on the floor when the
+    queue is full, so iteration ends via the stopped-flag poll instead.
+    """
 
     def __init__(self, maxsize: int = 100000):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize)
         self._stopped = threading.Event()
 
     def push(self, ev: Event) -> None:
-        if not self._stopped.is_set():
-            self._q.put(ev)
+        if self._stopped.is_set():
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            # a consumer maxsize events behind is gone; terminating it is
+            # the only option that doesn't block the dispatch thread
+            from ..utils.metrics import metrics
+
+            metrics.inc(COUNTER_OVERFLOW)
+            self.stop()
 
     def stop(self) -> None:
         if not self._stopped.is_set():
             self._stopped.set()
-            self._q.put(None)
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass  # sentinel-free termination: __iter__/get poll stopped
 
     @property
     def stopped(self) -> bool:
@@ -62,8 +89,15 @@ class Watcher:
         return ev
 
     def __iter__(self) -> Iterator[Event]:
+        # sentinel-free termination: a dropped sentinel (full queue at
+        # stop time) must still end the iteration once the queue drains
         while True:
-            ev = self._q.get()
+            try:
+                ev = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stopped.is_set():
+                    return
+                continue
             if ev is None:
                 return
             yield ev

@@ -130,8 +130,15 @@ class SnapshotAntiEntropy:
             rows.extend(r for r in window if r not in rows)
         return rows
 
-    def audit_once(self) -> Dict[str, object]:
-        """One audit/repair pass; returns a report dict (tests + SIGUSR2)."""
+    def audit_once(self) -> Dict[str, object]:  # graftlint: alias-safe
+        """One audit/repair pass; returns a report dict (tests + SIGUSR2).
+
+        Marked alias-safe: every device write in this pass goes through
+        ``flush(donate=False)`` — the alias-free ``_scatter_rows_safe``
+        program — so the auditor can never donate (and thereby corrupt)
+        the live snapshot it is repairing. The marker is the
+        machine-readable form of that contract for graftlint's donation
+        pass; the prose used to be the only record of it."""
         enc = self.encoder
         report: Dict[str, object] = {
             "rows_audited": 0,

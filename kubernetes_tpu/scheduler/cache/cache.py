@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from ...api import objects as v1
 from ...ops.encoding import EncodingConfig, SnapshotEncoder
+from ...testing.lockgraph import named_lock
 from .nodeinfo import NodeInfo, Snapshot, _has_affinity
 
 logger = logging.getLogger("kubernetes_tpu.scheduler.cache")
@@ -40,7 +41,9 @@ class SchedulerCache:
         encoder: Optional[SnapshotEncoder] = None,
         encoding_config: Optional[EncodingConfig] = None,
     ):
-        self.lock = threading.RLock()
+        # named for the lock-order watchdog (testing/lockgraph.py): the
+        # cache lock orders BEFORE the encoder's device_lock, everywhere
+        self.lock = named_lock("scheduler.cache")
         self._nodes: Dict[str, NodeInfo] = {}
         self._pod_to_node: Dict[str, str] = {}
         # pods scheduled to nodes the cache hasn't seen yet (informer start

@@ -266,11 +266,12 @@ class PriorityQueue:
     def update(self, old: Optional[v1.Pod], new: v1.Pod) -> None:
         with self._cond:
             key = new.metadata.key
-            for store in (self._active, self._backoff):
-                pi = store.get(key)
+            # the queue's own heaps, not the API store
+            for q in (self._active, self._backoff):
+                pi = q.get(key)
                 if pi is not None:
                     pi.pod = new
-                    store.update(pi)
+                    q.update(pi)
                     return
             pi = self._unschedulable.get(key)
             if pi is not None:

@@ -1093,7 +1093,10 @@ class Scheduler:
         gather (which passed its quiesced gate before this batch went
         busy) deadlocks the CPU client process-wide."""
         with self.cache.encoder.device_lock:
-            return kern(snap, batch, ptab, weights, key)
+            # kern arrives as a parameter, so the donation is invisible
+            # to static analysis at this call — the marker makes it the
+            # checked donation site (graftlint donation pass)
+            return kern(snap, batch, ptab, weights, key)  # graftlint: donating-call
 
     def _fetch_wave_results(self, batches: List["_InFlightBatch"]):
         """Seam for the fault injector: the combined device->host readback
@@ -2211,10 +2214,20 @@ class Scheduler:
                     victim, "Normal", "Preempted", "Preempting",
                     f"by {pod.metadata.key} on node {node}",
                 )
-                metrics.inc("preemption_victims")
+                metrics.inc("preemption_victims_total")
             except NotFound:
                 pass
-        metrics.inc("preemption_attempts")
+            except (DegradedWrites, NotPrimary):
+                # read-only store: abort the attempt (counted skip, the
+                # PR-3 discipline) — the preemptor pod stays pending and
+                # retries once writes reopen; pressing on would nominate
+                # a node whose victims were never actually evicted
+                metrics.inc(
+                    "scheduler_degraded_write_skips_total",
+                    {"write": "preempt_delete"},
+                )
+                return ""
+        metrics.inc("preemption_attempts_total")
 
         def mutate(p):
             p.status.nominated_node_name = node
@@ -2226,5 +2239,10 @@ class Scheduler:
             )
         except NotFound:
             return node
+        except (DegradedWrites, NotPrimary):
+            metrics.inc(
+                "scheduler_degraded_write_skips_total", {"write": "nominate"}
+            )
+            return node  # victims are gone; the nomination is best-effort
         self.queue.add_nominated_pod(pod, node)
         return node

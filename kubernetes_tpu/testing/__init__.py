@@ -1,14 +1,35 @@
-"""Test helpers: fluent object builders, a plugin-registration DSL, and a
-fake cache (reference pkg/scheduler/testing + internal/cache/fake)."""
+"""Test helpers: fluent object builders, a plugin-registration DSL, a
+fake cache (reference pkg/scheduler/testing + internal/cache/fake), and
+the lock-order watchdog (lockgraph).
 
-from .fake_cache import FakeCache  # noqa: F401
-from .framework_helpers import (  # noqa: F401
-    new_framework,
-    register_bind,
-    register_filter,
-    register_plugin,
-    register_pre_filter,
-    register_queue_sort,
-    register_score,
-)
-from .wrappers import NodeWrapper, PodWrapper  # noqa: F401
+Submodule imports are LAZY (PEP 562): production modules import
+``testing.lockgraph`` (named locks feed the watchdog), and an eager
+``from .fake_cache import FakeCache`` here would close an import cycle
+back through scheduler → client.apiserver → testing.
+"""
+
+_EXPORTS = {
+    "FakeCache": ("fake_cache", "FakeCache"),
+    "new_framework": ("framework_helpers", "new_framework"),
+    "register_bind": ("framework_helpers", "register_bind"),
+    "register_filter": ("framework_helpers", "register_filter"),
+    "register_plugin": ("framework_helpers", "register_plugin"),
+    "register_pre_filter": ("framework_helpers", "register_pre_filter"),
+    "register_queue_sort": ("framework_helpers", "register_queue_sort"),
+    "register_score": ("framework_helpers", "register_score"),
+    "NodeWrapper": ("wrappers", "NodeWrapper"),
+    "PodWrapper": ("wrappers", "PodWrapper"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    mod = importlib.import_module("." + mod_name, __name__)
+    return getattr(mod, attr)

@@ -1,0 +1,264 @@
+"""Lock-order watchdog: graftlint's runtime companion.
+
+The static passes prove donation sites hold ``device_lock`` and dispatch
+loops never block — they cannot prove the LOCKS THEMSELVES are acquired
+in a consistent global order. The PR-4 deadlock class (a donating wave
+launch under ``device_lock`` racing the audit's gather under the cache
+lock) is an ordering property: it only fires under the right
+interleaving, which a chaos run may never hit even while the inversion
+sits in the code.
+
+This module wraps the named production locks (encoder ``device_lock``,
+the scheduler cache lock, the store lock, the watch cache's per-kind
+locks — each created through :func:`named_lock`) so that, when the
+watchdog is ENABLED, every successful acquisition records
+``held → acquired`` edges into one process-wide lock-order graph. A new
+edge that closes a cycle is a lock-order inversion — two code paths that
+take the same pair of locks in opposite orders — and is recorded as a
+violation immediately, even though the run did not deadlock. The chaos
+suites (``make chaos-device``, ``make chaos-readpath``) enable the
+watchdog for the whole module and assert the final graph is acyclic.
+
+Disabled (production default) the wrapper costs one attribute load and
+one boolean test per acquire/release. Locks of the same NAME share graph
+nodes — per-kind cache locks all record as ``cacher.kind`` — which keeps
+the graph readable and still catches cross-class inversions; a
+same-name, cross-instance ABBA pair would be reported as a self-edge-
+free cycle of length 2 only if some path orders the two names, which is
+exactly the conservative behavior a watchdog wants.
+
+Not thread-exhaustive: edges only exist for orders actually executed.
+That is the point — it converts "the chaos suite happened not to
+deadlock" into "no executed path can deadlock on these locks".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_enabled = False
+_epoch = 0  # bumped by enable(): stale per-thread state self-invalidates
+_graph_lock = threading.Lock()  # leaf lock: never held while acquiring others
+_edges: Dict[str, Set[str]] = {}
+_edge_sites: Dict[Tuple[str, str], int] = {}
+_violations: List[List[str]] = []
+_acquires: Dict[str, int] = {}
+_tls = threading.local()
+
+
+def _held() -> List[str]:
+    """This thread's held-name stack for the CURRENT watchdog epoch.
+
+    release() records nothing while disabled, so a thread that acquired
+    under epoch N and releases after disable() would keep the name on
+    its stack forever — and fabricate `stale -> X` edges (possibly a
+    false cycle) in the next enabled suite in the same process. Epoch
+    tagging drops such leftovers: losing a genuinely-still-held entry
+    only costs a missed edge (false negative), never a false cycle."""
+    if getattr(_tls, "epoch", None) != _epoch:
+        _tls.epoch = _epoch
+        _tls.held = []
+    return _tls.held
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst in the edge graph (caller holds _graph_lock)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_acquire(name: str) -> None:
+    with _graph_lock:
+        _acquires[name] = _acquires.get(name, 0) + 1
+    held = _held()
+    if name in held:
+        held.append(name)  # re-entrant: balance the stack, no new edges
+        return
+    uniq = []
+    for h in held:
+        if h != name and h not in uniq:
+            uniq.append(h)
+    if uniq:
+        with _graph_lock:
+            for h in uniq:
+                if name in _edges.get(h, ()):
+                    _edge_sites[(h, name)] += 1
+                    continue
+                # NEW edge h -> name: closing a cycle means some other
+                # path already orders name before h — an inversion
+                back = _find_path(name, h)
+                _edges.setdefault(h, set()).add(name)
+                _edge_sites[(h, name)] = 1
+                if back is not None:
+                    _violations.append(back + [name])
+    held.append(name)
+
+
+def _record_release(name: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+class NamedLock:
+    """A lock wrapper that reports acquisitions to the watchdog.
+
+    Wraps an RLock by default. Compatible with ``threading.Condition``
+    (delegates ``_release_save``/``_acquire_restore``/``_is_owned``
+    straight through: a thread parked in ``wait()`` records nothing, and
+    its thread-local held stack stays consistent because a blocked
+    thread acquires nothing)."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner=None):
+        self.name = name
+        self._inner = inner if inner is not None else threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _enabled:
+            _record_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        if _enabled:
+            _record_release(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition compatibility: full release around wait() and silent
+    # re-acquire on wake, both invisible to the order graph (see class
+    # docstring)
+    def _release_save(self):
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"NamedLock({self.name!r}, {self._inner!r})"
+
+
+def named_lock(name: str, inner=None) -> NamedLock:
+    """The factory production modules call where they used to call
+    ``threading.RLock()`` directly. Always returns the wrapper — the
+    enable flag is checked per acquisition, so chaos suites can flip the
+    watchdog on for locks created long before."""
+    return NamedLock(name, inner)
+
+
+# -- watchdog control (chaos suites) -----------------------------------------
+
+
+def enable() -> None:
+    global _enabled, _epoch
+    reset()
+    _epoch += 1  # invalidate every thread's held stack from prior runs
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    with _graph_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _violations.clear()
+        _acquires.clear()
+
+
+def edges() -> Dict[str, Set[str]]:
+    with _graph_lock:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def edge_count() -> int:
+    with _graph_lock:
+        return sum(len(v) for v in _edges.values())
+
+
+def acquire_count() -> int:
+    """Total named-lock acquisitions observed while enabled — the
+    instrumentation-is-alive signal (a suite can legitimately record
+    zero EDGES when its locks never nest; it cannot record zero
+    acquisitions)."""
+    with _graph_lock:
+        return sum(_acquires.values())
+
+
+def acquires_by_name() -> Dict[str, int]:
+    with _graph_lock:
+        return dict(_acquires)
+
+
+def violations() -> List[List[str]]:
+    with _graph_lock:
+        return [list(v) for v in _violations]
+
+
+def find_cycle() -> Optional[List[str]]:
+    """Any cycle in the full graph (independent of insert-time capture)."""
+    with _graph_lock:
+        color: Dict[str, int] = {}
+
+        def dfs(node: str, path: List[str]) -> Optional[List[str]]:
+            color[node] = 1
+            for nxt in _edges.get(node, ()):
+                if color.get(nxt, 0) == 1:
+                    return path[path.index(nxt) :] + [nxt] if nxt in path else [nxt, node, nxt]
+                if color.get(nxt, 0) == 0:
+                    found = dfs(nxt, path + [nxt])
+                    if found:
+                        return found
+            color[node] = 2
+            return None
+
+        for node in list(_edges):
+            if color.get(node, 0) == 0:
+                found = dfs(node, [node])
+                if found:
+                    return found
+    return None
+
+
+def assert_acyclic() -> None:
+    """Fail loudly on any recorded inversion OR any cycle in the final
+    graph. The edge list in the message is the repro: each edge names a
+    lock order some real code path executed."""
+    vio = violations()
+    cyc = find_cycle()
+    if vio or cyc:
+        lines = ["lock-order watchdog: ORDER INVERSION DETECTED"]
+        for v in vio:
+            lines.append("  inversion: " + " -> ".join(v))
+        if cyc and not vio:
+            lines.append("  cycle: " + " -> ".join(cyc))
+        with _graph_lock:
+            for (a, b), n in sorted(_edge_sites.items()):
+                lines.append(f"  edge {a} -> {b} (seen {n}x)")
+        raise AssertionError("\n".join(lines))

@@ -43,15 +43,13 @@ import re
 import subprocess
 import sys
 
-DEFAULT_FILES = [
-    "tests/test_chaos_warmup.py",  # MUST run first: absorbs compiles
-    "tests/test_chaos.py",
-    "tests/test_chaos_pipeline.py",
-    "tests/test_chaos_device.py",
-    "tests/test_chaos_autoscaler.py",
-    "tests/test_chaos_readpath.py",
-    "tests/test_watchcache.py",
-]
+# the chaos-suite file list lives in the graftlint config so suite
+# enumeration has ONE home (this lint, the static analyzer, and the
+# lock-order watchdog wiring all read the same list)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "graftlint")
+)
+from config import CHAOS_SUITE_FILES as DEFAULT_FILES  # noqa: E402
 
 # tests whose id contains this substring absorb per-process compile cost
 # by design and are never judged against the threshold

@@ -1,0 +1,159 @@
+"""graftlint: the donation / blocking / metrics / degraded-write linter.
+
+Usage (from the repo root):
+
+    python scripts/graftlint                 # lint the tree, exit 1 on findings
+    python scripts/graftlint --list-metrics  # print the README metrics table
+    python scripts/graftlint --write-baseline  # snapshot findings as baseline
+    python scripts/graftlint path/to/file.py ...  # restrict the scan
+
+Findings print as ``file:line: [pass] message`` and the process exits
+nonzero when any unsuppressed finding (or any STALE suppression) exists.
+The checked-in suppression baseline (scripts/graftlint/baseline.txt) is
+seeded EMPTY and should stay that way: real findings get fixed, and a
+baseline entry that no longer matches anything is itself an error so
+dead suppressions can't accumulate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:  # `python scripts/graftlint` adds it; -m paths differ
+    sys.path.insert(0, _HERE)
+
+import blocking
+import config
+import core
+import degraded
+import donation
+import metrics_contract
+
+BASELINE = os.path.join(_HERE, "baseline.txt")
+
+
+def load_baseline(path: str):
+    keys = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    keys.append(line)
+    return keys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("files", nargs="*", help="restrict scan to these files")
+    ap.add_argument("--root", default=None, help="repo root (default: cwd)")
+    ap.add_argument(
+        "--baseline", default=BASELINE, help="suppression baseline file"
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline and exit 0",
+    )
+    ap.add_argument(
+        "--list-metrics",
+        action="store_true",
+        help="print the metrics reference table (markdown) and exit",
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.files:
+        rels = [os.path.relpath(os.path.abspath(f), root) for f in args.files]
+    else:
+        rels = core.discover(root, config.PACKAGES, config.EXCLUDE_DIRS)
+    tree = core.Tree(root, rels)
+    for err in tree.parse_errors:
+        print(f"graftlint: parse error: {err}", file=sys.stderr)
+    if tree.parse_errors:
+        return 2
+
+    if args.list_metrics:
+        registry, _ = metrics_contract.collect(tree)
+        kind_order = {"counter": 0, "gauge": 1, "histogram": 2}
+        print("| series | kind | labels |")
+        print("|---|---|---|")
+        for name in sorted(
+            registry,
+            key=lambda n: (
+                min(
+                    (kind_order[k] for k in registry[n].kinds),
+                    default=3,
+                ),
+                n,
+            ),
+        ):
+            s = registry[name]
+            kinds = "/".join(sorted(s.kinds))
+            keys = sorted({k for ks in s.label_sets for k in ks})
+            labels = ", ".join(keys) if keys else "—"
+            print(f"| `{name}` | {kinds} | {labels} |")
+        return 0
+
+    findings = []
+    findings += donation.run(tree)
+    findings += blocking.run(tree)
+    findings += metrics_contract.run(tree, root)
+    findings += degraded.run(tree)
+    # passes can surface the same hazard through two rules; report once
+    seen = set()
+    deduped = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.pass_name)):
+        sig = (f.path, f.line, f.pass_name, f.message)
+        if sig not in seen:
+            seen.add(sig)
+            deduped.append(f)
+    findings = deduped
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write(
+                "# graftlint suppression baseline. SHOULD BE EMPTY: real\n"
+                "# findings get fixed, not suppressed. Entries are\n"
+                "# `path::pass::key` finding keys; a stale entry (matching\n"
+                "# nothing) fails the lint so suppressions cannot outlive\n"
+                "# the code they excused.\n"
+            )
+            for f in findings:
+                fh.write(f.baseline_key() + "\n")
+        print(f"graftlint: wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    suppressed = [f for f in findings if f.baseline_key() in baseline]
+    live = [f for f in findings if f.baseline_key() not in baseline]
+    matched_keys = {f.baseline_key() for f in suppressed}
+    stale = [k for k in baseline if k not in matched_keys]
+
+    for f in live:
+        print(f.render())
+    for k in stale:
+        print(f"graftlint: STALE baseline entry (matches nothing): {k}")
+    n_pass = {}
+    for f in findings:
+        n_pass[f.pass_name] = n_pass.get(f.pass_name, 0) + 1
+    summary = ", ".join(f"{p}={n}" for p, n in sorted(n_pass.items())) or "none"
+    if live or stale:
+        print(
+            f"graftlint: {len(live)} finding(s) "
+            f"({summary}; suppressed={len(suppressed)}, stale={len(stale)}) "
+            f"across {len(tree.modules)} files"
+        )
+        return 1
+    print(
+        f"graftlint: OK — {len(tree.modules)} files clean "
+        f"(suppressed={len(suppressed)})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""Pass 2: dispatch-thread blocking-call lint.
+
+A single-threaded dispatch loop (the watch cache's per-kind fan-out, the
+store's write-path notify, the replication ship path, informer pumps)
+serves EVERY client behind it: one unbounded blocking call wedges them
+all. The founding bug: the base ``Watcher.stop()`` did a blocking
+sentinel ``queue.put`` — on a full queue (exactly the state of a
+terminated-slow watcher) it wedged the cacher dispatch thread for every
+informer on that kind.
+
+The pass walks the same-module call graph from each registered root
+(config.DISPATCH_ROOTS) and flags, in any reachable function:
+
+  * ``.put(...)`` with no ``timeout=`` / ``block=False`` (use
+    ``put_nowait`` or a bounded put);
+  * ``.join()`` / ``.wait()`` with no timeout;
+  * blocking socket primitives (accept/recv/connect/sendall/...);
+  * store RPCs (``.list(`` / ``.watch(`` on a store-ish receiver).
+
+The same primitives are banned lexically inside ``with`` bodies of hot
+locks (config.HOT_LOCK_SUFFIXES), plus ``time.sleep`` — a sleep under
+the cache lock stalls the entire scheduling pipeline.
+
+``# graftlint: allow-blocking(reason)`` on the call line acknowledges a
+deliberate blocking call (e.g. the cacher's resync re-list: the cache is
+unavailable anyway until it completes). The reason is mandatory — an
+empty reason is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from core import Finding, FuncInfo, Module, Tree, call_name, dotted_name
+import config
+
+PASS = "blocking"
+
+SOCKET_METHODS = {
+    "accept",
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "connect",
+    "sendall",
+    "makefile",
+}
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _classify(call: ast.Call) -> Optional[str]:
+    """What kind of blocking hazard is this call, if any?"""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    name = f.attr
+    recv = dotted_name(f.value)
+    recv_last = recv.rsplit(".", 1)[-1] if recv else ""
+    if name == "put":
+        block = _kw(call, "block")
+        if block is None and len(call.args) >= 2:
+            block = call.args[1]  # put(item, block, ...) positional
+        if (
+            _kw(call, "timeout") is None
+            and len(call.args) < 3  # put(item, block, timeout) positional
+            and not (
+                isinstance(block, ast.Constant) and block.value is False
+            )
+        ):
+            return "unbounded queue.put (use put_nowait or timeout=)"
+        return None
+    if name in ("join", "wait"):
+        # join()/wait() with any positional timeout or timeout= is bounded
+        if not call.args and _kw(call, "timeout") is None:
+            return f"unbounded .{name}() (pass a timeout)"
+        return None
+    if name in SOCKET_METHODS:
+        return f"blocking socket call .{name}()"
+    if (
+        name in config.STORE_RPC_METHODS
+        and recv_last in config.STORE_RPC_RECEIVERS
+    ):
+        return f"store RPC .{name}() on `{recv}`"
+    return None
+
+
+def _is_sleep(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "sleep"
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "time"
+    )
+
+
+def _allowed(mod: Module, call: ast.Call) -> Tuple[bool, bool]:
+    """(allowed, reason_missing) for an allow-blocking pragma spanning
+    the call's lines."""
+    start = call.lineno
+    end = getattr(call, "end_lineno", start)
+    for ln in range(start, end + 1):
+        for p in mod.pragmas.get(ln, ()):
+            if p.directive == "allow-blocking":
+                return True, not p.reason
+    return False, False
+
+
+def _reachable(tree: Tree, roots) -> Dict[str, FuncInfo]:
+    """Qualname -> FuncInfo for every function reachable from the roots
+    via the same-module call graph (plus config.EXTRA_REACHABLE edges)."""
+    by_qual: Dict[str, FuncInfo] = {}
+    for infos in tree.functions.values():
+        for fi in infos:
+            by_qual.setdefault(fi.qualname, fi)
+    out: Dict[str, FuncInfo] = {}
+    work: List[str] = [r for r in roots if r in by_qual]
+    while work:
+        qual = work.pop()
+        if qual in out:
+            continue
+        fi = by_qual[qual]
+        out[qual] = fi
+        for extra in config.EXTRA_REACHABLE.get(qual, ()):
+            if extra in by_qual and extra not in out:
+                work.append(extra)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if not cn:
+                continue
+            # same class first, then same module
+            cands = [
+                c
+                for c in tree.funcs_named(cn)
+                if c.module is fi.module
+                and (c.class_name == fi.class_name or c.class_name is None)
+            ]
+            if not cands:
+                cands = [
+                    c for c in tree.funcs_named(cn) if c.module is fi.module
+                ]
+            for c in cands:
+                if c.qualname not in out:
+                    work.append(c.qualname)
+    return out
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(mod: Module, call: ast.Call, ctx: str, what: str) -> None:
+        allowed, reason_missing = _allowed(mod, call)
+        if allowed and not reason_missing:
+            return
+        func = mod.enclosing_function(call)
+        where = func.name if func is not None else "<module>"
+        if allowed and reason_missing:
+            what = "allow-blocking pragma without a reason"
+        findings.append(
+            Finding(
+                mod.rel,
+                call.lineno,
+                PASS,
+                f"{ctx}:{where}:{call_name(call) or '?'}",
+                f"{what} in `{where}` ({ctx})",
+            )
+        )
+
+    # a) reachable from registered dispatch roots
+    reach = _reachable(tree, config.DISPATCH_ROOTS)
+    for qual, fi in sorted(reach.items()):
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                what = _classify(node)
+                if what:
+                    emit(fi.module, node, "reachable from dispatch loop", what)
+
+    # b) lexically inside hot-lock with-bodies, tree-wide
+    for mod, call in tree.walk_calls():
+        if not mod.inside_with_lock(call, config.HOT_LOCK_SUFFIXES):
+            continue
+        what = _classify(call)
+        if what is None and _is_sleep(call):
+            what = "time.sleep under a hot lock"
+        if what:
+            emit(mod, call, "inside hot-lock body", what)
+    return findings

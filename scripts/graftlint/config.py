@@ -1,0 +1,164 @@
+"""graftlint configuration: the contracts, spelled out in one place.
+
+Everything here IS the contract surface — the passes are generic AST
+machinery; which locks are hot, which loops are single-threaded dispatch
+loops, which call names are store writes, which metric families must be
+SIGUSR2-dumpable all live here so review of a contract change is a
+one-file diff.
+
+This module is import-light on purpose (stdlib only): it is imported by
+the lint runner, by tests, and by scripts/check_slow_markers.py (the
+chaos-suite file list lives here so suite enumeration has one home).
+"""
+
+# -- tree scope --------------------------------------------------------------
+
+# package dirs scanned by every pass (repo-relative)
+PACKAGES = ("kubernetes_tpu",)
+
+# dirs skipped even inside PACKAGES
+EXCLUDE_DIRS = ()
+
+# -- chaos suites (shared with scripts/check_slow_markers.py and the
+#    lock-order watchdog wiring) ---------------------------------------------
+
+CHAOS_SUITE_FILES = [
+    "tests/test_chaos_warmup.py",  # MUST run first: absorbs compiles
+    "tests/test_chaos.py",
+    "tests/test_chaos_pipeline.py",
+    "tests/test_chaos_device.py",
+    "tests/test_chaos_autoscaler.py",
+    "tests/test_chaos_readpath.py",
+    "tests/test_watchcache.py",
+]
+
+# -- pass 1: donation safety -------------------------------------------------
+
+# a donation site must sit lexically inside `with <...>.<suffix>:` for one
+# of these lock suffixes (dotted suffix match: "device_lock" matches
+# `self.cache.encoder.device_lock`)
+DEVICE_LOCK_SUFFIXES = ("device_lock",)
+
+# keywords that make a jax.jit/shard_map expression donation-bearing
+DONATION_KEYWORDS = ("donate_argnums", "donate_argnames")
+
+# -- pass 2: dispatch-thread blocking calls ----------------------------------
+
+# registered single-threaded dispatch loops, by qualified name
+# ("Class.method"). Blocking primitives reachable from these (same-module
+# call graph) are findings: one wedged call here stalls every client of
+# the loop, not one request.
+DISPATCH_ROOTS = (
+    # watch-cache: the ONE store watch per kind + its fan-out
+    "KindCache._run",
+    "KindCache._apply",
+    "KindCache._fanout",
+    "Cacher._bookmark_loop",
+    # store write path: every CRUD notify runs through this
+    "APIServer._notify",
+    # replication: ship() runs on the store write path; the heartbeat
+    # loop services every follower from one thread
+    "ReplicationListener.ship",
+    "ReplicationListener._heartbeat_loop",
+    # informer pump: one thread per informer, but a blocked pump freezes
+    # every handler behind it
+    "SharedInformer._run",
+    # controller event pump: one thread drains all watch streams
+    "WorkqueueController._watch_loop",
+    # base watch primitives: push runs on the store/cacher dispatch
+    # thread, stop on arbitrary callers including dispatch threads
+    "Watcher.push",
+    "Watcher.stop",
+)
+
+# extra reachability edges the same-module call graph can't see
+# (root qualname -> called qualnames)
+EXTRA_REACHABLE = {
+    "APIServer._notify": ("Watcher.push", "Watcher.stop"),
+    "KindCache._fanout": ("CacheWatcher.push_nonblock",),
+}
+
+# locks whose `with` bodies must stay free of blocking primitives and
+# store RPCs (dotted suffix match). device_lock serializes every
+# donation-bearing device entry point; cache.lock serializes the whole
+# scheduling pipeline.
+HOT_LOCK_SUFFIXES = ("device_lock", "cache.lock")
+
+# receiver names that make `.list(` / `.watch(` a store RPC
+STORE_RPC_RECEIVERS = {"store", "_store", "server", "_server", "api", "client", "_client"}
+STORE_RPC_METHODS = {"list", "watch"}
+
+# -- pass 3: metrics contract ------------------------------------------------
+
+# the human-facing metrics reference every series must appear in
+METRICS_DOC = "README.md"
+
+# series-name families that must be covered by a SIGUSR2 dump section
+# (a snapshot_gauges/snapshot_counters call whose prefix covers the
+# series). Families not listed are /metrics-only by design (e.g. the
+# reference-aligned scheduler latency histograms).
+DUMP_REQUIRED_FAMILIES = (
+    "snapshot_",
+    "kernel_guard_",
+    "scheduler_device_",
+    "scheduler_mesh_",
+    "scheduler_pending_binds",
+    "scheduler_bind_breaker",
+    "node_lifecycle_",
+    "autoscaler_",
+    "watch_cache_",
+    "apiserver_flowcontrol_",
+    "informer_",
+)
+
+# -- pass 4: degraded-write handling -----------------------------------------
+
+# dirs whose store-write call sites must handle DegradedWrites/QuorumLost
+DEGRADED_DIRS = (
+    "kubernetes_tpu/controller",
+    "kubernetes_tpu/scheduler",
+    "kubernetes_tpu/autoscaler",
+    "kubernetes_tpu/kubelet",
+)
+
+# method names that are store writes when called on a store-ish receiver
+WRITE_METHODS = {
+    "create",
+    "update",
+    "guaranteed_update",
+    "delete",
+    "bind_pod",
+    "bind_pods",
+    "evict_pod",
+    "write_events_bulk",
+}
+
+# receiver trailing names that identify the store / API client
+WRITE_RECEIVERS = {
+    "server",
+    "_server",
+    "store",
+    "_store",
+    "client",
+    "_client",
+    "api",
+    "apiserver",
+    "kube_client",
+}
+
+# exception names whose handlers count as degraded-write handling
+# (DegradedWrites is a RuntimeError; QuorumLost subclasses DegradedWrites)
+DEGRADED_HANDLERS = {
+    "DegradedWrites",
+    "QuorumLost",
+    "RuntimeError",
+    "Exception",
+    "BaseException",
+}
+
+# classes whose every entry point already runs under a guarded reconcile
+# loop (a worker that catches Exception and requeues rate-limited — the
+# park-and-retry discipline). Subclasses inherit the exemption
+# transitively. ReplicaSetController predates WorkqueueController but
+# runs the identical guarded _worker shape.
+DEGRADED_TOLERANT_BASES = {"WorkqueueController", "ReplicaSetController"}

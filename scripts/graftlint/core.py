@@ -1,0 +1,250 @@
+"""graftlint core: AST indexing, pragma extraction, findings.
+
+Every pass works from one `Tree` built in a single parse sweep over the
+package: per-module ASTs, a function index keyed by qualified name, the
+pragma map (``# graftlint: <directive>`` comments resolved to physical
+lines via tokenize, so a directive survives black-style reflow as long
+as it stays on the line it governs), and parent links so a pass can ask
+"is this call lexically inside a ``with x.device_lock`` body / a ``try``
+that handles DegradedWrites / a function marked alias-safe".
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<directive>[A-Za-z0-9_-]+)"
+    r"(?:\((?P<reason>[^)]*)\))?"
+)
+
+
+@dataclass
+class Finding:
+    path: str          # repo-relative
+    line: int
+    pass_name: str     # donation | blocking | metrics | degraded
+    key: str           # stable suppression key (no line numbers)
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.pass_name}::{self.key}"
+
+
+@dataclass
+class Pragma:
+    directive: str
+    reason: str
+    line: int
+
+
+class Module:
+    """One parsed source file: AST + parents + pragmas + helpers."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # line -> [Pragma]; a pragma governs the line its comment sits on
+        self.pragmas: Dict[int, List[Pragma]] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m:
+                    self.pragmas.setdefault(tok.start[0], []).append(
+                        Pragma(
+                            m.group("directive"),
+                            (m.group("reason") or "").strip(),
+                            tok.start[0],
+                        )
+                    )
+        except tokenize.TokenError:
+            pass
+        # module-level string constants (NAME = "literal") for resolving
+        # metric series names referenced through constants
+        self.str_constants: Dict[str, str] = {}
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                self.str_constants[node.targets[0].id] = node.value.value
+
+    # -- pragma queries ------------------------------------------------------
+
+    def line_has(self, line: int, directive: str) -> bool:
+        return any(
+            p.directive == directive for p in self.pragmas.get(line, ())
+        )
+
+    def node_has(self, node: ast.AST, directive: str) -> bool:
+        """Pragma on any physical line the node spans (decorator lines of a
+        function count: the pragma conventionally sits on the def line, but
+        a trailing-comment after a multi-line call lands on end_lineno)."""
+        start = getattr(node, "lineno", None)
+        if start is None:
+            return False
+        end = getattr(node, "end_lineno", start)
+        return any(
+            self.line_has(ln, directive) for ln in range(start, end + 1)
+        )
+
+    def func_marked(self, func: ast.AST, directive: str) -> bool:
+        """Pragma on the def line (or a decorator line) of a function."""
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        lines = [func.lineno]
+        for dec in func.decorator_list:
+            lines.append(dec.lineno)
+        # the def line proper can be below the decorators
+        body_start = func.body[0].lineno if func.body else func.lineno
+        lines.extend(range(func.lineno, body_start))
+        return any(self.line_has(ln, directive) for ln in set(lines))
+
+    # -- structural queries --------------------------------------------------
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def inside_with_lock(self, node: ast.AST, lock_suffixes) -> bool:
+        """Is node lexically inside a `with <expr>` whose context manager's
+        dotted name ends with one of lock_suffixes (e.g. "device_lock",
+        "cache.lock")?"""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    dotted = dotted_name(item.context_expr)
+                    if dotted and any(
+                        dotted == s or dotted.endswith("." + s)
+                        for s in lock_suffixes
+                    ):
+                        return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'self.cache.encoder.device_lock' for the attribute chain; None for
+    anything that isn't a pure Name/Attribute chain (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Trailing name of the called expression: `foo(...)` -> "foo",
+    `self.x.bar(...)` -> "bar"."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+@dataclass
+class FuncInfo:
+    module: "Module"
+    node: ast.AST                 # FunctionDef
+    qualname: str                 # "ClassName.method" or "function"
+    class_name: Optional[str]
+
+
+class Tree:
+    """The whole scanned package, parsed once and indexed."""
+
+    def __init__(self, root: str, rel_paths: List[str]):
+        self.root = root
+        self.modules: List[Module] = []
+        errors: List[str] = []
+        for rel in rel_paths:
+            path = os.path.join(root, rel)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                self.modules.append(Module(path, rel, src))
+            except (OSError, SyntaxError) as e:  # pragma: no cover
+                errors.append(f"{rel}: {e}")
+        self.parse_errors = errors
+        # function index: bare name -> [FuncInfo] (cross-module resolution
+        # is name-based on purpose: precise import tracking buys little in
+        # one package and would silently miss monkeypatched seams)
+        self.functions: Dict[str, List[FuncInfo]] = {}
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls = mod.enclosing_class(node)
+                    qual = (
+                        f"{cls.name}.{node.name}" if cls else node.name
+                    )
+                    self.functions.setdefault(node.name, []).append(
+                        FuncInfo(mod, node, qual, cls.name if cls else None)
+                    )
+
+    def funcs_named(self, name: str) -> List[FuncInfo]:
+        return self.functions.get(name, [])
+
+    def walk_calls(self) -> Iterator[Tuple[Module, ast.Call]]:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    yield mod, node
+
+
+def discover(root: str, packages, exclude_dirs=()) -> List[str]:
+    """Repo-relative paths of every .py under the given package dirs."""
+    out: List[str] = []
+    for pkg in packages:
+        base = os.path.join(root, pkg)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [
+                d
+                for d in dirnames
+                if d != "__pycache__"
+                and os.path.relpath(os.path.join(dirpath, d), root)
+                not in exclude_dirs
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(
+                        os.path.relpath(os.path.join(dirpath, fn), root)
+                    )
+    return sorted(out)

@@ -1,0 +1,202 @@
+"""Pass 4: degraded-write handling.
+
+The consensus store refuses writes while degraded (DegradedWrites) and
+can lose a write's outcome entirely (QuorumLost). PRs 3-5 established
+the discipline for every control-plane writer: RAISE into a framework
+that parks-and-retries, PARK the work explicitly (pending-bind buffer),
+or COUNTED-SKIP (autoscaler's degraded_write_skips counters) — but never
+let the exception escape to kill a loop or wedge a component.
+
+This pass finds every store-write call site in the control-plane dirs
+(config.DEGRADED_DIRS): a call of a config.WRITE_METHODS name on a
+store-ish receiver (config.WRITE_RECEIVERS). A site passes when:
+
+  * a lexically-enclosing ``try`` has a handler for DegradedWrites /
+    QuorumLost (or a superclass — RuntimeError/Exception — or bare
+    ``except``); or
+  * the enclosing class subclasses a tolerant base
+    (config.DEGRADED_TOLERANT_BASES — WorkqueueController's worker loop
+    catches around ``sync()`` and requeues rate-limited, so every
+    subclass reconcile is framework-guarded); or
+  * the call or its enclosing function is marked
+    ``# graftlint: degraded-ok(reason)`` — the caller-handles contract,
+    stated where the next reader will look. The reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from core import Finding, Module, Tree, call_name, dotted_name
+import config
+
+PASS = "degraded"
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Optional[Set[str]]:
+    """Exception names one except-clause catches; None = bare except."""
+    if handler.type is None:
+        return None
+    names: Set[str] = set()
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, ast.Attribute):
+            names.add(t.attr)
+    return names
+
+
+def _try_handles(mod: Module, node: ast.AST) -> bool:
+    """Does any enclosing try (with node in its BODY, not its handlers
+    or else/finally) catch a qualifying exception?"""
+    cur = node
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.Try):
+            in_body = any(
+                cur is stmt or _contains(stmt, cur) for stmt in anc.body
+            )
+            if in_body:
+                for h in anc.handlers:
+                    names = _handler_names(h)
+                    if names is None or names & config.DEGRADED_HANDLERS:
+                        return True
+        cur = anc
+    return False
+
+
+def _contains(tree_node: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(tree_node))
+
+
+def _is_param(func, name: str) -> bool:
+    if func is None:
+        return True  # module level: keep the conservative match
+    a = func.args
+    params = [
+        p.arg
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)
+    ]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            params.append(extra.arg)
+    return name in params
+
+
+def _class_graph(tree: Tree) -> Dict[str, Set[str]]:
+    bases: Dict[str, Set[str]] = {}
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bs = set()
+                for b in node.bases:
+                    d = dotted_name(b)
+                    if d:
+                        bs.add(d.rsplit(".", 1)[-1])
+                bases.setdefault(node.name, set()).update(bs)
+    return bases
+
+
+def _tolerant(cls: Optional[str], bases: Dict[str, Set[str]]) -> bool:
+    seen: Set[str] = set()
+    work = [cls] if cls else []
+    while work:
+        c = work.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        if c in config.DEGRADED_TOLERANT_BASES:
+            return True
+        work.extend(bases.get(c, ()))
+    return False
+
+
+def _marked_ok(mod: Module, call: ast.Call) -> Optional[bool]:
+    """True = marked with reason; False = marked WITHOUT reason (itself a
+    finding); None = unmarked."""
+    lines = list(
+        range(call.lineno, getattr(call, "end_lineno", call.lineno) + 1)
+    )
+    func = mod.enclosing_function(call)
+    pragmas = [
+        p
+        for ln in lines
+        for p in mod.pragmas.get(ln, ())
+        if p.directive == "degraded-ok"
+    ]
+    if not pragmas and func is not None:
+        body_start = func.body[0].lineno if func.body else func.lineno
+        for ln in range(func.lineno, body_start):
+            pragmas.extend(
+                p
+                for p in mod.pragmas.get(ln, ())
+                if p.directive == "degraded-ok"
+            )
+    if not pragmas:
+        return None
+    return all(p.reason for p in pragmas)
+
+
+def run(tree: Tree, dirs=None) -> List[Finding]:
+    findings: List[Finding] = []
+    bases = _class_graph(tree)
+    dirs = tuple(
+        d.rstrip("/") + "/" for d in (dirs or config.DEGRADED_DIRS)
+    )
+    for mod in tree.modules:
+        if not mod.rel.replace("\\", "/").startswith(dirs):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr not in config.WRITE_METHODS:
+                continue
+            recv = dotted_name(f.value)
+            if not recv or recv.rsplit(".", 1)[-1] not in config.WRITE_RECEIVERS:
+                continue
+            # a BARE receiver name must be a parameter of the enclosing
+            # function (injected server/store) — a local merely NAMED
+            # `store`/`client` (a heap, a dict) is not an API handle and
+            # would force production renames to dodge false findings
+            if "." not in recv and not _is_param(
+                mod.enclosing_function(node), recv
+            ):
+                continue
+            marked = _marked_ok(mod, node)
+            if marked is True:
+                continue
+            func = mod.enclosing_function(node)
+            where = func.name if func is not None else "<module>"
+            if marked is False:
+                findings.append(
+                    Finding(
+                        mod.rel, node.lineno, PASS,
+                        f"no-reason:{where}:{f.attr}",
+                        f"degraded-ok pragma on `{recv}.{f.attr}` in "
+                        f"`{where}` needs a reason",
+                    )
+                )
+                continue
+            if _try_handles(mod, node):
+                continue
+            cls = mod.enclosing_class(node)
+            if cls is not None and _tolerant(cls.name, bases):
+                continue
+            findings.append(
+                Finding(
+                    mod.rel, node.lineno, PASS,
+                    f"unguarded-write:{where}:{f.attr}",
+                    f"store write `{recv}.{f.attr}` in `{where}` can let "
+                    "DegradedWrites/QuorumLost escape (no enclosing "
+                    "handler, tolerant base, or degraded-ok marker)",
+                )
+            )
+    return findings

@@ -1,0 +1,295 @@
+"""Pass 1: donation safety.
+
+Every callable built with ``donate_argnums``/``donate_argnames`` donates
+its input buffers to XLA: the caller's arrays are dead the moment the
+call is dispatched. Two production bugs taught the discipline this pass
+enforces (PR 4): a donating wave launch racing the anti-entropy audit's
+row gather deadlocked the CPU client process-wide, and a donating
+scatter deserialized from a persistent compilation cache corrupted rows
+it was never asked to touch. The contract:
+
+  every call site of a donating callable must be (a) lexically inside a
+  ``with <...>.device_lock`` region, or (b) inside a function explicitly
+  marked alias-free (``# graftlint: alias-safe``), or (c) inside a
+  function marked ``# graftlint: holds-device-lock`` — in which case the
+  SAME requirement recursively applies to that function's call sites.
+
+Donating callables are discovered, not declared: any name assigned from
+an expression containing a donation keyword joins the module's donating
+set, names assigned from references to donating names propagate
+(``scatter = _rows if donate else _rows_safe``), calls to donating
+FACTORIES (functions whose return expression carries a donation keyword,
+e.g. ``make_wave_kernel_jit``) taint their assignment targets, and
+``from x import donating_name`` carries the taint across modules. A
+donating callable passed as an ARGUMENT (the injector-seam pattern)
+requires the receiving function to mark the forwarded invocation with
+``# graftlint: donating-call`` so the lock check lands on the real call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from core import Finding, Module, Tree, call_name
+import config
+
+PASS = "donation"
+
+
+def _has_donation_keyword(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in config.DONATION_KEYWORDS:
+                    return True
+    return False
+
+
+def _alias_taint(expr: ast.AST, names: Set[str]) -> bool:
+    """Does binding a name to this expression ALIAS a donating callable?
+    Plain names, conditional expressions and boolean selection between
+    names propagate (``scatter = _rows if donate else _rows_safe``); a
+    CALL result does not — invoking a donating kernel returns arrays,
+    not another donating callable."""
+    if isinstance(expr, ast.Name):
+        return expr.id in names
+    if isinstance(expr, ast.IfExp):
+        return _alias_taint(expr.body, names) or _alias_taint(
+            expr.orelse, names
+        )
+    if isinstance(expr, ast.BoolOp):
+        return any(_alias_taint(v, names) for v in expr.values)
+    return False
+
+
+def _calls_any(expr: ast.AST, names: Set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and call_name(n) in names:
+            return True
+    return False
+
+
+class ModTaint:
+    """Donating names for one module, scoped: module-level names are
+    visible everywhere in the module; a name bound inside a function is
+    donating only within that function (two unrelated locals both named
+    ``kern`` in different methods must not cross-taint)."""
+
+    def __init__(self) -> None:
+        self.module_level: Set[str] = set()
+        self.per_func: Dict[ast.AST, Set[str]] = {}
+
+    def visible(self, mod: Module, node: ast.AST) -> Set[str]:
+        names = set(self.module_level)
+        func = mod.enclosing_function(node)
+        while func is not None:
+            names |= self.per_func.get(func, set())
+            func = mod.enclosing_function(func)
+        return names
+
+    def add(self, mod: Module, assign: ast.AST, name: str) -> bool:
+        func = mod.enclosing_function(assign)
+        bucket = (
+            self.per_func.setdefault(func, set())
+            if func is not None
+            else self.module_level
+        )
+        if name in bucket:
+            return False
+        bucket.add(name)
+        return True
+
+    def all_names(self) -> Set[str]:
+        out = set(self.module_level)
+        for s in self.per_func.values():
+            out |= s
+        return out
+
+
+def discover(tree: Tree) -> Tuple[Dict[Module, ModTaint], Set[str]]:
+    """(per-module scoped donating names, donating factory names).
+
+    Taint flows across modules only through explicit imports of a
+    MODULE-LEVEL donating name or calls to a (globally known) factory."""
+    factories: Set[str] = set()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Return)
+                        and sub.value is not None
+                        and _has_donation_keyword(sub.value)
+                    ):
+                        factories.add(node.name)
+                        break
+    per_mod: Dict[Module, ModTaint] = {mod: ModTaint() for mod in tree.modules}
+    imports: Dict[Module, List[str]] = {
+        mod: [
+            alias.asname or alias.name
+            for node in ast.walk(mod.tree)
+            if isinstance(node, ast.ImportFrom)
+            for alias in node.names
+        ]
+        for mod in tree.modules
+    }
+    changed = True
+    while changed:
+        changed = False
+        exported = set()
+        for t in per_mod.values():
+            exported |= t.module_level
+        for mod in tree.modules:
+            taint = per_mod[mod]
+            for name in imports[mod]:
+                if name in exported and name not in taint.module_level:
+                    taint.module_level.add(name)
+                    changed = True
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                val = node.value
+                visible = taint.visible(mod, node)
+                tainted = (
+                    _has_donation_keyword(val)
+                    or _alias_taint(val, visible)
+                    or _calls_any(val, factories)
+                )
+                if not tainted:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if taint.add(mod, node, tgt.id):
+                            changed = True
+    # a factory is not itself a donating callable (calling it compiles,
+    # it doesn't donate)
+    for mod in tree.modules:
+        t = per_mod[mod]
+        t.module_level -= factories
+        for s in t.per_func.values():
+            s -= factories
+    return per_mod, factories
+
+
+def _site_ok(
+    mod: Module, node: ast.AST, deferred: List[str]
+) -> bool:
+    """One donation site: lock-held, alias-safe, or deferred to the
+    enclosing function's call sites (holds-device-lock)."""
+    if mod.inside_with_lock(node, config.DEVICE_LOCK_SUFFIXES):
+        return True
+    func = mod.enclosing_function(node)
+    while func is not None:
+        if mod.func_marked(func, "alias-safe"):
+            return True
+        if mod.func_marked(func, "holds-device-lock"):
+            deferred.append(func.name)
+            return True
+        func = mod.enclosing_function(func)
+    return False
+
+
+def run(tree: Tree) -> List[Finding]:
+    per_mod, factories = discover(tree)
+    findings: List[Finding] = []
+    deferred: List[str] = []  # functions whose callers must hold the lock
+
+    # `# graftlint: alias-safe` on an ASSIGNMENT declares the bound name
+    # an alias-free variant (fresh output buffers, no donation). The
+    # declaration is verified, not trusted: a donation keyword sneaking
+    # into a marked assignment is a contradiction finding.
+    for mod in tree.modules:
+        taint = per_mod[mod]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not mod.node_has(node, "alias-safe"):
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id in taint.visible(mod, node)
+                ):
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            PASS,
+                            f"alias-safe-contradiction:{tgt.id}",
+                            f"`{tgt.id}` is marked alias-safe but its "
+                            "definition is donation-bearing",
+                        )
+                    )
+
+    for mod in tree.modules:
+        taint = per_mod[mod]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            donating = taint.visible(mod, node)
+            cn = call_name(node)
+            if (cn in donating) or mod.node_has(node, "donating-call"):
+                if not _site_ok(mod, node, deferred):
+                    func = mod.enclosing_function(node)
+                    where = func.name if func is not None else "<module>"
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            PASS,
+                            f"unlocked-donation:{where}:{cn}",
+                            f"donating callable `{cn}` invoked outside a "
+                            f"device_lock region (and `{where}` is not "
+                            f"marked alias-safe or holds-device-lock)",
+                        )
+                    )
+            # donating callable forwarded as an argument: the receiver
+            # must mark the forwarded invocation as donating-call
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in donating:
+                    callee = cn or "<unknown>"
+                    marked = any(
+                        fi.module.node_has(sub, "donating-call")
+                        for fi in tree.funcs_named(callee)
+                        for sub in ast.walk(fi.node)
+                        if isinstance(sub, ast.Call)
+                    )
+                    if not marked:
+                        findings.append(
+                            Finding(
+                                mod.rel,
+                                node.lineno,
+                                PASS,
+                                f"unmarked-handoff:{callee}:{arg.id}",
+                                f"donating callable `{arg.id}` passed to "
+                                f"`{callee}`, which has no `# graftlint: "
+                                f"donating-call` marked invocation",
+                            )
+                        )
+
+    # recursive caller check for holds-device-lock functions
+    checked: Set[str] = set()
+    while deferred:
+        fname = deferred.pop()
+        if fname in checked:
+            continue
+        checked.add(fname)
+        for mod, call in tree.walk_calls():
+            if call_name(call) != fname:
+                continue
+            if not _site_ok(mod, call, deferred):
+                func = mod.enclosing_function(call)
+                where = func.name if func is not None else "<module>"
+                findings.append(
+                    Finding(
+                        mod.rel,
+                        call.lineno,
+                        PASS,
+                        f"unlocked-caller:{where}:{fname}",
+                        f"`{fname}` requires device_lock held "
+                        f"(# graftlint: holds-device-lock) but `{where}` "
+                        f"calls it outside a device_lock region",
+                    )
+                )
+    return findings

@@ -1,0 +1,38 @@
+"""graftlint fixture: dispatch-thread blocking-call violations (parsed
+only, never executed).
+
+The class/method names below deliberately collide with a registered
+dispatch root (config.DISPATCH_ROOTS contains "KindCache._run") so the
+reachability walk starts here.
+
+Expected findings:
+  1. unbounded queue.put in `_run`
+  2. unbounded .join() in `_helper` (reachable from `_run`)
+  3. store RPC .list() on `self.store` in `_run`
+  4. time.sleep under a hot lock (`device_lock`) in `hot_section`
+  5. allow-blocking pragma without a reason in `_lazy`
+"""
+
+import time
+
+
+class KindCache:
+    def _run(self):
+        self.q.put(1)  # finding 1
+        self._helper()
+        self._lazy()
+        objs, rv = self.store.list("pods")  # finding 3
+        self.q.put_nowait(2)  # clean
+        self.q.put(3, timeout=1.0)  # clean: bounded
+
+    def _helper(self):
+        self.thread.join()  # finding 2
+        self.thread.join(timeout=2.0)  # clean
+
+    def _lazy(self):
+        self.q.put(4)  # graftlint: allow-blocking()
+
+
+def hot_section(enc):
+    with enc.device_lock:
+        time.sleep(0.5)  # finding 4

@@ -1,0 +1,39 @@
+"""graftlint fixture: degraded-write violations (parsed only).
+
+Expected findings:
+  1. unguarded-write: `server.create` in `naked_create`
+  2. unguarded-write: `self.server.guaranteed_update` in
+     `BareController.flip` (catches only NotFound)
+  3. no-reason: degraded-ok pragma without a reason in `lazy_marker`
+"""
+
+
+def naked_create(server, obj):
+    return server.create("pods", obj)  # finding 1
+
+
+class BareController:
+    def flip(self, ns, name, mutate):
+        try:
+            self.server.guaranteed_update("pods", ns, name, mutate)  # finding 2
+        except NotFound:
+            pass
+
+    def guarded(self, obj):
+        try:
+            self.server.create("pods", obj)  # clean: handler qualifies
+        except DegradedWrites:
+            pass
+
+
+def lazy_marker(server, obj):
+    server.create("pods", obj)  # graftlint: degraded-ok()
+
+
+def marked_ok(server, obj):  # graftlint: degraded-ok(fixture: caller handles)
+    server.create("pods", obj)  # clean
+
+
+class GuardedByBase(WorkqueueController):
+    def sync(self, key):
+        self.server.delete("pods", "", key)  # clean: tolerant base

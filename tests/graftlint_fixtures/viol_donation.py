@@ -1,0 +1,38 @@
+"""graftlint fixture: donation-safety violations (NOT collected by
+pytest — parsed only, never imported/executed).
+
+Expected findings (tests/test_graftlint.py asserts exactly these):
+  1. unlocked-donation: `_don(x)` outside any device_lock region
+  2. unmarked-handoff: `_don` passed to `seam`, which marks nothing
+  3. alias-safe-contradiction: `_lying_safe` is marked alias-safe but
+     its definition donates
+"""
+
+import functools
+
+import jax
+
+
+def _impl(snap, idx):
+    return snap
+
+
+_don = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+_lying_safe = jax.jit(_impl, donate_argnums=(0,))  # graftlint: alias-safe
+
+
+def unlocked_call(x):
+    return _don(x, 0)  # finding 1: no device_lock, no marker
+
+
+def seam(kern, snap):
+    return kern(snap, 0)  # no donating-call marker here
+
+
+def handoff(snap):
+    return seam(_don, snap)  # finding 2: unmarked handoff
+
+
+def locked_ok(self, x):
+    with self.device_lock:
+        return _don(x, 0)  # clean: lexically inside device_lock

@@ -1,0 +1,26 @@
+"""graftlint fixture: metrics-contract violations (parsed only).
+
+Expected findings (against the fixture doc fixtures_metrics.md, which
+documents only `fixture_clean_total` and `fixture_gauge`):
+  1. counter-suffix: `fixture_bad_count` is a counter without `_total`
+  2. label-drift: `fixture_drift_total` emitted with two label key sets
+  3. kind-conflict: `fixture_kind_total` used as counter AND gauge
+  4. dynamic-name: series name built with an f-string
+  5-7. undocumented: fixture_bad_count, fixture_drift_total,
+       fixture_kind_total missing from the fixture doc
+"""
+
+from kubernetes_tpu.utils.metrics import metrics
+
+FIXTURE_CONST = "fixture_clean_total"
+
+
+def emit(kind):
+    metrics.inc("fixture_bad_count")  # findings 1 + undocumented
+    metrics.inc("fixture_drift_total", {"kind": kind})
+    metrics.inc("fixture_drift_total", {"reason": kind})  # label drift
+    metrics.inc("fixture_kind_total")
+    metrics.set_gauge("fixture_kind_total", 1.0)  # kind conflict
+    metrics.inc(f"fixture_{kind}_total")  # dynamic name
+    metrics.inc(FIXTURE_CONST)  # clean: resolves through the constant
+    metrics.set_gauge("fixture_gauge", 2.0, {"kind": kind})  # clean

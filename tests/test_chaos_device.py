@@ -40,7 +40,27 @@ from kubernetes_tpu.testing.device_faults import (
     DeviceFaultInjector,
     corrupt_device_rows,
 )
+from kubernetes_tpu.testing import lockgraph
 from kubernetes_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True, scope="module")
+def lock_order_watchdog():
+    """Record the acquisition-order graph of the named production locks
+    (store / scheduler.cache / encoder.device_lock) across the whole
+    suite and fail on any cycle: a lock-order inversion deadlocks only
+    under the right interleaving, so the run SUCCEEDING is no evidence —
+    the graph is (ISSUE 7's runtime companion to graftlint)."""
+    lockgraph.enable()
+    yield
+    try:
+        lockgraph.assert_acyclic()
+        assert lockgraph.edge_count() > 0, (
+            "watchdog recorded no lock-order edges: the data-plane suite "
+            "must exercise nested cache-lock -> device_lock acquisitions"
+        )
+    finally:
+        lockgraph.disable()
 
 
 def _cfg(**overrides):

@@ -34,7 +34,29 @@ from kubernetes_tpu.apiserver.client import AuthRESTClient
 from kubernetes_tpu.apiserver.rest import serve
 from kubernetes_tpu.client.informers import SharedInformer
 from kubernetes_tpu.runtime.watch import BOOKMARK
+from kubernetes_tpu.testing import lockgraph
 from kubernetes_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True, scope="module")
+def lock_order_watchdog():
+    """Lock-order watchdog over the read path: the per-kind cache locks
+    (one watchdog node, "cacher.kind") against the store lock under the
+    informer storms. A cycle = an inversion that deadlocks only under
+    the right interleaving; the graph catches it even when the storm
+    happens to survive (ISSUE 7's runtime companion to graftlint)."""
+    lockgraph.enable()
+    yield
+    try:
+        lockgraph.assert_acyclic()
+        # zero EDGES is legitimate (the read path never nests two named
+        # locks); zero ACQUISITIONS would mean the instrumentation died
+        assert lockgraph.acquire_count() > 0, (
+            "watchdog observed no named-lock acquisitions: the named "
+            "locks are not instrumented"
+        )
+    finally:
+        lockgraph.disable()
 
 
 def _relist_total(kind="pods"):

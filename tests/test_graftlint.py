@@ -1,0 +1,312 @@
+"""graftlint analyzer suite + lock-order watchdog tests.
+
+Each pass is exercised against a known-violation fixture file
+(tests/graftlint_fixtures/) with EXACT finding counts asserted — a pass
+that silently stops matching its hazard class fails here, not in some
+future review round — plus one clean file all four passes must accept.
+The suppression-baseline mechanism is tested end-to-end through the CLI
+(write-baseline → suppressed run → stale entry fails), and the full-tree
+run must be clean with the checked-in EMPTY baseline: the lint gate the
+Makefile enforces is also a unit test.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRAFTLINT_DIR = os.path.join(REPO, "scripts", "graftlint")
+if GRAFTLINT_DIR not in sys.path:
+    sys.path.append(GRAFTLINT_DIR)
+
+import blocking  # noqa: E402
+import config as gl_config  # noqa: E402
+import core  # noqa: E402
+import degraded  # noqa: E402
+import donation  # noqa: E402
+import metrics_contract  # noqa: E402
+
+FIXTURES = "tests/graftlint_fixtures"
+FIXTURE_DOC = os.path.join(REPO, FIXTURES, "fixtures_metrics.md")
+
+
+def _tree(*names):
+    return core.Tree(REPO, [f"{FIXTURES}/{n}" for n in names])
+
+
+def _keys(findings):
+    return sorted(f.key for f in findings)
+
+
+# -- pass 1: donation safety -------------------------------------------------
+
+
+def test_donation_fixture_exact_findings():
+    found = donation.run(_tree("viol_donation.py"))
+    assert _keys(found) == [
+        "alias-safe-contradiction:_lying_safe",
+        "unlocked-donation:unlocked_call:_don",
+        "unmarked-handoff:seam:_don",
+    ]
+
+
+def test_donation_discovers_through_factory_and_alias():
+    src = _tree("viol_donation.py")
+    per_mod, factories = donation.discover(src)
+    mod = src.modules[0]
+    assert "_don" in per_mod[mod].module_level
+    assert "_lying_safe" in per_mod[mod].module_level
+
+
+# -- pass 2: dispatch-thread blocking calls ----------------------------------
+
+
+def test_blocking_fixture_exact_findings():
+    found = blocking.run(_tree("viol_blocking.py"))
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 5, msgs
+    assert sum("queue.put" in m for m in msgs) == 1
+    assert sum(".join()" in m for m in msgs) == 1
+    assert sum("store RPC" in m for m in msgs) == 1
+    assert sum("time.sleep under a hot lock" in m for m in msgs) == 1
+    assert sum("without a reason" in m for m in msgs) == 1
+
+
+# -- pass 3: metrics contract ------------------------------------------------
+
+
+def test_metrics_fixture_exact_findings():
+    found = metrics_contract.run(
+        _tree("viol_metrics.py"), REPO, doc_path=FIXTURE_DOC
+    )
+    by_kind = {}
+    for f in found:
+        by_kind.setdefault(f.key.split(":")[0], []).append(f.key)
+    assert by_kind.pop("counter-suffix") == ["counter-suffix:fixture_bad_count"]
+    assert by_kind.pop("label-drift") == ["label-drift:fixture_drift_total"]
+    assert by_kind.pop("kind-conflict") == ["kind-conflict:fixture_kind_total"]
+    assert len(by_kind.pop("dynamic-name")) == 1
+    assert sorted(by_kind.pop("undocumented")) == [
+        "undocumented:fixture_bad_count",
+        "undocumented:fixture_drift_total",
+        "undocumented:fixture_kind_total",
+    ]
+    assert not by_kind, f"unexpected finding kinds: {by_kind}"
+
+
+# -- pass 4: degraded-write handling -----------------------------------------
+
+
+def test_degraded_fixture_exact_findings():
+    found = degraded.run(_tree("viol_degraded.py"), dirs=(FIXTURES,))
+    assert _keys(found) == [
+        "no-reason:lazy_marker:create",
+        "unguarded-write:flip:guaranteed_update",
+        "unguarded-write:naked_create:create",
+    ]
+
+
+# -- the clean fixture passes every pass -------------------------------------
+
+
+def test_clean_fixture_no_findings():
+    src = _tree("clean.py")
+    assert donation.run(src) == []
+    assert blocking.run(src) == []
+    assert metrics_contract.run(src, REPO, doc_path=FIXTURE_DOC) == []
+    assert degraded.run(src, dirs=(FIXTURES,)) == []
+
+
+# -- runner CLI: exit codes + suppression baseline ---------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join("scripts", "graftlint"), *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_full_tree_clean_with_empty_baseline():
+    """THE gate: the shipped tree is lint-clean and the checked-in
+    baseline is empty (ISSUE 7 acceptance)."""
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+    with open(os.path.join(GRAFTLINT_DIR, "baseline.txt")) as fh:
+        entries = [
+            ln
+            for ln in fh.read().splitlines()
+            if ln.strip() and not ln.startswith("#")
+        ]
+    assert entries == [], f"baseline must stay empty, has: {entries}"
+
+
+def test_violation_file_exits_nonzero_with_file_line_findings():
+    proc = _run_cli(f"{FIXTURES}/viol_donation.py")
+    assert proc.returncode == 1
+    # file:line: [pass] message
+    assert f"{FIXTURES}/viol_donation.py:" in proc.stdout
+    assert "[donation]" in proc.stdout
+
+
+def test_suppression_baseline_roundtrip(tmp_path):
+    baseline = str(tmp_path / "baseline.txt")
+    wrote = _run_cli(
+        f"{FIXTURES}/viol_donation.py", "--write-baseline",
+        "--baseline", baseline,
+    )
+    assert wrote.returncode == 0
+    # every finding suppressed -> clean exit
+    proc = _run_cli(
+        f"{FIXTURES}/viol_donation.py", "--baseline", baseline
+    )
+    assert proc.returncode == 0, proc.stdout
+    assert "suppressed=3" in proc.stdout
+    # a stale entry (matches nothing) must FAIL the run
+    with open(baseline, "a") as fh:
+        fh.write("gone/file.py::donation::unlocked-donation:ghost:fn\n")
+    proc = _run_cli(
+        f"{FIXTURES}/viol_donation.py", "--baseline", baseline
+    )
+    assert proc.returncode == 1
+    assert "STALE" in proc.stdout
+
+
+# -- lock-order watchdog (runtime companion) ---------------------------------
+
+
+from kubernetes_tpu.testing import lockgraph  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_lockgraph():
+    lockgraph.disable()
+    lockgraph.reset()
+    yield lockgraph
+    lockgraph.disable()
+    lockgraph.reset()
+
+
+def test_lockgraph_records_edges_and_stays_acyclic(fresh_lockgraph):
+    lg = fresh_lockgraph
+    lg.enable()
+    a, b = lg.named_lock("A"), lg.named_lock("B")
+    with a:
+        with b:
+            pass
+    assert lg.edges() == {"A": {"B"}}
+    lg.assert_acyclic()  # consistent order: no violation
+
+
+def test_lockgraph_detects_inversion(fresh_lockgraph):
+    lg = fresh_lockgraph
+    lg.enable()
+    a, b = lg.named_lock("A"), lg.named_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # ABBA inversion — never deadlocks single-threaded,
+            pass  # still MUST be flagged
+    assert lg.violations()
+    with pytest.raises(AssertionError, match="ORDER INVERSION"):
+        lg.assert_acyclic()
+
+
+def test_lockgraph_reentrant_acquire_is_not_a_cycle(fresh_lockgraph):
+    lg = fresh_lockgraph
+    lg.enable()
+    a = lg.named_lock("A")
+    with a:
+        with a:  # RLock re-entrancy: no self-edge, no violation
+            pass
+    assert lg.edges() == {}
+    lg.assert_acyclic()
+
+
+def test_lockgraph_disabled_records_nothing(fresh_lockgraph):
+    lg = fresh_lockgraph
+    a, b = lg.named_lock("A"), lg.named_lock("B")
+    with a:
+        with b:
+            pass
+    assert lg.edges() == {}
+    assert lg.acquire_count() == 0
+
+
+def test_lockgraph_condition_wait_stays_consistent(fresh_lockgraph):
+    lg = fresh_lockgraph
+    lg.enable()
+    cond = threading.Condition(lg.named_lock("C"))
+    woke = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=2.0)
+            woke.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=3.0)
+    assert woke == [True]
+    lg.assert_acyclic()
+
+
+def test_lockgraph_stale_held_state_does_not_leak_across_enable(
+    fresh_lockgraph,
+):
+    """A thread that acquired while enabled but released after disable()
+    keeps the name on its thread-local stack; the next enable() (same
+    process, e.g. the second chaos module in one pytest run) must not
+    inherit it as a phantom held lock fabricating false edges."""
+    lg = fresh_lockgraph
+    lg.enable()
+    a, b = lg.named_lock("A"), lg.named_lock("B")
+    a.acquire()
+    lg.disable()  # release below records nothing: "A" goes stale
+    a.release()
+    lg.enable()
+    with b:  # with stale state this thread would record A -> B
+        pass
+    assert lg.edges() == {}
+    lg.assert_acyclic()
+
+
+def test_lockgraph_cross_thread_inversion(fresh_lockgraph):
+    """The real deadlock shape: two threads, opposite order, timed so
+    both complete (no actual deadlock) — the graph still convicts."""
+    lg = fresh_lockgraph
+    lg.enable()
+    a, b = lg.named_lock("A"), lg.named_lock("B")
+    gate = threading.Barrier(2, timeout=5.0)
+
+    def t1():
+        with a:
+            with b:
+                pass
+        gate.wait()
+
+    def t2():
+        gate.wait()  # strictly after t1 released both: no deadlock
+        with b:
+            with a:
+                pass
+
+    th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
+    th1.start()
+    th2.start()
+    th1.join(timeout=5.0)
+    th2.join(timeout=5.0)
+    assert lg.violations(), "cross-thread ABBA must be recorded"
