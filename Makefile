@@ -14,6 +14,10 @@
 #   make chaos-readpath   read-path chaos only: hollow-informer storms on
 #                         the watch cache (one store watch per kind, zero
 #                         relists after a flap, zero bind starvation)
+#   make chaos-ha         scheduler-HA chaos only: kill the leader mid-wave
+#                         (standby adopts, zero double-binds, fast first
+#                         bind), zombie-leader bind fencing, graceful
+#                         lease handoff, leader-election edge cases
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 #   make lint-static      graftlint: donation-safety, dispatch-blocking,
 #                         metrics-contract, degraded-write static passes
@@ -23,7 +27,8 @@
 PY ?= python
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
-	chaos-device chaos-autoscaler chaos-readpath lint-slow lint-static lint
+	chaos-device chaos-autoscaler chaos-readpath chaos-ha lint-slow \
+	lint-static lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -34,7 +39,7 @@ chaos: lint
 		tests/test_replication.py tests/test_chaos.py \
 		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
 		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
-		tests/test_watchcache.py -q
+		tests/test_watchcache.py tests/test_chaos_ha.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -46,6 +51,9 @@ chaos-autoscaler:
 
 chaos-readpath:
 	$(PY) -m pytest tests/test_chaos_readpath.py tests/test_watchcache.py -q
+
+chaos-ha:
+	$(PY) -m pytest tests/test_chaos_ha.py -q
 
 lint-slow:
 	$(PY) scripts/check_slow_markers.py

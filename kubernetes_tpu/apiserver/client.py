@@ -11,6 +11,7 @@ and feeds a local Watcher, exactly how Reflector consumes watch responses
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 import urllib.error
@@ -27,6 +28,8 @@ from ..client.apiserver import (
 )
 from ..runtime.consensus import DegradedWrites, QuorumLost
 from ..runtime.watch import Event, Watcher
+
+logger = logging.getLogger("kubernetes_tpu.apiserver.client")
 
 
 class RESTClient:
@@ -51,6 +54,7 @@ class RESTClient:
         self.degraded_retries = degraded_retries
         self.degraded_retry_cap_s = degraded_retry_cap_s
         self._headers: dict = {}
+        self._warned_unfenced = False  # bind_pods fence gap: warn once
 
     # -- plumbing ------------------------------------------------------------
 
@@ -296,7 +300,7 @@ class RESTClient:
             codec.encode(binding),
         )
 
-    def bind_pods(self, bindings) -> list:
+    def bind_pods(self, bindings, fence=None) -> list:
         """Per-binding error list (None = bound). Retryable degraded-store
         refusals come back as the EXCEPTION OBJECT (DegradedWrites /
         QuorumLost), not a string — the scheduler's ride-through layer
@@ -304,7 +308,20 @@ class RESTClient:
         degraded refusal the remaining bindings are not attempted (each
         would burn its own client-side retry budget against a store that
         just said "read-only"); they get a fresh DegradedWrites — none of
-        them was applied, so replaying them later is safe."""
+        them was applied, so replaying them later is safe.
+
+        fence: accepted for signature compatibility with the in-process
+        store's leadership fencing (scheduler HA), but NOT enforced over
+        REST yet — the /binding route carries no fence header. Warn ONCE
+        per client so an HA deployment on the REST client is a visible
+        gap, not a silent one (and not a log flood at one line per wave;
+        ROADMAP follow-up)."""
+        if fence is not None and not self._warned_unfenced:
+            self._warned_unfenced = True
+            logger.warning(
+                "leadership bind fence is not enforced over REST; binds "
+                "proceed unfenced (in-process stores enforce it)"
+            )
         errors = []
         degraded: Optional[DegradedWrites] = None
         for b in bindings:

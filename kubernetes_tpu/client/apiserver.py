@@ -72,6 +72,15 @@ class NotPrimary(RuntimeError):
     (a follower promoted; see runtime/replication.py)."""
 
 
+class LeaderFenced(Conflict):
+    """Write rejected: the caller's leadership lease was superseded — a
+    newer holder (or a graceful release) bumped the lease transitions
+    since the caller's fencing token was minted. The zombie-ex-leader
+    fence: a paused leader resuming after a standby promotion gets THIS,
+    never a silently applied late bind. Non-retryable by design (the
+    caller is not the leader anymore)."""
+
+
 class APIServer:
     def __init__(self, watch_history: int = 200000, wal=None):
         # named for the lock-order watchdog (testing/lockgraph.py)
@@ -497,7 +506,30 @@ class APIServer:
 
     # -- typed convenience used by the scheduler ----------------------------
 
-    def bind_pods(self, bindings) -> list:
+    def _check_fence(self, fence) -> None:
+        """Caller holds the lock. Validates a leadership fencing token
+        (client/leaderelection.BindFence, duck-typed: namespace/name/
+        identity/transitions) against the CURRENT lease record. Any
+        mismatch — taken over, released, or the lease gone entirely —
+        raises LeaderFenced BEFORE anything is applied: the one-writer
+        guarantee leader election promises is enforced here, not assumed."""
+        ns = self._normalize_ns("leases", fence.namespace)
+        key = f"{ns}/{fence.name}" if ns else fence.name
+        lease = self._objects.get("leases", {}).get(key)
+        if (
+            lease is None
+            or lease.holder_identity != fence.identity
+            or lease.lease_transitions != fence.transitions
+        ):
+            holder = getattr(lease, "holder_identity", None)
+            transitions = getattr(lease, "lease_transitions", None)
+            raise LeaderFenced(
+                f"bind fenced: lease {key} now held by {holder!r} at "
+                f"transition {transitions} (caller's token: "
+                f"{fence.identity!r} at {fence.transitions})"
+            )
+
+    def bind_pods(self, bindings, fence=None) -> list:
         """Batch bind: one lock acquisition for a whole device batch (the
         uplink analogue of the reference's per-pod POST /binding — our
         scheduler commits hundreds of placements per cycle, so the API layer
@@ -505,10 +537,17 @@ class APIServer:
         error entry is the NotFound/Conflict exception itself, so callers
         (the REST route's status mapping, the scheduler's reconciler)
         branch on type instead of re-deriving it from message text.
+
+        fence: optional leadership fencing token (BindFence). When given,
+        the WHOLE batch is rejected with LeaderFenced unless the token
+        still matches the live lease — checked under the same lock the
+        binds apply under, so a promotion can never interleave mid-batch.
         """
         self._check_writable()
         errors = []
         with self._lock:
+            if fence is not None:
+                self._check_fence(fence)
             records = []  # WAL batch: group-committed in ONE fsync
             events = []
             for b in bindings:

@@ -7,17 +7,50 @@ expired, renews every retry_period, and calls on_stopped_leading (fatal in
 the scheduler) if it cannot renew within renew_deadline. The Lease record
 lives in the in-memory API server under kind "leases", so HA semantics are
 testable in-process.
+
+Scheduler-HA additions on top of the reference shape:
+
+  * **release-on-stop** (leaderelection.go ReleaseOnCancel): a graceful
+    ``stop()`` clears ``holder_identity`` and bumps ``lease_transitions``
+    so the warm standby acquires immediately instead of waiting out
+    ``lease_duration`` — the zero-downtime rolling-upgrade path. A crash
+    (``crash()``, or the process dying) releases nothing, and the standby
+    pays the lease wait.
+  * **degraded-store tolerance**: a lease write refused with a retryable
+    503 (``DegradedWrites``) or a replication fence (``NotPrimary``) is a
+    counted renewal skip, not an exception escaping the renew loop — the
+    holder keeps leading as long as a renewal lands within
+    ``renew_deadline``, exactly like every other control-plane writer
+    rides the PR-3 window out.
+  * **fencing token** (``BindFence``): each leadership grant is identified
+    by ``(identity, lease_transitions)``. Store writes that carry the
+    token are rejected with ``LeaderFenced`` once a newer grant exists, so
+    a paused ex-leader resuming after a standby promotion cannot land late
+    binds (the zombie fence; see ``APIServer.bind_pods``).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.objects import ObjectMeta
-from .apiserver import APIServer, AlreadyExists, Conflict, NotFound
+from ..runtime.consensus import DegradedWrites
+from ..utils.metrics import metrics
+from .apiserver import AlreadyExists, APIServer, Conflict, NotFound, NotPrimary
+
+logger = logging.getLogger("kubernetes_tpu.client.leaderelection")
+
+# one leadership grant landed (fresh acquire or takeover, not a renewal)
+COUNTER_ACQUISITIONS = "leader_election_acquisitions_total"
+# graceful releases (holder cleared + transitions bumped on stop())
+COUNTER_RELEASES = "leader_election_releases_total"
+# lease writes skipped because the store was degraded / fenced: the holder
+# keeps leading and retries within renew_deadline
+COUNTER_DEGRADED_SKIPS = "leader_election_degraded_renew_skips_total"
 
 
 @dataclass
@@ -31,14 +64,42 @@ class Lease:
     kind: str = "Lease"
 
 
+@dataclass(frozen=True)
+class BindFence:
+    """Fencing token for one leadership grant: store writes carrying it
+    are valid only while the named lease is still held by ``identity`` at
+    exactly ``transitions`` (any takeover — or a graceful release — bumps
+    the transition count and invalidates every outstanding token)."""
+
+    namespace: str
+    name: str
+    identity: str
+    transitions: int
+
+
+def default_identity() -> str:
+    """hostname_uuid, the reference's default id (leaderelection options:
+    id = hostname + "_" + uuid). A CONSTANT default here would be a trap:
+    two replicas launched without an explicit identity would each read
+    the other's lease as their own, renew it, and BOTH lead — with
+    mutually valid fence tokens, silently voiding the zombie fence."""
+    import socket
+    import uuid
+
+    return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
 @dataclass
 class LeaderElectionConfig:
     lock_name: str = "kube-scheduler"
     lock_namespace: str = "kube-system"
-    identity: str = "scheduler-0"
+    identity: str = field(default_factory=default_identity)
     lease_duration: float = 15.0
     renew_deadline: float = 10.0
     retry_period: float = 2.0
+    # ReleaseOnCancel: clear the lease on graceful stop so the standby
+    # takes over without waiting out lease_duration
+    release_on_cancel: bool = True
 
     def validate(self) -> None:
         if not self.lease_duration > self.renew_deadline:
@@ -65,13 +126,35 @@ class LeaderElector:
         self._stop = threading.Event()
         self._is_leader = threading.Event()
         self._observed_renew = 0.0
+        self._release_on_stop = config.release_on_cancel
+        # transitions observed at the last successful acquire/renew: the
+        # fencing token for THIS grant (a takeover always bumps it)
+        self._observed_transitions = 0
 
     @property
     def is_leader(self) -> bool:
         return self._is_leader.is_set()
 
     def stop(self) -> None:
+        """Graceful shutdown: stop renewing and (when release_on_cancel)
+        release the lease so the standby promotes immediately."""
         self._stop.set()
+
+    def crash(self) -> None:
+        """Chaos/test helper: stop WITHOUT releasing — simulates leader
+        death, where the standby must wait out the lease."""
+        self._release_on_stop = False
+        self._stop.set()
+
+    def fence(self) -> BindFence:
+        """Fencing token for the CURRENT leadership grant. Meaningful only
+        after _try_acquire_or_renew succeeded (i.e. inside on_started)."""
+        return BindFence(
+            namespace=self._cfg.lock_namespace,
+            name=self._cfg.lock_name,
+            identity=self._cfg.identity,
+            transitions=self._observed_transitions,
+        )
 
     def run(self) -> None:
         """Block: acquire, then start leading; return when leadership lost/stopped."""
@@ -84,6 +167,11 @@ class LeaderElector:
         started.start()
         self._renew_loop()
         self._is_leader.clear()
+        if self._stop.is_set() and self._release_on_stop:
+            # graceful shutdown while still holding the lease: release it
+            # (ReleaseOnCancel) — a rolling upgrade must not cost the
+            # standby a full lease_duration wait
+            self.release()
         if self._on_stopped:
             self._on_stopped()
 
@@ -104,15 +192,30 @@ class LeaderElector:
             )
             try:
                 self._server.create("leases", lease)
+                self._observed_transitions = lease.lease_transitions
                 return True
             except AlreadyExists:
                 return False
+            except (DegradedWrites, NotPrimary):
+                metrics.inc(COUNTER_DEGRADED_SKIPS)
+                return False
+        expired = lease.renew_time + lease.lease_duration_seconds <= now
         if (
-            lease.holder_identity != cfg.identity
-            and lease.renew_time + lease.lease_duration_seconds > now
+            lease.holder_identity  # a RELEASED lease ("" holder) is free now
+            and lease.holder_identity != cfg.identity
+            and not expired
         ):
             return False  # held by someone else and not expired
-        if lease.holder_identity != cfg.identity:
+        if lease.holder_identity != cfg.identity or expired:
+            # a NEW grant: takeover, released lease, or re-acquire after
+            # expiry — even by the SAME identity. The same-identity case
+            # matters: a replacement process reusing a static identity
+            # (--leader-elect-identity, a pod name) must mint a FRESH
+            # fence, or the paused old incarnation's token would still
+            # validate and its late binds would pass the zombie fence. A
+            # healthy holder can never hit the expired branch on a normal
+            # renew: renew_deadline < lease_duration means it deposes
+            # itself before its own lease can expire.
             lease.lease_transitions += 1
             lease.acquire_time = now
         lease.holder_identity = cfg.identity
@@ -120,14 +223,53 @@ class LeaderElector:
         lease.lease_duration_seconds = cfg.lease_duration
         try:
             self._server.update("leases", lease)
+            self._observed_transitions = lease.lease_transitions
             return True
         except (Conflict, NotFound):
             return False
+        except (DegradedWrites, NotPrimary):
+            # degraded store mid-renew: a retryable 503 must not escape as
+            # an exception (it would kill the renew thread and depose a
+            # healthy leader instantly). Counted skip; the renew loop keeps
+            # leading and retrying until renew_deadline decides.
+            metrics.inc(COUNTER_DEGRADED_SKIPS)
+            return False
+
+    def release(self) -> bool:
+        """Clear holder_identity + bump lease_transitions (the reference's
+        Lock.Update with an emptied LeaderElectionRecord). Returns True when
+        the lease was actually released by us."""
+        cfg = self._cfg
+        try:
+            lease = self._server.get("leases", cfg.lock_namespace, cfg.lock_name)
+        except NotFound:
+            return False
+        if lease.holder_identity != cfg.identity:
+            return False  # someone already took over: nothing to release
+        lease.holder_identity = ""
+        lease.lease_transitions += 1
+        lease.renew_time = 0.0
+        try:
+            self._server.update("leases", lease)
+        except (Conflict, NotFound):
+            return False
+        except (DegradedWrites, NotPrimary):
+            # best-effort: a degraded store at shutdown means the standby
+            # waits out the lease like a crash — counted, not raised
+            metrics.inc(COUNTER_DEGRADED_SKIPS)
+            return False
+        metrics.inc(COUNTER_RELEASES)
+        logger.info(
+            "released leader lease %s/%s (transitions=%d)",
+            cfg.lock_namespace, cfg.lock_name, lease.lease_transitions,
+        )
+        return True
 
     def _acquire(self) -> bool:
         while not self._stop.is_set():
             if self._try_acquire_or_renew():
                 self._observed_renew = self._clock()
+                metrics.inc(COUNTER_ACQUISITIONS)
                 return True
             self._stop.wait(self._cfg.retry_period)
         return False

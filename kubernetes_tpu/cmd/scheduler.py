@@ -43,9 +43,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        if self.path in ("/healthz", "/livez", "/readyz"):
+        if self.path in ("/healthz", "/livez"):
+            # liveness: the process is serving — a WARM STANDBY is alive
+            # (reference kube-scheduler serves healthz OK while waiting
+            # for the lease; a liveness probe must not restart-loop every
+            # standby replica out of its warm state)
             ok = self.server.health_check()
             self._respond(200 if ok else 500, b"ok" if ok else b"unhealthy")
+        elif self.path == "/readyz":
+            # readiness: actually leading (scheduling loops running)
+            ok = self.server.ready_check()
+            self._respond(200 if ok else 500, b"ok" if ok else b"standby")
         elif self.path == "/metrics":
             # content negotiation: Prometheus exposition text by default
             # (what the reference's legacyregistry serves); JSON on request
@@ -70,9 +78,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
             self._respond(404, b"not found")
 
 
-def serve_health(port: int, health_check) -> ThreadingHTTPServer:
+def serve_health(port: int, health_check, ready_check=None) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
     srv.health_check = health_check
+    srv.ready_check = ready_check or health_check
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
 
@@ -84,18 +93,43 @@ def run(
     block: bool = True,
     autoscaler_catalog=None,
     autoscaler_kwargs: Optional[dict] = None,
+    watch_cache: bool = True,
 ) -> Scheduler:
     """app.Run (server.go:142): health endpoints → informers → leader
     election (optional) → scheduling loops. autoscaler_catalog (a
     NodeGroupCatalog) additionally runs the kernel-driven cluster
     autoscaler against this scheduler's snapshot — it follows the
-    scheduler's leadership (starts with scheduling, stops with it)."""
+    scheduler's leadership (starts with scheduling, stops with it).
+
+    watch_cache: point the scheduler's informers at a shared Cacher
+    (apiserver/cacher.py) instead of direct store watches — N scheduler
+    replicas (leader + warm standbys) then cost ONE store watch per kind
+    total; writes pass through to the store untouched.
+
+    With leader election configured the process starts as a WARM STANDBY
+    (informers tailing, HBM snapshot + kernels warm, nothing scheduling)
+    and the election winner promotes: it adopts the dead leader's
+    in-flight wave from store read-back and arms the leadership bind
+    fence so a zombie ex-leader's late binds are rejected."""
     server = server or APIServer()
     cfg = config or KubeSchedulerConfiguration()
-    sched = Scheduler(server, cfg)
-    healthy = threading.Event()
+    backend = server
+    if watch_cache:
+        from ..apiserver.cacher import Cacher
+
+        backend = Cacher(server)
+    sched = Scheduler(backend, cfg)
+    if backend is not server:
+        sched._owned_read_cache = backend  # torn down by sched.stop()
+    # live = the process is serving (a warm standby IS live); ready =
+    # actually leading. Split so a liveness probe never restart-loops a
+    # standby replica out of its warm state.
+    live = threading.Event()
+    ready = threading.Event()
     if healthz_port:
-        serve_health(healthz_port, lambda: healthy.is_set())
+        serve_health(
+            healthz_port, lambda: live.is_set(), lambda: ready.is_set()
+        )
     CacheDebugger(sched).listen_for_signal()
 
     stop = threading.Event()
@@ -112,26 +146,46 @@ def run(
         sched.start()
         if autoscaler is not None:
             autoscaler.start()
-        healthy.set()
+        live.set()
+        ready.set()
 
+    elector = None
+    elector_thread = None
     if cfg.leader_election is not None:
+        # warm standby FIRST: by the time the election resolves (instant
+        # for the first replica, a failover later for the rest) the cache,
+        # the HBM snapshot, and the compiled kernels are already hot
+        sched.start_standby(identity=cfg.leader_election.identity)
+        live.set()  # a warm standby is live (not yet ready)
+
+        def on_started():
+            sched.promote(fence=elector.fence())
+            if autoscaler is not None:
+                autoscaler.start()
+            ready.set()
+
         def on_stopped():
             # leaderelection.go: losing the lease is fatal for the process
             logger.error("leader election lost; shutting down scheduling")
-            healthy.clear()
+            ready.clear()
+            live.clear()
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
             stop.set()
 
+        # the elector talks to the raw store: lease reads/writes are the
+        # fencing authority and must never be served from a cache
         elector = LeaderElector(
             server,
             cfg.leader_election,
-            on_started_leading=start_scheduling,
+            on_started_leading=on_started,
             on_stopped_leading=on_stopped,
         )
-        threading.Thread(target=elector.run, daemon=True).start()
+        elector_thread = threading.Thread(target=elector.run, daemon=True)
+        elector_thread.start()
         sched._elector = elector
+        sched._elector_thread = elector_thread
     else:
         start_scheduling()
 
@@ -142,6 +196,14 @@ def run(
         except KeyboardInterrupt:
             pass
         finally:
+            if elector is not None:
+                # graceful shutdown RELEASES the lease (ReleaseOnCancel):
+                # the standby promotes in retry-periods, not after waiting
+                # out lease_duration — join so the release lands before
+                # the process exits
+                elector.stop()
+                if elector_thread is not None:
+                    elector_thread.join(timeout=5.0)
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
@@ -154,6 +216,19 @@ def main(argv=None) -> int:
     parser.add_argument("--healthz-port", type=int, default=10251)
     parser.add_argument(
         "--leader-elect", action="store_true", default=False
+    )
+    parser.add_argument(
+        "--leader-elect-identity",
+        default="",
+        help="lease holder identity for this replica (default "
+        "hostname_uuid); replicas past the first start as warm standbys",
+    )
+    parser.add_argument(
+        "--no-watch-cache",
+        action="store_true",
+        default=False,
+        help="informers watch the store directly instead of the shared "
+        "watch cache (one store watch per kind per replica)",
     )
     parser.add_argument(
         "--platform",
@@ -184,6 +259,8 @@ def main(argv=None) -> int:
     )
     if args.leader_elect and cfg.leader_election is None:
         cfg.leader_election = LeaderElectionConfig()
+    if args.leader_elect_identity and cfg.leader_election is not None:
+        cfg.leader_election.identity = args.leader_elect_identity
     catalog = None
     if args.autoscale_shapes:
         from ..autoscaler import NodeGroup, NodeGroupCatalog, machine_shape
@@ -204,7 +281,12 @@ def main(argv=None) -> int:
                 )
             )
         catalog = NodeGroupCatalog(groups)
-    run(config=cfg, healthz_port=args.healthz_port, autoscaler_catalog=catalog)
+    run(
+        config=cfg,
+        healthz_port=args.healthz_port,
+        autoscaler_catalog=catalog,
+        watch_cache=not args.no_watch_cache,
+    )
     return 0
 
 

@@ -91,9 +91,21 @@ class ControllerManager:
         server,
         controllers: Optional[List[str]] = None,
         leader_election: Optional[LeaderElectionConfig] = None,
+        watch_cache: bool = False,
         **controller_kwargs,
     ):
         self.server = server
+        backend = server
+        if watch_cache:
+            # every controller's list+watch rides ONE shared Cacher: N
+            # reconcile loops cost one store watch per kind instead of one
+            # each (writes delegate through to the store untouched). The
+            # elector below stays on the raw server — lease writes are a
+            # fencing authority, never cache-served.
+            from ..apiserver.cacher import Cacher
+
+            backend = Cacher(server)
+        self.backend = backend
         names = controllers or list(CONTROLLER_INITIALIZERS)
         self.controllers: Dict[str, object] = {}
         for name in names:
@@ -101,7 +113,7 @@ class ControllerManager:
             if init is None:
                 raise ValueError(f"unknown controller {name!r}")
             kwargs = controller_kwargs.get(name, {})
-            self.controllers[name] = init(server, **kwargs)
+            self.controllers[name] = init(backend, **kwargs)
         self._leader_cfg = leader_election
         self._elector = None
         self._started = threading.Event()
@@ -134,3 +146,7 @@ class ControllerManager:
             ctrl.stop()
         if self._elector is not None:
             self._elector.stop()
+        if self.backend is not self.server:
+            # the Cacher this manager created: tear down its per-kind
+            # store watches + bookmark thread with the controllers
+            self.backend.stop()

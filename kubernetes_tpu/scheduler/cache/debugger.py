@@ -104,6 +104,15 @@ class CacheDebugger:
         if readpath:
             lines.append("Dump of read-path (watch cache / flow control) state:")
             lines.extend(readpath)
+        from ..ha import ha_health_lines
+
+        ha = ha_health_lines()
+        if ha:
+            lines.append(
+                "Dump of scheduler-HA / leader-election state "
+                f"(this replica: {getattr(self.sched, '_ha_identity', '?')}):"
+            )
+            lines.extend(ha)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------

@@ -1,0 +1,65 @@
+"""Scheduler HA: the warm-standby / promotion metric surface.
+
+The mechanics live where the state lives — standby bring-up, the standby
+snapshot-refresh loop, the promotion-time adoption pass and the fenced
+bind funnel are Scheduler methods (scheduler.py), the lease fencing token
+is minted by the LeaderElector (client/leaderelection.py) and enforced by
+the store (client/apiserver.py). This module is the one home for the
+``scheduler_ha_*`` series names and the SIGUSR2 dump section, so the
+metrics contract (graftlint pass 3) and the debugger read one surface.
+
+Role model: a process is either a WARM STANDBY (informers tailing the
+shared watch cache, HBM snapshot + compiled kernels kept warm, scheduling
+loops NOT running) or the LEADER (everything running, binds fenced on the
+leadership grant). ``scheduler_ha_role{identity}`` is 0/1 accordingly;
+promotion flips it and counts adoption outcomes per pod.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..utils.metrics import metrics
+
+# 0 = warm standby, 1 = leader; labeled by lease identity so two
+# replicas sharing a process (chaos suites) publish distinct series
+GAUGE_ROLE = "scheduler_ha_role"  # {identity}
+# seconds since the standby's device snapshot last matched the host
+# masters (refreshed by the standby tick; ~0 in steady state)
+GAUGE_STANDBY_SNAPSHOT_AGE = "scheduler_ha_standby_snapshot_age_seconds"  # {identity}
+# standby ticks that actually scattered pending deltas into HBM
+COUNTER_STANDBY_FLUSHES = "scheduler_ha_standby_flushes_total"
+# standby -> leader transitions in this process
+COUNTER_PROMOTIONS = "scheduler_ha_promotions_total"
+# promotion-time adoption pass outcomes, per queued pod read back from
+# the store: bound (dead leader's bind landed -> finish), pending (never
+# landed -> this leader places it, fenced), gone (deleted mid-flight)
+COUNTER_ADOPTIONS = "scheduler_ha_adoptions_total"  # {outcome}
+# binds rejected by the store's leadership fence (we are a zombie
+# ex-leader; the placement is forgotten, never retried)
+COUNTER_FENCED_BINDS = "scheduler_ha_fenced_binds_total"
+# kernel pre-compile passes completed while standing by
+COUNTER_STANDBY_WARMUPS = "scheduler_ha_standby_warmups_total"
+
+
+def ha_health_lines() -> List[str]:
+    """Scheduler-HA + leader-election state for the SIGUSR2 dump: role and
+    standby snapshot freshness per identity, promotion/adoption/fence
+    counters, and the elector's acquisition/release/degraded-skip
+    counters — a failed or slow handoff is diagnosable from one signal.
+    Empty when no HA-aware scheduler has published state yet."""
+    lines: List[str] = []
+    for snap in (
+        metrics.snapshot_gauges("scheduler_ha_"),
+        metrics.snapshot_counters("scheduler_ha_"),
+        metrics.snapshot_gauges("leader_election_"),
+        metrics.snapshot_counters("leader_election_"),
+    ):
+        for name, labels, value in snap:
+            annotation = ""
+            if name == GAUGE_ROLE:
+                annotation = "LEADER" if value else "warm standby"
+            lines.append(
+                metrics.format_series_line(name, labels, value, annotation)
+            )
+    return lines

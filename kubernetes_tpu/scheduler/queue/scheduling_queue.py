@@ -290,6 +290,30 @@ class PriorityQueue:
             self._unschedulable.pop(key, None)
             self.delete_nominated_if_exists(pod)
 
+    def delete_if_uid(self, pod: v1.Pod) -> bool:
+        """Delete the queued entry for pod's key ONLY while it still
+        holds the same uid. The leader-adoption pass runs concurrently
+        with informer delete/recreate churn: a blind by-key delete could
+        remove a RECREATED pod's fresh entry and strand it (the informer
+        stream itself is ordered, so its own handlers don't need this)."""
+        with self._cond:
+            key = pod.metadata.key
+            uid = pod.metadata.uid
+            for q in (self._active, self._backoff):
+                pi = q.get(key)
+                if pi is not None:
+                    if pi.pod.metadata.uid != uid:
+                        return False
+                    q.delete_by_key(key)
+                    self.delete_nominated_if_exists(pod)
+                    return True
+            pi = self._unschedulable.get(key)
+            if pi is not None and pi.pod.metadata.uid == uid:
+                del self._unschedulable[key]
+                self.delete_nominated_if_exists(pod)
+                return True
+            return False
+
     # -- nominated pods ------------------------------------------------------
 
     def add_nominated_pod(self, pod: v1.Pod, node_name: str) -> None:
@@ -319,6 +343,19 @@ class PriorityQueue:
         back to activeQ through the normal move machinery."""
         with self._lock:
             return list(self._unschedulable.values())
+
+    def pending_pod_infos(self) -> List[QueuedPodInfo]:
+        """Snapshot of EVERY queued pod (activeQ + backoffQ +
+        unschedulableQ): the leader-adoption pass reads each back from
+        the store on promotion. Read-only — entries stay queued; the
+        adoption pass deletes the ones the store says are bound or gone
+        through the normal delete path."""
+        with self._lock:
+            return (
+                self._active.list()
+                + self._backoff.list()
+                + list(self._unschedulable.values())
+            )
 
     def pending_pods(self) -> dict:
         with self._lock:

@@ -35,7 +35,7 @@ import jax
 import numpy as np
 
 from ..api import objects as v1
-from ..client.apiserver import APIServer, NotFound, NotPrimary
+from ..client.apiserver import APIServer, LeaderFenced, NotFound, NotPrimary
 from ..client.informers import SharedInformerFactory
 from ..runtime.consensus import DegradedWrites
 from ..controller.volume_scheduling import VolumeBinder
@@ -77,6 +77,15 @@ from .preemption import Preemptor
 from .profile import ProfileMap, new_profile_map
 from .queue import PriorityQueue, QueuedPodInfo
 from .ridethrough import COUNTER_RECONCILED, BindRideThrough, PendingBind
+from .ha import (
+    COUNTER_ADOPTIONS,
+    COUNTER_FENCED_BINDS,
+    COUNTER_PROMOTIONS,
+    COUNTER_STANDBY_FLUSHES,
+    COUNTER_STANDBY_WARMUPS,
+    GAUGE_ROLE,
+    GAUGE_STANDBY_SNAPSHOT_AGE,
+)
 from . import eventhandlers
 
 logger = logging.getLogger("kubernetes_tpu.scheduler")
@@ -292,6 +301,20 @@ class Scheduler:
         self._weights = self._build_weights()
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table)
+        # scheduler HA (ha.py): the leadership fencing token armed by
+        # promote() — every batch bind carries it so a zombie ex-leader's
+        # late binds are rejected at the store — plus the warm-standby
+        # refresh loop state (keeps the HBM snapshot tracking informer
+        # churn while no scheduling loop runs)
+        self._bind_fence = None
+        self._ha_identity = "scheduler-0"
+        self._standby_stop = threading.Event()
+        self._standby_thread: Optional[threading.Thread] = None
+        self._standby_last_fresh: Optional[float] = None
+        # a Cacher created FOR this scheduler (cmd/scheduler.run): stop()
+        # tears it down with us, or every run/stop cycle would leak one
+        # store watch per kind plus the bookmark thread
+        self._owned_read_cache = None
         eventhandlers.add_all_event_handlers(self)
 
     # -- wiring --------------------------------------------------------------
@@ -327,7 +350,17 @@ class Scheduler:
 
     def start(self) -> None:
         """informers → WaitForCacheSync → queue/janitor/scheduling loops
-        (app.Run, cmd/kube-scheduler/app/server.go:142)."""
+        (app.Run, cmd/kube-scheduler/app/server.go:142). Equivalent to
+        _bringup() + promote() with no standby phase in between — the
+        non-HA path every existing caller keeps."""
+        self._bringup()
+        self.promote()
+
+    def _bringup(self) -> None:
+        """The leader/standby-shared device bring-up: informers →
+        WaitForCacheSync → presized encoder → mesh sharding → warm
+        scatter programs. After this the HBM snapshot mirrors the synced
+        cluster; nothing schedules yet."""
         self.informer_factory.start()
         self.informer_factory.wait_for_cache_sync()
         # presize device capacities from the synced node count so the wave
@@ -360,6 +393,31 @@ class Scheduler:
                     self.cache.encoder.warm_scatter_programs()
             except Exception:
                 logger.exception("scatter warmup failed")
+
+    def promote(self, fence=None) -> None:
+        """Leadership start: arm the bind fence, adopt whatever the
+        previous leader left mid-flight, then start the scheduling loops
+        (auditor, queue flushers, janitor, the batch loop). Called by
+        start() directly in the non-HA path (fence None, no standby) and
+        by the election winner after start_standby()."""
+        was_standby = self._standby_thread is not None
+        self._stop_standby_loop()
+        self._bind_fence = fence
+        if was_standby or fence is not None:
+            # the PR-3 bind-outcome discipline, triggered by a leadership
+            # transition instead of a store reopen
+            t0 = time.monotonic()
+            counts = self._adopt_pending()
+            metrics.inc(COUNTER_PROMOTIONS)
+            logger.warning(
+                "scheduler %s promoted to leader in %.0f ms: adopted "
+                "%d landed binds, %d in-flight pods to place (fenced), "
+                "%d gone",
+                self._ha_identity,
+                (time.monotonic() - t0) * 1e3,
+                counts["bound"], counts["pending"], counts["gone"],
+            )
+        metrics.set_gauge(GAUGE_ROLE, 1.0, {"identity": self._ha_identity})
         if self.cfg.use_device and self.cfg.antientropy_period_s > 0:
             from .antientropy import SnapshotAntiEntropy
 
@@ -390,6 +448,235 @@ class Scheduler:
         )
         self._sched_thread.start()
 
+    # -- warm standby (scheduler HA, ha.py) -----------------------------------
+
+    def start_standby(
+        self, identity: str = "scheduler-0", refresh_period_s: float = 0.25
+    ) -> None:
+        """Warm-standby mode: informers tail the (shared) watch cache into
+        the scheduler cache and queue, the HBM snapshot is built and kept
+        in lockstep with informer churn by a refresh loop, and the wave /
+        serial kernels are pre-compiled — so promote() starts binding in
+        well under one autoscaler period instead of after a full rebuild
+        plus a compile storm. NO scheduling loop runs: the standby
+        acquires nothing and writes nothing."""
+        self._ha_identity = identity
+        self._bringup()
+        if self.cfg.use_device:
+            try:
+                self.warm_standby_kernels()
+            except Exception:
+                # a failed pre-compile costs promotion latency, never
+                # correctness: the leader path compiles lazily as before
+                logger.exception("standby kernel pre-warm failed")
+        metrics.set_gauge(GAUGE_ROLE, 0.0, {"identity": identity})
+        self._standby_last_fresh = time.monotonic()
+        metrics.set_gauge(
+            GAUGE_STANDBY_SNAPSHOT_AGE, 0.0, {"identity": identity}
+        )
+        self._standby_stop.clear()
+        self._standby_thread = threading.Thread(
+            target=self._standby_loop,
+            args=(refresh_period_s,),
+            daemon=True,
+            name=f"standby-{identity}",
+        )
+        self._standby_thread.start()
+        logger.info(
+            "scheduler %s standing by: cache synced (%d nodes), snapshot "
+            "warm, kernels compiled", identity, self.cache.node_count,
+        )
+
+    def _standby_loop(self, period_s: float) -> None:
+        """Keep the standby's device snapshot tracking the informer
+        stream: scatter pending encoder deltas every tick so the dirty-row
+        backlog at promotion is bounded by one period, and publish the
+        snapshot's freshness age for the SIGUSR2 dump."""
+        while not self._standby_stop.wait(period_s):
+            try:
+                if self.cfg.use_device and not self._device_down:
+                    if self.cache.encoder.has_pending_updates:
+                        self.cache.device_snapshot()  # flush under the lock
+                        metrics.inc(COUNTER_STANDBY_FLUSHES)
+                    self._standby_last_fresh = time.monotonic()
+                elif not self.cfg.use_device:
+                    # host-only scheduling: the cache IS the state, there
+                    # is no device snapshot to go stale
+                    self._standby_last_fresh = time.monotonic()
+                # _device_down: deliberately do NOT advance — the snapshot
+                # really is going stale, and this gauge exists precisely
+                # to make a cold standby visible before a promotion
+            except Exception:
+                logger.exception("standby snapshot refresh failed")
+            if self._standby_last_fresh is not None:
+                metrics.set_gauge(
+                    GAUGE_STANDBY_SNAPSHOT_AGE,
+                    max(0.0, time.monotonic() - self._standby_last_fresh),
+                    {"identity": self._ha_identity},
+                )
+
+    def _stop_standby_loop(self) -> None:
+        self._standby_stop.set()
+        t, self._standby_thread = self._standby_thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def warm_standby_kernels(self) -> None:
+        """Pre-compile the kernels the leader path needs first: the
+        small-bucket wave kernel variant and the serial batch kernel, plus
+        (via _bringup) the scatter/gather programs. Uses one synthetic
+        unsatisfiable pod — a resource request no node can hold — so both
+        kernels trace and compile real shapes while committing nothing;
+        if the readback ever shows a placement anyway, the device
+        snapshot is invalidated and rebuilt from the host masters rather
+        than trusted with a ghost pod."""
+        warm_pod = v1.Pod(
+            metadata=v1.ObjectMeta(
+                name="standby-warmup", namespace="kube-system"
+            ),
+            spec=v1.PodSpec(
+                containers=[v1.Container(requests={"cpu": "1000000"})]
+            ),
+        )
+        small = min(256, self._batch_size)
+        with self.cache.lock:
+            eb = self._tpl_cache.encode([warm_pod], pad_to=small)
+            ptab = self._pair_table(eb)
+            n_waves, batch_has_hard = self._batch_waves(eb)
+            n_waves = min(n_waves, 2)  # the small no-hard bucket's count
+            snap = self.cache.encoder.flush()
+            enc_cfg = self.cache.encoder.cfg
+        m_cand = min(self.cfg.wave_m_cand_small, self._m_cand)
+        if self._mesh is not None:
+            from ..parallel.sharded import make_sharded_wave_kernel
+
+            kern = make_sharded_wave_kernel(
+                enc_cfg.v_cap,
+                m_cand,
+                n_waves,
+                self.cfg.hard_pod_affinity_weight,
+                self._mesh,
+                self._use_pallas_fit,
+                self._score_refresh or batch_has_hard,
+                self._rtc_shape,
+                False,
+            )
+        else:
+            from ..ops.wavelattice import DEFAULT_RTC_SHAPE
+
+            kern = make_wave_kernel_jit(
+                enc_cfg.v_cap,
+                m_cand,
+                n_waves,
+                self.cfg.hard_pod_affinity_weight,
+                self._use_pallas_fit,
+                self._score_refresh or batch_has_hard,
+                self._rtc_shape or DEFAULT_RTC_SHAPE,
+                False,
+            )
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        new_snap, res = self._launch_wave_kernel(
+            kern, snap, eb.batch, ptab, np.asarray(self._weights), sub
+        )
+        placed = jax.device_get(res.placed)
+        with self.cache.lock:
+            if np.asarray(placed).any():
+                # the "unsatisfiable" pod somehow placed (encoding clamp):
+                # never trust the warm launch's snapshot with a ghost pod
+                logger.error(
+                    "standby warm-up pod was placed by the kernel; "
+                    "rebuilding the device snapshot from the host masters"
+                )
+                self.cache.encoder.invalidate_device()
+                self.cache.encoder.flush()
+            else:
+                self.cache.encoder.set_device_snapshot(new_snap)
+        # the serial batch kernel (the host-side fallback device variant)
+        kern2 = make_schedule_batch(
+            enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight
+        )
+        with self.cache.lock:
+            eb2 = encode_pod_batch(
+                self.cache.encoder, [warm_pod], pad_to=1
+            )
+            snap2 = self.cache.encoder.flush()
+        self._rng_key, sub2 = jax.random.split(self._rng_key)
+        self._run_serial_kernel(kern2, snap2, eb2.batch, sub2)
+        metrics.inc(COUNTER_STANDBY_WARMUPS)
+
+    def _adopt_pending(self) -> Dict[str, int]:
+        """Leader-adoption pass: the PR-3 pending-bind reconciler's
+        outcome discipline applied at a leadership transition. Every pod
+        the informers queued is read back from the STORE (the only
+        authority that survives the old leader): bind landed → finish
+        (cache it, drop it from the queue — never re-placed), never
+        landed → stays queued and the first wave places it with a fenced
+        bind (the store's already-bound + uid + leadership checks make a
+        double-bind structurally impossible even against a zombie), pod
+        gone → forget. Any pending binds buffered by an earlier leading
+        stint of THIS process drain through the store-reopen reconciler
+        unchanged."""
+        counts = {"bound": 0, "pending": 0, "gone": 0}
+        infos = self.queue.pending_pod_infos()
+        # read-back strategy: per-pod authoritative gets for a small
+        # backlog, ONE authoritative store list for a large one (a 10k-pod
+        # failover must not pay 10k sequential store-lock round-trips
+        # before the scheduling loop starts — promotion latency is the
+        # whole point of the warm standby). `.store` unwraps a Cacher to
+        # the raw store; a cache-served list could lag the dead leader's
+        # final bind events.
+        by_key = None
+        if len(infos) > 64:
+            try:
+                pods, _ = getattr(self.server, "store", self.server).list(
+                    "pods"
+                )
+                by_key = {p.metadata.key: p for p in pods}
+            except Exception:
+                logger.exception(
+                    "adoption bulk read-back failed; per-pod fallback"
+                )
+        for pi in infos:
+            pod = pi.pod
+            try:
+                if by_key is not None:
+                    cur = by_key.get(pod.metadata.key)
+                    if cur is not None and cur.metadata.uid != pod.metadata.uid:
+                        cur = None  # same name, different pod: ours is gone
+                else:
+                    cur = self._read_back_pod(pod)
+            except Exception:
+                # store unreachable mid-promotion: leave the pod queued —
+                # normal scheduling plus the ride-through buffer own it
+                logger.exception(
+                    "adoption read-back failed for %s; leaving queued",
+                    pod.metadata.key,
+                )
+                continue
+            # deletes are uid-guarded: the informer runs concurrently, and
+            # a pod deleted+recreated between our queue snapshot and this
+            # read-back must not lose its FRESH queue entry to a stale key
+            if cur is None:
+                self.queue.delete_if_uid(pod)
+                outcome = "gone"
+            elif cur.spec.node_name:
+                # the dead leader's bind landed: finish it — the cache
+                # (and therefore the device snapshot) takes the placement,
+                # the queue forgets the pod, and it is never re-placed
+                self.queue.delete_if_uid(pod)
+                self.cache.add_pod(cur)
+                outcome = "bound"
+            else:
+                outcome = "pending"
+            counts[outcome] += 1
+            metrics.inc(COUNTER_ADOPTIONS, {"outcome": outcome})
+        if self._ridethrough.depth:
+            # leftover parked binds from this process's previous stint:
+            # same read-back discipline, the reopen reconciler already
+            # implements it
+            self._reconcile_pending_binds()
+        return counts
+
     def _auto_pipeline_depth(self) -> int:
         """Pick the wave-pipeline depth from the measured device->host
         readback RTT: a tunneled/remote device (tens of ms per sync) wants
@@ -418,6 +705,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._stop_standby_loop()
         if self._auditor is not None:
             self._auditor.stop()
         self.queue.close()
@@ -428,6 +716,8 @@ class Scheduler:
         # into a shut-down pool
         if self._sched_thread is not None:
             self._sched_thread.join(timeout=10.0)
+        if self._owned_read_cache is not None:
+            self._owned_read_cache.stop()
         # release parked permit-waiters or the drain below would block on
         # their (up to 30s) wait timeouts
         for p in self.profiles.values():
@@ -625,18 +915,27 @@ class Scheduler:
             return False
         return True
 
-    def _reconcile_one(
-        self, e: PendingBind, still_degraded: List[PendingBind]
-    ) -> None:
-        pod = e.pi.pod
+    def _read_back_pod(self, pod: v1.Pod):
+        """Authoritative store read-back, uid-fenced: the current object
+        for pod's key, or None when it is gone — including the same-name-
+        different-pod case (ours was deleted and the name reused). Shared
+        by the reopen reconciler and the leader-adoption pass so their
+        outcome semantics cannot drift."""
         try:
             cur = self.server.get(
                 "pods", pod.metadata.namespace, pod.metadata.name
             )
         except NotFound:
-            cur = None
-        if cur is not None and cur.metadata.uid != pod.metadata.uid:
-            cur = None  # same name, different pod: ours is gone
+            return None
+        if cur.metadata.uid != pod.metadata.uid:
+            return None  # same name, different pod: ours is gone
+        return cur
+
+    def _reconcile_one(
+        self, e: PendingBind, still_degraded: List[PendingBind]
+    ) -> None:
+        pod = e.pi.pod
+        cur = self._read_back_pod(pod)
         if cur is None:
             # deleted while buffered, or lost with a failed primary
             self.cache.forget_pod(pod)
@@ -665,10 +964,14 @@ class Scheduler:
             target_node=e.node_name,
         )
         try:
-            errs = self.server.bind_pods([binding])
+            errs = self._bind_pods_fenced([binding])
             err = errs[0] if errs else None
         except DegradedWrites as exc:
             err = exc
+        except LeaderFenced:
+            # deposed mid-reconcile: the replay belongs to the new leader
+            self._on_fenced_binds([e.pi])
+            return
         if isinstance(err, DegradedWrites):
             still_degraded.append(e)
         elif err is None:
@@ -688,6 +991,31 @@ class Scheduler:
             self._handle_failure(
                 e.pi, self.queue.moves, message=str(err), error=True
             )
+
+    def _bind_pods_fenced(self, bindings) -> list:
+        """Every scheduler-originated batch bind funnels here: when a
+        leadership fence is armed (promote(fence=...)), the token rides
+        along and the store rejects the whole batch with LeaderFenced if
+        this process's grant has been superseded. Callers own the
+        DegradedWrites / LeaderFenced handling."""
+        if self._bind_fence is not None:
+            return self.server.bind_pods(bindings, fence=self._bind_fence)  # graftlint: degraded-ok(fence-attaching seam; both callers catch DegradedWrites/LeaderFenced at their call sites)
+        return self.server.bind_pods(bindings)  # graftlint: degraded-ok(fence-attaching seam; both callers catch DegradedWrites/LeaderFenced at their call sites)
+
+    def _on_fenced_binds(self, entries) -> None:
+        """We are a zombie ex-leader: a newer grant exists and the store
+        refused our binds. Drop the placements (the new leader owns these
+        pods now — re-placing or requeueing them here would just race it)
+        and count, so the chaos ledger can prove zero double-binds."""
+        metrics.inc(COUNTER_FENCED_BINDS, by=float(len(entries)))
+        logger.error(
+            "bind batch of %d rejected by the leadership fence: this "
+            "scheduler (%s) has been superseded; dropping the placements",
+            len(entries), self._ha_identity,
+        )
+        for pi in entries:
+            self.cache.forget_pod(pi.pod)
+            self._release_permits(pi.pod)
 
     def _release_permits(self, pod: v1.Pod) -> None:
         """Unwind paths that drop a buffered placement without a full
@@ -1861,13 +2189,18 @@ class Scheduler:
         ]
         b0 = time.monotonic()
         try:
-            errors = self.server.bind_pods(bindings)
+            errors = self._bind_pods_fenced(bindings)
         except DegradedWrites as e:
             # in-process store: the gate refused before applying anything
             # (Degraded — safe to replay) or the whole batch applied but
             # missed its quorum ack (QuorumLost — outcome unknown). Either
             # way the wave is NOT failed: park every placement.
             errors = [e] * len(bindings)
+        except LeaderFenced:
+            # zombie ex-leader: the store holds a newer leadership grant.
+            # Nothing applied — drop every placement and stand down.
+            self._on_fenced_binds([pi for pi, _n, _p in simple])
+            return
         bind_dur = time.monotonic() - b0
         e2e = time.monotonic() - t_start
         to_buffer: List[PendingBind] = []

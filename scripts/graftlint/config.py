@@ -30,6 +30,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_autoscaler.py",
     "tests/test_chaos_readpath.py",
     "tests/test_watchcache.py",
+    "tests/test_chaos_ha.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -109,6 +110,8 @@ DUMP_REQUIRED_FAMILIES = (
     "watch_cache_",
     "apiserver_flowcontrol_",
     "informer_",
+    "scheduler_ha_",
+    "leader_election_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------

@@ -102,13 +102,13 @@ class ChaosStore(APIServer):
     def recover(self):
         self.gate.degraded = False
 
-    def bind_pods(self, bindings):
+    def bind_pods(self, bindings, fence=None):
         with self._chaos_lock:
             mode, self.fail_next_bind = self.fail_next_bind, None
         if mode == "degraded":
             self.gate.degraded = True
             raise DegradedWrites("chaos: bind refused, store degraded")
-        errors = super().bind_pods(bindings)
+        errors = super().bind_pods(bindings, fence=fence)
         for b, err in zip(bindings, errors):
             if err is None:
                 self.applied_binds[b.pod_uid] += 1
