@@ -18,6 +18,11 @@
 #                         (standby adopts, zero double-binds, fast first
 #                         bind), zombie-leader bind fencing, graceful
 #                         lease handoff, leader-election edge cases
+#   make chaos-net        network/process chaos: REST control plane through
+#                         the NetChaosProxy (blackholed bind acks, resets,
+#                         partitions, half-open watches) + the multi-process
+#                         leader/standby/zombie topology (SIGSTOP, fenced
+#                         late REST binds, cross-process exactly-once ledger)
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 #   make lint-static      graftlint: donation-safety, dispatch-blocking,
 #                         metrics-contract, degraded-write static passes
@@ -27,8 +32,8 @@
 PY ?= python
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
-	chaos-device chaos-autoscaler chaos-readpath chaos-ha lint-slow \
-	lint-static lint
+	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
+	lint-slow lint-static lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -39,7 +44,8 @@ chaos: lint
 		tests/test_replication.py tests/test_chaos.py \
 		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
 		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
-		tests/test_watchcache.py tests/test_chaos_ha.py -q
+		tests/test_watchcache.py tests/test_chaos_ha.py \
+		tests/test_chaos_net.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -54,6 +60,9 @@ chaos-readpath:
 
 chaos-ha:
 	$(PY) -m pytest tests/test_chaos_ha.py -q
+
+chaos-net:
+	$(PY) -m pytest tests/test_chaos_net.py -q
 
 lint-slow:
 	$(PY) scripts/check_slow_markers.py

@@ -204,6 +204,9 @@ def decode(resource: str, data: dict, allow_unstructured: bool = True) -> Any:
     unknown resources are actually served; the WAL replays them blindly)."""
     cls = RESOURCE_KINDS.get(resource)
     if cls is None:
+        ensure_late_registration()  # import-order hole: see its docstring
+        cls = RESOURCE_KINDS.get(resource)
+    if cls is None:
         if allow_unstructured:
             return decode_unstructured(data)
         raise KeyError(f"unknown resource {resource!r}")
@@ -223,6 +226,9 @@ def decode_any(data: dict) -> Any:
         if scheme.recognizes(api_version, kind):
             return scheme.decode(data)
     resource = KIND_TO_RESOURCE.get(kind)
+    if resource is None:
+        ensure_late_registration()  # import-order hole: see its docstring
+        resource = KIND_TO_RESOURCE.get(kind)
     if resource is None:
         raise KeyError(f"unknown kind {kind!r}")
     return resource, from_dict(RESOURCE_KINDS[resource], data)
@@ -260,8 +266,23 @@ def decode_unstructured(data: dict) -> v1.Unstructured:
     )
 
 
-def _register_late() -> None:
-    # late imports: these kinds live in client/* which depends on the store
+_late_registered = False
+
+
+def ensure_late_registration() -> None:
+    """Register the kinds that live in client/* (events, leases) —
+    idempotent, safe to call from any lookup path. The import-time call
+    below succeeds in most processes, but when THIS module is first
+    imported via kubernetes_tpu.client's own import chain (e.g. a child
+    process whose first touch is ``import kubernetes_tpu.client``), the
+    client package is mid-import and the ImportError is swallowed — the
+    lease kind would then silently decode as Unstructured forever (found
+    by the netchaos multi-process suite: the REST elector's lease came
+    back untyped and the renew thread died). Lookup paths (decode,
+    decode_any, the REST serving gate) retry here on a miss."""
+    global _late_registered
+    if _late_registered:
+        return
     try:
         from ..client.events import ClusterEvent
         from ..client.leaderelection import Lease
@@ -272,6 +293,7 @@ def _register_late() -> None:
     KIND_TO_RESOURCE["Event"] = "events"
     RESOURCE_KINDS["leases"] = Lease
     KIND_TO_RESOURCE["Lease"] = "leases"
+    _late_registered = True
 
 
-_register_late()
+ensure_late_registration()

@@ -11,7 +11,6 @@ and feeds a local Watcher, exactly how Reflector consumes watch responses
 from __future__ import annotations
 
 import json
-import logging
 import threading
 import time
 import urllib.error
@@ -23,13 +22,13 @@ from ..client.apiserver import (
     AlreadyExists,
     Conflict,
     Expired,
+    LeaderFenced,
     NotFound,
     NotPrimary,
 )
+from ..client.leaderelection import FENCE_HEADER, fence_header_value
 from ..runtime.consensus import DegradedWrites, QuorumLost
 from ..runtime.watch import Event, Watcher
-
-logger = logging.getLogger("kubernetes_tpu.apiserver.client")
 
 
 class RESTClient:
@@ -54,7 +53,6 @@ class RESTClient:
         self.degraded_retries = degraded_retries
         self.degraded_retry_cap_s = degraded_retry_cap_s
         self._headers: dict = {}
-        self._warned_unfenced = False  # bind_pods fence gap: warn once
 
     # -- plumbing ------------------------------------------------------------
 
@@ -108,7 +106,13 @@ class RESTClient:
                 raise NotFound(msg) from None
             raise RuntimeError(msg) from None
 
-    def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+    def _request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[dict] = None,
+        headers: Optional[dict] = None,
+    ) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         attempt = 0
         while True:
@@ -116,7 +120,11 @@ class RESTClient:
                 url,
                 data=data,
                 method=method,
-                headers={"Content-Type": "application/json", **self._headers},
+                headers={
+                    "Content-Type": "application/json",
+                    **self._headers,
+                    **(headers or {}),
+                },
             )
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -134,6 +142,11 @@ class RESTClient:
                     reason = payload.get("reason", "")
                     if reason == "AlreadyExists":
                         raise AlreadyExists(msg) from None
+                    if reason == "LeaderFenced":
+                        # leadership fence rejection: the caller's lease
+                        # grant was superseded — non-retryable (the caller
+                        # is not the leader anymore), nothing was applied
+                        raise LeaderFenced(msg) from None
                     raise Conflict(msg) from None
                 if e.code == 503:
                     # three distinct 503 contracts (rest.py):
@@ -288,17 +301,55 @@ class RESTClient:
         threading.Thread(target=pump, daemon=True).start()
         return w
 
-    def bind_pod(self, binding) -> None:
+    @staticmethod
+    def _fence_headers(fence) -> Optional[dict]:
+        return (
+            {FENCE_HEADER: fence_header_value(fence)}
+            if fence is not None
+            else None
+        )
+
+    @staticmethod
+    def _classify_bind_transport(e: Exception) -> DegradedWrites:
+        """Map a transport-level failure of a /binding POST onto the bind
+        outcome taxonomy. A refused connect means the request never
+        reached the server — retryable, same contract as a degraded-store
+        refusal (nothing applied, safe to replay verbatim). ANYTHING else
+        (timeout, reset, EOF-without-response, half-delivered body) means
+        the request MAY have been processed with its response lost: the
+        one honest classification is QuorumLost — the caller must read
+        the pod back before any retry, never blindly replay (a netchaos
+        blackhole is exactly this shape: write applied, ack dropped)."""
+        cause = getattr(e, "reason", e)  # URLError wraps the socket error
+        if isinstance(cause, ConnectionRefusedError):
+            return DegradedWrites(f"api server unreachable: {cause}")
+        return QuorumLost(f"bind outcome unknown (transport failure: {e})")
+
+    def bind_pod(self, binding, fence=None) -> None:
         """Single-pod binding subresource (DefaultBinder's surface; the
         bulk bind_pods below shares the wire path). Raises on failure so
-        the bind plugin's error handling fires like the in-process store."""
-        self._request(
-            "POST",
-            self.base
-            + f"/api/v1/namespaces/{binding.pod_namespace}/pods/"
-            + f"{binding.pod_name}/binding",
-            codec.encode(binding),
-        )
+        the bind plugin's error handling fires like the in-process store.
+        fence: optional BindFence, attached as the X-Leadership-Fence
+        header; the server rejects with LeaderFenced when superseded."""
+        try:
+            self._request(
+                "POST",
+                self.base
+                + f"/api/v1/namespaces/{binding.pod_namespace}/pods/"
+                + f"{binding.pod_name}/binding",
+                codec.encode(binding),
+                headers=self._fence_headers(fence),
+            )
+        except (
+            LeaderFenced,
+            DegradedWrites,
+            NotFound,
+            Conflict,
+            urllib.error.HTTPError,
+        ):
+            raise
+        except OSError as e:
+            raise self._classify_bind_transport(e) from e
 
     def bind_pods(self, bindings, fence=None) -> list:
         """Per-binding error list (None = bound). Retryable degraded-store
@@ -308,22 +359,22 @@ class RESTClient:
         degraded refusal the remaining bindings are not attempted (each
         would burn its own client-side retry budget against a store that
         just said "read-only"); they get a fresh DegradedWrites — none of
-        them was applied, so replaying them later is safe.
+        them was applied, so replaying them later is safe. Transport
+        failures classify through _classify_bind_transport: refused
+        connect = retryable DegradedWrites, anything after the connect =
+        QuorumLost (outcome unknown, read back before retrying).
 
-        fence: accepted for signature compatibility with the in-process
-        store's leadership fencing (scheduler HA), but NOT enforced over
-        REST yet — the /binding route carries no fence header. Warn ONCE
-        per client so an HA deployment on the REST client is a visible
-        gap, not a silent one (and not a log flood at one line per wave;
-        ROADMAP follow-up)."""
-        if fence is not None and not self._warned_unfenced:
-            self._warned_unfenced = True
-            logger.warning(
-                "leadership bind fence is not enforced over REST; binds "
-                "proceed unfenced (in-process stores enforce it)"
-            )
+        fence: the leadership fencing token (BindFence), attached to every
+        binding POST as the X-Leadership-Fence header and validated by the
+        server against the live lease under the bind lock. A LeaderFenced
+        rejection RAISES (mirroring the in-process store's whole-batch
+        reject): the remaining bindings are not attempted — the caller is
+        not the leader anymore. Bindings that already landed in this batch
+        were applied while the grant was still valid and stay applied
+        exactly once; the new leader's adoption pass reads them back."""
         errors = []
         degraded: Optional[DegradedWrites] = None
+        fence_headers = self._fence_headers(fence)  # one token per batch
         for b in bindings:
             if degraded is not None:
                 errors.append(
@@ -337,8 +388,15 @@ class RESTClient:
                     + f"/api/v1/namespaces/{b.pod_namespace}/pods/"
                     + f"{b.pod_name}/binding",
                     codec.encode(b),
+                    headers=fence_headers,
                 )
                 errors.append(None)
+            except LeaderFenced:
+                # deposed mid-batch: nothing further may apply. Raise like
+                # the in-process store's atomic whole-batch reject; the
+                # scheduler's _on_fenced_binds drops every placement (the
+                # already-landed prefix is re-adopted from informer state)
+                raise
             except QuorumLost as e:
                 # THIS binding applied remotely but missed quorum: its
                 # outcome is unknown — surface the exception itself so the
@@ -352,6 +410,18 @@ class RESTClient:
                 # typed like the in-process store's error list, so the
                 # scheduler's reconciler branches identically over REST
                 errors.append(e)
+            except urllib.error.HTTPError as e:
+                # a non-2xx the taxonomy doesn't know (500, 403, ...):
+                # the server DID answer — a known refusal, not unknown
+                errors.append(str(e))
+            except OSError as e:
+                # transport failure (partition, reset, blackholed ack):
+                # classify, then stop attempting the rest of the batch —
+                # the network just proved undeliverable and each further
+                # attempt would burn its own timeout
+                err = self._classify_bind_transport(e)
+                errors.append(err)
+                degraded = err
             except Exception as e:
                 errors.append(str(e))
         return errors

@@ -37,6 +37,7 @@ from ..client.apiserver import (
     APIServer,
     Conflict,
     Expired,
+    LeaderFenced,
     NotFound,
     NotPrimary,
 )
@@ -204,6 +205,10 @@ class _Handler(BaseHTTPRequestHandler):
         rejected at routing granularity, mirroring the reference's
         ambiguous-plural restrictions.)"""
         group = self._group_of_path()
+        # close the late-registration import-order hole (events/leases
+        # kinds live in client/*): a process whose import chain swallowed
+        # the eager registration must not 404 those resources forever
+        codec.ensure_late_registration()
         try:
             crds, _ = self.store.list("customresourcedefinitions")
         except Exception:
@@ -922,7 +927,27 @@ class _Handler(BaseHTTPRequestHandler):
                 pod_name = name.rsplit("/", 1)[0]
                 b.pod_name = b.pod_name or pod_name
                 b.pod_namespace = b.pod_namespace or (ns or "default")
-                errs = self.store.bind_pods([b])
+                # leadership fencing over REST: an X-Leadership-Fence
+                # header rebuilds the BindFence and the store validates it
+                # against the live lease UNDER THE SAME LOCK the bind
+                # applies under — a scheduler replica deposed between
+                # minting the token and this request gets LeaderFenced
+                # (409, distinct reason), never a silently applied late
+                # bind. A malformed header is 400: it must never degrade
+                # to an unfenced bind.
+                from ..client.leaderelection import (
+                    FENCE_HEADER,
+                    fence_from_header,
+                )
+
+                fence = None
+                fence_hdr = self.headers.get(FENCE_HEADER)
+                if fence_hdr:
+                    try:
+                        fence = fence_from_header(fence_hdr)
+                    except ValueError as fe:
+                        return self._status_error(400, "BadRequest", str(fe))
+                errs = self.store.bind_pods([b], fence=fence)
                 if errs and errs[0] is not None:
                     # preserve the store's error taxonomy across the wire
                     # (bind_pods returns the typed exception): a vanished
@@ -986,6 +1011,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(201, codec.encode(created))
         except AlreadyExists as e:
             return self._status_error(409, "AlreadyExists", str(e))
+        except LeaderFenced as e:
+            # leadership fence rejection: the caller's lease grant was
+            # superseded BEFORE anything applied. 409 with a distinct
+            # reason so the client maps it back to LeaderFenced (a plain
+            # Conflict is retryable per-pod; this one means "you are not
+            # the leader anymore" for the whole batch)
+            return self._status_error(409, "LeaderFenced", str(e))
         except DegradedWrites as e:
             return self._degraded_error(e)
         except NotPrimary as e:

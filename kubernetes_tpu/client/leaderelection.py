@@ -31,6 +31,7 @@ Scheduler-HA additions on top of the reference shape:
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -75,6 +76,44 @@ class BindFence:
     name: str
     identity: str
     transitions: int
+
+
+# the one wire format for the fence over REST: the /binding route reads
+# this header, rebuilds the BindFence, and validates it against the lease
+# under the same lock the bind applies under (apiserver/rest.py). JSON in
+# a header keeps identity strings with arbitrary characters unambiguous
+# (a positional "ns/name/id/transitions" format would split on a
+# hostname's separators).
+FENCE_HEADER = "X-Leadership-Fence"
+
+
+def fence_header_value(fence: BindFence) -> str:
+    """Serialize a fence for the REST ``X-Leadership-Fence`` header."""
+    return json.dumps(
+        {
+            "namespace": fence.namespace,
+            "name": fence.name,
+            "identity": fence.identity,
+            "transitions": fence.transitions,
+        },
+        separators=(",", ":"),
+    )
+
+
+def fence_from_header(value: str) -> BindFence:
+    """Parse the REST fence header back into a BindFence. Raises
+    ValueError on anything malformed (the route maps it to 400 — a bad
+    fence must never silently degrade to an UNfenced bind)."""
+    try:
+        d = json.loads(value)
+        return BindFence(
+            namespace=str(d["namespace"]),
+            name=str(d["name"]),
+            identity=str(d["identity"]),
+            transitions=int(d["transitions"]),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed leadership fence header: {e}") from None
 
 
 def default_identity() -> str:
@@ -182,6 +221,14 @@ class LeaderElector:
         cfg = self._cfg
         try:
             lease = self._server.get("leases", cfg.lock_namespace, cfg.lock_name)
+        except OSError:
+            # REST transport failure (partition, refused connect, timeout —
+            # urllib errors are OSError subclasses): indistinguishable from
+            # a degraded store for leadership purposes. Counted skip; the
+            # renew loop keeps leading within renew_deadline, exactly the
+            # in-process degraded-store contract.
+            metrics.inc(COUNTER_DEGRADED_SKIPS)
+            return False
         except NotFound:
             lease = Lease(
                 metadata=ObjectMeta(name=cfg.lock_name, namespace=cfg.lock_namespace),
@@ -196,7 +243,9 @@ class LeaderElector:
                 return True
             except AlreadyExists:
                 return False
-            except (DegradedWrites, NotPrimary):
+            except (DegradedWrites, NotPrimary, OSError):
+                # OSError covers REST transport failures (urllib errors):
+                # same contract as a degraded store — counted skip
                 metrics.inc(COUNTER_DEGRADED_SKIPS)
                 return False
         expired = lease.renew_time + lease.lease_duration_seconds <= now
@@ -227,9 +276,11 @@ class LeaderElector:
             return True
         except (Conflict, NotFound):
             return False
-        except (DegradedWrites, NotPrimary):
-            # degraded store mid-renew: a retryable 503 must not escape as
-            # an exception (it would kill the renew thread and depose a
+        except (DegradedWrites, NotPrimary, OSError):
+            # degraded store mid-renew, or a REST transport failure (a
+            # partitioned/unreachable API server raises urllib errors,
+            # which are OSErrors): either way the 503/blip must not escape
+            # as an exception (it would kill the renew thread and depose a
             # healthy leader instantly). Counted skip; the renew loop keeps
             # leading and retrying until renew_deadline decides.
             metrics.inc(COUNTER_DEGRADED_SKIPS)
@@ -244,6 +295,11 @@ class LeaderElector:
             lease = self._server.get("leases", cfg.lock_namespace, cfg.lock_name)
         except NotFound:
             return False
+        except OSError:
+            # unreachable API server at shutdown: same as a degraded store
+            # below — the standby waits out the lease like a crash
+            metrics.inc(COUNTER_DEGRADED_SKIPS)
+            return False
         if lease.holder_identity != cfg.identity:
             return False  # someone already took over: nothing to release
         lease.holder_identity = ""
@@ -253,7 +309,7 @@ class LeaderElector:
             self._server.update("leases", lease)
         except (Conflict, NotFound):
             return False
-        except (DegradedWrites, NotPrimary):
+        except (DegradedWrites, NotPrimary, OSError):
             # best-effort: a degraded store at shutdown means the standby
             # waits out the lease like a crash — counted, not raised
             metrics.inc(COUNTER_DEGRADED_SKIPS)

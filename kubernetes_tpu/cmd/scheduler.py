@@ -5,8 +5,10 @@ Run (:142): healthz server (:10251, server.go:160-171), metrics mux
 (:237-268 with the debug DELETE reset), leader election gating sched.Run
 (:196-210 — losing leadership is fatal), SIGUSR2 cache debugger.
 
-The API backend is the in-process store; a REST-backed client lands with
-the apiserver façade.
+The API backend is the in-process store by default; ``--server URL``
+runs the replica against a remote apiserver process over REST (leases,
+informer streams, and leadership-fenced binds all cross the wire — the
+/binding route validates the X-Leadership-Fence header).
 """
 
 from __future__ import annotations
@@ -231,6 +233,15 @@ def main(argv=None) -> int:
         "watch cache (one store watch per kind per replica)",
     )
     parser.add_argument(
+        "--server",
+        default="",
+        help="API server base URL (e.g. http://127.0.0.1:18080): run this "
+        "replica against a remote apiserver process over REST instead of "
+        "an in-process store. Leader election and bind fencing work "
+        "end-to-end over the wire (the /binding route validates the "
+        "X-Leadership-Fence header)",
+    )
+    parser.add_argument(
         "--platform",
         default="",
         help="force a JAX platform (e.g. 'cpu' to run without the TPU — "
@@ -281,7 +292,13 @@ def main(argv=None) -> int:
                 )
             )
         catalog = NodeGroupCatalog(groups)
+    server = None
+    if args.server:
+        from ..apiserver.client import RESTClient
+
+        server = RESTClient(args.server)
     run(
+        server=server,
         config=cfg,
         healthz_port=args.healthz_port,
         autoscaler_catalog=catalog,

@@ -21,6 +21,8 @@ from ....api.objects import (
     tolerations_tolerate_taint,
 )
 from ....api.selectors import selector_from_match_labels
+from ....client.apiserver import LeaderFenced
+from ....runtime.consensus import DegradedWrites
 from ..interface import (
     BindPlugin,
     CycleState,
@@ -143,13 +145,16 @@ class DefaultBinder(BindPlugin):
     name = "DefaultBinder"
 
     def __init__(self, server=None):
+        # the scheduler injects its _FencedBindSurface here (the fence-
+        # attaching seam); a raw server appears only in fence-less direct
+        # framework construction (tests, non-HA embedders)
         self._server = server
 
     def bind(self, state, pod, node_name) -> Optional[Status]:
         if self._server is None:
             return Status.error("no API server")
         try:
-            self._server.bind_pod(
+            self._server.bind_pod(  # graftlint: fence-exempt(the injected surface IS the fenced seam — _FencedBindSurface routes into _bind_pods_fenced)
                 Binding(
                     pod_name=pod.metadata.name,
                     pod_namespace=pod.metadata.namespace,
@@ -157,6 +162,13 @@ class DefaultBinder(BindPlugin):
                     target_node=node_name,
                 )
             )
+        except (DegradedWrites, LeaderFenced):
+            # typed outcomes the binding cycle handles itself: park the
+            # placement (degraded ride-through) / drop it (zombie fence).
+            # Folding either into a generic error Status would turn a
+            # retryable outage into a failed pod — or a fence rejection
+            # into a requeue that races the new leader.
+            raise
         except Exception as e:  # Conflict / NotFound
             return Status.error(str(e))
         return None

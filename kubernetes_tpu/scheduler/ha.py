@@ -35,9 +35,11 @@ COUNTER_PROMOTIONS = "scheduler_ha_promotions_total"
 # the store: bound (dead leader's bind landed -> finish), pending (never
 # landed -> this leader places it, fenced), gone (deleted mid-flight)
 COUNTER_ADOPTIONS = "scheduler_ha_adoptions_total"  # {outcome}
-# binds rejected by the store's leadership fence (we are a zombie
-# ex-leader; the placement is forgotten, never retried)
-COUNTER_FENCED_BINDS = "scheduler_ha_fenced_binds_total"
+# binds rejected by the leadership fence (we are a zombie ex-leader; the
+# placement is forgotten, never retried), labeled by the transport that
+# enforced it: path=local (in-process store bind lock) or path=rest (the
+# /binding route's X-Leadership-Fence validation)
+COUNTER_FENCED_BINDS = "scheduler_ha_fenced_binds_total"  # {path}
 # kernel pre-compile passes completed while standing by
 COUNTER_STANDBY_WARMUPS = "scheduler_ha_standby_warmups_total"
 

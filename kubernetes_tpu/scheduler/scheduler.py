@@ -164,6 +164,37 @@ _SCORE_NAME_TO_COMPONENT = {
 }
 
 
+class _FencedBindSurface:
+    """The API surface handed to bind plugins (the framework context's
+    ``server``): ``bind_pod``/``bind_pods`` funnel through the scheduler's
+    fence-attaching seam (``_bind_pods_fenced``) so the per-pod plugin
+    path carries the SAME leadership fence as batch binds — the store (or
+    the REST /binding route) rejects a deposed replica's bind with
+    LeaderFenced before anything applies. Every other attribute proxies to
+    the real server, so out-of-tree plugins built against the APIServer
+    surface keep working unchanged."""
+
+    def __init__(self, sched: "Scheduler"):
+        self._sched = sched
+
+    def bind_pod(self, binding) -> None:
+        errs = self._sched._bind_pods_fenced([binding])
+        err = errs[0] if errs else None
+        if err is None:
+            return
+        if isinstance(err, Exception):
+            raise err
+        raise RuntimeError(str(err))
+
+    def bind_pods(self, bindings, fence=None) -> list:
+        # a caller-supplied fence is ignored on purpose: the scheduler's
+        # armed fence is the one source of truth for its own binds
+        return self._sched._bind_pods_fenced(bindings)
+
+    def __getattr__(self, name: str):
+        return getattr(self._sched.server, name)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -179,8 +210,25 @@ class Scheduler:
         )
         self._snapshot = None  # latest host snapshot (fallback/preemption)
         self.volume_binder = VolumeBinder(server)
+        # which transport enforces the leadership bind fence for this
+        # scheduler: "rest" when the (cache-unwrapped) backend is a
+        # RESTClient — the /binding route validates the X-Leadership-Fence
+        # header — else "local" (the in-process store's bind lock). Labels
+        # scheduler_ha_fenced_binds_total so a deployment can see WHERE
+        # its zombies are being stopped.
+        from ..apiserver.client import RESTClient
+
+        self._bind_transport = (
+            "rest"
+            if isinstance(getattr(server, "store", server), RESTClient)
+            else "local"
+        )
         context = {
-            "server": server,
+            # bind plugins get the fence-attaching surface, not the raw
+            # server: every per-pod DefaultBinder bind funnels through
+            # _bind_pods_fenced exactly like batch binds (reads and
+            # non-bind writes pass through untouched)
+            "server": _FencedBindSurface(self),
             "snapshot_getter": lambda: self._snapshot,
             "hard_pod_affinity_weight": self.cfg.hard_pod_affinity_weight,
             "volume_binder": self.volume_binder,
@@ -1002,12 +1050,45 @@ class Scheduler:
             return self.server.bind_pods(bindings, fence=self._bind_fence)  # graftlint: degraded-ok(fence-attaching seam; both callers catch DegradedWrites/LeaderFenced at their call sites)
         return self.server.bind_pods(bindings)  # graftlint: degraded-ok(fence-attaching seam; both callers catch DegradedWrites/LeaderFenced at their call sites)
 
+    def _check_fence_live(self) -> None:
+        """Best-effort fence pre-check for bind writes the store cannot
+        validate atomically — an extender binds OUT OF PROCESS, so the
+        only check available is re-reading the lease just before handing
+        it the pod. Raises LeaderFenced when this replica's grant was
+        superseded; an unreadable lease (degraded store, REST blip) lets
+        the bind proceed — the pre-check narrows the zombie window, the
+        store-validated fence on every in-tree bind closes it."""
+        f = self._bind_fence
+        if f is None:
+            return
+        try:
+            lease = self.server.get("leases", f.namespace, f.name)
+        except NotFound:
+            lease = None
+        except Exception:
+            return
+        if (
+            lease is None
+            or lease.holder_identity != f.identity
+            or lease.lease_transitions != f.transitions
+        ):
+            raise LeaderFenced(
+                f"extender bind fenced: lease {f.namespace}/{f.name} now "
+                f"held by {getattr(lease, 'holder_identity', None)!r} at "
+                f"transition {getattr(lease, 'lease_transitions', None)} "
+                f"(caller's token: {f.identity!r} at {f.transitions})"
+            )
+
     def _on_fenced_binds(self, entries) -> None:
         """We are a zombie ex-leader: a newer grant exists and the store
         refused our binds. Drop the placements (the new leader owns these
         pods now — re-placing or requeueing them here would just race it)
         and count, so the chaos ledger can prove zero double-binds."""
-        metrics.inc(COUNTER_FENCED_BINDS, by=float(len(entries)))
+        metrics.inc(
+            COUNTER_FENCED_BINDS,
+            {"path": self._bind_transport},
+            by=float(len(entries)),
+        )
         logger.error(
             "bind batch of %d rejected by the leadership fence: this "
             "scheduler (%s) has been superseded; dropping the placements",
@@ -2388,8 +2469,16 @@ class Scheduler:
                 None,
             )
             if ext_binder is not None:
+                # an extender binds out of process — the store can't
+                # validate the fence atomically, so pre-check the lease
+                # right before handing the pod over (best-effort: the
+                # in-tree paths stay store-fenced)
+                self._check_fence_live()
                 ext_binder.bind(pod, node_name)
             else:
+                # DefaultBinder binds through the _FencedBindSurface in
+                # the framework context: the write funnels into
+                # _bind_pods_fenced and carries the leadership fence
                 st = fw.run_bind_plugins(state, pod, node_name)
                 if not is_success(st):
                     raise RuntimeError(f"bind: {st.message}")
@@ -2408,6 +2497,13 @@ class Scheduler:
                 pod, "Normal", "Scheduled", "Binding",
                 f"Successfully assigned {pod.metadata.key} to {node_name}",
             )
+        except LeaderFenced:
+            # deposed mid-async-bind: the new leader owns this pod now.
+            # Unreserve and drop the placement — never requeue or retry
+            # (racing the new leader is exactly what the fence forbids).
+            self.volume_binder.forget_pod_volumes(pod)
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self._on_fenced_binds([pi])
         except DegradedWrites as e:
             if not self._pod_has_pvcs(pod):
                 # retryable store refusal mid-async-bind: park the

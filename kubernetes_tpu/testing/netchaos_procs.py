@@ -1,0 +1,304 @@
+"""Child-process entries for the multi-process network-chaos suite.
+
+`tests/test_chaos_net.py` runs the control plane as REAL OS processes —
+API server, leader scheduler, standby scheduler — talking REST through a
+NetChaosProxy, so partitions, SIGSTOP zombies, and lost responses hit
+actual sockets and actual process boundaries (nothing in-process chaos
+can fake). This module is what those children execute:
+
+    python -m kubernetes_tpu.testing.netchaos_procs apiserver \
+        --port P --ledger /path/ledger.jsonl
+    python -m kubernetes_tpu.testing.netchaos_procs scheduler \
+        --server http://127.0.0.1:PROXY --identity a --debug-port D \
+        [--zombie-hold] [--lease-duration 1.5 ...]
+
+The API-server child wraps its store in a **LedgerStore**: every bind
+application and acknowledgment appends a JSONL record, and every
+fence rejection is recorded with the rejected identity — the cross-
+process equivalent of ChaosStore's in-memory ledger, so the test can
+prove "every pod bound exactly once, every zombie bind fenced" from one
+file regardless of which process did what.
+
+The scheduler child wires a replica the way cmd/scheduler.py does
+(standby first, the election winner promotes with the fence) and adds a
+debug HTTP port: GET /status (role, counters) and POST /bind (drive one
+binding through the replica's OWN fence-attaching seam — how the test
+makes a resumed zombie attempt a late REST bind deterministically).
+``--zombie-hold`` keeps the scheduling loops running after the elector
+loses leadership: the deliberately misbehaving replica the fence exists
+to stop (a well-behaved one shuts down, and then there is nothing left
+to fence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("kubernetes_tpu.testing.netchaos_procs")
+
+
+# -- apiserver child ---------------------------------------------------------
+
+
+def _ledger_store(ledger_path: str):
+    """APIServer subclass appending bind outcomes to a JSONL ledger."""
+    from ..client.apiserver import APIServer, LeaderFenced
+
+    lock = threading.Lock()
+    fh = open(ledger_path, "a", encoding="utf-8")
+
+    class LedgerStore(APIServer):
+        def _ledger(self, record: dict) -> None:
+            with lock:
+                fh.write(json.dumps(record, separators=(",", ":")) + "\n")
+                fh.flush()
+
+        def bind_pods(self, bindings, fence=None):
+            try:
+                errors = super().bind_pods(bindings, fence=fence)
+            except LeaderFenced:
+                self._ledger(
+                    {
+                        "event": "fenced",
+                        "identity": getattr(fence, "identity", None),
+                        "transitions": getattr(fence, "transitions", None),
+                        "uids": [b.pod_uid for b in bindings],
+                    }
+                )
+                raise
+            for b, err in zip(bindings, errors):
+                if err is None:
+                    # the in-process store applies and acks atomically;
+                    # both records keep the ledger shape aligned with
+                    # ChaosStore (applied_binds / acked_binds)
+                    self._ledger(
+                        {
+                            "event": "applied",
+                            "uid": b.pod_uid,
+                            "node": b.target_node,
+                        }
+                    )
+                    self._ledger(
+                        {
+                            "event": "acked",
+                            "uid": b.pod_uid,
+                            "node": b.target_node,
+                        }
+                    )
+            return errors
+
+    return LedgerStore()
+
+
+def run_apiserver(port: int, ledger: str) -> None:
+    from ..apiserver.rest import serve
+
+    store = _ledger_store(ledger)
+    srv, bound_port, _ = serve(store=store, port=port, bookmark_period_s=0.5)
+    print(f"READY apiserver {bound_port}", flush=True)
+    threading.Event().wait()
+
+
+# -- scheduler child ---------------------------------------------------------
+
+
+class _DebugHandler(BaseHTTPRequestHandler):
+    server_version = "netchaos-scheduler-debug"
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path != "/status":
+            return self._json(404, {"error": "unknown path"})
+        from ..utils.metrics import metrics
+
+        rep = self.server.replica
+        fenced = sum(
+            v
+            for k, v in metrics.dump().items()
+            if k.startswith("scheduler_ha_fenced_binds_total")
+        )
+        self._json(
+            200,
+            {
+                "identity": rep.identity,
+                "leader": rep.elector.is_leader,
+                "promoted": rep.promoted.is_set(),
+                "deposed": rep.deposed.is_set(),
+                "fenced_binds": fenced,
+                "pending_binds": rep.sched._ridethrough.depth,
+            },
+        )
+
+    def do_POST(self):
+        if self.path != "/bind":
+            return self._json(404, {"error": "unknown path"})
+        from ..api.objects import Binding
+        from ..client.apiserver import LeaderFenced
+
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        binding = Binding(
+            pod_name=body["name"],
+            pod_namespace=body.get("namespace", "default"),
+            pod_uid=body.get("uid", ""),
+            target_node=body["node"],
+        )
+        # the replica's OWN fence-attaching seam: exactly the write a
+        # zombie's late wave would issue — including the wave path's
+        # fence handling (_on_fenced_binds drops the placement and counts
+        # under the transport label)
+        sched = self.server.replica.sched
+        try:
+            errs = sched._bind_pods_fenced([binding])
+        except LeaderFenced as e:
+            from ..api.objects import ObjectMeta, Pod
+            from ..scheduler.queue.scheduling_queue import QueuedPodInfo
+
+            sched._on_fenced_binds(
+                [
+                    QueuedPodInfo(
+                        pod=Pod(
+                            metadata=ObjectMeta(
+                                name=binding.pod_name,
+                                namespace=binding.pod_namespace,
+                                uid=binding.pod_uid,
+                            )
+                        )
+                    )
+                ]
+            )
+            return self._json(
+                200, {"result": "LeaderFenced", "message": str(e)}
+            )
+        except Exception as e:
+            return self._json(
+                200, {"result": type(e).__name__, "message": str(e)}
+            )
+        err = errs[0] if errs else None
+        if err is None:
+            return self._json(200, {"result": "ok"})
+        return self._json(
+            200, {"result": type(err).__name__, "message": str(err)}
+        )
+
+
+class _Replica:
+    """One scheduler replica over REST: standby first, the election
+    winner promotes with the fence (the cmd/scheduler.py wiring)."""
+
+    def __init__(self, server_url: str, identity: str, lease_cfg,
+                 zombie_hold: bool):
+        from ..apiserver.client import RESTClient
+        from ..client.leaderelection import LeaderElector
+        from ..scheduler import KubeSchedulerConfiguration, Scheduler
+
+        self.identity = identity
+        self.client = RESTClient(server_url, timeout=5.0)
+        cfg = KubeSchedulerConfiguration(use_device=False)
+        self.sched = Scheduler(self.client, cfg)
+        self.sched.start_standby(identity=identity)
+        self.promoted = threading.Event()
+        self.deposed = threading.Event()
+
+        def on_started():
+            self.sched.promote(fence=self.elector.fence())
+            self.promoted.set()
+
+        def on_stopped():
+            self.deposed.set()
+            if zombie_hold:
+                # the misbehaving replica: keeps scheduling with its
+                # stale fence — the store must stop it, not its manners
+                logger.error(
+                    "%s deposed; ZOMBIE-HOLD: scheduling loops stay up",
+                    identity,
+                )
+                return
+            logger.error("%s deposed; stopping scheduling", identity)
+            self.sched.stop()
+
+        self.elector = LeaderElector(
+            self.client,
+            lease_cfg,
+            on_started_leading=on_started,
+            on_stopped_leading=on_stopped,
+        )
+        self._thread = threading.Thread(
+            target=self.elector.run, daemon=True, name=f"elector-{identity}"
+        )
+        self._thread.start()
+
+
+def run_scheduler(
+    server_url: str,
+    identity: str,
+    debug_port: int,
+    lease_duration: float,
+    renew_deadline: float,
+    retry_period: float,
+    zombie_hold: bool,
+) -> None:
+    from ..client.leaderelection import LeaderElectionConfig
+
+    lease_cfg = LeaderElectionConfig(
+        identity=identity,
+        lease_duration=lease_duration,
+        renew_deadline=renew_deadline,
+        retry_period=retry_period,
+    )
+    replica = _Replica(server_url, identity, lease_cfg, zombie_hold)
+    dbg = ThreadingHTTPServer(("127.0.0.1", debug_port), _DebugHandler)
+    dbg.daemon_threads = True
+    dbg.replica = replica
+    threading.Thread(target=dbg.serve_forever, daemon=True).start()
+    print(f"READY scheduler {identity} {dbg.server_address[1]}", flush=True)
+    threading.Event().wait()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="netchaos-procs")
+    sub = parser.add_subparsers(dest="role", required=True)
+    ap = sub.add_parser("apiserver")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--ledger", required=True)
+    sp = sub.add_parser("scheduler")
+    sp.add_argument("--server", required=True)
+    sp.add_argument("--identity", required=True)
+    sp.add_argument("--debug-port", type=int, default=0)
+    sp.add_argument("--lease-duration", type=float, default=1.5)
+    sp.add_argument("--renew-deadline", type=float, default=1.0)
+    sp.add_argument("--retry-period", type=float, default=0.2)
+    sp.add_argument("--zombie-hold", action="store_true", default=False)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.role == "apiserver":
+        run_apiserver(args.port, args.ledger)
+    else:
+        run_scheduler(
+            args.server,
+            args.identity,
+            args.debug_port,
+            args.lease_duration,
+            args.renew_deadline,
+            args.retry_period,
+            args.zombie_hold,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

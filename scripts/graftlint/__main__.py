@@ -30,6 +30,7 @@ import config
 import core
 import degraded
 import donation
+import fenceseam
 import metrics_contract
 
 BASELINE = os.path.join(_HERE, "baseline.txt")
@@ -103,6 +104,7 @@ def main(argv=None) -> int:
     findings += blocking.run(tree)
     findings += metrics_contract.run(tree, root)
     findings += degraded.run(tree)
+    findings += fenceseam.run(tree)
     # passes can surface the same hazard through two rules; report once
     seen = set()
     deduped = []

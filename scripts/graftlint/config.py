@@ -31,6 +31,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_readpath.py",
     "tests/test_watchcache.py",
     "tests/test_chaos_ha.py",
+    "tests/test_chaos_net.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -165,3 +166,17 @@ DEGRADED_HANDLERS = {
 # transitively. ReplicaSetController predates WorkqueueController but
 # runs the identical guarded _worker shape.
 DEGRADED_TOLERANT_BASES = {"WorkqueueController", "ReplicaSetController"}
+
+# -- pass 5: scheduler bind-fence seam ---------------------------------------
+
+# dirs whose bind-write call sites must funnel through the fence seam
+# (scheduler-side only: that is where a leadership fence exists to attach)
+FENCE_SEAM_DIRS = ("kubernetes_tpu/scheduler",)
+
+# the ONE function allowed to call bind writes on a store receiver — it
+# attaches the leadership fencing token the store/REST route validates
+FENCE_SEAM_FUNCS = ("_bind_pods_fenced",)
+
+# method names that are bind writes when called on a store-ish receiver
+# (WRITE_RECEIVERS above)
+FENCE_BIND_METHODS = {"bind_pod", "bind_pods"}

@@ -1,0 +1,124 @@
+"""Pass 5: the scheduler bind-fence seam.
+
+ISSUE-10 closed the last unfenced bind path: every scheduler-originated
+bind — batch waves, the ride-through reconciler's replays, and the
+plugin-bearing per-pod path — funnels through ``_bind_pods_fenced``,
+which attaches the leadership fencing token the store (or the REST
+/binding route) validates under the bind lock. A new bind call site that
+talks to the store directly would re-open the zombie-ex-leader window
+the HA chaos suites exist to keep shut. This pass keeps the gap closed:
+
+Every call of a config.FENCE_BIND_METHODS name (``bind_pod`` /
+``bind_pods``) on a store-ish receiver (config.WRITE_RECEIVERS) inside
+config.FENCE_SEAM_DIRS is a finding UNLESS:
+
+  * the enclosing function IS the seam (config.FENCE_SEAM_FUNCS); or
+  * the call is marked ``# graftlint: fence-exempt(reason)`` — e.g. the
+    DefaultBinder plugin, whose injected ``server`` is the scheduler's
+    _FencedBindSurface (the seam itself wearing the APIServer interface).
+    The reason is mandatory.
+
+Same bare-receiver rule as the degraded pass: a bare name must be a
+parameter of the enclosing function to count as an API handle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from core import Finding, Module, Tree, dotted_name
+import config
+
+PASS = "fenceseam"
+
+
+def _is_param(func, name: str) -> bool:
+    if func is None:
+        return True  # module level: keep the conservative match
+    a = func.args
+    params = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            params.append(extra.arg)
+    return name in params
+
+
+def _marked_exempt(mod: Module, call: ast.Call) -> Optional[bool]:
+    """True = marked with reason; False = marked WITHOUT reason (itself a
+    finding); None = unmarked. Same placement rules as degraded-ok: on
+    the call's lines or on the enclosing function's def line(s)."""
+    lines = list(
+        range(call.lineno, getattr(call, "end_lineno", call.lineno) + 1)
+    )
+    func = mod.enclosing_function(call)
+    pragmas = [
+        p
+        for ln in lines
+        for p in mod.pragmas.get(ln, ())
+        if p.directive == "fence-exempt"
+    ]
+    if not pragmas and func is not None:
+        body_start = func.body[0].lineno if func.body else func.lineno
+        for ln in range(func.lineno, body_start):
+            pragmas.extend(
+                p
+                for p in mod.pragmas.get(ln, ())
+                if p.directive == "fence-exempt"
+            )
+    if not pragmas:
+        return None
+    return all(p.reason for p in pragmas)
+
+
+def run(tree: Tree, dirs=None) -> List[Finding]:
+    findings: List[Finding] = []
+    dirs = tuple(
+        d.rstrip("/") + "/" for d in (dirs or config.FENCE_SEAM_DIRS)
+    )
+    for mod in tree.modules:
+        if not mod.rel.replace("\\", "/").startswith(dirs):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr not in config.FENCE_BIND_METHODS:
+                continue
+            recv = dotted_name(f.value)
+            if not recv or recv.rsplit(".", 1)[-1] not in config.WRITE_RECEIVERS:
+                continue
+            if "." not in recv and not _is_param(
+                mod.enclosing_function(node), recv
+            ):
+                continue
+            func = mod.enclosing_function(node)
+            where = func.name if func is not None else "<module>"
+            if where in config.FENCE_SEAM_FUNCS:
+                continue
+            marked = _marked_exempt(mod, node)
+            if marked is True:
+                continue
+            if marked is False:
+                findings.append(
+                    Finding(
+                        mod.rel, node.lineno, PASS,
+                        f"no-reason:{where}:{f.attr}",
+                        f"fence-exempt pragma on `{recv}.{f.attr}` in "
+                        f"`{where}` needs a reason",
+                    )
+                )
+                continue
+            findings.append(
+                Finding(
+                    mod.rel, node.lineno, PASS,
+                    f"unfenced-bind:{where}:{f.attr}",
+                    f"bind write `{recv}.{f.attr}` in `{where}` bypasses "
+                    "the leadership fence seam (_bind_pods_fenced): a "
+                    "deposed replica could land a late bind here — route "
+                    "through the seam or mark fence-exempt(reason)",
+                )
+            )
+    return findings

@@ -1,0 +1,33 @@
+"""graftlint fixture: bind-fence-seam violations (parsed only).
+
+Expected findings:
+  1. unfenced-bind: `self.server.bind_pods` in `rogue_batch`
+  2. unfenced-bind: `server.bind_pod` in `rogue_single`
+  3. no-reason: fence-exempt pragma without a reason in `lazy_exempt`
+"""
+
+
+class RogueScheduler:
+    def rogue_batch(self, bindings):
+        return self.server.bind_pods(bindings)  # finding 1
+
+    def _bind_pods_fenced(self, bindings):
+        # clean: this IS the seam
+        return self.server.bind_pods(bindings, fence=self._bind_fence)
+
+
+def rogue_single(server, binding):
+    server.bind_pod(binding)  # finding 2
+
+
+def lazy_exempt(server, binding):
+    server.bind_pod(binding)  # graftlint: fence-exempt()
+
+
+def marked_exempt(server, binding):
+    server.bind_pod(binding)  # graftlint: fence-exempt(fixture: injected surface is the seam)
+
+
+def local_heap_named_server(binding):
+    server = {}  # a local merely NAMED server is not an API handle
+    server.bind_pod(binding)  # clean: bare name, not a parameter

@@ -641,3 +641,76 @@ def test_clock_jittered_renew_races_never_steal_a_live_lease():
     lease = s.get("leases", "kube-system", "kube-scheduler")
     assert lease.holder_identity == "chall"
     assert lease.lease_transitions == 1
+
+
+# -- scenario: the plugin-bearing per-pod bind path is fenced too --------------
+
+
+def test_plugin_per_pod_bind_path_is_fenced():
+    """ISSUE-10 acceptance: the plugin-bearing per-pod path (DefaultBinder
+    through the framework's bind surface, and the async binding cycle
+    around it) funnels through the same fence-attaching seam as batch
+    binds — a deposed replica's per-pod bind raises LeaderFenced, the
+    placement is dropped (never applied, never requeued), and the fenced
+    counter carries the transport label."""
+    from kubernetes_tpu.scheduler.framework.interface import CycleState
+    from kubernetes_tpu.scheduler.queue.scheduling_queue import QueuedPodInfo
+
+    store, cacher, pool = _cluster()
+    a = _Replica(store, cacher, "plugin-zombie-a")
+    assert wait_until(a.promoted.is_set, 15)
+    b = _Replica(store, cacher, "plugin-fresh-b")
+    try:
+        # depose a: pause its elector (no release), b takes the lease
+        a.elector.crash()
+        assert wait_until(b.promoted.is_set, 15), "standby never took over"
+
+        zp = v1.Pod(
+            metadata=v1.ObjectMeta(name="plugin-zombie-target"),
+            spec=v1.PodSpec(
+                # unsatisfiable selector: neither live scheduler can PLACE
+                # it (stays pending), but the default profile still owns
+                # it — the direct bind writes below target ha-0 explicitly
+                node_selector={"no-such-label": "nowhere"},
+                containers=[v1.Container(requests={"cpu": "100m"})],
+            ),
+        )
+        zp = store.create("pods", zp)
+
+        # (1) DefaultBinder through the framework context's bind surface:
+        # the plugin's write funnels into _bind_pods_fenced and the store
+        # rejects it with the zombie's stale token
+        prof = a.sched.profiles.for_pod(zp)
+        with pytest.raises(LeaderFenced):
+            prof.framework.run_bind_plugins(CycleState(), zp, "ha-0")
+        assert not store.get(
+            "pods", "default", "plugin-zombie-target"
+        ).spec.node_name, "a fenced plugin bind reached the store"
+
+        # (2) the whole async binding cycle: LeaderFenced is handled (not
+        # an unhandled thread exception), the placement dropped and
+        # counted under the transport label
+        before = metrics.dump().get(
+            "scheduler_ha_fenced_binds_total{'path': 'local'}", 0.0
+        )
+        a.sched.cache.assume_pod(zp, "ha-0", device_synced=False)
+        pi = QueuedPodInfo(pod=zp)
+        a.sched._bind_async(pi, "ha-0", CycleState(), time.monotonic())
+        assert not store.get(
+            "pods", "default", "plugin-zombie-target"
+        ).spec.node_name
+        after = metrics.dump().get(
+            "scheduler_ha_fenced_binds_total{'path': 'local'}", 0.0
+        )
+        assert after == before + 1, (before, after)
+
+        # (3) the extender pre-check seam rejects a deposed replica
+        with pytest.raises(LeaderFenced):
+            a.sched._check_fence_live()
+
+        assert_bind_invariants(store)
+    finally:
+        b.stop()
+        a.stop()
+        pool.stop()
+        cacher.stop()

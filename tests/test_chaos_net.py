@@ -1,0 +1,572 @@
+"""Network & process chaos: the control plane over REAL sockets and REAL
+OS processes, through the deterministic NetChaosProxy
+(kubernetes_tpu/testing/netchaos.py).
+
+Every prior chaos suite runs in one process against a ChaosStore; these
+scenarios exercise the failure modes only a deployed control plane sees
+— and prove the ISSUE-10 guarantees:
+
+  * a **blackholed bind ack** (write applied, response dropped) surfaces
+    as QuorumLost and the PR-3 read-back reconciler resolves it: the pod
+    ends bound EXACTLY once, never blindly replayed, never lost;
+  * a **mid-request reset** (request never reached the server) parks the
+    placement and the reconciler's uid-fenced replay binds it once;
+  * a **full partition** (ECONNREFUSED) blinds the scheduler; informers
+    recover on heal and everything binds exactly once;
+  * a **slow, jittery, bandwidth-capped network** binds everything with
+    zero duplicate applies;
+  * a **half-open watch stream** (client vanished without FIN) is reaped
+    by the REST bookmark heartbeat;
+  * in the **multi-process REST topology** (API server, leader, standby
+    as separate OS processes), partitioning or SIGSTOPping the leader
+    promotes the standby, and the healed/resumed zombie's late REST
+    binds are rejected with LeaderFenced — zero double-binds on the
+    cross-process JSONL ledger.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+
+import pytest
+
+from test_chaos_pipeline import ChaosStore, make_pod, wait_until
+
+from kubernetes_tpu.api.objects import (
+    Binding,
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.client import RESTClient
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.client.apiserver import LeaderFenced
+from kubernetes_tpu.runtime.consensus import DegradedWrites, QuorumLost
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.testing.netchaos import (
+    NetChaosProxy,
+    sigcont,
+    sigkill,
+    sigstop,
+)
+from kubernetes_tpu.utils.metrics import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_node(name, cpu="8"):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable={"cpu": cpu, "memory": "32Gi", "pods": 110}
+        ),
+    )
+
+
+class _Stack:
+    """In-process REST control plane behind a NetChaosProxy: ChaosStore
+    (the bind-invariant ledger) -> REST server -> proxy -> RESTClient.
+    The scheduler under test talks ONLY through the proxy."""
+
+    def __init__(self, n_nodes=4, bookmark_period_s=0.5):
+        self.store = ChaosStore()
+        self.srv, self.api_port, _ = serve(
+            store=self.store, port=0, bookmark_period_s=bookmark_period_s
+        )
+        self.proxy = NetChaosProxy("127.0.0.1", self.api_port).start()
+        self.client = RESTClient(
+            f"http://127.0.0.1:{self.proxy.port}", timeout=5.0
+        )
+        for i in range(n_nodes):
+            self.store.create("nodes", make_node(f"net-{i}"))
+        self.sched = None
+
+    def start_scheduler(self):
+        self.sched = Scheduler(
+            self.client, KubeSchedulerConfiguration(use_device=False)
+        )
+        self.sched.start()
+        return self.sched
+
+    def bound_count(self, prefix=""):
+        pods, _ = self.store.list("pods")
+        return sum(
+            1
+            for p in pods
+            if p.metadata.name.startswith(prefix) and p.spec.node_name
+        )
+
+    def assert_exactly_once(self):
+        assert all(
+            c == 1 for c in self.store.applied_binds.values()
+        ), f"duplicate applies: {dict(self.store.applied_binds)}"
+
+    def stop(self):
+        if self.sched is not None:
+            self.sched.stop()
+        self.proxy.stop()
+        self.srv.shutdown()
+
+
+@pytest.fixture
+def stack():
+    s = _Stack()
+    yield s
+    s.stop()
+
+
+# -- proxy semantics (fast: tier-1 coverage of the harness itself) ------------
+
+
+def test_proxy_passthrough_and_latency(stack):
+    """At zero injected faults the proxy is transparent: CRUD, binds,
+    and typed errors behave identically. Latency shaping measurably
+    delays a request (deterministic jitter, no dice)."""
+    c = stack.client
+    c.create("pods", make_pod("pt-0"))
+    assert c.get("pods", "default", "pt-0").metadata.name == "pt-0"
+    assert c.bind_pods(
+        [Binding(pod_name="pt-0", pod_namespace="default",
+                 target_node="net-0")]
+    ) == [None]
+    assert stack.store.get("pods", "default", "pt-0").spec.node_name == "net-0"
+    t0 = time.monotonic()
+    c.get("pods", "default", "pt-0")
+    base = time.monotonic() - t0
+    stack.proxy.set_latency(0.15)
+    t0 = time.monotonic()
+    c.get("pods", "default", "pt-0")
+    shaped = time.monotonic() - t0
+    stack.proxy.set_latency(0.0)
+    # request + response each pay one per-chunk delay
+    assert shaped >= base + 0.15, (base, shaped)
+
+
+def test_reset_mid_request_classifies_unknown_outcome(stack):
+    """A connection reset on a /binding POST surfaces as QuorumLost (the
+    request MAY have been processed — here it provably wasn't) and the
+    pod is untouched; a refused connect surfaces as retryable
+    DegradedWrites. The remaining batch is not attempted."""
+    c = stack.client
+    for i in range(2):
+        c.create("pods", make_pod(f"rst-{i}"))
+    stack.proxy.reset_next_requests(1, match=b"/binding")
+    errs = c.bind_pods(
+        [
+            Binding(pod_name=f"rst-{i}", pod_namespace="default",
+                    target_node="net-0")
+            for i in range(2)
+        ]
+    )
+    assert isinstance(errs[0], QuorumLost), errs
+    assert isinstance(errs[1], DegradedWrites), errs
+    assert stack.store.get("pods", "default", "rst-0").spec.node_name == ""
+    # refused connect (partition, refuse mode): retryable, never unknown
+    stack.proxy.partition("refuse")
+    errs = c.bind_pods(
+        [Binding(pod_name="rst-0", pod_namespace="default",
+                 target_node="net-0")]
+    )
+    assert isinstance(errs[0], DegradedWrites) and not isinstance(
+        errs[0], QuorumLost
+    ), errs
+    stack.proxy.heal()
+    assert c.bind_pods(
+        [Binding(pod_name="rst-0", pod_namespace="default",
+                 target_node="net-0")]
+    ) == [None]
+    stack.assert_exactly_once()
+
+
+def test_half_open_watch_stream_reaped_by_heartbeat():
+    """A watch client that vanishes without FIN leaves the server's
+    stream thread alive — until the idle bookmark heartbeat's write
+    fails and reaps it (the apiserver_watch_streams gauge drops)."""
+    store = ChaosStore()
+    srv, port, _ = serve(store=store, port=0, bookmark_period_s=0.3)
+    proxy = NetChaosProxy("127.0.0.1", port).start()
+    client = RESTClient(f"http://127.0.0.1:{proxy.port}", timeout=5.0)
+    w = client.watch("pods")
+    try:
+        assert wait_until(lambda: srv.watch_stream_count("pods") == 1, 10)
+        severed = proxy.half_open_upstream()
+        assert severed == 1, severed
+        # next heartbeat write (<=0.3 s) hits the dead leg and the
+        # server-side thread exits
+        assert wait_until(
+            lambda: srv.watch_stream_count("pods") == 0, 10
+        ), "half-open watch stream never reaped"
+    finally:
+        w.stop()
+        proxy.stop()
+        srv.shutdown()
+
+
+# -- scheduler-through-proxy scenarios ---------------------------------------
+
+
+@pytest.mark.slow
+def test_blackholed_bind_ack_never_replayed_never_lost(stack):
+    """ISSUE-10 acceptance: a bind whose RESPONSE is dropped (write
+    applied, ack lost) surfaces as QuorumLost; the placement parks and
+    the PR-3 read-back reconciler finds the bind LANDED — finished, not
+    replayed. The ledger shows exactly one application."""
+    sched = stack.start_scheduler()
+
+    def landed():
+        return metrics.dump().get(
+            "scheduler_bind_reconcile_total{'outcome': 'landed'}", 0.0
+        )
+
+    before = landed()
+    stack.proxy.blackhole_next_responses(1, match=b"/binding")
+    stack.store.create("pods", make_pod("bh-0"))
+    # the bind applies upstream, the ack is swallowed, the reconciler
+    # reads it back and finishes it — exactly once
+    assert wait_until(
+        lambda: stack.store.acked_binds.get(
+            stack.store.get("pods", "default", "bh-0").metadata.uid
+        )
+        is not None
+        or bool(stack.store.get("pods", "default", "bh-0").spec.node_name),
+        30,
+    ), "blackholed bind never applied"
+    assert wait_until(lambda: landed() == before + 1, 30), (
+        "reconciler never resolved the blackholed ack as landed"
+    )
+    assert wait_until(
+        lambda: stack.sched._ridethrough.depth == 0, 15
+    ), "pending-bind buffer never drained"
+    assert stack.store.get("pods", "default", "bh-0").spec.node_name
+    stack.assert_exactly_once()
+    # the pipeline is healthy afterwards: new pods bind normally
+    stack.store.create("pods", make_pod("bh-after"))
+    assert wait_until(lambda: stack.bound_count("bh-after") == 1, 15)
+    stack.assert_exactly_once()
+
+
+@pytest.mark.slow
+def test_reset_storm_parks_then_uid_fenced_replay_binds_once(stack):
+    """Every /binding POST is reset mid-request (bind-path-only
+    partition): placements park as unknown-outcome, the reconciler's
+    read-back finds them unbound and replays — against the still-broken
+    path — until the storm clears. Every pod ends bound exactly once."""
+    sched = stack.start_scheduler()
+    stack.proxy.reset_next_requests(9999, match=b"/binding")
+    for i in range(8):
+        stack.store.create("pods", make_pod(f"storm-{i}"))
+    # placements park (QuorumLost) while the bind path is down
+    assert wait_until(lambda: stack.sched._ridethrough.depth > 0, 20), (
+        "no placement ever parked under the reset storm"
+    )
+    assert stack.bound_count("storm-") == 0
+    remaining = stack.proxy.clear_faults()
+    assert remaining > 0
+    assert wait_until(lambda: stack.bound_count("storm-") == 8, 40), (
+        f"only {stack.bound_count('storm-')}/8 bound after the storm"
+    )
+    assert wait_until(lambda: stack.sched._ridethrough.depth == 0, 15)
+    stack.assert_exactly_once()
+
+
+@pytest.mark.slow
+def test_full_partition_heal_informers_recover_and_bind(stack):
+    """A full refuse-partition severs watches AND writes. Pods created
+    during the partition are invisible to the scheduler; on heal the
+    informers relist/resume and everything binds exactly once."""
+    sched = stack.start_scheduler()
+    stack.store.create("pods", make_pod("pre-part-0"))
+    assert wait_until(lambda: stack.bound_count("pre-part-") == 1, 20)
+    stack.proxy.partition("refuse")
+    for i in range(6):
+        stack.store.create("pods", make_pod(f"during-{i}"))
+    time.sleep(1.0)  # the partition holds: nothing can have bound
+    assert stack.bound_count("during-") == 0
+    stack.proxy.heal()
+    assert wait_until(lambda: stack.bound_count("during-") == 6, 45), (
+        f"only {stack.bound_count('during-')}/6 bound after heal"
+    )
+    stack.assert_exactly_once()
+
+
+@pytest.mark.slow
+def test_slow_network_soak_binds_everything_once(stack):
+    """Latency + deterministic jitter + a bandwidth cap: every request
+    is slow, none fail — all pods bind with zero duplicate applies and
+    the ride-through machinery never needs to engage."""
+    stack.proxy.set_latency(0.02, jitter_s=0.01)
+    stack.proxy.set_bandwidth(2e6)
+    sched = stack.start_scheduler()
+    for i in range(24):
+        stack.store.create("pods", make_pod(f"soak-{i}"))
+    assert wait_until(lambda: stack.bound_count("soak-") == 24, 60), (
+        f"only {stack.bound_count('soak-')}/24 bound on the slow network"
+    )
+    stack.assert_exactly_once()
+
+
+# -- multi-process topology ---------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Proc:
+    """One child process with line-captured stdout (READY handshake) and
+    stderr spooled to a file (log-heavy children must not deadlock on a
+    full pipe)."""
+
+    def __init__(self, args, tag):
+        self.tag = tag
+        self.errfile = tempfile.NamedTemporaryFile(
+            "w+", prefix=f"netchaos-{tag}-", suffix=".log", delete=False
+        )
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.testing.netchaos_procs",
+             *args],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=self.errfile,
+            text=True,
+            env=env,
+        )
+        self.lines = []
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            self.lines.append(line.strip())
+
+    def wait_ready(self, timeout=60.0) -> str:
+        assert wait_until(
+            lambda: any(l.startswith("READY") for l in self.lines)
+            or self.proc.poll() is not None,
+            timeout,
+        ), f"{self.tag} never became ready"
+        ready = [l for l in self.lines if l.startswith("READY")]
+        if not ready:
+            self.errfile.flush()
+            with open(self.errfile.name) as fh:
+                raise AssertionError(
+                    f"{self.tag} exited rc={self.proc.returncode}:\n"
+                    + fh.read()[-3000:]
+                )
+        return ready[0]
+
+    def kill(self):
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def _status(port: int) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/status", timeout=5
+    ) as r:
+        return json.loads(r.read())
+
+
+def _force_bind(port: int, name: str, node: str, uid: str = "") -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/bind",
+        data=json.dumps({"name": name, "node": node, "uid": uid}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _ledger_applied(path: str) -> Counter:
+    applied = Counter()
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec["event"] == "applied":
+                applied[rec["uid"]] += 1
+    return applied
+
+
+def _ledger_fenced_identities(path: str) -> list:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec["event"] == "fenced":
+                out.append(rec["identity"])
+    return out
+
+
+class _MultiProc:
+    """apiserver + leader + standby as real OS processes. The leader
+    talks REST through a NetChaosProxy; the standby and the test talk
+    straight to the API server."""
+
+    def __init__(self, leader_zombie_hold=True, leader_via_proxy=True):
+        self.api_port = _free_port()
+        self.ledger = tempfile.NamedTemporaryFile(
+            "w", prefix="netchaos-ledger-", suffix=".jsonl", delete=False
+        ).name
+        self.procs = []
+        self.api = self._spawn(
+            ["apiserver", "--port", str(self.api_port),
+             "--ledger", self.ledger],
+            "apiserver",
+        )
+        self.api.wait_ready()
+        self.direct_url = f"http://127.0.0.1:{self.api_port}"
+        self.client = RESTClient(self.direct_url, timeout=5.0)
+        self.proxy = None
+        leader_url = self.direct_url
+        if leader_via_proxy:
+            self.proxy = NetChaosProxy("127.0.0.1", self.api_port).start()
+            leader_url = f"http://127.0.0.1:{self.proxy.port}"
+        self.leader_debug = _free_port()
+        leader_args = [
+            "scheduler", "--server", leader_url, "--identity", "lead-a",
+            "--debug-port", str(self.leader_debug),
+        ]
+        if leader_zombie_hold:
+            leader_args.append("--zombie-hold")
+        self.leader = self._spawn(leader_args, "leader")
+        self.leader.wait_ready(120)
+        assert wait_until(
+            lambda: _status(self.leader_debug)["promoted"], 60
+        ), "first replica never promoted"
+        self.standby_debug = _free_port()
+        self.standby = self._spawn(
+            ["scheduler", "--server", self.direct_url,
+             "--identity", "stand-b",
+             "--debug-port", str(self.standby_debug)],
+            "standby",
+        )
+        self.standby.wait_ready(120)
+
+    def _spawn(self, args, tag):
+        p = _Proc(args, tag)
+        self.procs.append(p)
+        return p
+
+    def all_bound(self, n: int) -> bool:
+        pods, _ = self.client.list("pods")
+        return sum(1 for p in pods if p.spec.node_name) >= n
+
+    def stop(self):
+        if self.proxy is not None:
+            self.proxy.stop()
+        for p in self.procs:
+            p.kill()
+
+
+@pytest.mark.slow
+def test_multiproc_partition_promote_heal_zombie_rest_binds_fenced():
+    """THE acceptance scenario, over real processes and real sockets:
+    partition the leader mid-wave -> the standby promotes and binds
+    everything -> heal -> the zombie's late REST binds (it kept its
+    scheduling loops: --zombie-hold) are rejected with LeaderFenced —
+    and the cross-process ledger shows every pod applied exactly once."""
+    mp = _MultiProc(leader_zombie_hold=True, leader_via_proxy=True)
+    try:
+        for i in range(4):
+            mp.client.create("nodes", make_node(f"net-{i}"))
+        for i in range(10):
+            mp.client.create("pods", make_pod(f"wave1-{i}"))
+        assert wait_until(lambda: mp.all_bound(10), 60), (
+            "leader never bound the first wave"
+        )
+        # partition the leader mid-operation: its renews AND binds now
+        # fail fast (ECONNREFUSED through its proxy)
+        mp.proxy.partition("refuse")
+        assert wait_until(
+            lambda: _status(mp.standby_debug)["promoted"], 60
+        ), "standby never promoted after the partition"
+        # the second wave lands while the zombie is cut off
+        for i in range(10):
+            mp.client.create("pods", make_pod(f"wave2-{i}"))
+        assert wait_until(lambda: mp.all_bound(20), 60), (
+            "standby never bound the second wave"
+        )
+        mp.proxy.heal()
+        # the healed zombie still holds its stale fence (zombie-hold kept
+        # its loops alive): drive one late REST bind through its own
+        # fence-attaching seam — the server must reject it
+        target = mp.client.create("pods", make_pod("late-target"))
+        out = _force_bind(
+            mp.leader_debug, "late-target", "net-0", target.metadata.uid
+        )
+        assert out["result"] == "LeaderFenced", out
+        # the pod the zombie tried to steal is untouched by that attempt
+        # and the new leader binds it
+        assert wait_until(lambda: mp.all_bound(21), 60)
+        applied = _ledger_applied(mp.ledger)
+        assert applied and all(c == 1 for c in applied.values()), (
+            f"double-applied binds: { {k: v for k, v in applied.items() if v != 1} }"
+        )
+        assert "lead-a" in _ledger_fenced_identities(mp.ledger), (
+            "the zombie's fenced bind never reached the ledger"
+        )
+        # the zombie counted its rejection (path=rest)
+        assert _status(mp.leader_debug)["fenced_binds"] >= 1
+    finally:
+        mp.stop()
+
+
+@pytest.mark.slow
+def test_multiproc_sigstop_zombie_resumes_into_the_fence():
+    """Process chaos: SIGSTOP freezes the leader through its lease
+    expiry (the canonical GC-pause/zombie shape); the standby promotes;
+    SIGCONT resumes the zombie, whose late REST binds carry the stale
+    fence and are rejected. Exactly-once on the ledger throughout."""
+    mp = _MultiProc(leader_zombie_hold=True, leader_via_proxy=False)
+    try:
+        for i in range(4):
+            mp.client.create("nodes", make_node(f"net-{i}"))
+        for i in range(6):
+            mp.client.create("pods", make_pod(f"pre-stop-{i}"))
+        assert wait_until(lambda: mp.all_bound(6), 60)
+        sigstop(mp.leader.proc)
+        assert wait_until(
+            lambda: _status(mp.standby_debug)["promoted"], 60
+        ), "standby never promoted after SIGSTOP"
+        for i in range(6):
+            mp.client.create("pods", make_pod(f"mid-stop-{i}"))
+        assert wait_until(lambda: mp.all_bound(12), 60)
+        sigcont(mp.leader.proc)
+        target = mp.client.create("pods", make_pod("zombie-late"))
+        out = _force_bind(
+            mp.leader_debug, "zombie-late", "net-1", target.metadata.uid
+        )
+        assert out["result"] == "LeaderFenced", out
+        assert wait_until(lambda: mp.all_bound(13), 60)
+        applied = _ledger_applied(mp.ledger)
+        assert applied and all(c == 1 for c in applied.values()), (
+            f"double-applied binds: { {k: v for k, v in applied.items() if v != 1} }"
+        )
+        assert "lead-a" in _ledger_fenced_identities(mp.ledger)
+        # SIGKILL epilogue: hard-kill the zombie; the survivors are
+        # unaffected (the new leader keeps binding)
+        sigkill(mp.leader.proc)
+        mp.client.create("pods", make_pod("post-kill"))
+        assert wait_until(lambda: mp.all_bound(14), 60)
+    finally:
+        mp.stop()

@@ -27,6 +27,7 @@ import config as gl_config  # noqa: E402
 import core  # noqa: E402
 import degraded  # noqa: E402
 import donation  # noqa: E402
+import fenceseam  # noqa: E402
 import metrics_contract  # noqa: E402
 
 FIXTURES = "tests/graftlint_fixtures"
@@ -109,6 +110,27 @@ def test_degraded_fixture_exact_findings():
     ]
 
 
+# -- pass 5: bind-fence seam --------------------------------------------------
+
+
+def test_fenceseam_fixture_exact_findings():
+    found = fenceseam.run(_tree("viol_fenceseam.py"), dirs=(FIXTURES,))
+    assert _keys(found) == [
+        "no-reason:lazy_exempt:bind_pod",
+        "unfenced-bind:rogue_batch:bind_pods",
+        "unfenced-bind:rogue_single:bind_pod",
+    ]
+
+
+def test_fenceseam_production_scheduler_is_clean():
+    """The production scheduler tree routes every bind write through
+    _bind_pods_fenced (or carries a reasoned fence-exempt marker on the
+    injected-surface call) — the gap ISSUE-10 closed stays closed."""
+    rels = core.discover(REPO, ("kubernetes_tpu",), ())
+    tree = core.Tree(REPO, rels)
+    assert fenceseam.run(tree) == []
+
+
 # -- the clean fixture passes every pass -------------------------------------
 
 
@@ -118,6 +140,7 @@ def test_clean_fixture_no_findings():
     assert blocking.run(src) == []
     assert metrics_contract.run(src, REPO, doc_path=FIXTURE_DOC) == []
     assert degraded.run(src, dirs=(FIXTURES,)) == []
+    assert fenceseam.run(src, dirs=(FIXTURES,)) == []
 
 
 # -- runner CLI: exit codes + suppression baseline ---------------------------

@@ -111,6 +111,14 @@ def test_two_process_distributed_wave_kernel():
         if rc != 0 and (
             "distributed" in err.lower() and "not" in err.lower()
             or "UNIMPLEMENTED" in err
+            # this image's jaxlib CPU backend has no cross-process
+            # collectives (no gloo/mpi): multi-host device_put fails with
+            # INVALID_ARGUMENT "Multiprocess computations aren't
+            # implemented on the CPU backend" — an environment limitation
+            # (tracked: carried as tier-1's "1 pre-existing failure"
+            # since PR 4; triaged in the ISSUE-10 multi-process PR), not
+            # a regression. TPU/GPU runs exercise the real path.
+            or "Multiprocess computations aren't implemented" in err
         ):
             pytest.skip(f"jax distributed CPU unsupported here: {err[-300:]}")
         assert rc == 0, err[-2000:]

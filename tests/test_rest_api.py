@@ -192,3 +192,220 @@ def test_serializer_roundtrip_pod_affinity():
     # cluster-scoped namespace survives
     node_wire = json.dumps(codec.encode(make_node("n")))
     assert codec.decode("nodes", json.loads(node_wire)).metadata.namespace == ""
+
+
+# -- leadership fencing over REST (ISSUE 10) ---------------------------------
+
+
+def _make_lease(store, holder="sched-a", transitions=3):
+    from kubernetes_tpu.client.leaderelection import Lease
+
+    lease = Lease(
+        metadata=ObjectMeta(name="kube-scheduler", namespace="kube-system"),
+        holder_identity=holder,
+        lease_duration_seconds=15.0,
+        renew_time=time.monotonic(),
+        lease_transitions=transitions,
+    )
+    store.create("leases", lease)
+    return lease
+
+
+def _fence(identity="sched-a", transitions=3, name="kube-scheduler"):
+    from kubernetes_tpu.client.leaderelection import BindFence
+
+    return BindFence(
+        namespace="kube-system",
+        name=name,
+        identity=identity,
+        transitions=transitions,
+    )
+
+
+def test_rest_bind_fence_valid_and_rejections(rest):
+    """The /binding route validates X-Leadership-Fence against the live
+    lease: a matching token binds, a stale-transitions token, an
+    identity mismatch, and a fence naming a lease the server has never
+    seen all reject with LeaderFenced — and nothing applies."""
+    from kubernetes_tpu.client.apiserver import LeaderFenced
+
+    client, store, _port = rest
+    client.create("nodes", make_node("n0"))
+    _make_lease(store, holder="sched-a", transitions=3)
+    for i in range(4):
+        client.create("pods", make_pod(f"fp{i}"))
+    from kubernetes_tpu.api.objects import Binding
+
+    def binding(i):
+        return Binding(
+            pod_name=f"fp{i}", pod_namespace="default", target_node="n0"
+        )
+
+    # matching fence: binds land
+    assert client.bind_pods([binding(0)], fence=_fence()) == [None]
+    assert client.get("pods", "default", "fp0").spec.node_name == "n0"
+    # stale transitions (a takeover bumped the lease since this token)
+    with pytest.raises(LeaderFenced):
+        client.bind_pods([binding(1)], fence=_fence(transitions=2))
+    # identity mismatch (someone else holds the lease)
+    with pytest.raises(LeaderFenced):
+        client.bind_pods([binding(1)], fence=_fence(identity="sched-b"))
+    # fence on a lease the server has never seen
+    with pytest.raises(LeaderFenced):
+        client.bind_pods([binding(1)], fence=_fence(name="no-such-lease"))
+    # single-pod surface rejects identically
+    with pytest.raises(LeaderFenced):
+        client.bind_pod(binding(2), fence=_fence(transitions=99))
+    # none of the rejected binds applied
+    for i in (1, 2, 3):
+        assert client.get("pods", "default", f"fp{i}").spec.node_name == ""
+
+
+def test_rest_bind_fence_malformed_header_is_400(rest):
+    """A garbage fence header must 400, never silently degrade to an
+    UNfenced bind."""
+    client, store, port = rest
+    client.create("nodes", make_node("n0"))
+    client.create("pods", make_pod("mp0"))
+    from kubernetes_tpu.client.leaderelection import FENCE_HEADER
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/namespaces/default/pods/mp0/binding",
+        data=json.dumps(
+            {"podName": "mp0", "podNamespace": "default", "targetNode": "n0"}
+        ).encode(),
+        method="POST",
+        headers={
+            "Content-Type": "application/json",
+            FENCE_HEADER: "not json at all",
+        },
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+    assert client.get("pods", "default", "mp0").spec.node_name == ""
+
+
+def test_rest_fenced_mid_batch_leaves_prefix_applied_once(rest):
+    """A fenced 409 arriving mid-batch raises (the remaining bindings
+    are never attempted) while the bindings that landed before the
+    takeover stay applied exactly once."""
+    from kubernetes_tpu.client.apiserver import LeaderFenced
+    from kubernetes_tpu.api.objects import Binding
+
+    client, store, _port = rest
+    client.create("nodes", make_node("n0"))
+    _make_lease(store, holder="sched-a", transitions=3)
+    for i in range(3):
+        client.create("pods", make_pod(f"bp{i}"))
+    applied = []
+    orig_bind = store.bind_pods
+
+    def bind_and_then_takeover(bindings, fence=None):
+        errs = orig_bind(bindings, fence=fence)
+        applied.extend(
+            b.pod_name for b, e in zip(bindings, errs) if e is None
+        )
+        if len(applied) == 1:
+            # a standby takes over between this request and the next:
+            # holder + transitions move on
+            lease = store.get("leases", "kube-system", "kube-scheduler")
+            lease.holder_identity = "sched-b"
+            lease.lease_transitions += 1
+            store.update("leases", lease)
+        return errs
+
+    store.bind_pods = bind_and_then_takeover
+    bindings = [
+        Binding(pod_name=f"bp{i}", pod_namespace="default", target_node="n0")
+        for i in range(3)
+    ]
+    with pytest.raises(LeaderFenced):
+        client.bind_pods(bindings, fence=_fence())
+    store.bind_pods = orig_bind
+    # the pre-takeover prefix applied exactly once; nothing after it
+    assert applied == ["bp0"]
+    assert client.get("pods", "default", "bp0").spec.node_name == "n0"
+    assert client.get("pods", "default", "bp1").spec.node_name == ""
+    assert client.get("pods", "default", "bp2").spec.node_name == ""
+
+
+def test_leader_elector_over_rest(rest):
+    """LeaderElector driven through the RESTClient: acquire/renew/release
+    work over the wire, and a degraded store (503 Degraded), a fenced
+    store (503 without Retry-After -> NotPrimary), and a transport
+    failure all classify as COUNTED SKIPS — the holder keeps leading
+    within renew_deadline, exactly the in-process contract."""
+    from kubernetes_tpu.client.leaderelection import (
+        COUNTER_DEGRADED_SKIPS,
+        LeaderElectionConfig,
+        LeaderElector,
+    )
+    from kubernetes_tpu.utils.metrics import metrics
+
+    client, store, _port = rest
+
+    class _Gate:
+        degraded = False
+
+        def check_writable(self):
+            if self.degraded:
+                from kubernetes_tpu.runtime.consensus import DegradedWrites
+
+                raise DegradedWrites("test: degraded")
+
+    gate = _Gate()
+    store.write_gate.attach_consensus(gate)
+    cfg = LeaderElectionConfig(
+        identity="rest-elector",
+        lease_duration=4.0,
+        renew_deadline=3.0,
+        retry_period=0.5,
+    )
+    started = []
+    elector = LeaderElector(
+        client, cfg, on_started_leading=lambda: started.append(1)
+    )
+    # acquire over REST (lease create through the wire)
+    assert elector._try_acquire_or_renew() is True
+    lease = client.get("leases", "kube-system", "kube-scheduler")
+    assert lease.holder_identity == "rest-elector"
+    fence = elector.fence()
+    assert fence.transitions == lease.lease_transitions
+
+    def skips():
+        return metrics.dump().get(f"{COUNTER_DEGRADED_SKIPS}{{}}", 0.0)
+
+    # degraded store: renew is a counted skip, not an exception
+    before = skips()
+    gate.degraded = True
+    assert elector._try_acquire_or_renew() is False
+    assert skips() == before + 1
+    gate.degraded = False
+    assert elector._try_acquire_or_renew() is True
+    # fenced store (503 without Retry-After -> NotPrimary): counted skip
+    before = skips()
+    store.write_gate.fenced = True
+    assert elector._try_acquire_or_renew() is False
+    assert skips() == before + 1
+    store.write_gate.fenced = False
+    # transport failure (nothing listening): counted skip, no exception
+    dead = LeaderElector(
+        RESTClient("http://127.0.0.1:9", timeout=0.5),
+        LeaderElectionConfig(
+            identity="dead",
+            lease_duration=4.0,
+            renew_deadline=3.0,
+            retry_period=0.5,
+        ),
+        on_started_leading=lambda: None,
+    )
+    before = skips()
+    assert dead._try_acquire_or_renew() is False
+    assert skips() == before + 1
+    # graceful release over REST: holder cleared, transitions bumped
+    t0 = client.get("leases", "kube-system", "kube-scheduler").lease_transitions
+    assert elector.release() is True
+    lease = client.get("leases", "kube-system", "kube-scheduler")
+    assert lease.holder_identity == ""
+    assert lease.lease_transitions == t0 + 1
