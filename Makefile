@@ -31,6 +31,14 @@
 
 PY ?= python
 
+# Persistent JAX compilation cache for the chaos/lint targets: safe now
+# that the generational snapshot keeps donation off reader-visible
+# buffers (deserialized donating executables were the reason this was
+# banned — see kubernetes_tpu/utils/compilation_cache.py). One cache dir
+# across every pytest process kills the per-process compile storm.
+JAX_CACHE ?= $(CURDIR)/.jax_cache
+CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
+
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
 	lint-slow lint-static lint
@@ -39,7 +47,7 @@ test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
 chaos: lint
-	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_consensus.py \
+	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py tests/test_consensus.py \
 		tests/test_replication_quorum.py \
 		tests/test_replication.py tests/test_chaos.py \
 		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
@@ -49,23 +57,23 @@ chaos: lint
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
-	$(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_device.py -q
+	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_device.py -q
 
 chaos-autoscaler:
-	$(PY) -m pytest tests/test_chaos_warmup.py \
+	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py \
 		tests/test_chaos_autoscaler.py -q
 
 chaos-readpath:
-	$(PY) -m pytest tests/test_chaos_readpath.py tests/test_watchcache.py -q
+	$(CACHED) $(PY) -m pytest tests/test_chaos_readpath.py tests/test_watchcache.py -q
 
 chaos-ha:
-	$(PY) -m pytest tests/test_chaos_ha.py -q
+	$(CACHED) $(PY) -m pytest tests/test_chaos_ha.py -q
 
 chaos-net:
-	$(PY) -m pytest tests/test_chaos_net.py -q
+	$(CACHED) $(PY) -m pytest tests/test_chaos_net.py -q
 
 lint-slow:
-	$(PY) scripts/check_slow_markers.py
+	$(CACHED) $(PY) scripts/check_slow_markers.py
 
 lint-static:
 	$(PY) scripts/graftlint

@@ -297,6 +297,13 @@ def main() -> int:
                 "e2e_p50_ms": round(res.e2e_p50_ms, 3),
                 "e2e_p99_ms": round(res.e2e_p99_ms, 3),
                 "algo_p99_ms": round(res.algo_p99_ms, 3),
+                # generational wave pipelining: configured depth + the
+                # high-water mark of batches concurrently in flight (≥2
+                # = waves demonstrably overlapped instead of serializing)
+                "pipeline": {
+                    "depth": res.pipeline_depth,
+                    "max_waves_inflight": res.max_waves_inflight,
+                },
                 "stage_breakdown_s": {
                     "encode_total": round(res.encode_total_s, 3),
                     "kernel_total": round(res.kernel_total_s, 3),
@@ -328,6 +335,8 @@ def main() -> int:
                         "pod_p99_ms": round(lat.pod_p99_ms, 3),
                         "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
                         "scheduled": lat.scheduled,
+                        "pipeline_depth": lat.pipeline_depth,
+                        "max_waves_inflight": lat.max_waves_inflight,
                     }
                     if lat is not None
                     else None
@@ -360,6 +369,14 @@ def main() -> int:
         "platform": detail.get("platform", "unknown"),
         "detail_file": detail_path,
     }
+    # first-class headline fields (ISSUE 11): steady-state pod p99 and
+    # pipeline depth/occupancy — the latency assault's acceptance metrics
+    lat_d = detail.get("steady_state_latency") or {}
+    if lat_d:
+        compact["steady_pod_p99_ms"] = lat_d.get("pod_p99_ms")
+    pipe_d = detail.get("pipeline") or {}
+    if pipe_d:
+        compact["pipeline"] = pipe_d
     asc = detail.get("autoscaler") or {}
     if asc:
         # one compact autoscaler line item: 1k pending pods, 4 candidate

@@ -167,8 +167,10 @@ class WhatIfSimulator:
             row: node.metadata.name
             for node, row in zip(virtual_nodes, vrows)
         }
-        # the overlay snapshot shares no buffers with the live one, so the
-        # (non-donating) kernel run needs no device_lock
+        # the overlay snapshot shares no buffers with the live one (built
+        # by the alias-free scatter under a generation pin), so the
+        # (non-donating) kernel run needs no lease at all — it may overlap
+        # wave launches and audits freely
         kern = make_schedule_batch(v_cap, self.hard_w)
         self._rng, sub = jax.random.split(self._rng)
         res = kern(snap, eb.batch, self._weights, sub)

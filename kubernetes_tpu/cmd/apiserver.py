@@ -26,6 +26,15 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
     )
+    # the apiserver itself is host-only, but control-plane helpers it
+    # hosts (e.g. an in-process scheduler replica in tests, tooling that
+    # imports through this entry) share the process: point JAX at the
+    # persistent compilation cache up front so any kernel they compile
+    # lands in (or comes from) the shared cache. Safe post-generational
+    # snapshot; KTPU_NO_COMPILATION_CACHE=1 opts out.
+    from ..utils.compilation_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     from ..apiserver.rest import serve
 
     srv, port, _store = serve(

@@ -263,6 +263,14 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # persistent compilation cache, on by default: the generational
+    # snapshot made donation safe against deserialized executables (see
+    # utils/compilation_cache.py), so a replica restart or a standby
+    # promotion deserializes its kernels instead of paying the cold-start
+    # compile storm. KTPU_NO_COMPILATION_CACHE=1 opts out.
+    from ..utils.compilation_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     cfg = (
         load_config_file(args.config)
         if args.config

@@ -32,6 +32,22 @@ Key design moves (SURVEY.md §7 stage 2):
   this is the "incrementally-maintained device-side count structure" that
   replaces the existing-pods half of InterPodAffinity's PreFilter.
 
+* **Generational double-buffering (pin → donate → retire).** The device
+  snapshot is a sequence of immutable *generations*. Readers (the
+  anti-entropy audit's row gather, the autoscaler's what-if overlay, the
+  chaos fault injector) take a `pin_generation()` lease on the current
+  generation; writers (the wave launch's donating kernel, flush's row
+  scatters) advance it through a `donation_lease()`: the lease seals the
+  live generation, and — when a reader holds a pin, or the generation
+  shares buffers with a pinned ancestor — hands the donating program a
+  fresh COPY instead, so the pinned buffers stay intact until their pin
+  count drains and the generation retires. This replaces the old
+  process-wide `device_lock`: a gather no longer serializes against a
+  wave launch (the round-8 donation/audit deadlock shape is now legal
+  concurrency), multiple waves pipeline in flight, and — because a
+  donating program can never alias buffers a reader observes — the
+  persistent JAX compilation cache is safe to enable everywhere.
+
 Units: cpu in millicores, memory/ephemeral-storage quantised to KiB
 (requests ceil, allocatable floor — conservative), pods/extended raw counts;
 all int32. Nodes with >2 TiB of a single resource clamp to int32 max.
@@ -55,6 +71,7 @@ logger = logging.getLogger("kubernetes_tpu.ops.encoding")
 from ..api import objects as v1
 from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, ResourceList
 from ..testing.lockgraph import named_lock
+from ..utils.metrics import metrics
 from ..api.selectors import (
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
@@ -106,6 +123,185 @@ N_BASE_RES = 4
 
 _KIB = 1024
 I32_MAX = np.int32(2**31 - 1)
+
+# -- snapshot generation lifecycle metrics (pin → donate → retire) ----------
+GAUGE_GEN_CURRENT = "snapshot_generation_current"
+GAUGE_GEN_PINNED = "snapshot_generation_pinned_readers"
+GAUGE_GEN_RETIRING = "snapshot_generation_retiring"
+COUNTER_GEN_RETIRED = "snapshot_generation_retired_total"
+COUNTER_GEN_COPY_ON_PIN = "snapshot_generation_copy_on_pin_total"
+COUNTER_GEN_RETIRE_STALLS = "snapshot_generation_retire_stalls_total"
+HIST_GEN_RETIRE_LATENCY = "snapshot_generation_retire_latency_seconds"
+# the histogram above serves /metrics quantiles; this gauge mirrors the
+# most recent retirement's latency into the SIGUSR2 dataplane dump
+# (which renders gauges/counters, not histograms)
+GAUGE_GEN_LAST_RETIRE_LATENCY = "snapshot_generation_last_retire_latency_seconds"
+
+# a superseded-but-still-pinned generation older than this is a stuck pin
+# (a reader leaked its lease): reported once per generation, observable in
+# /metrics and the SIGUSR2 dataplane dump instead of silently holding HBM
+RETIRE_STALL_AFTER_S = 30.0
+
+
+class SnapshotGeneration:
+    """One immutable HBM buffer set of the double-buffered snapshot.
+
+    ``pins`` counts readers holding a :class:`GenerationLease`; ``sealed``
+    marks a donor mid-advance (new pins and new donors wait the few µs
+    until the successor installs); ``shared_parent`` points at a still-
+    live predecessor whose buffers this generation reuses (the reshape-
+    merge upload keeps unreshaped fields) — donation must treat the pair
+    as one pin scope. ``superseded_at`` stamps retirement latency."""
+
+    __slots__ = (
+        "gen_id", "snap", "pins", "sealed", "shared_parent",
+        "superseded_at", "stall_reported",
+    )
+
+    def __init__(self, gen_id: int, snap: DeviceSnapshot, shared_parent=None):
+        self.gen_id = gen_id
+        self.snap = snap
+        self.pins = 0
+        self.sealed = False
+        self.shared_parent = shared_parent
+        self.superseded_at: Optional[float] = None
+        self.stall_reported = False
+
+
+class GenerationLease:
+    """Reader pin on the current snapshot generation.
+
+    While held, the pinned generation's buffers are never donated: a wave
+    launch (or flush scatter) arriving mid-lease advances through a fresh
+    copy instead (`snapshot_generation_copy_on_pin_total`). ``snap`` is
+    None when no device snapshot exists yet."""
+
+    __slots__ = ("_enc", "_gen", "gen_id", "snap")
+
+    def __init__(self, enc: "SnapshotEncoder"):
+        self._enc = enc
+        self._gen = None
+        self.gen_id = -1
+        self.snap: Optional[DeviceSnapshot] = None
+
+    def __enter__(self) -> "GenerationLease":
+        enc = self._enc
+        with enc._gen_lock:
+            # a donor sealed the live generation and is mid-install
+            # (microseconds — dispatch is async); bounded waits so a donor
+            # that died mid-advance can never park readers forever
+            while enc._gen is not None and enc._gen.sealed:
+                enc._gen_lock.wait(timeout=0.05)
+            gen = enc._gen
+            if gen is None:
+                return self
+            gen.pins += 1
+            self._gen = gen
+            self.gen_id = gen.gen_id
+            self.snap = gen.snap
+            enc._check_retire_stalls_locked()
+            enc._publish_gen_gauges_locked()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        gen, self._gen = self._gen, None
+        self.snap = None
+        if gen is not None:
+            self._enc._unpin(gen)
+
+
+class DonationLease:
+    """Writer-side generation advance: seal → dispatch → install.
+
+    ``__enter__`` seals the live generation and yields ``.snap`` — the
+    sealed buffers when nothing pins them, a fresh copy when a reader
+    does (the double-buffer move: generation N keeps serving its pinned
+    readers while the donor consumes a private copy that becomes N+1).
+    The caller runs its donating (or alias-free, ``donating=False``)
+    program and assigns ``.result``; ``__exit__`` installs the result as
+    the next live generation and retires the predecessor once its pins
+    drain. On a failed dispatch an in-place donation leaves the buffers
+    unknowable, so the generation is dropped and the next flush re-uploads
+    from the host masters; a copied/alias-free attempt just unseals."""
+
+    __slots__ = (
+        "_enc", "_base", "snap", "copied", "result", "donating", "shared",
+    )
+
+    def __init__(self, enc: "SnapshotEncoder", donating: bool = True):
+        self._enc = enc
+        self._base = None
+        self.snap: Optional[DeviceSnapshot] = None
+        self.copied = False
+        self.result: Optional[DeviceSnapshot] = None
+        self.donating = donating
+        # caller sets True when .result reuses some of the base's buffers
+        # (the reshape-merge upload): the installed generation then keeps
+        # a shared-buffer tie to its pinned predecessor
+        self.shared = False
+
+    def __enter__(self) -> "DonationLease":
+        enc = self._enc
+        with enc._gen_lock:
+            while enc._gen is not None and enc._gen.sealed:
+                enc._gen_lock.wait(timeout=0.05)
+            gen = enc._gen
+            if gen is None:
+                raise RuntimeError(
+                    "no live snapshot generation to advance (flush first)"
+                )
+            gen.sealed = True
+            self._base = gen
+            try:
+                enc._check_retire_stalls_locked()
+                pinned = gen.pins > 0 or (
+                    gen.shared_parent is not None
+                    and gen.shared_parent.pins > 0
+                )
+                if self.donating and pinned:
+                    # readers pin generation N: hand the donor a fresh copy
+                    # so the pinned buffers survive until the pins drain
+                    self.snap = _copy_snapshot(gen.snap)
+                    self.copied = True
+                    metrics.inc(COUNTER_GEN_COPY_ON_PIN)
+                else:
+                    self.snap = gen.snap
+            except BaseException:
+                # a failed post-seal step (e.g. the copy dispatch dying on
+                # device loss) raises out of __enter__, so __exit__ never
+                # runs — unseal HERE or every later pin/lease/install
+                # waits on the sealed generation forever. The copy is
+                # non-donating, so the sealed buffers are still intact.
+                gen.sealed = False
+                self._base = None
+                enc._gen_lock.notify_all()
+                raise
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        enc = self._enc
+        with enc._gen_lock:
+            base = self._base
+            if et is not None or self.result is None:
+                if self.donating and not self.copied:
+                    # the donating program may have consumed the sealed
+                    # buffers: content unknowable, force a full re-upload
+                    if enc._gen is base:
+                        enc._gen = None
+                    enc._full_upload = True
+                    enc._content_invalid = True
+                elif base is not None:
+                    base.sealed = False
+                enc._gen_lock.notify_all()
+                enc._publish_gen_gauges_locked()
+                return False
+            enc._install_locked(
+                self.result,
+                base,
+                consumed=self.donating and not self.copied,
+                shared_with_base=self.shared,
+            )
+        return False
 
 
 def zpad(a: np.ndarray, n: int) -> np.ndarray:
@@ -372,15 +568,17 @@ class SnapshotEncoder:
         self._pods: Dict[int, Dict[str, _PodEntry]] = {}  # row -> pod-key -> entry
 
         self._alloc_masters()
-        # serializes every device entry point that can DONATE the snapshot
-        # buffers (flush's scatter, the wave launch) against concurrent
-        # readers (the anti-entropy audit's row gather): a donation racing
-        # a read deadlocks the CPU client and poisons every later jax call
-        # in the process. LEAF lock — never acquire any other lock while
-        # holding it (the cache lock, when needed, is taken FIRST). The
-        # leaf contract is machine-checked: the named wrapper feeds the
-        # lock-order watchdog (testing/lockgraph.py) during chaos runs.
-        self.device_lock = named_lock("encoder.device_lock")
+        # generation bookkeeping lock: guards ONLY the pin/seal/install
+        # protocol (a few integer fields + list membership), never held
+        # across a blocking device readback. LEAF lock — never acquire any
+        # other lock while holding it (the cache lock, when needed, is
+        # taken FIRST). Named so the lock-order watchdog
+        # (testing/lockgraph.py) sees every acquisition during chaos runs;
+        # a Condition so sealed-generation waits are event-driven.
+        self._gen_lock = threading.Condition(named_lock("encoder.gen_lock"))
+        self._gen: Optional[SnapshotGeneration] = None  # live generation
+        self._retiring: List[SnapshotGeneration] = []  # superseded, pinned
+        self._next_gen_id = 1
         self._dirty_rows: set = set()
         # rows a failure path could not keep host/device convergent on
         # (e.g. a mid-wave encoder exception after the kernel committed):
@@ -392,12 +590,148 @@ class SnapshotEncoder:
         # means "shapes may have grown" and flush re-uploads per-field.
         self._content_invalid = True
         self._globals_dirty = False  # non-row fields (band_prio, eterm meta)
-        self._device: Optional[DeviceSnapshot] = None
         # multi-chip placement: snapshot sharding pytree + replicated spec
         # (set by the scheduler when it owns a device mesh; None = one chip)
         self._snap_shardings: Optional[DeviceSnapshot] = None
         self._rep_sharding = None
-        self.generation = 0  # bumped on every mutation
+        self.generation = 0  # host-mutation counter, bumped on every change
+
+    # -- generation table (pin → donate → retire) ----------------------------
+
+    @property
+    def _device(self) -> Optional[DeviceSnapshot]:
+        """The live generation's snapshot (compat read surface: tests and
+        diagnostics check `enc._device is None` / diff its fields)."""
+        gen = self._gen
+        return None if gen is None else gen.snap
+
+    @property
+    def device_generation(self) -> int:
+        """Monotonic id of the live device generation (-1 before first
+        upload)."""
+        gen = self._gen
+        return -1 if gen is None else gen.gen_id
+
+    def pin_generation(self) -> GenerationLease:
+        """Reader lease on the current generation: while held, a wave
+        launch cannot donate (consume) the pinned buffers — it advances
+        through a copy instead. The lease-scoped snapshot is therefore
+        safe to gather from concurrently with donating launches."""
+        return GenerationLease(self)
+
+    def donation_lease(self, donating: bool = True) -> DonationLease:
+        """Writer lease that advances the generation; see
+        :class:`DonationLease`. Every donating dispatch in the tree must
+        sit lexically inside one of these blocks (graftlint's donation
+        pass enforces it — the successor of the retired `device_lock`
+        discipline)."""
+        return DonationLease(self, donating=donating)
+
+    def _unpin(self, gen: SnapshotGeneration) -> None:
+        with self._gen_lock:
+            gen.pins -= 1
+            if gen.pins <= 0 and gen is not self._gen:
+                try:
+                    self._retiring.remove(gen)
+                except ValueError:
+                    pass
+                self._retire_locked(gen)
+                self._gen_lock.notify_all()
+            self._publish_gen_gauges_locked()
+
+    def _install_locked(
+        self,
+        snap: DeviceSnapshot,
+        base: Optional[SnapshotGeneration],
+        consumed: bool,
+        shared_with_base: bool = False,
+    ) -> None:
+        """Install `snap` as the next live generation (caller holds
+        `_gen_lock`). `consumed`: base's buffers were donated in place —
+        the generation object is dead on arrival (seal guarantees it had
+        zero pins). `shared_with_base`: the new generation reuses some of
+        base's buffers (reshape-merge), so donation treats the pair as
+        one pin scope until base retires."""
+        now = time.monotonic()
+        parent = None
+        if base is not None:
+            base.superseded_at = now
+            if shared_with_base:
+                if base.pins > 0:
+                    parent = base
+                elif (
+                    base.shared_parent is not None
+                    and base.shared_parent.pins > 0
+                ):
+                    # the tie must survive CHAINED sharing: base reuses a
+                    # still-pinned grandparent's buffers (two capacity
+                    # growths while one reader pins), so the new
+                    # generation's kept fields are the grandparent's —
+                    # dropping the tie here would let a later donation
+                    # consume buffers that pinned reader still gathers
+                    parent = base.shared_parent
+            if consumed or base.pins <= 0:
+                self._retire_locked(base)
+            else:
+                self._retiring.append(base)
+        self._gen = SnapshotGeneration(
+            self._next_gen_id, snap, shared_parent=parent
+        )
+        self._next_gen_id += 1
+        self._gen_lock.notify_all()
+        self._publish_gen_gauges_locked()
+
+    def _install_generation(
+        self, snap: DeviceSnapshot, shared_with_base: bool = False
+    ) -> None:
+        """Install a freshly-uploaded snapshot (device_put — fresh
+        buffers unless shared_with_base) as the live generation."""
+        with self._gen_lock:
+            while self._gen is not None and self._gen.sealed:
+                self._gen_lock.wait(timeout=0.05)
+            self._install_locked(
+                snap, self._gen, consumed=False,
+                shared_with_base=shared_with_base,
+            )
+
+    def _retire_locked(self, gen: SnapshotGeneration) -> None:
+        """Buffer set leaves service: count it, stamp retirement latency,
+        release any child's shared-buffer tie to it."""
+        if gen.superseded_at is not None:
+            latency = max(0.0, time.monotonic() - gen.superseded_at)
+            metrics.observe(HIST_GEN_RETIRE_LATENCY, latency)
+            metrics.set_gauge(GAUGE_GEN_LAST_RETIRE_LATENCY, latency)
+        metrics.inc(COUNTER_GEN_RETIRED)
+        children = list(self._retiring)
+        if self._gen is not None:
+            children.append(self._gen)
+        for child in children:
+            if child.shared_parent is gen:
+                child.shared_parent = None
+
+    def _check_retire_stalls_locked(self) -> None:
+        now = time.monotonic()
+        for gen in self._retiring:
+            if gen.stall_reported or gen.superseded_at is None:
+                continue
+            if now - gen.superseded_at > RETIRE_STALL_AFTER_S:
+                gen.stall_reported = True
+                metrics.inc(COUNTER_GEN_RETIRE_STALLS)
+                logger.error(
+                    "snapshot generation %d superseded %.1f s ago still "
+                    "holds %d reader pin(s): a lease leaked — its HBM "
+                    "buffers cannot retire",
+                    gen.gen_id, now - gen.superseded_at, gen.pins,
+                )
+
+    def _publish_gen_gauges_locked(self) -> None:
+        gen = self._gen
+        pins = sum(g.pins for g in self._retiring)
+        if gen is not None:
+            pins += gen.pins
+            metrics.set_gauge(GAUGE_GEN_CURRENT, float(gen.gen_id))
+        metrics.set_gauge(GAUGE_GEN_PINNED, float(pins))
+        metrics.set_gauge(GAUGE_GEN_RETIRING, float(len(self._retiring)))
 
     # -- master allocation / growth ---------------------------------------
 
@@ -1086,22 +1420,28 @@ class SnapshotEncoder:
         in ONE transfer (the audit's read side). None when no device
         snapshot exists yet.
 
+        Runs under a generation pin, NOT a lock: the pinned generation's
+        buffers cannot be donated while the lease is held (a concurrent
+        wave launch advances through a copy), so this gather may overlap
+        a donating launch freely — the exact round-8 interleaving that
+        used to deadlock the CPU client is now legal.
+
         The gather index is padded to the scatter program sizes (16/1024,
         chunking larger sets): a distinct XLA program per sample size
         would compile on nearly every audit pass (the round-robin window
         tail and the suspect set both vary), each compile seconds of
         cache-lock hold."""
-        if self._device is None or not rows:
+        if not rows:
             return None
         out: Dict[str, np.ndarray] = {}
-        with self.device_lock:
-            # barrier before reading: the snapshot may be the output of a
-            # donation-bearing scatter still in flight, and a gather
-            # dispatched against those aliased buffers can read rows the
-            # scatter hasn't written yet (observed with persistent-cache
-            # deserialized executables on CPU: the audit's confirm fetch
-            # saw pre-repair values and escalated to a spurious rebuild)
-            jax.block_until_ready(self._device)
+        with self.pin_generation() as lease:
+            if lease.snap is None:
+                return None
+            # barrier before reading: the pinned generation may be the
+            # output of a scatter still in flight; waiting on the pinned
+            # buffers (ours by lease — no aliasing possible) keeps the
+            # audit's confirm fetch ordered after the repair it confirms
+            jax.block_until_ready(lease.snap)
             for i in range(0, len(rows), _SCATTER_PAD_BIG):
                 chunk = rows[i : i + _SCATTER_PAD_BIG]
                 pad = (
@@ -1112,7 +1452,7 @@ class SnapshotEncoder:
                 # pad rows repeat row 0 (cheap, in range); sliced off below
                 idx = np.zeros(pad, np.int32)
                 idx[: len(chunk)] = chunk
-                gathered = jax.device_get(_gather_rows(self._device, idx))
+                gathered = jax.device_get(_gather_rows(lease.snap, idx))
                 for name, arr in gathered.items():
                     arr = np.asarray(arr)[: len(chunk)]
                     out[name] = (
@@ -1159,6 +1499,9 @@ class SnapshotEncoder:
         cache). Global (non-row) fields changed without any dirty row
         (band allocation, eterm interning) refresh via a row-less scatter.
 
+        Every device write advances the snapshot generation through a
+        donation lease (seal → dispatch → install); concurrent readers
+        keep gathering from their pinned (previous) generation throughout.
         `donate=False` routes row scatters through the alias-free variant
         (`_scatter_rows_safe`) — the anti-entropy audit uses it so a repair
         can never be corrupted by the in-place update path it is auditing.
@@ -1166,8 +1509,7 @@ class SnapshotEncoder:
         t0 = time.monotonic()
         self._flush_what = None
         try:
-            with self.device_lock:
-                return self._flush_inner(donate=donate)
+            return self._flush_inner(donate=donate)
         finally:
             dt = time.monotonic() - t0
             if dt > 0.2:
@@ -1175,19 +1517,20 @@ class SnapshotEncoder:
                     "slow flush %.0f ms: %s", dt * 1e3, self._flush_what
                 )
 
-    def _flush_inner(self, donate: bool = True) -> DeviceSnapshot:  # graftlint: holds-device-lock
+    def _flush_inner(self, donate: bool = True) -> DeviceSnapshot:
         masters = self._masters()
-        if self._device is None or self._content_invalid:
+        if self._gen is None or self._content_invalid:
             self._flush_what = "full upload (first use or content invalid)"
             if self._snap_shardings is not None:
-                self._device = jax.device_put(masters, self._snap_shardings)
+                snap = jax.device_put(masters, self._snap_shardings)
             else:
-                self._device = jax.device_put(jax.tree.map(jnp.asarray, masters))
+                snap = jax.device_put(jax.tree.map(jnp.asarray, masters))
             self._full_upload = False
             self._content_invalid = False
             self._globals_dirty = False
             self._dirty_rows.clear()
-            return self._device
+            self._install_generation(snap)
+            return snap
         if self._full_upload:
             # capacity growth (_grow): device content is still valid, only
             # some field SHAPES changed. Re-upload exactly those fields from
@@ -1195,23 +1538,29 @@ class SnapshotEncoder:
             # a t_cap bump mid-burst then costs one [N, t_cap] transfer, not
             # the full ~2 s snapshot re-upload. Dirty rows stay pending: the
             # scatter below applies them to the kept fields (for re-uploaded
-            # fields it rewrites values already present — harmless).
-            merged = {}
-            reshaped = []
-            for name in DeviceSnapshot._fields:
-                m = getattr(masters, name)
-                d = getattr(self._device, name)
-                if tuple(d.shape) != m.shape:
-                    reshaped.append(name)
-                    if self._snap_shardings is not None:
-                        merged[name] = jax.device_put(
-                            m, getattr(self._snap_shardings, name)
-                        )
+            # fields it rewrites values already present — harmless). The
+            # merged generation SHARES the kept buffers with its
+            # predecessor, so it installs shared_with_base: donation
+            # treats the pair as one pin scope until the predecessor
+            # retires.
+            with self.donation_lease(donating=False) as dl:
+                merged = {}
+                reshaped = []
+                for name in DeviceSnapshot._fields:
+                    m = getattr(masters, name)
+                    d = getattr(dl.snap, name)
+                    if tuple(d.shape) != m.shape:
+                        reshaped.append(name)
+                        if self._snap_shardings is not None:
+                            merged[name] = jax.device_put(
+                                m, getattr(self._snap_shardings, name)
+                            )
+                        else:
+                            merged[name] = jax.device_put(jnp.asarray(m))
                     else:
-                        merged[name] = jax.device_put(jnp.asarray(m))
-                else:
-                    merged[name] = d
-            self._device = DeviceSnapshot(**merged)
+                        merged[name] = d
+                dl.result = DeviceSnapshot(**merged)
+                dl.shared = True  # kept fields are the base's own buffers
             self._full_upload = False
             self._flush_what = f"reshape upload of {reshaped}"
         if not self._dirty_rows:
@@ -1233,25 +1582,31 @@ class SnapshotEncoder:
             f"{(self._flush_what + ' + ') if self._flush_what else ''}"
             f"scatter of {len(rows)} dirty rows"
         )
-        first = True
-        i = 0
-        while first or i < len(rows):
-            first = False
-            chunk = rows[i : i + _SCATTER_PAD_BIG]
-            i += _SCATTER_PAD_BIG
-            self._scatter_chunk(masters, chunk, donate=donate)
-        return self._device
+        with self.donation_lease(donating=donate) as dl:
+            snap = dl.snap
+            first = True
+            i = 0
+            while first or i < len(rows):
+                first = False
+                chunk = rows[i : i + _SCATTER_PAD_BIG]
+                i += _SCATTER_PAD_BIG
+                snap = self._scatter_chunk(
+                    snap, masters, chunk, donate=donate
+                )
+            dl.result = snap
+        return snap
 
-    def _scatter_chunk(  # graftlint: holds-device-lock
+    def _scatter_chunk(  # graftlint: holds-generation-lease
         self,
+        snap: DeviceSnapshot,
         masters: DeviceSnapshot,
         rows: list,
         pad: Optional[int] = None,
         donate: bool = True,
-    ) -> None:
-        # callers hold device_lock (enforced by graftlint's donation
-        # pass at every call site): the donate=True path dispatches the
-        # donating scatter against the live snapshot buffers
+    ) -> DeviceSnapshot:
+        # callers hold a donation lease (enforced by graftlint's donation
+        # pass at every call site): `snap` is lease-scoped — the sealed
+        # live buffers, or the lease's private copy when readers pin them
         if pad is None:
             pad = (
                 _SCATTER_PAD_SMALL
@@ -1281,28 +1636,35 @@ class SnapshotEncoder:
         else:
             idx_d, updates_d = jax.device_put((idx, updates))
         scatter = _scatter_rows if donate else _scatter_rows_safe
-        self._device = scatter(self._device, idx_d, updates_d)
+        return scatter(snap, idx_d, updates_d)
 
     def warm_scatter_programs(self) -> None:
         """Compile the scatter pad variants out-of-window (no-op scatters:
         all indices OOB-dropped), donating AND alias-free, plus the two
-        padded audit gather programs — 6 compiles at bring-up instead of
-        mid-burst (or mid-audit under the cache lock: the first audit
-        pass would otherwise pay the gather compiles while holding it).
-        Call at component start, after the snapshot exists."""
-        if self._device is None:
+        padded audit gather programs and the copy-on-pin program — 7
+        compiles at bring-up instead of mid-burst (or mid-audit under the
+        cache lock: the first audit pass would otherwise pay the gather
+        compiles while holding it). Call at component start, after the
+        snapshot exists."""
+        if self._gen is None:
             self.flush()
-        with self.device_lock:
-            masters = self._masters()
-            for donate in (True, False):
-                self._scatter_chunk(
-                    masters, [], pad=_SCATTER_PAD_SMALL, donate=donate
+        masters = self._masters()
+        for donate in (True, False):
+            with self.donation_lease(donating=donate) as dl:
+                snap = self._scatter_chunk(
+                    dl.snap, masters, [], pad=_SCATTER_PAD_SMALL,
+                    donate=donate,
                 )
-                self._scatter_chunk(
-                    masters, [], pad=_SCATTER_PAD_BIG, donate=donate
+                dl.result = self._scatter_chunk(
+                    snap, masters, [], pad=_SCATTER_PAD_BIG, donate=donate
                 )
-            for pad in (_SCATTER_PAD_SMALL, _SCATTER_PAD_BIG):
-                _gather_rows(self._device, np.zeros(pad, np.int32))
+        with self.pin_generation() as lease:
+            if lease.snap is not None:
+                for pad in (_SCATTER_PAD_SMALL, _SCATTER_PAD_BIG):
+                    _gather_rows(lease.snap, np.zeros(pad, np.int32))
+                # the copy program backs copy-on-pin donation: compile it
+                # here, not the first time a reader overlaps a wave launch
+                _copy_snapshot(lease.snap)
 
     def set_sharding(self, snap_shardings, replicated_sharding) -> None:
         """Adopt multi-chip placement (parallel/mesh.snapshot_shardings):
@@ -1341,16 +1703,22 @@ class SnapshotEncoder:
         self._full_upload = True
         self._content_invalid = True
 
-    def set_device_snapshot(self, snap: DeviceSnapshot) -> None:
-        """Install a kernel-returned snapshot (occupancy committed on device).
+    def swap_live_snapshot(self, snap: DeviceSnapshot) -> None:
+        """Testing/fault-injection hook: install `snap` — typically the
+        live snapshot with one field replaced — as a new generation that
+        SHARES the remaining buffers with its predecessor (so a donating
+        advance copies while any pin on the predecessor drains). The
+        production write paths never call this; kernel outputs install
+        through the wave launch's donation lease.
 
-        The wave kernel donates the input snapshot and returns it with batch
-        commits applied; the scheduler replays the same commits into the host
-        masters (via cache assume → add_pod), so a subsequent row-set flush
-        writes identical values — device and host stay convergent without a
-        delta-add protocol, as long as replay happens before the next flush
-        (the synchronous cycle guarantees it)."""
-        self._device = snap
+        (Design note, kept from the old `set_device_snapshot`: the wave
+        kernel donates the input snapshot and returns it with batch
+        commits applied; the scheduler replays the same commits into the
+        host masters via cache assume → add_pod, so a subsequent row-set
+        flush writes identical values — device and host stay convergent
+        without a delta-add protocol, as long as replay happens before
+        the next flush.)"""
+        self._install_generation(snap, shared_with_base=True)
 
     # -- what-if simulation overlay (autoscaler) -----------------------------
 
@@ -1375,15 +1743,16 @@ class SnapshotEncoder:
         for K more rows (the caller falls back to skipping the pass —
         growing n_cap here would recompile every kernel variant mid-run).
 
-        Isolation contract (the PR-4 donation discipline): the live
-        snapshot is never mutated and never donated — the overlay is
-        produced by the alias-free `_scatter_rows_safe` program, so every
-        buffer of the returned snapshot is fresh; the overlay is never
-        installed as the live snapshot (`set_device_snapshot` is not
-        called on it) and must never be handed to a donating program. The
-        device section holds `device_lock`: the scatter READS the live
-        buffers, and a read racing a wave launch's donation deadlocks the
-        CPU client process-wide.
+        Isolation contract (the generational successor of the PR-4
+        donation discipline): the live snapshot is never mutated and
+        never donated — the overlay is produced by the alias-free
+        `_scatter_rows_safe` program, so every buffer of the returned
+        snapshot is fresh; the overlay is never installed as a live
+        generation and must never be handed to a donating program. The
+        device section holds a generation PIN, not a lock: the scatter
+        READS the pinned generation's buffers, which a concurrent wave
+        launch cannot donate (it advances through a copy instead), so a
+        what-if pass may overlap wave launches freely.
 
         Caller must hold the cache lock (vocab interning + the masters
         read must be consistent with row_names)."""
@@ -1396,14 +1765,14 @@ class SnapshotEncoder:
         # change), which must settle before the base snapshot is chosen
         encoded = [self.encode_node_row_values(n) for n in virtual_nodes]
         masters = self._masters()
-        with self.device_lock:
-            if self._device is not None and not self.has_pending_updates:
+        with self.pin_generation() as lease:
+            if lease.snap is not None and not self.has_pending_updates:
                 # steady state: the live snapshot is current — the overlay
                 # costs one padded row scatter, not a full upload. (When a
                 # wave pipeline is in flight the device may additionally
                 # hold kernel commits the masters haven't replayed yet;
                 # the device view is then the MORE current base.)
-                base = self._device
+                base = lease.snap
             elif self._snap_shardings is not None:
                 base = jax.device_put(masters, self._snap_shardings)
             else:
@@ -1492,11 +1861,32 @@ def _scatter_rows_impl(
 _scatter_rows = functools.partial(jax.jit, donate_argnums=(0,))(_scatter_rows_impl)
 
 # repair path: NO donation. The anti-entropy auditor's settle/repair
-# scatters go through this variant: a donating executable deserialized
-# from a persistent compilation cache (JAX_COMPILATION_CACHE_DIR) has been
-# observed writing garbage into non-targeted rows on the CPU backend —
-# the repairer must not be able to corrupt the very state it is fixing,
+# scatters go through this variant: the PR-4 corruption (a donating
+# executable deserialized from a persistent compilation cache writing
+# garbage into non-targeted rows on CPU) hit exactly when donation
+# aliased buffers a concurrent reader observed — gone structurally now
+# that donation only ever consumes lease-private buffers, but the
+# repairer still must not use the in-place update path it is auditing,
 # so it pays the copy and gets fresh, alias-free output buffers. The
 # marker below is machine-checked: graftlint fails if a donation keyword
 # ever lands on this definition.
 _scatter_rows_safe = jax.jit(_scatter_rows_impl)  # graftlint: alias-safe
+
+
+def _copy_snapshot_impl(snap: DeviceSnapshot) -> DeviceSnapshot:
+    # arithmetic identities, not `lambda x: x`: a jitted identity can
+    # alias output to input, and an aliased "copy" would hand the donor
+    # the very buffers the pin protects. Real ops allocate fresh output
+    # buffers (no donation on this program, enforced by the marker below).
+    def cp(a):
+        if a.dtype == jnp.bool_:
+            return jnp.logical_or(a, jnp.zeros((), jnp.bool_))
+        return a + jnp.zeros((), a.dtype)
+
+    return jax.tree.map(cp, snap)
+
+
+# copy-on-pin: when a reader pins generation N, a donating wave launch
+# consumes a fresh copy instead of the pinned buffers (DonationLease).
+# NOT donating by construction — the whole point is fresh output buffers.
+_copy_snapshot = jax.jit(_copy_snapshot_impl)  # graftlint: alias-safe

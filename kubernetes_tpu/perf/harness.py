@@ -49,6 +49,12 @@ class BenchResult:
     kernel_cycle_p50_ms: float = 0.0
     kernel_cycle_p99_ms: float = 0.0
     kernel_per_pod_ms: float = 0.0
+    # wave pipelining on the generational snapshot: configured depth and
+    # the high-water mark of batches concurrently in flight (≥2 is the
+    # pipelined-wave acceptance bar — one wave's device time overlapping
+    # another's readback/bind instead of serializing on a device lock)
+    pipeline_depth: int = 0
+    max_waves_inflight: int = 0
     samples: List[int] = field(default_factory=list)  # scheduled count / 100ms
 
     def to_dict(self) -> dict:
@@ -197,6 +203,10 @@ def _run_benchmark_body(
             if measured_scheduled > 0
             else 0.0
         ),
+        pipeline_depth=sched._pipeline_depth,
+        max_waves_inflight=int(
+            metrics.gauge("scheduler_wave_inflight_max") or 0
+        ),
         samples=samples,
     )
     if not quiet:
@@ -227,6 +237,9 @@ class LatencyResult:
     pod_p99_ms: float
     cycle_p50_ms: float
     cycle_p99_ms: float
+    # wave pipelining over the measured window (see BenchResult)
+    pipeline_depth: int = 0
+    max_waves_inflight: int = 0
 
 
 def run_latency_benchmark(
@@ -261,6 +274,11 @@ def run_latency_benchmark(
         server.create("pods", warm)
         _wait_all_scheduled(server, len(init_pods) + 1, timeout_s)
         metrics.reset()
+        # the reset wiped the inflight-max gauge, but the scheduler only
+        # republishes it when the peak GROWS — zero the peak too, or the
+        # measured window can never re-reach the warmup burst's depth and
+        # max_waves_inflight reads 0 forever
+        sched._wave_inflight_peak = 0
 
         interval = 1.0 / rate_pods_per_s
         t_next = time.monotonic()
@@ -293,6 +311,10 @@ def run_latency_benchmark(
         pod_p99_ms=q(pod_h, 0.99),
         cycle_p50_ms=q(e2e_h, 0.5),
         cycle_p99_ms=q(e2e_h, 0.99),
+        pipeline_depth=sched._pipeline_depth,
+        max_waves_inflight=int(
+            metrics.gauge("scheduler_wave_inflight_max") or 0
+        ),
     )
 
 

@@ -9,7 +9,11 @@ every pod that scores that node until the process restarts.
 
 Each audit pass (period `period_s`, under the cache lock, only while the
 wave pipeline is quiescent — an in-flight batch legitimately holds device
-commits the masters haven't replayed yet):
+commits the masters haven't replayed yet; that gate is SEMANTIC only:
+mechanically the audit's row gather runs under a generation pin
+(`SnapshotEncoder.pin_generation`), which a concurrent donating wave
+launch cannot invalidate — it advances through a copy while the pinned
+generation keeps serving the gather):
 
   1. **settle** — flush pending deltas so any remaining diff is drift, not
      an expected in-flight update;
@@ -38,6 +42,12 @@ Counters/gauges (rendered by /metrics and the SIGUSR2 debugger dump):
   snapshot_audit_passes_total         completed audit passes
   snapshot_audit_drift_rows           rows drifted in the LAST pass (gauge)
   snapshot_audit_consecutive_drift    consecutive drifting passes (gauge)
+
+The generation-lifecycle series (`snapshot_generation_*`, emitted by
+ops/encoding.py: current id, pinned readers, retiring count, retired /
+copy-on-pin / retire-stall counters, retirement-latency histogram) render
+through the same `snapshot_` dump prefix, so a stuck reader pin is
+observable in the SIGUSR2 dump, never a silent HBM leak.
 """
 
 from __future__ import annotations
@@ -291,6 +301,7 @@ def dataplane_health_lines() -> List[str]:
         "kernel_guard_",
         "scheduler_device_",
         "scheduler_mesh_",
+        "scheduler_wave_",
     ):
         for name, labels, value in metrics.snapshot_gauges(prefix):
             annotation = ""

@@ -42,7 +42,8 @@ class SchedulerCache:
         encoding_config: Optional[EncodingConfig] = None,
     ):
         # named for the lock-order watchdog (testing/lockgraph.py): the
-        # cache lock orders BEFORE the encoder's device_lock, everywhere
+        # cache lock orders BEFORE the encoder's generation bookkeeping
+        # lock (encoder.gen_lock), everywhere
         self.lock = named_lock("scheduler.cache")
         self._nodes: Dict[str, NodeInfo] = {}
         self._pod_to_node: Dict[str, str] = {}

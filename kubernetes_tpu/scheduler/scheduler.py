@@ -90,6 +90,13 @@ from . import eventhandlers
 
 logger = logging.getLogger("kubernetes_tpu.scheduler")
 
+# wave pipeline observability: batches launched-but-unresolved right now,
+# the high-water mark since start (the "≥2 waves in flight" acceptance
+# gauge), and the configured/auto-probed pipeline depth
+GAUGE_WAVE_INFLIGHT = "scheduler_wave_inflight"
+GAUGE_WAVE_INFLIGHT_MAX = "scheduler_wave_inflight_max"
+GAUGE_WAVE_PIPELINE_DEPTH = "scheduler_wave_pipeline_depth"
+
 
 @contextmanager
 def _stage_timer(stage: str):
@@ -301,6 +308,7 @@ class Scheduler:
         # of the reference's async binding goroutine overlapping the next
         # scheduleOne, scheduler.go:666, taken to its batch conclusion).
         self._pending: List[_InFlightBatch] = []
+        self._wave_inflight_peak = 0  # high-water mark of len(_pending)
         # resolved by start() when cfg.pipeline_depth == 0 (auto)
         self._pipeline_depth = self.cfg.pipeline_depth or 2
         # auto batch size: TPU backends take the big batch (template-shaped
@@ -309,6 +317,10 @@ class Scheduler:
         self._batch_size = self.cfg.device_batch_size or (
             4096 if jax.default_backend() == "tpu" else 1024
         )
+        # the latency (ragged-tail) kernel bucket: one home for the value
+        # the batch-fill policy, the launch bucketing, and the standby
+        # warm-up all reason about
+        self._small_bucket = min(256, self._batch_size)
         # auto: serial-fidelity refresh where it's free (TPU); the same
         # [P, M] per-wave gathers are ~25% of CPU kernel wall
         self._score_refresh = (
@@ -432,6 +444,9 @@ class Scheduler:
                 )
         if self.cfg.pipeline_depth == 0 and self.cfg.use_device:
             self._pipeline_depth = self._auto_pipeline_depth()
+        metrics.set_gauge(
+            GAUGE_WAVE_PIPELINE_DEPTH, float(self._pipeline_depth)
+        )
         if self.cfg.use_device:
             # compile the two dirty-row scatter programs at bring-up: each
             # is a ~2 s XLA compile through the tunnel that would otherwise
@@ -469,13 +484,16 @@ class Scheduler:
         if self.cfg.use_device and self.cfg.antientropy_period_s > 0:
             from .antientropy import SnapshotAntiEntropy
 
-            # quiescence gate: an in-flight wave batch legitimately holds
-            # device commits the masters haven't replayed yet — auditing
-            # then would "repair" the kernel's own work away. _busy is set
-            # under the queue lock BEFORE the first pod leaves the queue
-            # and cleared only after the batch fully resolves, so a
-            # lock-held re-check of these flags is race-free against the
-            # launch path (which takes the cache lock after _busy is set).
+            # quiescence gate — a SEMANTIC gate only, since the
+            # generational snapshot made the mechanics safe (the audit's
+            # gather pins a generation no launch can donate): an in-flight
+            # wave batch legitimately holds device commits the masters
+            # haven't replayed yet, and a master-vs-device diff would
+            # "repair" the kernel's own work away. _busy is set under the
+            # queue lock BEFORE the first pod leaves the queue and cleared
+            # only after the batch fully resolves, so a lock-held re-check
+            # of these flags is race-free against the launch path (which
+            # takes the cache lock after _busy is set).
             self._auditor = SnapshotAntiEntropy(
                 self.cache.encoder,
                 lock=self.cache.lock,
@@ -586,7 +604,7 @@ class Scheduler:
                 containers=[v1.Container(requests={"cpu": "1000000"})]
             ),
         )
-        small = min(256, self._batch_size)
+        small = self._small_bucket
         with self.cache.lock:
             eb = self._tpl_cache.encode([warm_pod], pad_to=small)
             ptab = self._pair_table(eb)
@@ -630,15 +648,15 @@ class Scheduler:
         with self.cache.lock:
             if np.asarray(placed).any():
                 # the "unsatisfiable" pod somehow placed (encoding clamp):
-                # never trust the warm launch's snapshot with a ghost pod
+                # never trust the warm launch's snapshot with a ghost pod.
+                # (The launch's donation lease already installed it as the
+                # live generation — rebuild over it from the host masters.)
                 logger.error(
                     "standby warm-up pod was placed by the kernel; "
                     "rebuilding the device snapshot from the host masters"
                 )
                 self.cache.encoder.invalidate_device()
                 self.cache.encoder.flush()
-            else:
-                self.cache.encoder.set_device_snapshot(new_snap)
         # the serial batch kernel (the host-side fallback device variant)
         kern2 = make_schedule_batch(
             enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight
@@ -833,14 +851,30 @@ class Scheduler:
             # Batch-fill policy: the wave kernel's cycle cost is nearly
             # batch-size-independent (per-wave [TPL, N] work dominates), so
             # burst throughput = fill per kernel. With a batch in flight and
-            # less than a full batch queued, resolve the in-flight batch
-            # FIRST: its readback + bind work overlaps the device compute,
-            # and the burst keeps accumulating toward a full batch instead
-            # of being split into runt kernels (a 267-pod launch pays the
-            # same ~cycle as a 4096-pod one). A full queue keeps the eager
+            # a MID-SIZE backlog queued (more than the small-bucket pad,
+            # less than a full batch), resolve the in-flight batch FIRST:
+            # its readback + bind work overlaps the device compute, and the
+            # burst keeps accumulating toward a full batch instead of being
+            # split into runt kernels (a 267-pod launch pays the same
+            # ~cycle as a 4096-pod one). A full queue keeps the eager
             # depth-N pipeline exactly as before; with nothing in flight
             # don't block or linger — a lone low-load pod ships immediately.
-            if self._pending and self.queue.active_len() < self._batch_size:
+            #
+            # BELOW the small-bucket pad the batch is a runt either way, so
+            # waiting a cycle to fatten it only adds latency: launch NOW and
+            # let the new batch chain on the in-flight one's donated
+            # generation (the launch path resolves the oldest batch right
+            # after dispatch, so its compute overlaps the readback + binds).
+            # This is the trickle-load payoff of the generational pipeline —
+            # steady-state pod latency drops from ~2 wave cycles (wait out
+            # the in-flight batch, then pay your own) to ~1 — and it only
+            # became safe when wave launches stopped serializing against
+            # audits/what-ifs on the device lock.
+            backlog = self.queue.active_len()
+            if (
+                self._pending
+                and self._small_bucket < backlog < self._batch_size
+            ):
                 self._busy = True
                 try:
                     self._resolve_pending()
@@ -1497,15 +1531,25 @@ class Scheduler:
         """Seam for the deterministic fault injector
         (testing/device_faults.py): every wave launch goes through here.
 
-        Under the encoder's device_lock: the launch DONATES the snapshot
-        buffers, and a donation racing the anti-entropy audit's row
-        gather (which passed its quiesced gate before this batch went
-        busy) deadlocks the CPU client process-wide."""
-        with self.cache.encoder.device_lock:
+        The launch DONATES the snapshot buffers, so it runs inside the
+        encoder's donation lease: the lease seals the live generation —
+        or, when a reader (audit gather, what-if overlay) holds a pin on
+        it, hands the kernel a fresh copy so the pinned buffers survive —
+        and installs the kernel's output snapshot as the next generation.
+        No lock is held across the dispatch: gathers on pinned
+        generations overlap wave launches freely (the round-8 deadlock
+        interleaving is now ordinary pipelining). `snap` stays in the
+        seam signature for the injector but the lease's snapshot is
+        authoritative — they differ exactly when a reader pinned between
+        flush and launch."""
+        enc = self.cache.encoder
+        with enc.donation_lease() as dl:
             # kern arrives as a parameter, so the donation is invisible
             # to static analysis at this call — the marker makes it the
             # checked donation site (graftlint donation pass)
-            return kern(snap, batch, ptab, weights, key)  # graftlint: donating-call
+            new_snap, res = kern(dl.snap, batch, ptab, weights, key)  # graftlint: donating-call
+            dl.result = new_snap
+        return new_snap, res
 
     def _fetch_wave_results(self, batches: List["_InFlightBatch"]):
         """Seam for the fault injector: the combined device->host readback
@@ -1525,7 +1569,7 @@ class Scheduler:
         # two padded-batch buckets: ragged tails use a small lattice, bursts
         # the full one. Exactly two jit variants per wave count — each extra
         # bucket is another multi-second XLA compile on first use
-        small = min(256, self._batch_size)
+        small = self._small_bucket
         pad = small if len(pis) <= small else self._batch_size
         # tiny batches ride the narrow-candidate variant: per-wave cost
         # scales with m_cand, and a 1-pod low-load cycle should not pay
@@ -1625,8 +1669,8 @@ class Scheduler:
             self.cache.encoder.invalidate_device()
             raise
         trace.step("launch")
-        with self.cache.lock:
-            self.cache.encoder.set_device_snapshot(new_snap)
+        # the donation lease inside _launch_wave_kernel already installed
+        # new_snap as the live generation — nothing to publish here
         self._pending.append(
             _InFlightBatch(
                 pis, eb, row_names, res, moves0, trace, t_start, verify_snap,
@@ -1634,6 +1678,12 @@ class Scheduler:
             )
         )
         metrics.inc("scheduler_wave_batches_total")
+        metrics.set_gauge(GAUGE_WAVE_INFLIGHT, float(len(self._pending)))
+        if len(self._pending) > self._wave_inflight_peak:
+            self._wave_inflight_peak = len(self._pending)
+            metrics.set_gauge(
+                GAUGE_WAVE_INFLIGHT_MAX, float(self._wave_inflight_peak)
+            )
         if len(self._pending) >= self._pipeline_depth:
             # pipeline full: ONE combined readback resolves every batch but
             # the newest, which stays in flight so its device time overlaps
@@ -1654,6 +1704,7 @@ class Scheduler:
         if k <= 0:
             return
         batches, self._pending = self._pending[:k], self._pending[k:]
+        metrics.set_gauge(GAUGE_WAVE_INFLIGHT, float(len(self._pending)))
         with _stage_timer("kernel"):
             try:
                 # transient device/tunnel blips get bounded jittered

@@ -48,11 +48,12 @@ def corrupt_device_rows(
     only (host masters untouched): the exact shape of a scatter-drift or
     bit-flip bug. Default mutation adds a large constant so every
     resource column visibly diverges. Preserves the encoder's sharding
-    placement so a mesh-sharded snapshot stays valid. Holds the
-    encoder's device_lock: the read/put here must not overlap a wave
-    launch's snapshot donation."""
-    with encoder.device_lock:
-        dev = encoder._device
+    placement so a mesh-sharded snapshot stays valid. Runs under a
+    generation pin (the read must not observe buffers a wave launch
+    donates mid-gather) and installs the corrupted snapshot as a new
+    generation that shares its untouched buffers with the pinned one."""
+    with encoder.pin_generation() as lease:
+        dev = lease.snap
         if dev is None:
             raise RuntimeError("no device snapshot to corrupt (flush first)")
         arr = np.array(jax.device_get(getattr(dev, field)))
@@ -72,7 +73,7 @@ def corrupt_device_rows(
             if sharding is not None
             else jax.device_put(jnp.asarray(arr))
         )
-        encoder._device = dev._replace(**{field: new})
+        encoder.swap_live_snapshot(dev._replace(**{field: new}))
 
 
 class DeviceFaultInjector:

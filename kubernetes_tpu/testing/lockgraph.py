@@ -1,16 +1,17 @@
 """Lock-order watchdog: graftlint's runtime companion.
 
-The static passes prove donation sites hold ``device_lock`` and dispatch
-loops never block — they cannot prove the LOCKS THEMSELVES are acquired
-in a consistent global order. The PR-4 deadlock class (a donating wave
-launch under ``device_lock`` racing the audit's gather under the cache
-lock) is an ordering property: it only fires under the right
-interleaving, which a chaos run may never hit even while the inversion
-sits in the code.
+The static passes prove donation sites hold a generation lease and
+dispatch loops never block — they cannot prove the LOCKS THEMSELVES are
+acquired in a consistent global order. The PR-4 deadlock class (a
+donating wave launch under the since-retired ``device_lock`` racing the
+audit's gather under the cache lock) is an ordering property: it only
+fires under the right interleaving, which a chaos run may never hit even
+while the inversion sits in the code.
 
-This module wraps the named production locks (encoder ``device_lock``,
-the scheduler cache lock, the store lock, the watch cache's per-kind
-locks — each created through :func:`named_lock`) so that, when the
+This module wraps the named production locks (the encoder's generation
+bookkeeping lock ``encoder.gen_lock``, the scheduler cache lock, the
+store lock, the watch cache's per-kind locks — each created through
+:func:`named_lock`) so that, when the
 watchdog is ENABLED, every successful acquisition records
 ``held → acquired`` edges into one process-wide lock-order graph. A new
 edge that closes a cycle is a lock-order inversion — two code paths that

@@ -1,0 +1,73 @@
+"""Persistent JAX compilation cache, on by default for process entries.
+
+Every process bring-up used to pay a multi-second XLA compile storm (wave
+kernel variants, scatter/gather programs, the serial batch kernel) — and
+the persistent cache (`JAX_COMPILATION_CACHE_DIR`) that would amortize it
+across processes was deliberately OFF: a donating scatter deserialized
+from the cache was observed corrupting rows it was never asked to touch
+when its donation aliased buffers a concurrent reader observed (the PR-4
+`_scatter_rows_safe` incident). The generational snapshot removed that
+aliasing structurally — donation only ever consumes lease-private,
+unpinned buffers — so the cache is safe to enable everywhere, and the
+scheduler/apiserver entry points (cmd/) plus the Makefile chaos targets
+do so by default.
+
+Opt out with ``KTPU_NO_COMPILATION_CACHE=1`` (e.g. to bisect a suspected
+stale-cache artifact); point ``JAX_COMPILATION_CACHE_DIR`` somewhere
+explicit to share one cache across process families (the chaos Makefile
+targets use ``.jax_cache`` in the repo root).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger("kubernetes_tpu.utils.compilation_cache")
+
+DISABLE_ENV = "KTPU_NO_COMPILATION_CACHE"
+DIR_ENV = "JAX_COMPILATION_CACHE_DIR"
+
+
+def enable_persistent_compilation_cache(
+    default_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Point JAX at a persistent compilation cache directory and return
+    it (None when disabled or JAX refuses). Call before the first jit
+    dispatch; idempotent. Respects an explicit ``JAX_COMPILATION_CACHE_DIR``
+    and the ``KTPU_NO_COMPILATION_CACHE`` kill switch."""
+    if os.environ.get(DISABLE_ENV, "").lower() in ("1", "true", "yes"):
+        return None
+    cache_dir = (
+        os.environ.get(DIR_ENV)
+        or default_dir
+        or os.path.join(tempfile.gettempdir(), "kubernetes_tpu_jax_cache")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        logger.warning("compilation cache dir %s not writable", cache_dir)
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        logger.exception("enabling the persistent compilation cache failed")
+        return None
+    # best-effort knobs (names vary across jax versions): cache even quick
+    # compiles — the wave path's scatter/gather programs are individually
+    # fast to compile but numerous, and cold-start pays all of them
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # pragma: no cover - knob absent in this jax
+            pass
+    os.environ.setdefault(DIR_ENV, cache_dir)
+    logger.info("persistent JAX compilation cache: %s", cache_dir)
+    return cache_dir

@@ -10,21 +10,19 @@ setup+call+teardown wall-clock exceeds the threshold — by construction
 every test in that run is missing the marker (marked ones are
 deselected).
 
-Per-process one-time JAX compiles (~5-20 s of wave-kernel/encoder
-tracing) are POSITIONAL: whichever test first drives a scheduler wave
-pays them, so judging that test against the threshold plays whack-a-mole
-(mark it slow and the next test inherits the bill). The suite list
-therefore starts with `tests/test_chaos_warmup.py`, whose single
-`warmup_compile` absorber test exists to soak up those compiles, and
-absorber tests are exempt from the threshold. Everything after it is
-judged at its steady-state cost — what it actually adds to tier-1, where
-earlier files have already compiled everything.
-
-(Historical note: this lint used to warm a persistent JAX compilation
-cache (JAX_COMPILATION_CACHE_DIR) across two pytest passes instead.
-Donating executables deserialized from that cache were observed writing
-garbage rows on the CPU backend — see `_scatter_rows_safe` in
-ops/encoding.py — so the lint no longer uses a persistent cache at all.)
+Per-process one-time JAX compile/trace cost is POSITIONAL: whichever
+test first drives a scheduler wave pays it, so judging that test against
+the threshold plays whack-a-mole (mark it slow and the next test
+inherits the bill). The suite list therefore starts with
+`tests/test_chaos_warmup.py`, whose single `warmup_compile` absorber
+test exists to soak up that bring-up cost, and absorber tests are exempt
+from the threshold. Everything after it is judged at its steady-state
+cost — what it actually adds to tier-1, where earlier files have already
+compiled everything. The Makefile additionally runs this lint (and every
+chaos target) under the persistent JAX compilation cache
+(JAX_COMPILATION_CACHE_DIR=.jax_cache): with the generational snapshot,
+donation never touches reader-visible buffers, so deserialized donating
+executables are safe and the absorber mostly pays tracing, not XLA.
 
 Usage:
     python scripts/check_slow_markers.py [--threshold 5.0] [files...]

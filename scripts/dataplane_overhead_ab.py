@@ -13,6 +13,13 @@ Also measures guard-trip recovery: a poisoned first readback (NaN score)
 quarantines the wave to the host path — reported as the wall-clock from
 scheduler start to every pod bound, with and without the injected trip.
 
+`--generational` adds a locked-vs-generational A/B: the same steady-state
+probe with the wave pipeline serialized (pipeline_depth=1, the cadence
+the retired process-wide device_lock imposed — every wave fully resolves
+before the next launches) vs the generational default (waves chain in
+flight on donated snapshot generations while audits/what-ifs read pinned
+older generations).
+
 Usage: python scripts/dataplane_overhead_ab.py [--rate 300] [--pods 400]
 Emits one JSON line; CPU-forced unless BENCH_AB_TPU=1.
 """
@@ -33,18 +40,22 @@ if os.environ.get("BENCH_AB_TPU", "") not in ("1", "true"):
     jax.config.update("jax_platforms", "cpu")
 
 
-def steady_state_arm(defenses: bool, rate: float, n_pods: int):
+def steady_state_arm(
+    defenses: bool, rate: float, n_pods: int, pipeline_depth: int = 0
+):
     from kubernetes_tpu.perf.harness import run_latency_benchmark
     from kubernetes_tpu.perf.workloads import WORKLOADS
     from kubernetes_tpu.scheduler import KubeSchedulerConfiguration
 
     if defenses:
-        scfg = KubeSchedulerConfiguration()  # defaults: everything on
+        # defaults: everything on; pipeline_depth 0 = auto
+        scfg = KubeSchedulerConfiguration(pipeline_depth=pipeline_depth)
     else:
         scfg = KubeSchedulerConfiguration(
             kernel_output_guards=False,
             guard_sample_per_wave=0,
             antientropy_period_s=0.0,
+            pipeline_depth=pipeline_depth,
         )
     cfg = WORKLOADS["SchedulingPodAffinity/5000"]
     lat = run_latency_benchmark(cfg, rate, n_pods=n_pods, sched_config=scfg)
@@ -55,6 +66,8 @@ def steady_state_arm(defenses: bool, rate: float, n_pods: int):
         "pod_p90_ms": round(lat.pod_p90_ms, 3),
         "pod_p99_ms": round(lat.pod_p99_ms, 3),
         "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
+        "pipeline_depth": lat.pipeline_depth,
+        "max_waves_inflight": lat.max_waves_inflight,
     }
 
 
@@ -146,6 +159,13 @@ def main() -> int:
         action="store_true",
         help="also A/B the burst-throughput headline (adds ~2 min)",
     )
+    ap.add_argument(
+        "--generational",
+        action="store_true",
+        help="A/B the generational wave pipeline against the serialized "
+        "cadence (pipeline_depth=1: every wave fully resolves before the "
+        "next launches — the old device_lock-era behavior)",
+    )
     args = ap.parse_args()
 
     out = {"metric": "dataplane_defense_overhead_ab"}
@@ -181,6 +201,34 @@ def main() -> int:
         out["rate_delta_pct"] = round(
             100.0 * (on["rate_pods_per_s"] / off["rate_pods_per_s"] - 1.0), 2
         )
+    if args.generational:
+        # locked-vs-generational: the defenses stay at their defaults in
+        # both arms; what changes is wave overlap. pipeline_depth=1 makes
+        # every wave resolve (readback + bind) before the next launches —
+        # the serialization the retired device_lock imposed — while the
+        # generational arm lets ≥2 waves chain in flight on donated
+        # generations. Same alternating best-of discipline as above.
+        gen_runs, ser_runs = [], []
+        for rep in range(max(1, args.reps)):
+            order = [(0, gen_runs), (1, ser_runs)]
+            if rep % 2:
+                order.reverse()
+            for depth, runs in order:
+                runs.append(
+                    steady_state_arm(
+                        True, args.rate, args.pods, pipeline_depth=depth
+                    )
+                )
+        out["pipeline_generational"] = best(gen_runs)
+        out["pipeline_serialized"] = best(ser_runs)
+        out["pipeline_generational_runs"] = gen_runs
+        out["pipeline_serialized_runs"] = ser_runs
+        ser = out["pipeline_serialized"]
+        gen = out["pipeline_generational"]
+        if ser["pod_p99_ms"]:
+            out["pipeline_p99_speedup"] = round(
+                ser["pod_p99_ms"] / gen["pod_p99_ms"], 3
+            ) if gen["pod_p99_ms"] else None
     if args.burst:
         bon, boff = [], []
         for rep in range(max(1, args.reps)):

@@ -36,10 +36,19 @@ CHAOS_SUITE_FILES = [
 
 # -- pass 1: donation safety -------------------------------------------------
 
-# a donation site must sit lexically inside `with <...>.<suffix>:` for one
-# of these lock suffixes (dotted suffix match: "device_lock" matches
-# `self.cache.encoder.device_lock`)
-DEVICE_LOCK_SUFFIXES = ("device_lock",)
+# a donation site must sit lexically inside `with <...>.<suffix>(...):`
+# for one of these generation-lease context managers (dotted suffix match
+# on the called attribute: "donation_lease" matches
+# `self.cache.encoder.donation_lease(donating=False)`) — the lease seals
+# the live snapshot generation and hands the donor copy-on-pin buffers
+# while readers pin an older generation
+GENERATION_LEASE_SUFFIXES = ("donation_lease",)
+
+# the RETIRED big lock: the process-wide device_lock serialized every
+# donation-bearing device entry point against every reader and is gone
+# from the tree — any `with <...>.device_lock` anywhere is a finding
+# (the wave path must never grow it back)
+RETIRED_LOCK_SUFFIXES = ("device_lock",)
 
 # keywords that make a jax.jit/shard_map expression donation-bearing
 DONATION_KEYWORDS = ("donate_argnums", "donate_argnames")
@@ -81,10 +90,10 @@ EXTRA_REACHABLE = {
 }
 
 # locks whose `with` bodies must stay free of blocking primitives and
-# store RPCs (dotted suffix match). device_lock serializes every
-# donation-bearing device entry point; cache.lock serializes the whole
-# scheduling pipeline.
-HOT_LOCK_SUFFIXES = ("device_lock", "cache.lock")
+# store RPCs (dotted suffix match). _gen_lock guards the snapshot
+# generation pin/seal/install protocol (every lease operation crosses
+# it); cache.lock serializes the whole scheduling pipeline.
+HOT_LOCK_SUFFIXES = ("_gen_lock", "cache.lock")
 
 # receiver names that make `.list(` / `.watch(` a store RPC
 STORE_RPC_RECEIVERS = {"store", "_store", "server", "_server", "api", "client", "_client"}
@@ -104,6 +113,7 @@ DUMP_REQUIRED_FAMILIES = (
     "kernel_guard_",
     "scheduler_device_",
     "scheduler_mesh_",
+    "scheduler_wave_",
     "scheduler_pending_binds",
     "scheduler_bind_breaker",
     "node_lifecycle_",

@@ -142,18 +142,28 @@ class Module:
 
     def inside_with_lock(self, node: ast.AST, lock_suffixes) -> bool:
         """Is node lexically inside a `with <expr>` whose context manager's
-        dotted name ends with one of lock_suffixes (e.g. "device_lock",
-        "cache.lock")?"""
+        dotted name ends with one of lock_suffixes (e.g. "_gen_lock",
+        "cache.lock")? Call-form context managers match on the called
+        attribute chain (`with enc.donation_lease():` → "donation_lease"),
+        so lease factories are checkable the same way bare locks are."""
         for anc in self.ancestors(node):
             if isinstance(anc, (ast.With, ast.AsyncWith)):
                 for item in anc.items:
-                    dotted = dotted_name(item.context_expr)
-                    if dotted and any(
-                        dotted == s or dotted.endswith("." + s)
-                        for s in lock_suffixes
-                    ):
+                    if with_item_matches(item, lock_suffixes):
                         return True
         return False
+
+
+def with_item_matches(item: ast.withitem, suffixes) -> bool:
+    """Does one `with` item's context manager — a bare attribute chain or
+    a call on one — end with one of the dotted suffixes?"""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    dotted = dotted_name(expr)
+    return bool(dotted) and any(
+        dotted == s or dotted.endswith("." + s) for s in suffixes
+    )
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:

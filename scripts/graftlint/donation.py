@@ -1,18 +1,26 @@
-"""Pass 1: donation safety.
+"""Pass 1: donation safety — the generation-lease discipline.
 
 Every callable built with ``donate_argnums``/``donate_argnames`` donates
 its input buffers to XLA: the caller's arrays are dead the moment the
-call is dispatched. Two production bugs taught the discipline this pass
-enforces (PR 4): a donating wave launch racing the anti-entropy audit's
-row gather deadlocked the CPU client process-wide, and a donating
-scatter deserialized from a persistent compilation cache corrupted rows
-it was never asked to touch. The contract:
+call is dispatched. Two production bugs taught the original discipline
+(PR 4): a donating wave launch racing the anti-entropy audit's row
+gather deadlocked the CPU client process-wide, and a donating scatter
+deserialized from a persistent compilation cache corrupted rows it was
+never asked to touch. The big ``device_lock`` that first carried that
+contract is RETIRED: the snapshot is generational (pin → donate →
+retire, ops/encoding.py), readers pin a generation no donor may consume,
+and donors advance through a ``donation_lease()`` that seals the live
+generation (or hands the donor a copy-on-pin buffer set). The contract:
 
   every call site of a donating callable must be (a) lexically inside a
-  ``with <...>.device_lock`` region, or (b) inside a function explicitly
-  marked alias-free (``# graftlint: alias-safe``), or (c) inside a
-  function marked ``# graftlint: holds-device-lock`` — in which case the
-  SAME requirement recursively applies to that function's call sites.
+  ``with <...>.donation_lease(...)`` region
+  (config.GENERATION_LEASE_SUFFIXES), or (b) inside a function
+  explicitly marked alias-free (``# graftlint: alias-safe``), or (c)
+  inside a function marked ``# graftlint: holds-generation-lease`` — in
+  which case the SAME requirement recursively applies to that function's
+  call sites. Additionally, any ``with <...>.device_lock`` region
+  anywhere in the tree is itself a finding (``retired-device-lock``):
+  the wave path must never grow the big lock back.
 
 Donating callables are discovered, not declared: any name assigned from
 an expression containing a donation keyword joins the module's donating
@@ -23,7 +31,7 @@ e.g. ``make_wave_kernel_jit``) taint their assignment targets, and
 ``from x import donating_name`` carries the taint across modules. A
 donating callable passed as an ARGUMENT (the injector-seam pattern)
 requires the receiving function to mark the forwarded invocation with
-``# graftlint: donating-call`` so the lock check lands on the real call.
+``# graftlint: donating-call`` so the lease check lands on the real call.
 """
 
 from __future__ import annotations
@@ -175,15 +183,15 @@ def discover(tree: Tree) -> Tuple[Dict[Module, ModTaint], Set[str]]:
 def _site_ok(
     mod: Module, node: ast.AST, deferred: List[str]
 ) -> bool:
-    """One donation site: lock-held, alias-safe, or deferred to the
-    enclosing function's call sites (holds-device-lock)."""
-    if mod.inside_with_lock(node, config.DEVICE_LOCK_SUFFIXES):
+    """One donation site: lease-held, alias-safe, or deferred to the
+    enclosing function's call sites (holds-generation-lease)."""
+    if mod.inside_with_lock(node, config.GENERATION_LEASE_SUFFIXES):
         return True
     func = mod.enclosing_function(node)
     while func is not None:
         if mod.func_marked(func, "alias-safe"):
             return True
-        if mod.func_marked(func, "holds-device-lock"):
+        if mod.func_marked(func, "holds-generation-lease"):
             deferred.append(func.name)
             return True
         func = mod.enclosing_function(func)
@@ -193,7 +201,33 @@ def _site_ok(
 def run(tree: Tree) -> List[Finding]:
     per_mod, factories = discover(tree)
     findings: List[Finding] = []
-    deferred: List[str] = []  # functions whose callers must hold the lock
+    deferred: List[str] = []  # functions whose callers must hold a lease
+
+    # the retired big lock: any `with <...>.device_lock` region is a
+    # finding — the generation-lease discipline replaced it, and a
+    # reintroduced device_lock would quietly re-serialize the wave path
+    from core import with_item_matches
+
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                if with_item_matches(item, config.RETIRED_LOCK_SUFFIXES):
+                    func = mod.enclosing_function(node)
+                    where = func.name if func is not None else "<module>"
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            PASS,
+                            f"retired-device-lock:{where}",
+                            "`device_lock` is retired: serialize device "
+                            "writers through the generation-lease "
+                            f"discipline instead (`{where}` holds a "
+                            "with-region on it)",
+                        )
+                    )
 
     # `# graftlint: alias-safe` on an ASSIGNMENT declares the bound name
     # an alias-free variant (fresh output buffers, no donation). The
@@ -240,8 +274,8 @@ def run(tree: Tree) -> List[Finding]:
                             PASS,
                             f"unlocked-donation:{where}:{cn}",
                             f"donating callable `{cn}` invoked outside a "
-                            f"device_lock region (and `{where}` is not "
-                            f"marked alias-safe or holds-device-lock)",
+                            f"donation_lease region (and `{where}` is not "
+                            f"marked alias-safe or holds-generation-lease)",
                         )
                     )
             # donating callable forwarded as an argument: the receiver
@@ -268,7 +302,7 @@ def run(tree: Tree) -> List[Finding]:
                             )
                         )
 
-    # recursive caller check for holds-device-lock functions
+    # recursive caller check for holds-generation-lease functions
     checked: Set[str] = set()
     while deferred:
         fname = deferred.pop()
@@ -287,9 +321,10 @@ def run(tree: Tree) -> List[Finding]:
                         call.lineno,
                         PASS,
                         f"unlocked-caller:{where}:{fname}",
-                        f"`{fname}` requires device_lock held "
-                        f"(# graftlint: holds-device-lock) but `{where}` "
-                        f"calls it outside a device_lock region",
+                        f"`{fname}` requires a generation lease held "
+                        f"(# graftlint: holds-generation-lease) but "
+                        f"`{where}` calls it outside a donation_lease "
+                        f"region",
                     )
                 )
     return findings

@@ -18,8 +18,9 @@ _safe = jax.jit(_impl)  # graftlint: alias-safe
 
 class Encoder:
     def flush_rows(self, snap):
-        with self.device_lock:
-            return _don(snap, 0)
+        with self.donation_lease() as dl:
+            dl.result = _don(dl.snap, 0)
+            return dl.result
 
     def repair_rows(self, snap):  # graftlint: alias-safe
         return _safe(snap, 0)

@@ -9,7 +9,7 @@ Expected findings:
   1. unbounded queue.put in `_run`
   2. unbounded .join() in `_helper` (reachable from `_run`)
   3. store RPC .list() on `self.store` in `_run`
-  4. time.sleep under a hot lock (`device_lock`) in `hot_section`
+  4. time.sleep under a hot lock (`_gen_lock`) in `hot_section`
   5. allow-blocking pragma without a reason in `_lazy`
 """
 
@@ -34,5 +34,5 @@ class KindCache:
 
 
 def hot_section(enc):
-    with enc.device_lock:
+    with enc._gen_lock:
         time.sleep(0.5)  # finding 4

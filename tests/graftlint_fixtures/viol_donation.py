@@ -2,10 +2,14 @@
 pytest — parsed only, never imported/executed).
 
 Expected findings (tests/test_graftlint.py asserts exactly these):
-  1. unlocked-donation: `_don(x)` outside any device_lock region
+  1. unlocked-donation: `_don(x)` outside any donation_lease region
   2. unmarked-handoff: `_don` passed to `seam`, which marks nothing
   3. alias-safe-contradiction: `_lying_safe` is marked alias-safe but
      its definition donates
+  4. retired-device-lock: `legacy_locked` holds a `with ...device_lock`
+     region — the big lock is retired, generation leases replaced it
+  5. unlocked-donation: the `_don` call inside `legacy_locked` — the
+     retired lock no longer excuses a donation site
 """
 
 import functools
@@ -22,7 +26,7 @@ _lying_safe = jax.jit(_impl, donate_argnums=(0,))  # graftlint: alias-safe
 
 
 def unlocked_call(x):
-    return _don(x, 0)  # finding 1: no device_lock, no marker
+    return _don(x, 0)  # finding 1: no donation lease, no marker
 
 
 def seam(kern, snap):
@@ -33,6 +37,12 @@ def handoff(snap):
     return seam(_don, snap)  # finding 2: unmarked handoff
 
 
-def locked_ok(self, x):
-    with self.device_lock:
-        return _don(x, 0)  # clean: lexically inside device_lock
+def legacy_locked(self, x):
+    with self.device_lock:  # findings 4+5: retired lock, unexcused site
+        return _don(x, 0)
+
+
+def leased_ok(self, x):
+    with self.donation_lease() as dl:
+        dl.result = _don(dl.snap, 0)  # clean: inside a donation lease
+        return dl.result

@@ -1,0 +1,56 @@
+"""graftlint fixture: generation-lease discipline violations (parsed
+only, never executed) — the contract that replaced the retired
+`device_lock`.
+
+Expected findings (tests/test_graftlint.py asserts exactly these):
+  1. unlocked-caller: `advance` is marked holds-generation-lease, and
+     `caller_outside` invokes it outside any donation_lease region
+  2. retired-device-lock: `old_style_reader` still serializes a gather
+     on the retired big lock
+  3. unlocked-donation: `chunk_no_marker` dispatches the donating
+     scatter with neither a lease region nor a deferral marker
+
+Clean shapes exercised alongside (must NOT be findings):
+  * `leased_caller` invokes the holds-generation-lease function inside
+    a `with enc.donation_lease(...)` region (call-form context manager)
+  * `repair` is alias-safe and uses the non-donating variant
+"""
+
+import functools
+
+import jax
+
+
+def _impl(snap, idx):
+    return snap
+
+
+_scatter = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+_scatter_safe = jax.jit(_impl)  # graftlint: alias-safe
+
+
+def advance(enc, snap):  # graftlint: holds-generation-lease
+    return _scatter(snap, 0)
+
+
+def caller_outside(enc, snap):
+    return advance(enc, snap)  # finding 1: no lease at the call site
+
+
+def leased_caller(enc):
+    with enc.donation_lease(donating=True) as dl:
+        dl.result = advance(enc, dl.snap)  # clean: lease held lexically
+        return dl.result
+
+
+def old_style_reader(enc, idx):
+    with enc.device_lock:  # finding 2: the big lock is retired
+        return idx
+
+
+def chunk_no_marker(snap):
+    return _scatter(snap, 1)  # finding 3: bare donation site
+
+
+def repair(snap):  # graftlint: alias-safe
+    return _scatter_safe(snap, 1)  # clean: alias-free variant

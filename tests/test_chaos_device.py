@@ -47,7 +47,7 @@ from kubernetes_tpu.utils.metrics import metrics
 @pytest.fixture(autouse=True, scope="module")
 def lock_order_watchdog():
     """Record the acquisition-order graph of the named production locks
-    (store / scheduler.cache / encoder.device_lock) across the whole
+    (store / scheduler.cache / encoder.gen_lock) across the whole
     suite and fail on any cycle: a lock-order inversion deadlocks only
     under the right interleaving, so the run SUCCEEDING is no evidence —
     the graph is (ISSUE 7's runtime companion to graftlint)."""
@@ -57,7 +57,7 @@ def lock_order_watchdog():
         lockgraph.assert_acyclic()
         assert lockgraph.edge_count() > 0, (
             "watchdog recorded no lock-order edges: the data-plane suite "
-            "must exercise nested cache-lock -> device_lock acquisitions"
+            "must exercise nested cache-lock -> gen-lock acquisitions"
         )
     finally:
         lockgraph.disable()
@@ -642,3 +642,164 @@ def test_suspect_rows_survive_failed_audit_pass():
     report = aud.audit_once()
     assert report["rows_audited"] >= 1
     assert not enc.suspect_rows, "completed pass should drain the suspects"
+
+
+# -- generational snapshot: pinned readers vs donating waves ------------------
+
+
+def test_audit_gather_concurrent_with_donating_launch_on_newer_generation():
+    """The EXACT round-8 failure shape, now legal: a reader holds a pin
+    on generation N (the anti-entropy audit's row gather) while a
+    donating advance lands on the newer generation. Under the old
+    process-wide device_lock this interleaving deadlocked the CPU client;
+    under the generational discipline the donor pays one copy-on-pin and
+    the pinned gather completes against intact, uncorrupted buffers."""
+    metrics.reset()
+    enc = SnapshotEncoder()
+    for i in range(8):
+        enc.add_node(_node(f"gg-{i}"))
+    enc.add_pod("gg-0", _labeled_pod("gg-pod"))
+    enc.flush()
+    expected_req = enc.m_req.copy()
+
+    with enc.pin_generation() as lease:
+        pinned_gen = lease.gen_id
+        copies0 = metrics.counter("snapshot_generation_copy_on_pin_total")
+        # donating advance while the pin is held: the old deadlock recipe
+        enc.mark_row_dirty("gg-1")
+        enc.flush(donate=True)
+        assert enc.device_generation > pinned_gen
+        assert (
+            metrics.counter("snapshot_generation_copy_on_pin_total")
+            == copies0 + 1
+        ), "a donating advance under a reader pin must copy, never consume"
+        # the pinned generation's buffers survived the donation: gather
+        # them AFTER the donating scatter dispatched (round-8 ordering)
+        pinned_req = np.asarray(jax.device_get(lease.snap.requested))
+        assert np.array_equal(pinned_req, expected_req), (
+            "pinned generation corrupted by a concurrent donation"
+        )
+        assert metrics.gauge("snapshot_generation_pinned_readers") == 1.0
+        assert metrics.gauge("snapshot_generation_retiring") == 1.0
+    # pin released -> the superseded generation retires
+    assert metrics.gauge("snapshot_generation_retiring") == 0.0
+    assert metrics.counter("snapshot_generation_retired_total") >= 1.0
+
+    # threaded soak of the same shape: an auditor-style fetch loop races
+    # a donating-flush loop; zero deadlocks, zero cross-generation reads
+    # (every fetched row equals the host masters, which never change)
+    import threading
+
+    live = [r for r, nm in enumerate(enc.row_names) if nm]
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                fetched = enc.fetch_device_rows(live)
+                if fetched is None:
+                    continue
+                if not np.array_equal(
+                    fetched["requested"], enc.m_req[live]
+                ):
+                    errors.append("cross-generation read: stale rows")
+                    return
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(repr(e))
+
+    def writer():
+        try:
+            for i in range(60):
+                enc.mark_row_dirty(f"gg-{i % 8}")
+                enc.flush(donate=True)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(repr(e))
+        finally:
+            stop.set()
+
+    tr, tw = threading.Thread(target=reader), threading.Thread(target=writer)
+    tr.start()
+    tw.start()
+    tw.join(timeout=60.0)
+    tr.join(timeout=60.0)
+    assert not tw.is_alive() and not tr.is_alive(), (
+        "gather vs donating flush deadlocked (the round-8 shape is back)"
+    )
+    assert not errors, errors
+
+
+@pytest.mark.slow  # multi-batch pipeline fill: several wave cycles + binds
+def test_pipelined_waves_at_least_two_in_flight_with_concurrent_reads():
+    """Pipelined-wave chaos variant (ISSUE 11 acceptance): with a deep
+    pipeline configured, at least TWO wave batches are demonstrably in
+    flight concurrently (`scheduler_wave_inflight_max`), while an
+    auditor-style gather loop reads pinned generations the whole time —
+    zero guard trips attributable to cross-generation reads, every pod
+    bound exactly once, no leaked assumes."""
+    import threading
+
+    metrics.reset()
+    store = ChaosStore()
+    pool = NodeAgentPool(store, housekeeping_interval=0.1)
+    for i in range(8):
+        pool.add_node(f"pw-{i}", cpu="64")
+    sched = Scheduler(
+        store,
+        _cfg(
+            pipeline_depth=3,
+            device_batch_size=8,
+            device_batch_window=0.0,
+        ),
+    )
+    pool.start()
+    n = 96
+    # pods exist BEFORE the scheduler starts: the queue opens with 12
+    # full batches ready, so the loop stacks launches to pipeline depth
+    for i in range(n):
+        store.create("pods", make_pod(f"pw-{i}", cpu="100m"))
+    enc = sched.cache.encoder
+    stop = threading.Event()
+    reader_errors = []
+
+    def gather_loop():
+        try:
+            while not stop.is_set():
+                rows = [r for r, nm in enumerate(enc.row_names) if nm]
+                if rows:
+                    enc.fetch_device_rows(rows)
+                time.sleep(0.002)
+        except Exception as e:  # pragma: no cover - failure reporting
+            reader_errors.append(repr(e))
+
+    t = threading.Thread(target=gather_loop, daemon=True)
+    t.start()
+    sched.start()
+    try:
+        assert wait_until(lambda: _bound_count(store) == n, 60)
+        _no_leaked_assumes(sched)
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        sched.stop()
+        pool.stop()
+    assert not reader_errors, reader_errors
+    inflight_max = metrics.gauge("scheduler_wave_inflight_max") or 0.0
+    assert inflight_max >= 2.0, (
+        f"pipeline never had 2 waves in flight (max {inflight_max}); "
+        "the generational snapshot exists to make this legal"
+    )
+    # zero guard trips of any reason: a cross-generation read would
+    # surface as a poisoned readback or an oracle-infeasible placement
+    # (oracle churn SKIPS are fine — they are the guard declining to
+    # judge a node the informers legitimately mutated mid-wave)
+    trips = [
+        (name, labels, val)
+        for name, labels, val in metrics.snapshot_counters(
+            "kernel_guard_trips_total"
+        )
+        if val
+    ]
+    assert not trips, f"guard trips during pipelined waves: {trips}"
+    assert_bind_invariants(store)
+    _no_oversubscription(store, cpu_capacity_m=64000)

@@ -49,6 +49,8 @@ def test_donation_fixture_exact_findings():
     found = donation.run(_tree("viol_donation.py"))
     assert _keys(found) == [
         "alias-safe-contradiction:_lying_safe",
+        "retired-device-lock:legacy_locked",
+        "unlocked-donation:legacy_locked:_don",
         "unlocked-donation:unlocked_call:_don",
         "unmarked-handoff:seam:_don",
     ]
@@ -60,6 +62,20 @@ def test_donation_discovers_through_factory_and_alias():
     mod = src.modules[0]
     assert "_don" in per_mod[mod].module_level
     assert "_lying_safe" in per_mod[mod].module_level
+
+
+def test_generation_lease_fixture_exact_findings():
+    """The generation-lease discipline that replaced device_lock: a
+    holds-generation-lease function's callers carry the obligation, a
+    retired-lock with-region is flagged wherever it appears, and a bare
+    donation site is still a finding — while lease-held call-form
+    with-regions and alias-safe variants stay clean."""
+    found = donation.run(_tree("viol_generation.py"))
+    assert _keys(found) == [
+        "retired-device-lock:old_style_reader",
+        "unlocked-caller:caller_outside:advance",
+        "unlocked-donation:chunk_no_marker:_scatter",
+    ]
 
 
 # -- pass 2: dispatch-thread blocking calls ----------------------------------
@@ -191,7 +207,7 @@ def test_suppression_baseline_roundtrip(tmp_path):
         f"{FIXTURES}/viol_donation.py", "--baseline", baseline
     )
     assert proc.returncode == 0, proc.stdout
-    assert "suppressed=3" in proc.stdout
+    assert "suppressed=5" in proc.stdout
     # a stale entry (matches nothing) must FAIL the run
     with open(baseline, "a") as fh:
         fh.write("gone/file.py::donation::unlocked-donation:ghost:fn\n")
