@@ -696,18 +696,39 @@ class SnapshotEncoder:
 
     def _retire_locked(self, gen: SnapshotGeneration) -> None:
         """Buffer set leaves service: count it, stamp retirement latency,
-        release any child's shared-buffer tie to it."""
+        re-point any child's shared-buffer tie PAST it. The tie must
+        propagate, not sever: with chained sharing (reader R1 pins A, a
+        reshape installs B sharing A, reader R2 pins B, a reshape
+        installs C sharing B), R2's unpin retires intermediate B while
+        C's kept fields are still A's buffers — C inherits the tie to
+        the still-pinned A, or a later donation on C would consume the
+        buffers R1's gather reads."""
         if gen.superseded_at is not None:
             latency = max(0.0, time.monotonic() - gen.superseded_at)
             metrics.observe(HIST_GEN_RETIRE_LATENCY, latency)
             metrics.set_gauge(GAUGE_GEN_LAST_RETIRE_LATENCY, latency)
         metrics.inc(COUNTER_GEN_RETIRED)
+        parent = gen.shared_parent
+        if parent is not None and parent.pins <= 0:
+            # unpinned ancestors are already retired (or about to be):
+            # dropping the reference keeps no dead buffer set reachable
+            parent = None
         children = list(self._retiring)
         if self._gen is not None:
             children.append(self._gen)
         for child in children:
             if child.shared_parent is gen:
-                child.shared_parent = None
+                child.shared_parent = parent
+
+    def check_retire_stalls(self) -> None:
+        """Stall-watchdog sweep for periodic callers (the anti-entropy
+        pass, the SIGUSR2 dataplane dump). The lease-entry checks fire
+        only on new pin/donation traffic, so without this a leaked
+        reader pin on an otherwise idle encoder would hold its HBM
+        generation invisibly until the next lease happened to arrive."""
+        with self._gen_lock:
+            self._check_retire_stalls_locked()
+            self._publish_gen_gauges_locked()
 
     def _check_retire_stalls_locked(self) -> None:
         now = time.monotonic()

@@ -150,6 +150,11 @@ class SnapshotAntiEntropy:
         machine-readable form of that contract for graftlint's donation
         pass; the prose used to be the only record of it."""
         enc = self.encoder
+        # the retire-stall watchdog otherwise only runs on new lease
+        # traffic: sweep it from this periodic pass (before any skip
+        # path) so a leaked reader pin on an idle encoder still surfaces
+        # in /metrics instead of silently holding its HBM generation
+        enc.check_retire_stalls()
         report: Dict[str, object] = {
             "rows_audited": 0,
             "master_repaired": [],

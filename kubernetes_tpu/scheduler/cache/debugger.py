@@ -88,6 +88,12 @@ class CacheDebugger:
             lines.extend(ride)
         from ..antientropy import dataplane_health_lines
 
+        # refresh the retire-stall watchdog before rendering: a leaked
+        # reader pin must show up in THIS dump even if no lease traffic
+        # (and no audit pass) has run since the generation was superseded
+        enc = getattr(cache, "encoder", None)
+        if enc is not None:
+            enc.check_retire_stalls()
         plane = dataplane_health_lines()
         if plane:
             lines.append("Dump of data-plane self-defense state:")

@@ -32,7 +32,11 @@ from kubernetes_tpu.api import objects as v1
 from kubernetes_tpu.api.resources import CPU
 from kubernetes_tpu.api.selectors import selector_from_match_labels
 from kubernetes_tpu.kubelet.kubelet import NodeAgentPool, make_node_object
-from kubernetes_tpu.ops.encoding import RES_CPU, SnapshotEncoder
+from kubernetes_tpu.ops.encoding import (
+    RES_CPU,
+    RETIRE_STALL_AFTER_S,
+    SnapshotEncoder,
+)
 from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
 from kubernetes_tpu.scheduler.antientropy import SnapshotAntiEntropy
 from kubernetes_tpu.scheduler.cache.cache import SchedulerCache
@@ -727,6 +731,84 @@ def test_audit_gather_concurrent_with_donating_launch_on_newer_generation():
         "gather vs donating flush deadlocked (the round-8 shape is back)"
     )
     assert not errors, errors
+
+
+def test_chained_shared_generations_survive_intermediate_retirement():
+    """Two overlapping readers across two capacity growths: R1 pins A; a
+    t_cap growth installs B sharing A's kept buffers; R2 pins B; a second
+    growth installs C sharing B's kept fields — which are still A's
+    buffers. When R2 unpins, intermediate B retires, and C must INHERIT
+    the shared-buffer tie to still-pinned A (not have it severed): a
+    donating advance on C then pays copy-on-pin instead of consuming the
+    buffers R1's gather reads."""
+    metrics.reset()
+    enc = SnapshotEncoder()
+    for i in range(8):
+        enc.add_node(_node(f"cs-{i}"))
+    enc.add_pod("cs-0", _labeled_pod("cs-pod"))
+    enc.flush()
+    expected_req = enc.m_req.copy()
+
+    with enc.pin_generation() as r1:  # pins A
+        gen_a = r1.gen_id
+        enc._ensure_cap("t_cap", enc.cfg.t_cap * 2)
+        enc.flush()  # reshape-merge installs B sharing A's kept buffers
+        with enc.pin_generation() as r2:  # pins B
+            assert r2.gen_id > gen_a
+            enc._ensure_cap("t_cap", enc.cfg.t_cap * 2)
+            enc.flush()  # installs C sharing B (kept fields: A's buffers)
+        # R2 unpinned -> intermediate B retired; the tie must now point
+        # at A, the oldest still-pinned ancestor
+        live = enc._gen
+        assert live.shared_parent is not None, (
+            "intermediate retirement severed the shared-buffer tie while "
+            "the oldest ancestor is still pinned"
+        )
+        assert live.shared_parent.gen_id == gen_a
+        copies0 = metrics.counter("snapshot_generation_copy_on_pin_total")
+        enc.mark_row_dirty("cs-1")
+        enc.flush(donate=True)  # donating advance on C
+        assert (
+            metrics.counter("snapshot_generation_copy_on_pin_total")
+            == copies0 + 1
+        ), "donation on a chained-shared generation must copy, not consume"
+        # R1's pinned buffers (aliased by C's kept fields) survived
+        pinned_req = np.asarray(jax.device_get(r1.snap.requested))
+        assert np.array_equal(pinned_req, expected_req), (
+            "reader R1's pinned buffers were donated out from under it"
+        )
+    # every pin drained: ties clear, all superseded generations retire
+    assert enc._gen.shared_parent is None
+    assert metrics.gauge("snapshot_generation_retiring") == 0.0
+    assert not enc._retiring
+
+
+def test_leaked_pin_trips_stall_watchdog_without_lease_traffic():
+    """A leaked reader pin on an otherwise idle encoder must trip the
+    retire-stall watchdog from the periodic sweep (anti-entropy pass /
+    SIGUSR2 dump), not only when the next pin or donation arrives."""
+    metrics.reset()
+    enc = SnapshotEncoder()
+    for i in range(4):
+        enc.add_node(_node(f"lp-{i}"))
+    enc.flush()
+    leaked = enc.pin_generation().__enter__()  # never exited
+    enc.mark_row_dirty("lp-0")
+    enc.flush(donate=True)  # supersedes the pinned generation
+    stuck = enc._retiring[0]
+    stuck.superseded_at -= RETIRE_STALL_AFTER_S + 1.0
+    assert metrics.counter("snapshot_generation_retire_stalls_total") == 0
+    # the audit pass sweeps the watchdog even on its skip paths, which
+    # take no generation lease at all
+    aud = SnapshotAntiEntropy(enc, quiesced=lambda: False)
+    report = aud.audit_once()
+    assert report["skipped"] == "pipeline busy"
+    assert metrics.counter("snapshot_generation_retire_stalls_total") == 1
+    # reported once per stuck generation, not once per sweep
+    enc.check_retire_stalls()
+    assert metrics.counter("snapshot_generation_retire_stalls_total") == 1
+    leaked.__exit__(None, None, None)
+    assert metrics.gauge("snapshot_generation_retiring") == 0.0
 
 
 @pytest.mark.slow  # multi-batch pipeline fill: several wave cycles + binds
