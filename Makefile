@@ -25,8 +25,15 @@
 #                         late REST binds, cross-process exactly-once ledger)
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 #   make lint-static      graftlint: donation-safety, dispatch-blocking,
-#                         metrics-contract, degraded-write static passes
-#                         (scripts/graftlint/, empty suppression baseline)
+#                         metrics-contract, degraded-write, bind-fence,
+#                         guarded-by inference + thread-hygiene +
+#                         stale-pragma audit (scripts/graftlint/, empty
+#                         suppression baseline); prints a per-pass
+#                         findings/wall-time summary line
+#   make lint-fast        graftlint --changed: full-tree analysis, findings
+#                         scoped to files changed vs HEAD + their importers
+#                         — the pre-commit loop (skips the slow-marker
+#                         suite run); lint-static remains the merge gate
 #   make lint             lint-static + lint-slow (invoked from `make chaos`)
 
 PY ?= python
@@ -41,7 +48,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	lint-slow lint-static lint
+	lint-slow lint-static lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -77,6 +84,9 @@ lint-slow:
 
 lint-static:
 	$(PY) scripts/graftlint
+
+lint-fast:
+	$(PY) scripts/graftlint --changed
 
 lint: lint-static lint-slow
 

@@ -43,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..client.apiserver import Expired
 from ..runtime.watch import ADDED, BOOKMARK, DELETED, Event, Watcher
-from ..testing.lockgraph import named_lock
+from ..testing.lockgraph import named_lock, track_attrs
 from ..utils.metrics import metrics
 
 logger = logging.getLogger("kubernetes_tpu.apiserver.cacher")
@@ -502,13 +502,35 @@ class KindCache:
         with self._lock:
             return self._floor
 
-    def fanout_clients(self) -> int:
+    @property
+    def current_rv(self) -> int:
+        """The cache's high-water rv, read under the kind lock (the bare
+        `.rv` attribute is for lock-holding internals; the guarded-by
+        contract keeps outside readers off it)."""
+        with self._lock:
+            return self.rv
+
+    def stats_snapshot(self) -> dict:
+        """One-lock snapshot of the per-kind observability counters —
+        Cacher.stats() used to read `_objects`/`_ring` bare, exactly the
+        unguarded minority access the lockset sanitizer now rejects.
+        The fan-out prune/count folds into the SAME lock hold so the
+        row's size/rv/fanout values all coexist at one instant."""
         with self._lock:
             self._watchers = [w for w in self._watchers if not w.stopped]
             metrics.set_gauge(
                 GAUGE_FANOUT, len(self._watchers), {"kind": self.kind}
             )
-            return len(self._watchers)
+            return {
+                "size": len(self._objects),
+                "rv": self.rv,
+                "window_floor": self._floor,
+                "window_used": len(self._ring),
+                "fanout_clients": len(self._watchers),
+            }
+
+    def fanout_clients(self) -> int:
+        return self.stats_snapshot()["fanout_clients"]
 
     def stop(self) -> None:
         self._stop.set()
@@ -541,7 +563,8 @@ class Cacher:
         self.bookmark_period_s = bookmark_period_s
         self._watcher_queue_size = watcher_queue_size
         self._caches: Dict[str, KindCache] = {}
-        self._lock = threading.Lock()
+        # named for the lock-order watchdog + lockset sanitizer
+        self._lock = named_lock("cacher.top")
         self._stop = threading.Event()
         self._bookmark_thread = threading.Thread(
             target=self._bookmark_loop, name="watchcache-bookmarks", daemon=True
@@ -603,7 +626,7 @@ class Cacher:
             # version") instead — callers surface it as a retryable 504
             raise TimeoutError(
                 f"{kind} watch cache not fresh: have rv "
-                f"{kc.rv}, need {fresh_rv}"
+                f"{kc.current_rv}, need {fresh_rv}"
             )
         return kc.list_page(
             namespace=namespace,
@@ -613,7 +636,7 @@ class Cacher:
         )
 
     def current_rv(self, kind: str) -> int:
-        return self.cache_for(kind).rv
+        return self.cache_for(kind).current_rv
 
     # -- bookmarks -----------------------------------------------------------
 
@@ -640,16 +663,25 @@ class Cacher:
     def stats(self) -> Dict[str, dict]:
         with self._lock:
             caches = dict(self._caches)
-        return {
-            kind: {
-                "size": len(kc._objects),
-                "rv": kc.rv,
-                "window_floor": kc.floor,
-                "fanout_clients": kc.fanout_clients(),
-                "window_used": len(kc._ring),
-            }
-            for kind, kc in caches.items()
-        }
+        return {kind: kc.stats_snapshot() for kind, kc in caches.items()}
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): per-kind cache
+# state is written by the ONE dispatch thread and read by every client
+# list/watch/stats path — all under `cacher.kind`; the Cacher's kind map
+# under `cacher.top`. Chaos readpath storms assert the locksets never
+# go empty.
+track_attrs(
+    KindCache,
+    "_objects",
+    "_ring",
+    "_floor",
+    "rv",
+    "_watchers",
+    "_continuations",
+    "_cont_seq",
+)
+track_attrs(Cacher, "_caches")
 
 
 def readpath_health_lines() -> List[str]:

@@ -114,7 +114,7 @@ class WhatIfSimulator:
     # so one middle bucket earns its compile
     PAD_BUCKETS = (64, 256)
 
-    def __init__(self, cache, hard_pod_affinity_weight: float = 1.0,
+    def __init__(self, cache: "SchedulerCache", hard_pod_affinity_weight: float = 1.0,
                  max_pods_per_pass: int = 1024):
         self.cache = cache
         self.hard_w = hard_pod_affinity_weight

@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..api import serialization, validation
 from ..api.objects import event_copy
 from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
-from ..testing.lockgraph import named_lock
+from ..testing.lockgraph import named_lock, track_attrs
 
 
 class NotFound(KeyError):
@@ -737,3 +737,11 @@ class APIServer:
             return pod
 
         self.guaranteed_update("pods", binding.pod_namespace, binding.pod_name, mutate)
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): the store's
+# object/watcher/history maps are guarded by the `store` lock on every
+# CRUD, notify, and replication-catchup path. `_rv` is deliberately NOT
+# tracked: the replication heartbeat piggybacks a lock-free int peek of
+# it by design (runtime/replication.py _heartbeat_loop).
+track_attrs(APIServer, "_objects", "_watchers", "_history", "_evicted_rv")

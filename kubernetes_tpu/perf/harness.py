@@ -81,7 +81,8 @@ def run_benchmark(
     sched = Scheduler(server, scfg)
     # presize for a larger target cluster so a warm-up run compiles the same
     # kernel variant (same v_cap/n_cap) the measured run will use
-    sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
+    with sched.cache.lock:
+        sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
 
     nodes, init_pods, factory = build_workload(cfg)
     for n in nodes:
@@ -257,7 +258,8 @@ def run_latency_benchmark(
     server = APIServer()
     scfg = sched_config or KubeSchedulerConfiguration()
     sched = Scheduler(server, scfg)
-    sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
+    with sched.cache.lock:
+        sched.cache.encoder.presize_for_cluster(presize_nodes or cfg.num_nodes)
 
     nodes, init_pods, factory = build_workload(cfg)
     for n in nodes:
@@ -499,7 +501,7 @@ def run_readpath_benchmark(
     # dispatch is synchronous into every client queue: once the cache rv
     # catches the store rv, every delivery is enqueued
     deadline = time.monotonic() + 60.0
-    while kc.rv < server.resource_version and time.monotonic() < deadline:
+    while kc.current_rv < server.resource_version and time.monotonic() < deadline:
         time.sleep(0.001)
     duration = time.monotonic() - t0
     # let the sampled drainers finish their queues for honest percentiles

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 
 from ...api import objects as v1
 from ...ops.encoding import EncodingConfig, SnapshotEncoder
-from ...testing.lockgraph import named_lock
+from ...testing.lockgraph import named_lock, track_attrs
 from .nodeinfo import NodeInfo, Snapshot, _has_affinity
 
 logger = logging.getLogger("kubernetes_tpu.scheduler.cache")
@@ -368,6 +368,13 @@ class SchedulerCache:
         with self.lock:
             return pod_key in self._assumed
 
+    def assumed_keys(self) -> List[str]:
+        """Sorted outstanding-assume keys under the lock: the O(assumed)
+        accessor pollers want (a `dump()` poll would serialize the whole
+        cache per probe while holding the lock everyone else needs)."""
+        with self.lock:
+            return sorted(self._assumed)
+
     def has_pod(self, pod_key: str) -> bool:
         """True if the pod is assumed or placed (any node)."""
         with self.lock:
@@ -480,3 +487,18 @@ class SchedulerCache:
                 },
                 "assumed": sorted(self._assumed.keys()),
             }
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): the maps every
+# informer handler, wave commit, janitor sweep, and autoscaler scan
+# shares — guarded by `scheduler.cache`, now machine-checked in chaos
+track_attrs(
+    SchedulerCache,
+    "_nodes",
+    "_pod_to_node",
+    "_assumed",
+    "_orphans",
+    "_snap_clones",
+    "_generation",
+    "_ext_generation",
+)

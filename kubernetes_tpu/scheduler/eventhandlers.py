@@ -125,7 +125,7 @@ def _on_node_delete(sched, node):
     sched.queue.move_all_to_active_or_backoff(qevents.NODE_DELETE)
 
 
-def _register_service(sched, svc) -> bool:
+def _register_service(sched: "Scheduler", svc) -> bool:
     sel = getattr(svc.spec, "selector", None)
     if not sel:
         return False
@@ -140,7 +140,7 @@ def _register_service(sched, svc) -> bool:
         return len(enc.service_sids) != before
 
 
-def _rebuild_service_sids(sched) -> None:
+def _rebuild_service_sids(sched: "Scheduler") -> None:
     """Recompute the service-derived sid set from the LIVE services (the
     vocab can't shrink, but a deleted/retargeted service must drop out of
     the match_svc masks)."""

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ...api import objects as v1
+from ...testing.lockgraph import named_lock, track_attrs
 from .heap import Heap
 
 
@@ -44,7 +45,10 @@ class PriorityQueue:
         pod_max_backoff: float = 10.0,
         unschedulable_timeout: float = 60.0,
     ):
-        self._lock = threading.RLock()
+        # named for the lock-order watchdog + lockset sanitizer
+        # (testing/lockgraph.py); _cond shares the SAME lock, so both
+        # spellings record as "scheduler.queue"
+        self._lock = named_lock("scheduler.queue")
         self._cond = threading.Condition(self._lock)
         if less is None:
             less = lambda a, b: (
@@ -336,6 +340,15 @@ class PriorityQueue:
 
     # -- introspection -------------------------------------------------------
 
+    def moves_snapshot(self) -> int:
+        """The move-event counter, read under the queue lock. The
+        scheduler captures it before a scheduling attempt and compares at
+        failure time (AddUnschedulableIfNotPresent's movesAtFailure);
+        the bare attribute is for lock-holding internals only — the
+        lockset sanitizer caught the scheduler reading it bare."""
+        with self._lock:
+            return self.moves
+
     def unschedulable_pod_infos(self) -> List[QueuedPodInfo]:
         """Snapshot of unschedulableQ (the autoscaler's scale-up input):
         pods the scheduler proved don't fit the CURRENT cluster. Read-only
@@ -374,6 +387,21 @@ class PriorityQueue:
         pods are not available to the batch former)."""
         with self._lock:
             return len(self._active)
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): the queue's
+# heaps, the unschedulable/nominated maps, and the move counter are the
+# shared state every scheduler/informer/autoscaler thread touches —
+# chaos suites assert their lockset never goes empty
+track_attrs(
+    PriorityQueue,
+    "_active",
+    "_backoff",
+    "_unschedulable",
+    "_nominated",
+    "_nominated_by_node",
+    "moves",
+)
 
 
 def _significant_update(old: Optional[v1.Pod], new: v1.Pod) -> bool:

@@ -33,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..testing.lockgraph import named_lock, track_attrs
 from ..utils.metrics import metrics
 
 # gauges (rendered by /metrics and the SIGUSR2 debugger dump)
@@ -80,7 +81,8 @@ class BindRideThrough:
         self._probe_initial = probe_initial_s
         self._probe_max = probe_max_s
         self._probe_delay = probe_initial_s
-        self._lock = threading.Lock()
+        # named for the lock-order watchdog + lockset sanitizer
+        self._lock = named_lock("scheduler.ridethrough")
         self._entries: Dict[str, PendingBind] = {}  # pod UID -> entry
         self._open = False
         self._opened_at: Optional[float] = None
@@ -193,3 +195,15 @@ class BindRideThrough:
         metrics.set_gauge(
             GAUGE_BREAKER_STATE, BREAKER_OPEN if self._open else BREAKER_CLOSED
         )
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): the buffer and
+# breaker state are shared by the scheduling loop, the async bind pool,
+# and the reconciler — one lock, machine-checked
+track_attrs(
+    BindRideThrough,
+    "_entries",
+    "_open",
+    "_opened_at",
+    "_probe_delay",
+)

@@ -914,7 +914,7 @@ class Scheduler:
                 self.schedule_pod_batch(pis)
             except Exception:
                 logger.exception("scheduling batch failed")
-                moves = self.queue.moves
+                moves = self.queue.moves_snapshot()
                 for pi in pis:
                     self.queue.add_unschedulable_if_not_present(pi, moves)
             finally:
@@ -1071,7 +1071,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
             metrics.inc(COUNTER_RECONCILED, {"outcome": "lost_requeued"})
             self._handle_failure(
-                e.pi, self.queue.moves, message=str(err), error=True
+                e.pi, self.queue.moves_snapshot(), message=str(err), error=True
             )
 
     def _bind_pods_fenced(self, bindings) -> list:
@@ -1171,7 +1171,7 @@ class Scheduler:
     def schedule_pod_batch(self, pis: List[QueuedPodInfo]) -> None:
         trace = Trace("schedule_batch", pods=len(pis))
         t_start = time.monotonic()
-        moves0 = self.queue.moves
+        moves0 = self.queue.moves_snapshot()
         known: List[QueuedPodInfo] = []
         extender_pis: List[QueuedPodInfo] = []
         for pi in pis:
@@ -1666,7 +1666,8 @@ class Scheduler:
                 kern, snap, eb.batch, ptab, np.asarray(self._weights), sub
             )
         except Exception:
-            self.cache.encoder.invalidate_device()
+            with self.cache.lock:
+                self.cache.encoder.invalidate_device()
             raise
         trace.step("launch")
         # the donation lease inside _launch_wave_kernel already installed
@@ -1723,7 +1724,8 @@ class Scheduler:
             except Exception as e:
                 # device/tunnel error: the kernels' on-device commits are
                 # unknowable — rebuild HBM from the host masters and retry
-                self.cache.encoder.invalidate_device()
+                with self.cache.lock:
+                    self.cache.encoder.invalidate_device()
                 logger.exception(
                     "wave pipeline readback failed (%d batches)", len(batches)
                 )
@@ -1733,7 +1735,7 @@ class Scheduler:
                         "scheduler_device_loss_total", {"stage": "readback"}
                     )
                     self._handle_device_loss(e)
-                moves = self.queue.moves
+                moves = self.queue.moves_snapshot()
                 for b in batches:
                     for pi in b.pis:
                         if self.cache.has_pod(pi.pod.metadata.key):
@@ -1779,7 +1781,7 @@ class Scheduler:
             except Exception:
                 logger.exception("committing wave batch failed")
                 tails.append(None)
-                moves = self.queue.moves
+                moves = self.queue.moves_snapshot()
                 for pi in b.pis:
                     if not self.cache.has_pod(pi.pod.metadata.key):
                         self.queue.add_unschedulable_if_not_present(pi, moves)
@@ -1790,7 +1792,7 @@ class Scheduler:
                 self._finish_batch(b, tail[0], tail[1])
             except Exception:
                 logger.exception("resolving wave batch failures failed")
-                moves = self.queue.moves
+                moves = self.queue.moves_snapshot()
                 for pi in tail[0]:
                     if not self.cache.has_pod(pi.pod.metadata.key):
                         self.queue.add_unschedulable_if_not_present(pi, moves)
@@ -2288,9 +2290,10 @@ class Scheduler:
                 if device_synced:
                     # the kernel already committed this placement on-device;
                     # with no host replay the row must be re-uploaded
-                    self.cache.encoder.mark_row_dirty(node_name)
+                    with self.cache.lock:
+                        self.cache.encoder.mark_row_dirty(node_name)
                 self._handle_failure(
-                    pi, self.queue.moves, message=err, error=True
+                    pi, self.queue.moves_snapshot(), message=err, error=True
                 )
                 continue
             prof = self.profiles.for_pod(pod)
@@ -2354,7 +2357,7 @@ class Scheduler:
             else:
                 self.cache.forget_pod(pi.pod)
                 self._handle_failure(
-                    pi, self.queue.moves, message=str(err), error=True
+                    pi, self.queue.moves_snapshot(), message=str(err), error=True
                 )
         if to_buffer:
             self._buffer_pending_binds(to_buffer)
@@ -2371,13 +2374,13 @@ class Scheduler:
         st = fw.run_reserve_plugins(state, pod, node_name)
         if not is_success(st):
             self.cache.forget_pod(pod)
-            self._handle_failure(pi, self.queue.moves, message=st.message, error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message, error=True)
             return
         st = fw.run_permit_plugins(state, pod, node_name)
         if st is not None and st.code not in (Code.SUCCESS, Code.WAIT):
             self.cache.forget_pod(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
-            self._handle_failure(pi, self.queue.moves, message=st.message)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message)
             return
         try:
             self._bind_pool.submit(
@@ -2389,7 +2392,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(
-                pi, self.queue.moves, message="scheduler shutting down"
+                pi, self.queue.moves_snapshot(), message="scheduler shutting down"
             )
 
     # -- host fallback path ---------------------------------------------------
@@ -2450,7 +2453,7 @@ class Scheduler:
         try:
             self.volume_binder.assume_pod_volumes(pod, ni.node)
         except Exception as e:
-            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=str(e), error=True)
             return False
         return True
 
@@ -2464,13 +2467,13 @@ class Scheduler:
         st = fw.run_reserve_plugins(state, pod, node_name)
         if not is_success(st):
             self.volume_binder.forget_pod_volumes(pod)
-            self._handle_failure(pi, self.queue.moves, message=st.message, error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message, error=True)
             return
         try:
             self.cache.assume_pod(pod, node_name)
         except ValueError as e:
             self.volume_binder.forget_pod_volumes(pod)
-            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=str(e), error=True)
             return
         self.queue.delete_nominated_if_exists(pod)
         st = fw.run_permit_plugins(state, pod, node_name)
@@ -2478,7 +2481,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
             self.volume_binder.forget_pod_volumes(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
-            self._handle_failure(pi, self.queue.moves, message=st.message)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message)
             return
         try:
             self._bind_pool.submit(
@@ -2490,7 +2493,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(
-                pi, self.queue.moves, message="scheduler shutting down"
+                pi, self.queue.moves_snapshot(), message="scheduler shutting down"
             )
 
     def _bind_async(self, pi: QueuedPodInfo, node_name: str, state, t_start) -> None:
@@ -2568,12 +2571,12 @@ class Scheduler:
             self.cache.forget_pod(pod)
             self.volume_binder.forget_pod_volumes(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
-            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=str(e), error=True)
         except Exception as e:
             self.cache.forget_pod(pod)
             self.volume_binder.forget_pod_volumes(pod)
             fw.run_unreserve_plugins(state, pod, node_name)
-            self._handle_failure(pi, self.queue.moves, message=str(e), error=True)
+            self._handle_failure(pi, self.queue.moves_snapshot(), message=str(e), error=True)
 
     # -- failure path ---------------------------------------------------------
 

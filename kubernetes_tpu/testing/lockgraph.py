@@ -31,20 +31,58 @@ exactly the conservative behavior a watchdog wants.
 Not thread-exhaustive: edges only exist for orders actually executed.
 That is the point — it converts "the chaos suite happened not to
 deadlock" into "no executed path can deadlock on these locks".
+
+**Eraser mode** (the lockset sanitizer, ISSUE 12) is the watchdog's
+second runtime check: where the order graph proves the locks are taken
+in one order, Eraser mode proves shared attributes are taken under a
+lock AT ALL. Production classes register their shared mutable
+attributes with :func:`track_attrs`; each becomes a data descriptor
+that, while the sanitizer is enabled, records the classic Eraser state
+machine per ``(class, attr)``:
+
+  * exclusive to the first accessing thread → nothing tracked (init
+    writes are free);
+  * a second thread arrives → the candidate lockset C(v) starts as the
+    named locks held at that access and is intersected at every
+    subsequent access from any thread;
+  * C(v) empty once the attribute is shared → a RACE is recorded:
+    attribute, both stack tips (the previous access and the one that
+    emptied the set), and both locksets. Tracked attributes are exactly
+    the ones graftlint's guarded-by pass proved lock-guarded, so a
+    lock-free READ is as much a contract violation as a write — no
+    write requirement, unlike classic Eraser. ``lockgraph_races_total``
+    counts each distinct racy attribute once per epoch.
+
+The same autouse fixtures that assert the order graph is acyclic assert
+zero races (``assert_clean``), so `make chaos-device`, `chaos-readpath`
+and `chaos-ha` now machine-check the guarded-by contract graftlint
+pass 6 infers statically. Disabled (the production default) a tracked
+attribute costs one descriptor indirection and one boolean test per
+access; untracked attributes cost nothing.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 _enabled = False
+_eraser = False
 _epoch = 0  # bumped by enable(): stale per-thread state self-invalidates
 _graph_lock = threading.Lock()  # leaf lock: never held while acquiring others
 _edges: Dict[str, Set[str]] = {}
 _edge_sites: Dict[Tuple[str, str], int] = {}
 _violations: List[List[str]] = []
 _acquires: Dict[str, int] = {}
+# Eraser state lives ON each tracked instance (__dict__[_STATE_SLOT],
+# attr -> _AttrState): the exclusive-to-one-thread phase (constructor
+# writes) is an INSTANCE property, and a global map keyed by id() would
+# let a freed object's shared state bleed into a new instance reusing
+# the same address — the long multi-suite chaos runs hit exactly that.
+_STATE_SLOT = "_lockgraph_attr_state"
+_attr_accesses = 0
+_races: List[dict] = []
 _tls = threading.local()
 
 
@@ -113,6 +151,153 @@ def _record_release(name: str) -> None:
             return
 
 
+# -- Eraser mode: the lockset sanitizer ---------------------------------------
+
+
+class _AttrState:
+    __slots__ = (
+        "epoch",
+        "first_thread",
+        "shared",
+        "lockset",
+        "reported",
+        "last_site",
+        "last_lockset",
+        "last_thread",
+        "last_write",
+    )
+
+    def __init__(self, epoch: int, tid: object):
+        self.epoch = epoch
+        self.first_thread = tid
+        self.shared = False
+        self.lockset: Optional[Set[str]] = None
+        self.reported = False
+        self.last_site = "?"
+        self.last_lockset: Set[str] = set()
+        self.last_thread = tid
+        self.last_write = False
+
+
+def _thread_token() -> object:
+    """Identity of the calling thread with thread-LIFETIME scope: the OS
+    recycles `threading.get_ident()` values after a thread exits, so a
+    raw ident could make a later thread look like the object's exclusive
+    first thread and silently disarm the lockset machine. A per-thread
+    sentinel object dies with the thread (thread-local storage), so it
+    can never collide with a live one."""
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        tok = _tls.token = object()
+    return tok
+
+
+def _attr_access(cls_name: str, attr: str, is_write: bool, obj) -> None:
+    """One tracked-attribute access while the sanitizer is enabled.
+    Callers are the descriptor's __get__/__set__ (stack depth 2 below
+    the production access)."""
+    global _attr_accesses
+    tid = _thread_token()
+    held = set(_held())
+    frame = sys._getframe(2)
+    site = f"{frame.f_code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno}"
+    states = obj.__dict__.setdefault(_STATE_SLOT, {})
+    raced = None
+    with _graph_lock:
+        _attr_accesses += 1
+        st = states.get(attr)
+        if st is None or st.epoch != _epoch:
+            st = states[attr] = _AttrState(_epoch, tid)
+        if not st.shared and tid != st.first_thread:
+            st.shared = True
+        if st.shared:
+            st.lockset = (
+                set(held) if st.lockset is None else (st.lockset & held)
+            )
+            if not st.lockset and not st.reported:
+                st.reported = True
+                raced = f"{cls_name}.{attr}"
+                _races.append(
+                    {
+                        "attr": raced,
+                        "site": site,
+                        "lockset": sorted(held),
+                        "write": is_write,
+                        "prev_site": st.last_site,
+                        "prev_lockset": sorted(st.last_lockset),
+                        "prev_write": st.last_write,
+                    }
+                )
+        st.last_site = site
+        st.last_lockset = held
+        st.last_thread = tid
+        st.last_write = is_write
+    if raced is not None:
+        # outside _graph_lock: it is a leaf lock, and metrics.inc takes
+        # the metrics registry lock — counting inside would stack a
+        # foreign lock under the leaf
+        _count_race(raced)
+
+
+def _count_race(attr: str) -> None:
+    try:  # metrics are observability, never a sanitizer failure mode
+        from ..utils.metrics import metrics
+
+        metrics.inc("lockgraph_races_total", {"attr": attr})
+    except Exception:  # pragma: no cover - import cycles in exotic embeds
+        pass
+
+
+class guarded:
+    """Data descriptor wrapping one shared attribute for the sanitizer.
+
+    The value lives in the instance ``__dict__`` under a mangled slot;
+    disabled, an access costs the descriptor call plus one boolean test.
+    Install with :func:`track_attrs` (after the class body) or declare
+    ``attr = guarded("attr")`` in the class."""
+
+    __slots__ = ("name", "slot", "cls_name")
+
+    def __init__(self, name: str, cls_name: Optional[str] = None):
+        self.name = name
+        self.slot = "_lockgraph_" + name
+        self.cls_name = cls_name or "?"
+
+    def __set_name__(self, owner, name):  # declarative form
+        if self.cls_name == "?":
+            self.cls_name = owner.__name__
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            val = obj.__dict__[self.slot]
+        except KeyError:
+            raise AttributeError(self.name) from None
+        if _eraser:
+            _attr_access(self.cls_name, self.name, False, obj)
+        return val
+
+    def __set__(self, obj, value):
+        obj.__dict__[self.slot] = value
+        if _eraser:
+            _attr_access(self.cls_name, self.name, True, obj)
+
+    def __delete__(self, obj):
+        obj.__dict__.pop(self.slot, None)
+        if _eraser:
+            _attr_access(self.cls_name, self.name, True, obj)
+
+
+def track_attrs(cls, *names: str) -> None:
+    """Register shared mutable attributes of a production class with the
+    lockset sanitizer. Call once, right after the class definition — the
+    descriptors are permanent and idle-cheap; only enable(eraser=True)
+    makes them record."""
+    for name in names:
+        setattr(cls, name, guarded(name, cls.__name__))
+
+
 class NamedLock:
     """A lock wrapper that reports acquisitions to the watchdog.
 
@@ -173,24 +358,33 @@ def named_lock(name: str, inner=None) -> NamedLock:
 # -- watchdog control (chaos suites) -----------------------------------------
 
 
-def enable() -> None:
-    global _enabled, _epoch
+def enable(eraser: bool = False) -> None:
+    """Arm the watchdog (and, with eraser=True, the lockset sanitizer).
+    Always starts a fresh epoch: edges, races, and every thread's held
+    stack and per-attribute Eraser state from prior suites in the same
+    process are invalidated."""
+    global _enabled, _eraser, _epoch
     reset()
     _epoch += 1  # invalidate every thread's held stack from prior runs
     _enabled = True
+    _eraser = eraser
 
 
 def disable() -> None:
-    global _enabled
+    global _enabled, _eraser
     _enabled = False
+    _eraser = False
 
 
 def reset() -> None:
+    global _attr_accesses
     with _graph_lock:
         _edges.clear()
         _edge_sites.clear()
         _violations.clear()
         _acquires.clear()
+        _attr_accesses = 0
+        _races.clear()
 
 
 def edges() -> Dict[str, Set[str]]:
@@ -263,3 +457,42 @@ def assert_acyclic() -> None:
             for (a, b), n in sorted(_edge_sites.items()):
                 lines.append(f"  edge {a} -> {b} (seen {n}x)")
         raise AssertionError("\n".join(lines))
+
+
+def races() -> List[dict]:
+    """Empty-lockset race reports recorded by the sanitizer this epoch."""
+    with _graph_lock:
+        return [dict(r) for r in _races]
+
+
+def tracked_access_count() -> int:
+    """Tracked-attribute accesses observed this epoch — the
+    sanitizer-is-alive signal (a suite can legitimately record zero
+    RACES; with Eraser mode armed over the production classes it cannot
+    record zero accesses)."""
+    with _graph_lock:
+        return _attr_accesses
+
+
+def assert_no_races() -> None:
+    """Fail loudly on any empty-lockset race: each report carries both
+    stack tips and both locksets — the repro is in the message."""
+    got = races()
+    if got:
+        lines = ["lockset sanitizer: EMPTY-LOCKSET RACE DETECTED"]
+        for r in got:
+            lines.append(
+                f"  {r['attr']}: {r['prev_site']} "
+                f"(locks {r['prev_lockset'] or ['-']}, "
+                f"{'write' if r['prev_write'] else 'read'}) vs "
+                f"{r['site']} (locks {r['lockset'] or ['-']}, "
+                f"{'write' if r['write'] else 'read'})"
+            )
+        raise AssertionError("\n".join(lines))
+
+
+def assert_clean() -> None:
+    """The chaos-suite exit gate: zero lock-order cycles AND zero
+    empty-lockset races."""
+    assert_acyclic()
+    assert_no_races()

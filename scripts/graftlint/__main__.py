@@ -1,11 +1,18 @@
-"""graftlint: the donation / blocking / metrics / degraded-write linter.
+"""graftlint: the static concurrency/contract linter.
 
 Usage (from the repo root):
 
     python scripts/graftlint                 # lint the tree, exit 1 on findings
+    python scripts/graftlint --changed       # only changed files + importers
     python scripts/graftlint --list-metrics  # print the README metrics table
+    python scripts/graftlint --list-guards   # print the README attr→lock table
     python scripts/graftlint --write-baseline  # snapshot findings as baseline
     python scripts/graftlint path/to/file.py ...  # restrict the scan
+
+Passes: donation-safety (1), dispatch-blocking (2), metrics-contract
+(3), degraded-write (4), bind-fence seam (5), guarded-by inference (6),
+thread-hygiene, and the stale-pragma audit (always last — it fails any
+suppression pragma no pass consulted).
 
 Findings print as ``file:line: [pass] message`` and the process exits
 nonzero when any unsuppressed finding (or any STALE suppression) exists.
@@ -19,7 +26,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 if _HERE not in sys.path:  # `python scripts/graftlint` adds it; -m paths differ
@@ -31,9 +40,25 @@ import core
 import degraded
 import donation
 import fenceseam
+import guardedby
 import metrics_contract
+import pragmas
+import threads
 
 BASELINE = os.path.join(_HERE, "baseline.txt")
+
+# (name, runner) in execution order; the pragma audit is appended last
+# by main() because it must see every other pass's consumption marks
+PASSES = (
+    ("donation", lambda tree, root: donation.run(tree)),
+    ("blocking", lambda tree, root: blocking.run(tree)),
+    ("metrics", lambda tree, root: metrics_contract.run(tree, root)),
+    ("degraded", lambda tree, root: degraded.run(tree)),
+    ("fenceseam", lambda tree, root: fenceseam.run(tree)),
+    ("guardedby", lambda tree, root: guardedby.run(tree, root)),
+    ("threads", lambda tree, root: threads.run(tree)),
+    ("pragmas", lambda tree, root: pragmas.run(tree)),
+)
 
 
 def load_baseline(path: str):
@@ -45,6 +70,66 @@ def load_baseline(path: str):
                 if line and not line.startswith("#"):
                     keys.append(line)
     return keys
+
+
+def changed_files(root: str):
+    """Repo-relative .py files touched by the working tree (diff vs HEAD
+    + untracked), restricted to the scanned packages."""
+    out = set()
+    for args in (
+        ("git", "diff", "--name-only", "HEAD"),
+        ("git", "ls-files", "--others", "--exclude-standard"),
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(ln.strip() for ln in proc.stdout.splitlines() if ln.strip())
+    pkg_prefixes = tuple(p.rstrip("/") + "/" for p in config.PACKAGES)
+    return sorted(
+        f
+        for f in out
+        if f.endswith(".py")
+        and f.startswith(pkg_prefixes)
+        and os.path.exists(os.path.join(root, f))
+    )
+
+
+def with_importers(root: str, changed, universe):
+    """changed + every package file whose import lines mention a changed
+    module's name (one text-scan level: the pre-commit loop wants cheap,
+    not perfect — `make lint-static` remains the full gate)."""
+    stems = {
+        os.path.splitext(os.path.basename(f))[0]
+        for f in changed
+        if os.path.basename(f) != "__init__.py"
+    }
+    picked = set(changed)
+    for rel in universe:
+        if rel in picked or not stems:
+            continue
+        try:
+            with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+                for line in fh:
+                    ls = line.lstrip()
+                    if not (
+                        ls.startswith("import ") or ls.startswith("from ")
+                    ):
+                        continue
+                    head = ls.split("#", 1)[0]
+                    if any(
+                        s in head.replace(",", " ").replace(".", " ").split()
+                        for s in stems
+                    ):
+                        picked.add(rel)
+                        break
+        except OSError:
+            continue
+    return sorted(picked)
 
 
 def main(argv=None) -> int:
@@ -60,18 +145,70 @@ def main(argv=None) -> int:
         help="write current findings to the baseline and exit 0",
     )
     ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="report only findings in files changed vs HEAD (plus their "
+        "importers); the analysis itself stays full-tree — subset "
+        "analysis would fabricate/miss whole-program findings",
+    )
+    ap.add_argument(
         "--list-metrics",
         action="store_true",
         help="print the metrics reference table (markdown) and exit",
     )
+    ap.add_argument(
+        "--list-guards",
+        action="store_true",
+        help="print the inferred attr→lock table (markdown) and exit",
+    )
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root or os.getcwd())
+    changed_scope = None  # scoped-report modes: report findings only here
+    report_all_stale = False
     if args.files:
-        rels = [os.path.relpath(os.path.abspath(f), root) for f in args.files]
+        # same whole-program treatment as --changed: the guarded-by
+        # inference and the pragma audit need the FULL tree (a subset
+        # scan loses the call sites that consume a pragma and would
+        # instruct deleting suppressions the full gate requires). The
+        # named files — fixtures may sit outside the scanned packages —
+        # join the tree, and the report is scoped to them. Stale
+        # baseline entries stay unscoped: explicit-file runs are how
+        # the baseline itself is maintained.
+        named = [
+            os.path.relpath(os.path.abspath(f), root) for f in args.files
+        ]
+        rels = sorted(
+            set(core.discover(root, config.PACKAGES, config.EXCLUDE_DIRS))
+            | set(named)
+        )
+        changed_scope = set(named)
+        report_all_stale = True
+    elif args.changed:
+        # ONE full-tree parse and full-fidelity passes, findings
+        # filtered to the changed files + their importers: several
+        # passes are whole-program (guarded-by's every-call-site-holds-
+        # lock inference, the metrics dump/constant resolution, the
+        # pragma audit's consumption marks), and running them on a
+        # subset both fabricates findings (lock-holding callers outside
+        # the subset) and misses real ones. The pre-commit speed comes
+        # from scoped output and from skipping `make lint`'s slow-marker
+        # suite run, not from a smaller (unsound) analysis.
+        universe = core.discover(root, config.PACKAGES, config.EXCLUDE_DIRS)
+        ch = changed_files(root)
+        if ch is None:
+            print("graftlint: --changed needs a git checkout", file=sys.stderr)
+            return 2
+        if not ch:
+            print("graftlint: OK — no changed files")
+            return 0
+        rels = universe
+        changed_scope = set(with_importers(root, ch, universe))
     else:
         rels = core.discover(root, config.PACKAGES, config.EXCLUDE_DIRS)
+    t_parse = time.monotonic()
     tree = core.Tree(root, rels)
+    parse_s = time.monotonic() - t_parse
     for err in tree.parse_errors:
         print(f"graftlint: parse error: {err}", file=sys.stderr)
     if tree.parse_errors:
@@ -99,12 +236,27 @@ def main(argv=None) -> int:
             print(f"| `{name}` | {kinds} | {labels} |")
         return 0
 
+    if args.list_guards:
+        for line in guardedby.guards_table(tree):
+            print(line)
+        return 0
+
+    # findings stay UNFILTERED through baseline matching and
+    # --write-baseline: scoping before either would truncate the
+    # baseline on write and misreport out-of-scope entries as STALE.
+    # Only the final report is scoped.
     findings = []
-    findings += donation.run(tree)
-    findings += blocking.run(tree)
-    findings += metrics_contract.run(tree, root)
-    findings += degraded.run(tree)
-    findings += fenceseam.run(tree)
+    timings = []
+    for name, runner in PASSES:
+        t0 = time.monotonic()
+        got = runner(tree, root)
+        shown = (
+            len(got)
+            if changed_scope is None
+            else sum(1 for f in got if f.path in changed_scope)
+        )
+        timings.append((name, shown, time.monotonic() - t0))
+        findings += got
     # passes can surface the same hazard through two rules; report once
     seen = set()
     deduped = []
@@ -134,25 +286,42 @@ def main(argv=None) -> int:
     live = [f for f in findings if f.baseline_key() not in baseline]
     matched_keys = {f.baseline_key() for f in suppressed}
     stale = [k for k in baseline if k not in matched_keys]
+    if changed_scope is not None:
+        # scope the REPORT, after full-fidelity baseline matching: an
+        # out-of-scope suppression is neither stale nor this run's
+        # problem, and an out-of-scope finding waits for the full gate
+        live = [f for f in live if f.path in changed_scope]
+        if not report_all_stale:
+            stale = [
+                k for k in stale if k.split("::", 1)[0] in changed_scope
+            ]
 
     for f in live:
         print(f.render())
     for k in stale:
         print(f"graftlint: STALE baseline entry (matches nothing): {k}")
-    n_pass = {}
-    for f in findings:
-        n_pass[f.pass_name] = n_pass.get(f.pass_name, 0) + 1
-    summary = ", ".join(f"{p}={n}" for p, n in sorted(n_pass.items())) or "none"
+    # the one-line pass summary `make lint` surfaces: findings + wall
+    # time per pass, so a pass that silently got slow (or silently
+    # stopped finding anything) is visible on every run
+    summary = " ".join(f"{n}={c}/{s:.2f}s" for n, c, s in timings)
+    total_s = parse_s + sum(s for _n, _c, s in timings)
     if live or stale:
         print(
             f"graftlint: {len(live)} finding(s) "
-            f"({summary}; suppressed={len(suppressed)}, stale={len(stale)}) "
-            f"across {len(tree.modules)} files"
+            f"(suppressed={len(suppressed)}, stale={len(stale)}) "
+            f"across {len(tree.modules)} files "
+            f"[parse={parse_s:.2f}s {summary} total={total_s:.2f}s]"
         )
         return 1
+    scoped = (
+        f", scoped to {len(changed_scope)} file(s)"
+        if changed_scope is not None
+        else ""
+    )
     print(
         f"graftlint: OK — {len(tree.modules)} files clean "
-        f"(suppressed={len(suppressed)})"
+        f"(suppressed={len(suppressed)}{scoped}) "
+        f"[parse={parse_s:.2f}s {summary} total={total_s:.2f}s]"
     )
     return 0
 

@@ -109,6 +109,7 @@ def _allowed(mod: Module, call: ast.Call) -> Tuple[bool, bool]:
     for ln in range(start, end + 1):
         for p in mod.pragmas.get(ln, ()):
             if p.directive == "allow-blocking":
+                p.consumed = True
                 return True, not p.reason
     return False, False
 

@@ -177,6 +177,71 @@ DEGRADED_HANDLERS = {
 # runs the identical guarded _worker shape.
 DEGRADED_TOLERANT_BASES = {"WorkqueueController", "ReplicaSetController"}
 
+# -- pass 6: guarded-by inference --------------------------------------------
+
+# concurrency-critical classes whose `self._x` state the guarded-by pass
+# indexes. For each attribute the pass infers the guarding lock from
+# majority usage (accesses lexically inside `with <lock>` bodies or in
+# functions whose every call site holds the lock, resolved through the
+# call graph) and flags minority unguarded accesses. These are exactly
+# the classes the post-device_lock concurrency model shares across
+# threads: the encoder's generation table, the scheduler cache, the
+# watch cache, the store, the scheduling queue, the ride-through buffer,
+# and the elector.
+GUARDEDBY_CLASSES = (
+    "SnapshotEncoder",
+    "SchedulerCache",
+    "KindCache",
+    "Cacher",
+    "APIServer",
+    "PriorityQueue",
+    "BindRideThrough",
+    "LeaderElector",
+)
+
+# canonicalization of lock spellings to the runtime watchdog names
+# (testing/lockgraph.py named_lock names), so the static pass, the
+# dynamic lockset sanitizer, and `# graftlint: holds-<lock>` pragmas all
+# speak one vocabulary. Keys are tried most-specific first:
+# "<Class>.<attr>" for `with self.<attr>` inside <Class>, then the
+# trailing "<recv>.<attr>" pair, then the bare attribute name.
+GUARD_LOCK_ALIASES = {
+    "SchedulerCache.lock": "scheduler.cache",
+    "cache.lock": "scheduler.cache",
+    "SnapshotEncoder._gen_lock": "encoder.gen_lock",
+    "_gen_lock": "encoder.gen_lock",
+    "KindCache._lock": "cacher.kind",
+    "Cacher._lock": "cacher.top",
+    "APIServer._lock": "store",
+    "PriorityQueue._lock": "scheduler.queue",
+    "PriorityQueue._cond": "scheduler.queue",
+    "BindRideThrough._lock": "scheduler.ridethrough",
+    # the anti-entropy auditor is handed the scheduler cache lock at
+    # construction: its `with self.lock` IS the cache lock
+    "SnapshotAntiEntropy.lock": "scheduler.cache",
+}
+
+# the human-facing attr→lock reference the inferred guard map must
+# appear in (the `--list-guards` generator regenerates its table)
+GUARDS_DOC = "README.md"
+
+# -- stale-pragma audit -------------------------------------------------------
+
+# suppression directives that MUST be consulted by some pass on their
+# line: one of these surviving where no pass looks anymore is itself a
+# finding (the pragma equivalent of a stale baseline entry). "holds-"
+# prefixed directives are audited as a family.
+AUDITED_PRAGMAS = (
+    "allow-blocking",
+    "degraded-ok",
+    "fence-exempt",
+    "alias-safe",
+    "unguarded",
+    "guarded-by",
+    "thread-ok",
+)
+AUDITED_PRAGMA_PREFIXES = ("holds-",)
+
 # -- pass 5: scheduler bind-fence seam ---------------------------------------
 
 # dirs whose bind-write call sites must funnel through the fence seam

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 PRAGMA_RE = re.compile(
-    r"#\s*graftlint:\s*(?P<directive>[A-Za-z0-9_-]+)"
+    r"#\s*graftlint:\s*(?P<directive>[A-Za-z0-9_.-]+)"
     r"(?:\((?P<reason>[^)]*)\))?"
 )
 
@@ -45,6 +45,10 @@ class Pragma:
     directive: str
     reason: str
     line: int
+    # set whenever a pass queries this line for this directive: the
+    # stale-pragma audit fails any suppression pragma no pass consulted,
+    # so a pragma cannot outlive the code it excused
+    consumed: bool = False
 
 
 class Module:
@@ -92,9 +96,12 @@ class Module:
     # -- pragma queries ------------------------------------------------------
 
     def line_has(self, line: int, directive: str) -> bool:
-        return any(
-            p.directive == directive for p in self.pragmas.get(line, ())
-        )
+        hit = False
+        for p in self.pragmas.get(line, ()):
+            if p.directive == directive:
+                p.consumed = True
+                hit = True
+        return hit
 
     def node_has(self, node: ast.AST, directive: str) -> bool:
         """Pragma on any physical line the node spans (decorator lines of a

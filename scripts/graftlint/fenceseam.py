@@ -68,6 +68,8 @@ def _marked_exempt(mod: Module, call: ast.Call) -> Optional[bool]:
             )
     if not pragmas:
         return None
+    for p in pragmas:
+        p.consumed = True
     return all(p.reason for p in pragmas)
 
 

@@ -1,0 +1,851 @@
+"""Pass 6: guarded-by inference — the lockset contract, statically.
+
+PR 11 deleted the process-wide ``device_lock``; since then the scheduler
+runs waves, audits, what-if passes, and informer flushes genuinely
+concurrently, and the only machine checks were lock *ordering*
+(testing/lockgraph.py) and donation-lease placement (pass 1). Nothing
+checked that shared mutable state is actually *guarded*. This pass
+encodes the classic Eraser lockset discipline as a static contract over
+the concurrency-critical classes (config.GUARDEDBY_CLASSES):
+
+  * every ``self._x`` attribute access (and every shared module global —
+    a name some function mutates through ``global``) in those classes is
+    indexed;
+  * each attribute's guarding lock is INFERRED from majority usage: an
+    access counts as guarded when it sits lexically inside a
+    ``with <lock>:`` body, inside a function carrying a
+    ``# graftlint: holds-<lock>`` pragma, or inside a function whose
+    EVERY call site (resolved through the cross-module call graph, with
+    attribute types inferred from constructor assignments) holds the
+    lock;
+  * minority unguarded accesses are findings:
+    ``attr 'X' guarded by 'Y' at N sites, unguarded here``.
+
+Lock spellings canonicalize to the runtime watchdog names
+(config.GUARD_LOCK_ALIASES), so ``with self.lock`` in SchedulerCache,
+``with self.cache.lock`` in the scheduler, and the dynamic sanitizer's
+held-lockset all agree the guard is ``scheduler.cache``.
+
+Overrides:
+  * ``# graftlint: guarded-by(lock)`` on an attribute assignment
+    declares the guard explicitly (stronger than inference: ALL
+    unguarded accesses flag, even if they are the majority);
+  * ``# graftlint: unguarded(reason)`` on an access exempts that one
+    site; on the ``__init__`` assignment it exempts the whole attribute
+    (single-writer / atomic-read designs). The reason is mandatory.
+
+Accesses inside ``__init__`` — and inside helpers reachable ONLY from
+``__init__`` — are pre-publication and never counted: no other thread
+can hold a reference yet.
+
+The inferred map doubles as documentation: ``--list-guards`` renders it
+as a markdown table, and every inferred row must appear in the README's
+concurrency-contract table (config.GUARDS_DOC), checked exactly the way
+the metrics pass checks the metrics reference.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from core import Finding, Module, Tree, dotted_name
+import config
+
+PASS = "guardedby"
+
+# method names that mutate a container in place: a `self._q.append(x)`
+# is a WRITE to `_q` for lockset purposes even though the attribute
+# binding never changes
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "popleft",
+    "remove",
+    "setdefault",
+    "sort",
+    "update",
+}
+
+# constructors whose instances synchronize themselves: an attribute
+# holding one needs no external guard (calls on it are thread-safe by
+# contract; only REBINDING such an attribute would race, and rebinding
+# still counts as a write on the attribute itself)
+_SYNC_TYPES = {
+    "Event",
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "SimpleQueue",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "Lock",
+    "RLock",
+    "Condition",
+    "named_lock",
+}
+
+# ast simple-statement types an access pragma may sit on (compound
+# statements span whole blocks — a pragma there would govern too much)
+_SIMPLE_STMT = (
+    ast.Assign,
+    ast.AugAssign,
+    ast.AnnAssign,
+    ast.Expr,
+    ast.Return,
+    ast.Raise,
+    ast.Assert,
+    ast.Delete,
+)
+
+
+def canon_lock(dotted: str, cls_name: Optional[str]) -> str:
+    """Canonical (watchdog) name for a lock spelling inside cls_name."""
+    parts = dotted.split(".")
+    if parts[0] == "self" and len(parts) == 2 and cls_name:
+        cands = [f"{cls_name}.{parts[1]}", parts[1]]
+    elif len(parts) >= 2:
+        cands = [".".join(parts[-2:]), parts[-1]]
+    else:
+        cands = [parts[0]]
+    for c in cands:
+        if c in config.GUARD_LOCK_ALIASES:
+            return config.GUARD_LOCK_ALIASES[c]
+    return cands[0]
+
+
+def _is_lockish(dotted: str) -> bool:
+    last = dotted.rsplit(".", 1)[-1].lower()
+    return "lock" in last or last == "_cond" or last.endswith("_cond")
+
+
+def _lexical_locks(mod: Module, node: ast.AST, cls_name: Optional[str]) -> Set[str]:
+    """Canonical names of every lock whose `with` body lexically encloses
+    node. Call-form context managers (lease factories) are not locks."""
+    out: Set[str] = set()
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    continue  # lease factories etc. — not mutual exclusion
+                d = dotted_name(expr)
+                if d and _is_lockish(d):
+                    out.add(canon_lock(d, cls_name))
+    return out
+
+
+def _holds_pragmas(mod: Module, func: ast.AST) -> Set[str]:
+    """Locks declared held via `# graftlint: holds-<lock>` on the def
+    line (or decorator lines) of func."""
+    out: Set[str] = set()
+    if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out
+    lines = {func.lineno}
+    for dec in func.decorator_list:
+        lines.add(dec.lineno)
+    body_start = func.body[0].lineno if func.body else func.lineno
+    lines.update(range(func.lineno, body_start))
+    for ln in lines:
+        for p in mod.pragmas.get(ln, ()):
+            if p.directive.startswith("holds-"):
+                name = p.directive[len("holds-") :]
+                if name == "generation-lease":
+                    continue  # pass-1 directive, not a lock
+                p.consumed = True
+                out.add(config.GUARD_LOCK_ALIASES.get(name, name))
+    return out
+
+
+def _stmt_lines(mod: Module, node: ast.AST) -> List[int]:
+    """Physical lines of the nearest simple statement holding node (the
+    lines an access-site pragma may sit on)."""
+    stmt: ast.AST = node
+    for anc in mod.ancestors(node):
+        if isinstance(anc, _SIMPLE_STMT):
+            stmt = anc
+            break
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            break
+    start = getattr(stmt, "lineno", getattr(node, "lineno", 0))
+    end = getattr(stmt, "end_lineno", start)
+    return list(range(start, end + 1))
+
+
+def _access_pragma(mod: Module, node: ast.AST, directive: str):
+    """The pragma of `directive` governing this access, if any."""
+    for ln in _stmt_lines(mod, node):
+        for p in mod.pragmas.get(ln, ()):
+            if p.directive == directive:
+                p.consumed = True
+                return p
+    return None
+
+
+# -- attribute-type + call-graph machinery ------------------------------------
+
+
+@dataclass
+class _Ctx:
+    """Everything the inference needs, computed once per tree."""
+
+    class_index: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    class_module: Dict[str, Module] = field(default_factory=dict)
+    # (class, attr) -> class name the attr holds (constructor inference)
+    attr_types: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # methods per class: class -> {name: FunctionDef}
+    methods: Dict[str, Dict[str, ast.AST]] = field(default_factory=dict)
+    # function node -> list of (mod, call node, enclosing func node|None,
+    # enclosing class name|None)
+    call_sites: Dict[ast.AST, List[tuple]] = field(default_factory=dict)
+    # function node -> (module, qualname, class)
+    func_info: Dict[ast.AST, tuple] = field(default_factory=dict)
+    held: Dict[ast.AST, Optional[Set[str]]] = field(default_factory=dict)
+    init_only: Dict[ast.AST, bool] = field(default_factory=dict)
+
+
+def _classes_in_value(value: ast.AST, class_index) -> Optional[str]:
+    """First known class constructed anywhere inside an assigned value
+    (`self.x = Cls(...)`, `self.x = arg or Cls(...)`)."""
+    for sub in ast.walk(value):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id in class_index
+        ):
+            return sub.func.id
+    return None
+
+
+def _annotation_class(ann: Optional[ast.AST], class_index) -> Optional[str]:
+    if ann is None:
+        return None
+    for sub in ast.walk(ann):
+        if isinstance(sub, ast.Name) and sub.id in class_index:
+            return sub.id
+        if (
+            isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and sub.value.rsplit(".", 1)[-1] in class_index
+        ):
+            return sub.value.rsplit(".", 1)[-1]
+    return None
+
+
+def _build_ctx(tree: Tree) -> _Ctx:
+    ctx = _Ctx()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                ctx.class_index.setdefault(node.name, node)
+                ctx.class_module.setdefault(node.name, mod)
+                meths = ctx.methods.setdefault(node.name, {})
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        meths.setdefault(item.name, item)
+
+    # attr types: self.X = Cls(...) / annotated params assigned through
+    for cls_name, cls_node in ctx.class_index.items():
+        mod = ctx.class_module[cls_name]
+        # param annotations of __init__: `encoder: Optional[SnapshotEncoder]`
+        init = ctx.methods.get(cls_name, {}).get("__init__")
+        param_types: Dict[str, str] = {}
+        if init is not None:
+            a = init.args
+            for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                t = _annotation_class(p.annotation, ctx.class_index)
+                if t:
+                    param_types[p.arg] = t
+        for node in ast.walk(cls_node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    t = None
+                    if node.value is not None:
+                        t = _classes_in_value(node.value, ctx.class_index)
+                        if t is None and isinstance(node.value, ast.Name):
+                            t = param_types.get(node.value.id)
+                    if t is None and isinstance(node, ast.AnnAssign):
+                        t = _annotation_class(
+                            node.annotation, ctx.class_index
+                        )
+                    if t:
+                        ctx.attr_types.setdefault((cls_name, tgt.attr), t)
+
+    # function registry
+    for infos in tree.functions.values():
+        for fi in infos:
+            ctx.func_info[fi.node] = (fi.module, fi.qualname, fi.class_name)
+            ctx.call_sites.setdefault(fi.node, [])
+
+    # resolve every call to callee function nodes
+    for mod in tree.modules:
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            enc_func = mod.enclosing_function(call)
+            enc_cls = mod.enclosing_class(call)
+            enc_cls_name = enc_cls.name if enc_cls else None
+            for callee in _resolve_call(tree, ctx, mod, call, enc_cls_name, enc_func):
+                ctx.call_sites.setdefault(callee, []).append(
+                    (mod, call, enc_func, enc_cls_name)
+                )
+
+    _compute_init_only(ctx)
+    _compute_held(tree, ctx)
+    return ctx
+
+
+def _name_class(
+    ctx: _Ctx,
+    mod: Module,
+    name: str,
+    enc_cls_name: Optional[str],
+    enc_func: Optional[ast.AST],
+    depth: int,
+) -> Optional[str]:
+    """Static class of a bare name: an annotated parameter, or a local
+    assigned from a resolvable expression (`enc = self.cache.encoder`)."""
+    if enc_func is None or depth > 3:
+        return None
+    a = enc_func.args
+    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        if p.arg == name:
+            return _annotation_class(p.annotation, ctx.class_index)
+    best = None
+    for node in ast.walk(enc_func):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            best = _receiver_class(
+                ctx, mod, node.value, enc_cls_name, enc_func, depth + 1
+            ) or _classes_in_value(node.value, ctx.class_index)
+    return best
+
+
+def _receiver_class(
+    ctx: _Ctx,
+    mod: Module,
+    recv: ast.AST,
+    enc_cls_name: Optional[str],
+    enc_func: Optional[ast.AST],
+    depth: int = 0,
+) -> Optional[str]:
+    """Static class of a call receiver: `self[.X[.Y]]`, an annotated
+    parameter, or a local assigned from one of those — attribute chains
+    fold through the constructor-inferred attr-type map."""
+    d = dotted_name(recv)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if parts[0] == "self":
+        cur = enc_cls_name
+    else:
+        cur = _name_class(ctx, mod, parts[0], enc_cls_name, enc_func, depth)
+    for attr in parts[1:]:
+        if cur is None:
+            return None
+        cur = ctx.attr_types.get((cur, attr))
+    return cur
+
+
+def _resolve_call(
+    tree: Tree,
+    ctx: _Ctx,
+    mod: Module,
+    call: ast.Call,
+    enc_cls_name: Optional[str],
+    enc_func: Optional[ast.AST],
+) -> List[ast.AST]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in ctx.class_index:
+            init = ctx.methods.get(f.id, {}).get("__init__")
+            return [init] if init is not None else []
+        # same-module plain function, else the unique imported one
+        same = [
+            fi.node
+            for fi in tree.funcs_named(f.id)
+            if fi.module is mod and fi.class_name is None
+        ]
+        if same:
+            return same
+        other = [
+            fi.node
+            for fi in tree.funcs_named(f.id)
+            if fi.class_name is None
+        ]
+        return other if len(other) == 1 else []
+    if isinstance(f, ast.Attribute):
+        cls = _receiver_class(ctx, mod, f.value, enc_cls_name, enc_func)
+        if cls is None:
+            return []
+        m = ctx.methods.get(cls, {}).get(f.attr)
+        return [m] if m is not None else []
+    return []
+
+
+def _compute_init_only(ctx: _Ctx) -> None:
+    """init_only[f]: every path reaching f starts in some __init__ —
+    accesses in f are pre-publication."""
+    for fnode, (mod, qual, cls) in ctx.func_info.items():
+        ctx.init_only[fnode] = qual.endswith("__init__")
+    changed = True
+    while changed:
+        changed = False
+        for fnode, sites in ctx.call_sites.items():
+            if fnode not in ctx.init_only or ctx.init_only[fnode] or not sites:
+                continue
+            if all(
+                enc is not None and ctx.init_only.get(enc, False)
+                for (_m, _c, enc, _cn) in sites
+            ):
+                ctx.init_only[fnode] = True
+                changed = True
+
+
+def _compute_held(tree: Tree, ctx: _Ctx) -> None:
+    """Greatest-fixpoint lock-held sets: held(f) = ⋂ over call sites of
+    (lexical locks at the site ∪ held(enclosing)) ∪ holds- pragmas.
+    None means "universe" (optimistic init for functions with sites)."""
+    pragma_holds: Dict[ast.AST, Set[str]] = {}
+    for fnode, (mod, _qual, _cls) in ctx.func_info.items():
+        pragma_holds[fnode] = _holds_pragmas(mod, fnode)
+        ctx.held[fnode] = None if ctx.call_sites.get(fnode) else set(
+            pragma_holds[fnode]
+        )
+    changed = True
+    while changed:
+        changed = False
+        for fnode, sites in ctx.call_sites.items():
+            if fnode not in ctx.func_info or not sites:
+                continue
+            acc: Optional[Set[str]] = None
+            for mod, call, enc, enc_cls_name in sites:
+                if enc is not None and ctx.init_only.get(enc, False):
+                    continue  # pre-publication caller: no concurrency yet
+                at = _lexical_locks(mod, call, enc_cls_name)
+                if enc is not None:
+                    h = ctx.held.get(enc)
+                    if h is None:
+                        continue  # universe: doesn't narrow
+                    at = at | h
+                acc = at if acc is None else (acc & at)
+            if acc is None:
+                continue  # all sites still optimistic
+            acc = acc | pragma_holds[fnode]
+            if ctx.held[fnode] is None or acc != ctx.held[fnode]:
+                if ctx.held[fnode] is None or acc < ctx.held[fnode]:
+                    ctx.held[fnode] = acc
+                    changed = True
+    # anything still optimistic (call-site cycles with no grounded entry)
+    # resolves to its pragma set only
+    for fnode in ctx.held:
+        if ctx.held[fnode] is None:
+            ctx.held[fnode] = set(pragma_holds.get(fnode, ()))
+
+
+def _is_write(mod: Module, node: ast.AST) -> bool:
+    """Rebinding, aug-assign, item-assign/del through the attribute, or
+    an in-place mutator method call on it."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = mod.parents.get(node)
+    if isinstance(parent, ast.AugAssign) and parent.target is node:
+        return True
+    if (
+        isinstance(parent, ast.Subscript)
+        and parent.value is node
+        and isinstance(parent.ctx, (ast.Store, ast.Del))
+    ):
+        return True
+    if (
+        isinstance(parent, ast.Attribute)
+        and parent.value is node
+        and parent.attr in _MUTATORS
+    ):
+        gp = mod.parents.get(parent)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+@dataclass
+class _Access:
+    mod: Module
+    node: ast.AST
+    line: int
+    held: Set[str]
+    func_name: str
+    is_init: bool
+    is_write: bool
+
+
+def _collect_accesses(
+    tree: Tree, ctx: _Ctx, classes
+) -> Dict[Tuple[str, str], List[_Access]]:
+    """(class, attr) -> accesses, for the configured classes. Methods and
+    properties of the class are not data attributes; __init__-only
+    helpers are pre-publication."""
+    out: Dict[Tuple[str, str], List[_Access]] = {}
+    for cls_name in classes:
+        cls_node = ctx.class_index.get(cls_name)
+        if cls_node is None:
+            continue
+        mod = ctx.class_module[cls_name]
+        meths = ctx.methods.get(cls_name, {})
+        for fname, fnode in meths.items():
+            held_base = ctx.held.get(fnode, set())
+            init = ctx.init_only.get(fnode, False)
+            for node in ast.walk(fnode):
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    continue
+                if node.attr in meths:
+                    continue  # method/property reference, not shared data
+                is_write = _is_write(mod, node)
+                held = set(held_base) | _lexical_locks(mod, node, cls_name)
+                out.setdefault((cls_name, node.attr), []).append(
+                    _Access(
+                        mod,
+                        node,
+                        node.lineno,
+                        held,
+                        fname,
+                        init,
+                        is_write,
+                    )
+                )
+    return out
+
+
+def _collect_global_accesses(
+    tree: Tree, ctx: _Ctx, classes
+) -> Dict[Tuple[str, str], List[_Access]]:
+    """Shared module globals (a name some function rebinds via `global`)
+    in the modules that define the guarded classes."""
+    mods = {
+        ctx.class_module[c]
+        for c in classes
+        if c in ctx.class_module
+    }
+    out: Dict[Tuple[str, str], List[_Access]] = {}
+    for mod in mods:
+        shared: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Global):
+                shared.update(node.names)
+        if not shared:
+            continue
+        stem = os.path.splitext(os.path.basename(mod.rel))[0]
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Name) and node.id in shared):
+                continue
+            fnode = mod.enclosing_function(node)
+            if fnode is None:
+                continue  # module level = import-time, pre-threading
+            cls = mod.enclosing_class(node)
+            held = set(ctx.held.get(fnode, set())) | _lexical_locks(
+                mod, node, cls.name if cls else None
+            )
+            out.setdefault((stem, node.id), []).append(
+                _Access(
+                    mod,
+                    node,
+                    node.lineno,
+                    held,
+                    fnode.name,
+                    ctx.init_only.get(fnode, False),
+                    isinstance(node.ctx, (ast.Store, ast.Del)),
+                )
+            )
+    return out
+
+
+# -- inference + findings -----------------------------------------------------
+
+
+@dataclass
+class GuardInfo:
+    owner: str          # class name or module stem
+    attr: str
+    lock: Optional[str]
+    guarded: int
+    total: int
+    declared: bool
+    exempt: bool
+    decl_site: Optional[Tuple[str, int]]  # (rel path, line) anchor
+
+
+def _declarations(ctx: _Ctx, classes):
+    """Per-attr explicit overrides from pragmas on assignment sites:
+    guarded-by(lock) declares the guard; unguarded(reason) on an
+    __init__ assignment exempts the attribute. Returns
+    (declared_guards, exempt_attrs, missing_reason_findings, decl_sites)."""
+    declared: Dict[Tuple[str, str], str] = {}
+    exempt: Dict[Tuple[str, str], bool] = {}
+    sync_attrs: Set[Tuple[str, str]] = set()
+    missing: List[Finding] = []
+    decl_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for cls_name in classes:
+        cls_node = ctx.class_index.get(cls_name)
+        if cls_node is None:
+            continue
+        mod = ctx.class_module[cls_name]
+        for node in ast.walk(cls_node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                key = (cls_name, tgt.attr)
+                fnode = mod.enclosing_function(node)
+                in_init = (
+                    fnode is not None
+                    and ctx.init_only.get(fnode, False)
+                )
+                if node.value is not None:
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        vn = (
+                            v.func.attr
+                            if isinstance(v.func, ast.Attribute)
+                            else v.func.id
+                            if isinstance(v.func, ast.Name)
+                            else None
+                        )
+                        if vn in _SYNC_TYPES:
+                            sync_attrs.add(key)
+                if key not in decl_sites or (
+                    in_init and decl_sites[key][2] is False
+                ):
+                    decl_sites[key] = (mod.rel, node.lineno, in_init)
+                for ln in range(
+                    node.lineno, getattr(node, "end_lineno", node.lineno) + 1
+                ):
+                    for p in mod.pragmas.get(ln, ()):
+                        if p.directive == "guarded-by":
+                            p.consumed = True
+                            if not p.reason:
+                                missing.append(
+                                    Finding(
+                                        mod.rel,
+                                        ln,
+                                        PASS,
+                                        f"no-lock:{cls_name}.{tgt.attr}",
+                                        "guarded-by pragma on "
+                                        f"'{tgt.attr}' names no lock",
+                                    )
+                                )
+                            else:
+                                declared[key] = (
+                                    config.GUARD_LOCK_ALIASES.get(
+                                        p.reason.strip(), p.reason.strip()
+                                    )
+                                )
+                        elif p.directive == "unguarded" and in_init:
+                            p.consumed = True
+                            if not p.reason:
+                                missing.append(
+                                    Finding(
+                                        mod.rel,
+                                        ln,
+                                        PASS,
+                                        f"no-reason:{cls_name}.{tgt.attr}",
+                                        "unguarded pragma on "
+                                        f"'{tgt.attr}' needs a reason",
+                                    )
+                                )
+                            else:
+                                exempt[key] = True
+    return declared, exempt, sync_attrs, missing, decl_sites
+
+
+def infer(
+    tree: Tree, classes=None
+) -> Tuple[List[GuardInfo], List[Finding], Dict[Tuple[str, str], List[_Access]]]:
+    classes = tuple(classes or config.GUARDEDBY_CLASSES)
+    ctx = _build_ctx(tree)
+    accesses = _collect_accesses(tree, ctx, classes)
+    accesses.update(_collect_global_accesses(tree, ctx, classes))
+    declared, exempt, sync_attrs, findings, decl_sites = _declarations(
+        ctx, classes
+    )
+
+    guards: List[GuardInfo] = []
+    for (owner, attr), accs in sorted(accesses.items()):
+        counted = [a for a in accs if not a.is_init]
+        total = len(counted)
+        by_lock: Dict[str, int] = {}
+        for a in counted:
+            for lk in a.held:
+                by_lock[lk] = by_lock.get(lk, 0) + 1
+        lock: Optional[str] = None
+        guarded = 0
+        if (owner, attr) in declared:
+            lock = declared[(owner, attr)]
+            guarded = by_lock.get(lock, 0)
+        elif not any(a.is_write for a in counted):
+            # immutable after publication: every post-__init__ access is
+            # a read, so no guard is required (the Eraser read-only
+            # exemption, statically)
+            pass
+        elif (owner, attr) in sync_attrs and not any(
+            isinstance(a.node.ctx, (ast.Store, ast.Del)) for a in counted
+        ):
+            # a never-rebound synchronization primitive (Event, Queue,
+            # named lock): calls on it are thread-safe by contract
+            pass
+        elif by_lock:
+            lock, guarded = max(
+                by_lock.items(), key=lambda kv: (kv[1], kv[0])
+            )
+            # strict majority or the attribute has no inferred guard
+            if guarded * 2 <= total:
+                lock, guarded = None, 0
+        guards.append(
+            GuardInfo(
+                owner,
+                attr,
+                lock,
+                guarded,
+                total,
+                (owner, attr) in declared,
+                exempt.get((owner, attr), False),
+                decl_sites.get((owner, attr), (None, 0, False))[:2]
+                if (owner, attr) in decl_sites
+                else None,
+            )
+        )
+    return guards, findings, accesses
+
+
+def run(tree: Tree, root: Optional[str] = None, classes=None, doc_path=None) -> List[Finding]:
+    classes = tuple(classes or config.GUARDEDBY_CLASSES)
+    guards, findings, accesses = infer(tree, classes)
+    by_key = {(g.owner, g.attr): g for g in guards}
+
+    for (owner, attr), accs in sorted(accesses.items()):
+        g = by_key[(owner, attr)]
+        if g.exempt or g.lock is None:
+            continue
+        for a in accs:
+            if a.is_init or g.lock in a.held:
+                continue
+            p = _access_pragma(a.mod, a.node, "unguarded")
+            if p is not None:
+                if p.reason:
+                    continue
+                findings.append(
+                    Finding(
+                        a.mod.rel,
+                        a.line,
+                        PASS,
+                        f"no-reason:{owner}.{attr}:{a.func_name}",
+                        f"unguarded pragma on '{attr}' in "
+                        f"`{a.func_name}` needs a reason",
+                    )
+                )
+                continue
+            findings.append(
+                Finding(
+                    a.mod.rel,
+                    a.line,
+                    PASS,
+                    f"unguarded:{owner}.{attr}:{a.func_name}",
+                    f"attr '{attr}' guarded by '{g.lock}' at "
+                    f"{g.guarded} sites, unguarded here "
+                    f"(`{owner}.{a.func_name}`)",
+                )
+            )
+
+    # the guard map is documentation-bearing: every inferred row must
+    # appear in the README table (the --list-guards generator emits it)
+    if root is not None:
+        doc = doc_path or os.path.join(root, config.GUARDS_DOC)
+        doc_text = ""
+        if os.path.exists(doc):
+            with open(doc, "r", encoding="utf-8") as fh:
+                doc_text = fh.read()
+        for g in guards:
+            if g.lock is None or g.exempt:
+                continue
+            tag = f"`{g.owner}.{g.attr}`"
+            documented = any(
+                tag in line and f"`{g.lock}`" in line
+                for line in doc_text.splitlines()
+            )
+            if not documented:
+                rel, line = g.decl_site or (
+                    ctx_rel_fallback(accesses, g),
+                    0,
+                )
+                findings.append(
+                    Finding(
+                        rel,
+                        line,
+                        PASS,
+                        f"undocumented:{g.owner}.{g.attr}",
+                        f"inferred guard {tag} -> `{g.lock}` missing from "
+                        f"{config.GUARDS_DOC} (regenerate with "
+                        "--list-guards)",
+                    )
+                )
+    return findings
+
+
+def ctx_rel_fallback(accesses, g: GuardInfo) -> str:
+    accs = accesses.get((g.owner, g.attr), ())
+    return accs[0].mod.rel if accs else config.GUARDS_DOC
+
+
+def guards_table(tree: Tree, classes=None) -> List[str]:
+    """The markdown attr→lock table `--list-guards` prints (and the
+    README embeds). Only attributes with an inferred or declared guard
+    appear — unguarded-by-design state is not part of the contract."""
+    guards, _f, _a = infer(tree, classes)
+    lines = ["| attribute | guarded by | guarded sites |", "|---|---|---|"]
+    for g in sorted(guards, key=lambda g: (g.owner, g.attr)):
+        if g.lock is None or g.exempt:
+            continue
+        mark = " (declared)" if g.declared else ""
+        lines.append(
+            f"| `{g.owner}.{g.attr}` | `{g.lock}` | "
+            f"{g.guarded}/{g.total}{mark} |"
+        )
+    return lines

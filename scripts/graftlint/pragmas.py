@@ -1,0 +1,49 @@
+"""Stale-pragma audit — runs AFTER every other pass.
+
+A suppression pragma is a promise tied to one line of code: "this
+blocking call is deliberate", "this attribute access is single-writer".
+When the code it excused moves or disappears, the pragma keeps sitting
+there granting an exemption nothing claims — exactly the rot the
+stale-baseline check kills for baseline entries. Every pass marks the
+pragmas it CONSULTS (core.Pragma.consumed); any audited directive
+(config.AUDITED_PRAGMAS / AUDITED_PRAGMA_PREFIXES) left unconsumed at
+the end of the run is a finding: delete the pragma or re-attach it to
+the line it governs.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from core import Finding, Tree
+import config
+
+PASS = "pragmas"
+
+
+def _audited(directive: str) -> bool:
+    return directive in config.AUDITED_PRAGMAS or any(
+        directive.startswith(p) for p in config.AUDITED_PRAGMA_PREFIXES
+    )
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules:
+        for line, pragmas in sorted(mod.pragmas.items()):
+            for p in pragmas:
+                if p.consumed or not _audited(p.directive):
+                    continue
+                findings.append(
+                    Finding(
+                        mod.rel,
+                        line,
+                        PASS,
+                        f"stale:{p.directive}",
+                        f"stale pragma '{p.directive}"
+                        f"{'(' + p.reason + ')' if p.reason else ''}' — "
+                        "no pass consults it on this line (delete it, or "
+                        "move it to the line it governs)",
+                    )
+                )
+    return findings

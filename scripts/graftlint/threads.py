@@ -1,0 +1,159 @@
+"""Thread-hygiene mini-pass.
+
+Every ``threading.Thread(...)`` in production code must say what it
+means about lifetime:
+
+  * ``daemon=`` must be set EXPLICITLY. An implicit non-daemon thread is
+    the classic interpreter-hang-at-exit bug; an implicit daemon thread
+    (inherited from a daemonic parent) dies mid-write without cleanup.
+    Either way the author never chose.
+  * a thread explicitly marked ``daemon=False`` must have a reachable
+    bounded join — a ``.join(timeout=...)`` / ``.join(<secs>)`` on the
+    name it was assigned to, somewhere in the same module. An unjoined
+    non-daemon thread wedges shutdown forever; an UNbounded join just
+    moves the wedge into the joiner.
+
+``# graftlint: thread-ok(reason)`` on the constructor line acknowledges
+a deliberate exception (reason mandatory).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from core import Finding, Module, Tree, dotted_name
+
+PASS = "threads"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        d = dotted_name(f)
+        return d is not None and d.endswith("threading.Thread")
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    return False
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _thread_ok(mod: Module, call: ast.Call):
+    for ln in range(call.lineno, getattr(call, "end_lineno", call.lineno) + 1):
+        for p in mod.pragmas.get(ln, ()):
+            if p.directive == "thread-ok":
+                p.consumed = True
+                return p
+    return None
+
+
+def _assign_target(mod: Module, call: ast.Call) -> Optional[str]:
+    """Trailing name the Thread is bound to (`t = ...` -> "t",
+    `self._thread = ...` -> "_thread"), for matching joins."""
+    parent = mod.parents.get(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        tgt = parent.targets[0]
+        d = dotted_name(tgt)
+        if d:
+            return d.rsplit(".", 1)[-1]
+    return None
+
+
+def _bounded_joins(mod: Module) -> Set[str]:
+    """Receiver trailing names with a bounded .join() somewhere in the
+    module (positional seconds or timeout=), incl. loop variables over a
+    thread list (`for t in self._threads: t.join(2)` matches both "t"
+    and "_threads")."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            continue
+        timeout = _kw(node, "timeout")
+        if timeout is None and node.args:
+            timeout = node.args[0]
+        # an explicit None is join()'s own spelling of unbounded
+        if timeout is None or (
+            isinstance(timeout, ast.Constant) and timeout.value is None
+        ):
+            continue
+        d = dotted_name(node.func.value)
+        if not d:
+            continue
+        name = d.rsplit(".", 1)[-1]
+        out.add(name)
+        # a join on a loop variable blesses the iterated container too
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.For) and isinstance(anc.target, ast.Name):
+                if anc.target.id == name:
+                    cd = dotted_name(anc.iter)
+                    if cd:
+                        out.add(cd.rsplit(".", 1)[-1])
+    return out
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules:
+        joins: Optional[Set[str]] = None
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            func = mod.enclosing_function(node)
+            where = func.name if func is not None else "<module>"
+            p = _thread_ok(mod, node)
+            if p is not None:
+                if p.reason:
+                    continue
+                findings.append(
+                    Finding(
+                        mod.rel,
+                        node.lineno,
+                        PASS,
+                        f"no-reason:{where}",
+                        f"thread-ok pragma in `{where}` needs a reason",
+                    )
+                )
+                continue
+            daemon = _kw(node, "daemon")
+            if daemon is None:
+                findings.append(
+                    Finding(
+                        mod.rel,
+                        node.lineno,
+                        PASS,
+                        f"implicit-daemon:{where}",
+                        f"threading.Thread in `{where}` does not set "
+                        "daemon= explicitly (inherited daemonicity is "
+                        "never a choice — say daemon=True or daemon=False "
+                        "+ a bounded join)",
+                    )
+                )
+                continue
+            if isinstance(daemon, ast.Constant) and daemon.value is False:
+                if joins is None:
+                    joins = _bounded_joins(mod)
+                target = _assign_target(mod, node)
+                if target is None or target not in joins:
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            PASS,
+                            f"unjoined:{where}:{target or '?'}",
+                            f"non-daemon thread in `{where}` has no "
+                            "reachable join(timeout=...) in this module "
+                            "(an unjoined non-daemon thread wedges "
+                            "shutdown; an unbounded join moves the wedge)",
+                        )
+                    )
+    return findings

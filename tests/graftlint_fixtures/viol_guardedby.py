@@ -1,0 +1,76 @@
+"""guardedby-pass fixture: known violations with exact finding keys.
+
+Not imported at runtime — parsed by scripts/graftlint/guardedby.py in
+tests with classes=("FixtureCache",). One majority-guarded dict with a
+minority bare access, one declared-guard attribute violated, one
+exempted single-writer attribute, a call-graph-inherited helper, a
+reasonless unguarded pragma, and a shared module global with a racy
+bump.
+"""
+
+import threading
+
+from kubernetes_tpu.testing.lockgraph import named_lock
+
+_epoch = 0
+_glock = named_lock("fixture.global")
+
+
+def bump_epoch():
+    global _epoch
+    with _glock:
+        _epoch += 1
+
+
+def bump_epoch_again():
+    global _epoch
+    with _glock:
+        _epoch += 2
+
+
+def racy_bump():
+    global _epoch
+    _epoch += 1  # global write outside _glock: finding
+
+
+class FixtureCache:
+    def __init__(self):
+        self._lock = named_lock("fixture.cache")
+        self._items = {}
+        self._hits = 0
+        self._era = 0  # graftlint: guarded-by(FixtureCache._lock)
+        self._solo = 0  # graftlint: unguarded(single-writer stat, read-torn values acceptable)
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._hits += 1
+
+    def get(self, k):
+        with self._lock:
+            return self._items.get(k)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def churn(self):
+        with self._lock:
+            self._locked_helper()
+
+    def _locked_helper(self):
+        # every call site holds the lock: the access inherits it through
+        # the call graph, no pragma needed
+        self._hits += 1
+
+    def bad_peek(self):
+        return self._items.get("x")  # minority bare access: finding
+
+    def bump_era(self):
+        self._era += 1  # declared guard not held: finding
+
+    def solo_tick(self):
+        self._solo += 1  # attr-level unguarded override: silent
+
+    def lazy_read(self):
+        return self._hits  # graftlint: unguarded()

@@ -1,0 +1,43 @@
+"""thread-hygiene fixture: known violations with exact finding keys."""
+
+import threading
+
+
+def spawn_implicit():
+    t = threading.Thread(target=print)  # no daemon=: finding
+    t.start()
+
+
+def spawn_unjoined():
+    t = threading.Thread(target=print, daemon=False)  # no bounded join: finding
+    t.start()
+
+
+def spawn_ok_daemon():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+class Pump:
+    def start(self):
+        self._t = threading.Thread(target=print, daemon=False)
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=2.0)  # bounded join on the same name: clean
+
+
+def spawn_none_join():
+    t2 = threading.Thread(target=print, daemon=False)
+    t2.start()
+    t2.join(timeout=None)  # explicit None is still unbounded: finding
+
+
+def spawn_marked():
+    t = threading.Thread(target=print)  # graftlint: thread-ok(fixture: short-lived, process exit waits on it elsewhere)
+    t.start()
+
+
+def spawn_lazy_marked():
+    t = threading.Thread(target=print)  # graftlint: thread-ok()
+    t.start()

@@ -46,7 +46,32 @@ from kubernetes_tpu.client.leaderelection import (
 from kubernetes_tpu.kubelet.kubelet import NodeAgentPool
 from kubernetes_tpu.runtime.consensus import DegradedWrites
 from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.testing import lockgraph
 from kubernetes_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True, scope="module")
+def lock_order_watchdog():
+    """Lock-order watchdog + lockset sanitizer over the HA suite (ISSUE
+    12): leader, standby, and zombie replicas share one store and one
+    watch cache from different threads — exactly the concurrency the
+    guarded-by contract exists for. Any lock-order cycle or any tracked
+    attribute whose lockset goes empty across threads fails the suite,
+    even when the interleaving happened to be benign."""
+    lockgraph.enable(eraser=True)
+    yield
+    try:
+        lockgraph.assert_clean()
+        assert lockgraph.acquire_count() > 0, (
+            "watchdog observed no named-lock acquisitions: the named "
+            "locks are not instrumented"
+        )
+        assert lockgraph.tracked_access_count() > 0, (
+            "lockset sanitizer observed no tracked-attribute accesses: "
+            "the production classes are not instrumented"
+        )
+    finally:
+        lockgraph.disable()
 
 # The acceptance budget for "the standby starts binding fast": ONE
 # autoscaler period. The PR-5 autoscaler's what-if simulation alone costs

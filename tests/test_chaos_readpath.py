@@ -45,15 +45,21 @@ def lock_order_watchdog():
     informer storms. A cycle = an inversion that deadlocks only under
     the right interleaving; the graph catches it even when the storm
     happens to survive (ISSUE 7's runtime companion to graftlint)."""
-    lockgraph.enable()
+    lockgraph.enable(eraser=True)
     yield
     try:
-        lockgraph.assert_acyclic()
+        # zero CYCLES and zero empty-lockset RACES (Eraser mode, ISSUE
+        # 12): the informer storms drive every watch-cache lockset
+        lockgraph.assert_clean()
         # zero EDGES is legitimate (the read path never nests two named
         # locks); zero ACQUISITIONS would mean the instrumentation died
         assert lockgraph.acquire_count() > 0, (
             "watchdog observed no named-lock acquisitions: the named "
             "locks are not instrumented"
+        )
+        assert lockgraph.tracked_access_count() > 0, (
+            "lockset sanitizer observed no tracked-attribute accesses: "
+            "the watch-cache classes are not instrumented"
         )
     finally:
         lockgraph.disable()
@@ -136,7 +142,7 @@ def _storm_scenario(n_informers: int, n_events: int, sampled: int = 32):
     try:
         store.create("pods", make_pod("seed"))
         kc = cacher.cache_for("pods")
-        assert wait_until(lambda: kc.rv == store.resource_version, 5)
+        assert wait_until(lambda: kc.current_rv == store.resource_version, 5)
 
         # a handful of REAL informers ride along: they are the clients
         # whose relist behavior the flap gate asserts
@@ -180,7 +186,7 @@ def _storm_scenario(n_informers: int, n_events: int, sampled: int = 32):
         assert not bind_errors
 
         total_rv = store.resource_version
-        assert wait_until(lambda: kc.rv == total_rv, 30)
+        assert wait_until(lambda: kc.current_rv == total_rv, 30)
         assert wait_until(
             lambda: all(f"storm-{n_events-1}" in s for s in seen), 30
         ), "real informers never saw the end of the storm"
@@ -252,7 +258,7 @@ def test_degraded_store_cache_keeps_serving_reads_and_watches():
         kc = cacher.cache_for("pods")
         for i in range(5):
             store.create("pods", make_pod(f"p{i}"))
-        assert wait_until(lambda: kc.rv == store.resource_version, 5)
+        assert wait_until(lambda: kc.current_rv == store.resource_version, 5)
         rv = store.resource_version
         store.degrade()
         # writes refuse...
@@ -277,7 +283,7 @@ def test_degraded_store_cache_keeps_serving_reads_and_watches():
         assert replayed == 4  # events 2..5 (rv 1 already seen)
         store.recover()
         store.create("pods", make_pod("after-recover"))
-        assert wait_until(lambda: kc.rv == store.resource_version, 5)
+        assert wait_until(lambda: kc.current_rv == store.resource_version, 5)
         w.stop()
     finally:
         cacher.stop()

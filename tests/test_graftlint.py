@@ -28,7 +28,10 @@ import core  # noqa: E402
 import degraded  # noqa: E402
 import donation  # noqa: E402
 import fenceseam  # noqa: E402
+import guardedby  # noqa: E402
 import metrics_contract  # noqa: E402
+import pragmas as gl_pragmas  # noqa: E402
+import threads as gl_threads  # noqa: E402
 
 FIXTURES = "tests/graftlint_fixtures"
 FIXTURE_DOC = os.path.join(REPO, FIXTURES, "fixtures_metrics.md")
@@ -147,6 +150,102 @@ def test_fenceseam_production_scheduler_is_clean():
     assert fenceseam.run(tree) == []
 
 
+# -- pass 6: guarded-by inference ---------------------------------------------
+
+
+def test_guardedby_fixture_exact_findings():
+    """The lockset contract, statically: a majority-guarded dict with a
+    minority bare access, a declared guard violated, a reasonless
+    unguarded pragma, and a shared module global written outside its
+    lock — while the call-graph-inherited helper, the attr-level
+    unguarded override, and the reasoned pragma stay silent."""
+    found = guardedby.run(_tree("viol_guardedby.py"), classes=("FixtureCache",))
+    assert _keys(found) == [
+        "no-reason:FixtureCache._hits:lazy_read",
+        "unguarded:FixtureCache._era:bump_era",
+        "unguarded:FixtureCache._items:bad_peek",
+        "unguarded:viol_guardedby._epoch:racy_bump",
+    ]
+
+
+def test_guardedby_finding_message_shape():
+    found = guardedby.run(_tree("viol_guardedby.py"), classes=("FixtureCache",))
+    msg = next(f for f in found if f.key.endswith("_items:bad_peek")).message
+    assert "guarded by 'FixtureCache._lock' at 3 sites, unguarded here" in msg
+
+
+def test_guardedby_inference_map():
+    guards, _f, _a = guardedby.infer(
+        _tree("viol_guardedby.py"), classes=("FixtureCache",)
+    )
+    by = {(g.owner, g.attr): g for g in guards}
+    assert by[("FixtureCache", "_items")].lock == "FixtureCache._lock"
+    assert by[("FixtureCache", "_era")].declared
+    assert by[("FixtureCache", "_solo")].exempt
+    # the lock attribute itself is never written post-init: no guard row
+    assert by[("FixtureCache", "_lock")].lock is None
+
+
+def test_guardedby_production_tree_clean_and_documented():
+    """THE tentpole gate: every shared attribute of the concurrency-
+    critical classes has a consistent lockset (minority accesses fixed
+    in ISSUE 12, not baselined), and the inferred attr→lock map is
+    documented in the README table."""
+    rels = core.discover(REPO, ("kubernetes_tpu",), ())
+    tree = core.Tree(REPO, rels)
+    assert guardedby.run(tree, REPO) == []
+
+
+# -- thread-hygiene pass -------------------------------------------------------
+
+
+def test_threads_fixture_exact_findings():
+    found = gl_threads.run(_tree("viol_threads.py"))
+    assert _keys(found) == [
+        "implicit-daemon:spawn_implicit",
+        "no-reason:spawn_lazy_marked",
+        "unjoined:spawn_none_join:t2",
+        "unjoined:spawn_unjoined:t",
+    ]
+
+
+def test_threads_production_tree_clean():
+    rels = core.discover(REPO, ("kubernetes_tpu",), ())
+    tree = core.Tree(REPO, rels)
+    assert gl_threads.run(tree) == []
+
+
+# -- stale-pragma audit --------------------------------------------------------
+
+
+def test_stale_pragma_flagged_when_no_pass_consults(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "def f():\n"
+        "    return 1  # graftlint: allow-blocking(nothing blocking here)\n"
+    )
+    tree = core.Tree(str(tmp_path), ["mod.py"])
+    blocking.run(tree)  # nothing blocking -> pragma never consulted
+    found = gl_pragmas.run(tree)
+    assert _keys(found) == ["stale:allow-blocking"]
+    assert "no pass consults it" in found[0].message
+
+
+def test_consulted_pragma_not_stale():
+    """viol_blocking's allow-blocking pragma sits on a real blocking call:
+    the blocking pass consults (and rejects) it, so the audit is silent."""
+    tree = _tree("viol_blocking.py")
+    blocking.run(tree)
+    assert gl_pragmas.run(tree) == []
+
+
+def test_unaudited_directives_ignored(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("X = 1  # graftlint: metrics-exempt(not audited)\n")
+    tree = core.Tree(str(tmp_path), ["mod.py"])
+    assert gl_pragmas.run(tree) == []
+
+
 # -- the clean fixture passes every pass -------------------------------------
 
 
@@ -157,6 +256,10 @@ def test_clean_fixture_no_findings():
     assert metrics_contract.run(src, REPO, doc_path=FIXTURE_DOC) == []
     assert degraded.run(src, dirs=(FIXTURES,)) == []
     assert fenceseam.run(src, dirs=(FIXTURES,)) == []
+    assert guardedby.run(src) == []
+    assert gl_threads.run(src) == []
+    # every pragma in clean.py is consulted by the passes above
+    assert gl_pragmas.run(src) == []
 
 
 # -- runner CLI: exit codes + suppression baseline ---------------------------
@@ -321,6 +424,143 @@ def test_lockgraph_stale_held_state_does_not_leak_across_enable(
         pass
     assert lg.edges() == {}
     lg.assert_acyclic()
+
+
+# -- lockset sanitizer (Eraser mode) ------------------------------------------
+
+
+class _TrackedBox:
+    pass
+
+
+lockgraph.track_attrs(_TrackedBox, "val")
+
+
+def test_eraser_two_thread_unguarded_write_detected(fresh_lockgraph):
+    """The deliberately injected unguarded write (ISSUE 12 acceptance):
+    two threads, no lock, MUST produce an empty-lockset race report with
+    both stack tips."""
+    lg = fresh_lockgraph
+    lg.enable(eraser=True)
+    box = _TrackedBox()
+    box.val = 1
+    t = threading.Thread(target=lambda: setattr(box, "val", 2))
+    t.start()
+    t.join(timeout=5.0)
+    got = lg.races()
+    assert got and got[0]["attr"] == "_TrackedBox.val"
+    assert got[0]["prev_site"] and got[0]["site"]
+    with pytest.raises(AssertionError, match="EMPTY-LOCKSET RACE"):
+        lg.assert_clean()
+
+
+def test_eraser_consistently_guarded_attr_silent(fresh_lockgraph):
+    lg = fresh_lockgraph
+    lg.enable(eraser=True)
+    lock = lockgraph.named_lock("box.lock")
+    box = _TrackedBox()
+    with lock:
+        box.val = 1
+
+    def writer():
+        with lock:
+            box.val = box.val + 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join(timeout=5.0)
+    assert lg.races() == []
+    lg.assert_clean()
+    assert lg.tracked_access_count() > 0
+
+
+def test_eraser_disabled_mode_is_a_noop(fresh_lockgraph):
+    lg = fresh_lockgraph  # never enabled
+    box = _TrackedBox()
+    box.val = 1
+    t = threading.Thread(target=lambda: setattr(box, "val", 2))
+    t.start()
+    t.join(timeout=5.0)
+    assert lg.races() == []
+    assert lg.tracked_access_count() == 0
+    assert box.val == 2  # the descriptor still stores/loads faithfully
+
+
+def test_eraser_watchdog_only_mode_records_no_attrs(fresh_lockgraph):
+    """enable() without eraser=True keeps the pre-ISSUE-12 behavior: the
+    order graph records, attribute accesses don't."""
+    lg = fresh_lockgraph
+    lg.enable()
+    box = _TrackedBox()
+    box.val = 1
+    t = threading.Thread(target=lambda: setattr(box, "val", 2))
+    t.start()
+    t.join(timeout=5.0)
+    assert lg.races() == []
+    assert lg.tracked_access_count() == 0
+
+
+def test_eraser_epoch_reset_across_suites(fresh_lockgraph):
+    """A race recorded in one suite's epoch must not leak into the next
+    enable() in the same process (the chaos suites share a pytest run),
+    and per-attribute exclusive/shared state starts over."""
+    lg = fresh_lockgraph
+    lg.enable(eraser=True)
+    box = _TrackedBox()
+    box.val = 1
+    t = threading.Thread(target=lambda: setattr(box, "val", 2))
+    t.start()
+    t.join(timeout=5.0)
+    assert lg.races()
+    lg.enable(eraser=True)  # next suite
+    assert lg.races() == []
+    box.val = 3  # same thread only: exclusive, still silent
+    assert lg.races() == []
+    lg.assert_clean()
+
+
+def test_eraser_per_instance_state(fresh_lockgraph):
+    """Constructor writes are an INSTANCE's exclusive phase: building a
+    second object on a second thread must not poison the first object's
+    lockset (the first armed chaos run caught exactly this aggregation
+    bug)."""
+    lg = fresh_lockgraph
+    lg.enable(eraser=True)
+    lock = lockgraph.named_lock("box.lock")
+    a = _TrackedBox()
+    with lock:
+        a.val = 1
+
+    def other():
+        b = _TrackedBox()
+        b.val = 99  # different instance, no lock — NOT a race on `a`
+        with lock:
+            a.val = a.val + 1
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(timeout=5.0)
+    assert lg.races() == []
+
+
+# -- CLI: --changed and --list-guards -----------------------------------------
+
+
+def test_cli_list_guards_table_shape():
+    proc = _run_cli("--list-guards")
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert lines[0] == "| attribute | guarded by | guarded sites |"
+    assert any("`SchedulerCache._nodes`" in ln and "`scheduler.cache`" in ln
+               for ln in lines)
+
+
+def test_cli_changed_mode_clean_tree():
+    """--changed on a clean checkout lints nothing (or only already-clean
+    modified files) and exits 0 — the `make lint-fast` pre-commit loop."""
+    proc = _run_cli("--changed")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
 
 
 def test_lockgraph_cross_thread_inversion(fresh_lockgraph):

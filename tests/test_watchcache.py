@@ -79,7 +79,7 @@ def test_reconnect_at_exactly_oldest_buffered_rv(cached_store):
     kc = cacher.cache_for("pods")  # cache FIRST: the ring buffers events
     for i in range(8):  # window=4: the first events get evicted
         store.create("pods", make_pod(f"p{i}"))
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     assert wait_until(lambda: len(kc._ring) == 4)
     oldest = kc._ring[0].resource_version
     w = cacher.watch("pods", from_version=oldest)
@@ -95,7 +95,7 @@ def test_reconnect_one_before_oldest_is_410(cached_store):
     kc = cacher.cache_for("pods")
     for i in range(8):
         store.create("pods", make_pod(f"p{i}"))
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     assert wait_until(lambda: len(kc._ring) == 4)
     oldest = kc._ring[0].resource_version
     x0 = metrics.counter("watch_cache_expired_total", {"kind": "pods"})
@@ -111,7 +111,7 @@ def test_reconnect_at_future_rv_skips_already_seen_events(cached_store):
     store, cacher = cached_store
     store.create("pods", make_pod("p0"))
     kc = cacher.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     future = store.resource_version + 2
     w = cacher.watch("pods", from_version=future)
     # these two land AT or BELOW the client's claimed position: skipped
@@ -119,7 +119,7 @@ def test_reconnect_at_future_rv_skips_already_seen_events(cached_store):
     store.create("pods", make_pod("claimed-2"))
     # this one is past it: delivered
     store.create("pods", make_pod("new"))
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     evs = drain(w)
     assert [e.object.metadata.name for e in evs] == ["new"]
     w.stop()
@@ -129,7 +129,7 @@ def test_empty_cache_cold_start(cached_store):
     store, cacher = cached_store
     # no objects, no history: watch from 0 must neither 410 nor replay
     w = cacher.watch("pods", from_version=0)
-    assert cacher.cache_for("pods").rv == 0
+    assert cacher.cache_for("pods").current_rv == 0
     store.create("pods", make_pod("first"))
     ev = w.get(timeout=2.0)
     assert ev is not None and ev.type == ADDED
@@ -145,7 +145,7 @@ def test_replay_within_window_touches_no_store_watch(cached_store):
     kc = cacher.cache_for("pods")
     for i in range(3):
         store.create("pods", make_pod(f"p{i}"))
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     r0 = metrics.counter("watch_cache_replays_total", {"kind": "pods"})
     watchers = [cacher.watch("pods", from_version=1) for _ in range(20)]
     for w in watchers:
@@ -166,7 +166,7 @@ def test_bookmarks_advance_idle_clients(cached_store):
     store, cacher = cached_store
     store.create("pods", make_pod("p0"))
     kc = cacher.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     w = cacher.watch("pods", from_version=store.resource_version)
     got = []
 
@@ -264,7 +264,7 @@ def test_list_pagination_consistent_at_single_rv(cached_store):
     for i in range(7):
         store.create("pods", make_pod(f"p{i}"))
     kc = cacher.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     items, rv, tok = cacher.list_page("pods", limit=3)
     assert len(items) == 3 and tok
     items2, rv2, tok2 = cacher.list_page("pods", limit=3, continue_token=tok)
@@ -284,13 +284,13 @@ def test_continue_token_across_compaction(cached_store):
     for i in range(6):
         store.create("pods", make_pod(f"p{i}"))
     kc = cacher.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     items, rv, tok = cacher.list_page("pods", limit=2)
     # compaction: window=4, so 8 more events evict everything page 1 saw
     for i in range(8):
         store.create("pods", make_pod(f"churn-{i}"))
     store.delete("pods", "default", "p3")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     items2, rv2, tok2 = cacher.list_page("pods", limit=2, continue_token=tok)
     assert rv2 == rv, "continuation drifted off its snapshot rv"
     assert [o.metadata.name for o in items2] == ["p2", "p3"], (
@@ -329,7 +329,7 @@ def test_slow_watcher_terminated_not_blocking(cached_store):
     try:
         store.create("pods", make_pod("seed"))
         kc = cacher.cache_for("pods")
-        assert wait_until(lambda: kc.rv == store.resource_version)
+        assert wait_until(lambda: kc.current_rv == store.resource_version)
         slow = kc.watch(from_version=0, queue_size=8)
         healthy = cacher.watch("pods", from_version=0)
         s0 = metrics.counter(
@@ -412,7 +412,7 @@ def test_dispatch_thread_survives_resync_errors(cached_store):
     store, cacher = cached_store
     kc = cacher.cache_for("pods")
     store.create("pods", make_pod("p0"))
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     orig_list = store.list
     fails = {"n": 2}  # first two re-list attempts blow up
 
@@ -464,7 +464,7 @@ def test_rest_watch_emits_bookmark_lines_and_410(rest_server):
     for i in range(6):
         store.create("pods", make_pod(f"w{i}"))
     kc = small.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     try:
         urllib.request.urlopen(
             f"http://127.0.0.1:{port}/api/v1/pods?watch=1&resourceVersion=1",
@@ -516,7 +516,7 @@ def test_rest_list_rv0_served_from_cache(rest_server):
     srv, port, store = rest_server
     store.create("pods", make_pod("p0"))
     kc = srv.cacher.cache_for("pods")
-    assert wait_until(lambda: kc.rv == store.resource_version)
+    assert wait_until(lambda: kc.current_rv == store.resource_version)
     p0 = metrics.counter("watch_cache_list_pages_total", {"kind": "pods"})
     out = json.load(
         urllib.request.urlopen(
