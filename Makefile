@@ -23,6 +23,9 @@
 #                         partitions, half-open watches) + the multi-process
 #                         leader/standby/zombie topology (SIGSTOP, fenced
 #                         late REST binds, cross-process exactly-once ledger)
+#   make tracing-ab       same-process tracing-overhead A/B (on vs off):
+#                         acceptance rail — enabled-mode steady-state
+#                         throughput regresses <3%, disabled ≈ noise
 #   make lint-slow        fail if any chaos test >5s lacks the `slow` marker
 #   make lint-static      graftlint: donation-safety, dispatch-blocking,
 #                         metrics-contract, degraded-write, bind-fence,
@@ -48,7 +51,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	lint-slow lint-static lint-fast lint
+	tracing-ab lint-slow lint-static lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -78,6 +81,9 @@ chaos-ha:
 
 chaos-net:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_net.py -q
+
+tracing-ab:
+	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py
 
 lint-slow:
 	$(CACHED) $(PY) scripts/check_slow_markers.py

@@ -337,6 +337,15 @@ def main() -> int:
                         "scheduled": lat.scheduled,
                         "pipeline_depth": lat.pipeline_depth,
                         "max_waves_inflight": lat.max_waves_inflight,
+                        # the p99 hunt's raw material: per-stage waterfall
+                        # from REAL per-pod spans (not ad-hoc timers), the
+                        # span-sum/e2e reconciliation ratio, and the p99
+                        # exemplar's full trace — retrievable by id via
+                        # SIGUSR2 and /debug/traces on a live process
+                        "stage_waterfall": lat.stage_waterfall,
+                        "waterfall_vs_e2e": round(lat.waterfall_vs_e2e, 4),
+                        "p99_trace_id": lat.p99_trace_id,
+                        "p99_trace": lat.p99_trace,
                     }
                     if lat is not None
                     else None
@@ -374,6 +383,15 @@ def main() -> int:
     lat_d = detail.get("steady_state_latency") or {}
     if lat_d:
         compact["steady_pod_p99_ms"] = lat_d.get("pod_p99_ms")
+        # compact stage waterfall (p99 per stage, ms) + the reconciliation
+        # ratio: the one-line answer to "where does the p99 pod spend it"
+        wf = lat_d.get("stage_waterfall") or {}
+        if wf:
+            compact["waterfall_p99_ms"] = {
+                k: v.get("p99_ms") for k, v in wf.items()
+            }
+            compact["waterfall_vs_e2e"] = lat_d.get("waterfall_vs_e2e")
+            compact["p99_trace_id"] = lat_d.get("p99_trace_id")
     pipe_d = detail.get("pipeline") or {}
     if pipe_d:
         compact["pipeline"] = pipe_d

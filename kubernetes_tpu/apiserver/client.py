@@ -29,6 +29,7 @@ from ..client.apiserver import (
 from ..client.leaderelection import FENCE_HEADER, fence_header_value
 from ..runtime.consensus import DegradedWrites, QuorumLost
 from ..runtime.watch import Event, Watcher
+from ..utils.tracing import TRACE_HEADER, trace_for_binding
 
 
 class RESTClient:
@@ -310,6 +311,17 @@ class RESTClient:
         )
 
     @staticmethod
+    def _bind_headers(base: Optional[dict], binding) -> Optional[dict]:
+        """Fence headers plus trace-context propagation: the pod's trace
+        id (minted at queue admission in THIS process) rides the
+        X-Trace-Context header so the store process stamps its apply —
+        or its LeaderFenced rejection — under the same identity."""
+        tid = trace_for_binding(binding)
+        if not tid:
+            return base
+        return {**(base or {}), TRACE_HEADER: tid}
+
+    @staticmethod
     def _classify_bind_transport(e: Exception) -> DegradedWrites:
         """Map a transport-level failure of a /binding POST onto the bind
         outcome taxonomy. A refused connect means the request never
@@ -338,7 +350,7 @@ class RESTClient:
                 + f"/api/v1/namespaces/{binding.pod_namespace}/pods/"
                 + f"{binding.pod_name}/binding",
                 codec.encode(binding),
-                headers=self._fence_headers(fence),
+                headers=self._bind_headers(self._fence_headers(fence), binding),
             )
         except (
             LeaderFenced,
@@ -388,7 +400,7 @@ class RESTClient:
                     + f"/api/v1/namespaces/{b.pod_namespace}/pods/"
                     + f"{b.pod_name}/binding",
                     codec.encode(b),
-                    headers=fence_headers,
+                    headers=self._bind_headers(fence_headers, b),
                 )
                 errors.append(None)
             except LeaderFenced:

@@ -640,6 +640,35 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/metrics":
+            # Prometheus exposition for THIS process (the debug surface
+            # every process family now shares — utils/debugserver.py is
+            # the standalone listener for scheduler/controller-manager).
+            # Authorized like the metrics.k8s.io route: on a secured API
+            # port the registry is not an anonymous surface.
+            if not self._authorize("get", "metrics", None):
+                return
+            from ..utils.debugserver import metrics_payload
+
+            body, ctype = metrics_payload()
+            self.send_response(200)
+            self._last_code = 200
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/debug/traces":
+            # the trace ring's REST view: ?id=<trace_id> for one trace
+            # (store-side stamps attached), else slowest-N (?n=, ?kind=).
+            # Same authz gate as /metrics: traces carry pod identities.
+            if not self._authorize("get", "metrics", None):
+                return
+            from ..utils.debugserver import traces_payload
+
+            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            code, payload = traces_payload(q)
+            return self._json(code, payload)
         if self._maybe_proxy():
             return
         if self._serve_metrics_api():
@@ -947,7 +976,17 @@ class _Handler(BaseHTTPRequestHandler):
                         fence = fence_from_header(fence_hdr)
                     except ValueError as fe:
                         return self._status_error(400, "BadRequest", str(fe))
-                errs = self.store.bind_pods([b], fence=fence)
+                # trace-context propagation (utils/tracing.py): the
+                # scheduler-minted trace id arrives in X-Trace-Context;
+                # re-establish it thread-locally so the store's apply
+                # (or LeaderFenced rejection) stamps under the SAME id —
+                # a bind that crosses REST keeps its identity
+                from ..utils.tracing import TRACE_HEADER, bind_context
+
+                trace_hdr = self.headers.get(TRACE_HEADER) or ""
+                bind_key = f"{b.pod_namespace}/{b.pod_name}"
+                with bind_context({bind_key: trace_hdr} if trace_hdr else {}):
+                    errs = self.store.bind_pods([b], fence=fence)
                 if errs and errs[0] is not None:
                     # preserve the store's error taxonomy across the wire
                     # (bind_pods returns the typed exception): a vanished

@@ -543,44 +543,62 @@ class APIServer:
         still matches the live lease — checked under the same lock the
         binds apply under, so a promotion can never interleave mid-batch.
         """
+        from ..utils.tracing import stamp_bind
+
         self._check_writable()
         errors = []
-        with self._lock:
-            if fence is not None:
-                self._check_fence(fence)
-            records = []  # WAL batch: group-committed in ONE fsync
-            events = []
-            for b in bindings:
-                try:
-                    store = self._objects.get("pods", {})
-                    key = f"{b.pod_namespace}/{b.pod_name}"
-                    pod = store.get(key)
-                    if pod is None:
-                        raise NotFound(f"pods {key} not found")
-                    if pod.spec.node_name:
-                        raise Conflict(f"pod {key} already bound")
-                    if b.pod_uid and pod.metadata.uid != b.pod_uid:
-                        raise Conflict("uid mismatch on binding")
-                    pod.spec.node_name = b.target_node
-                    self._bump(pod)
-                    records.append(
-                        (pod.metadata.resource_version, "update", "pods", pod)
-                    )
-                    events.append(
-                        Event(
-                            MODIFIED,
-                            event_copy(pod),
-                            pod.metadata.resource_version,
+        try:
+            with self._lock:
+                if fence is not None:
+                    self._check_fence(fence)
+                records = []  # WAL batch: group-committed in ONE fsync
+                events = []
+                for b in bindings:
+                    try:
+                        store = self._objects.get("pods", {})
+                        key = f"{b.pod_namespace}/{b.pod_name}"
+                        pod = store.get(key)
+                        if pod is None:
+                            raise NotFound(f"pods {key} not found")
+                        if pod.spec.node_name:
+                            raise Conflict(f"pod {key} already bound")
+                        if b.pod_uid and pod.metadata.uid != b.pod_uid:
+                            raise Conflict("uid mismatch on binding")
+                        pod.spec.node_name = b.target_node
+                        self._bump(pod)
+                        records.append(
+                            (pod.metadata.resource_version, "update", "pods", pod)
                         )
-                    )
-                    errors.append(None)
-                except (NotFound, Conflict) as e:
-                    errors.append(e)
-            # durable BEFORE any watcher learns of the binds (etcd fires
-            # watch events post-commit); the batch shares one fsync
-            self._log_batch(records)
-            for ev in events:
-                self._notify("pods", ev)
+                        events.append(
+                            Event(
+                                MODIFIED,
+                                event_copy(pod),
+                                pod.metadata.resource_version,
+                            )
+                        )
+                        errors.append(None)
+                    except (NotFound, Conflict) as e:
+                        errors.append(e)
+                # durable BEFORE any watcher learns of the binds (etcd fires
+                # watch events post-commit); the batch shares one fsync
+                self._log_batch(records)
+                for ev in events:
+                    self._notify("pods", ev)
+        except LeaderFenced as fe:
+            # the fenced rejection is a trace event too: a zombie's late
+            # bind shows up under the SAME id the deposed scheduler
+            # minted (the id crossed the REST hop in X-Trace-Context)
+            for b in bindings:
+                stamp_bind(
+                    b, "fenced",
+                    identity=getattr(fence, "identity", ""),
+                    detail=str(fe)[:160],
+                )
+            raise
+        # store-side stamp: the ack the scheduler's trace resolves to
+        # (outside the store lock — the trace ledger is a leaf concern)
+        for b, err in zip(bindings, errors):
+            stamp_bind(b, "applied" if err is None else type(err).__name__)
         return errors
 
     def write_events_bulk(self, events_in) -> None:

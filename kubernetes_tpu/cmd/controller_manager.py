@@ -22,11 +22,22 @@ def main(argv=None) -> int:
         help="comma-separated controller names, * = all",
     )
     parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument(
+        "--debug-port",
+        type=int,
+        default=None,
+        help="serve /metrics (Prometheus text) and /debug/traces on this "
+        "loopback port (default off; 0 = ephemeral)",
+    )
     parser.add_argument("-v", "--verbosity", type=int, default=1)
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
     )
+    if args.debug_port is not None:
+        from ..utils.debugserver import serve_debug
+
+        serve_debug(args.debug_port)
     from ..apiserver.client import RESTClient
     from ..client.leaderelection import LeaderElectionConfig
     from ..controller.manager import ControllerManager

@@ -59,15 +59,16 @@ class _HealthHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             # content negotiation: Prometheus exposition text by default
             # (what the reference's legacyregistry serves); JSON on request
+            from ..utils.debugserver import metrics_payload
+
             if "application/json" in (self.headers.get("Accept") or ""):
+                from ..utils.tracing import tracer
+
+                tracer.publish_gauges()  # tracing series are batch-published
                 body = json.dumps(metrics.dump(), indent=1).encode()
                 self._respond(200, body, "application/json")
             else:
-                self._respond(
-                    200,
-                    metrics.render_prometheus().encode(),
-                    "text/plain; version=0.0.4",
-                )
+                self._respond(200, *metrics_payload())
         else:
             self._respond(404, b"not found")
 
@@ -96,6 +97,7 @@ def run(
     autoscaler_catalog=None,
     autoscaler_kwargs: Optional[dict] = None,
     watch_cache: bool = True,
+    debug_port: Optional[int] = None,
 ) -> Scheduler:
     """app.Run (server.go:142): health endpoints → informers → leader
     election (optional) → scheduling loops. autoscaler_catalog (a
@@ -132,6 +134,12 @@ def run(
         serve_health(
             healthz_port, lambda: live.is_set(), lambda: ready.is_set()
         )
+    if debug_port is not None:
+        # /metrics + /debug/traces for THIS scheduler process (the
+        # SIGUSR2 dump's HTTP twin — trace lookups without log access)
+        from ..utils.debugserver import serve_debug
+
+        serve_debug(debug_port)
     CacheDebugger(sched).listen_for_signal()
 
     stop = threading.Event()
@@ -216,6 +224,14 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kube-scheduler-tpu")
     parser.add_argument("--config", help="ComponentConfig or Policy file")
     parser.add_argument("--healthz-port", type=int, default=10251)
+    parser.add_argument(
+        "--debug-port",
+        type=int,
+        default=None,
+        help="serve /metrics (Prometheus text) and /debug/traces "
+        "(slowest-N / by-trace-id lookup) on this loopback port "
+        "(default off; 0 = ephemeral)",
+    )
     parser.add_argument(
         "--leader-elect", action="store_true", default=False
     )
@@ -311,6 +327,7 @@ def main(argv=None) -> int:
         healthz_port=args.healthz_port,
         autoscaler_catalog=catalog,
         watch_cache=not args.no_watch_cache,
+        debug_port=args.debug_port,
     )
     return 0
 

@@ -18,6 +18,7 @@ from ..api.objects import Pod
 from ..client.apiserver import APIServer
 from ..scheduler import KubeSchedulerConfiguration, Scheduler
 from ..utils.metrics import metrics
+from ..utils.tracing import tracer
 from .workloads import WorkloadConfig, build_workload
 
 
@@ -241,6 +242,16 @@ class LatencyResult:
     # wave pipelining over the measured window (see BenchResult)
     pipeline_depth: int = 0
     max_waves_inflight: int = 0
+    # per-stage waterfall from REAL per-pod spans (utils/tracing.py):
+    # stage -> {count, total_s, p50_ms, p99_ms}, waterfall order
+    stage_waterfall: Optional[dict] = None
+    # mean per-trace in-cycle stage sum over the e2e histogram mean —
+    # the reconciliation check (acceptance: within 5% of 1.0)
+    waterfall_vs_e2e: float = 0.0
+    # the p99 exemplar's trace id + its full rendered trace: "what is
+    # the p99" answered with the actual pod's waterfall
+    p99_trace_id: str = ""
+    p99_trace: Optional[dict] = None
 
 
 def run_latency_benchmark(
@@ -255,6 +266,7 @@ def run_latency_benchmark(
     percentiles. The rate should be well below the burst throughput so the
     queue never backs up (latency is then scheduling cost, not queue depth)."""
     metrics.reset()
+    tracer.reset()
     server = APIServer()
     scfg = sched_config or KubeSchedulerConfiguration()
     sched = Scheduler(server, scfg)
@@ -276,6 +288,9 @@ def run_latency_benchmark(
         server.create("pods", warm)
         _wait_all_scheduled(server, len(init_pods) + 1, timeout_s)
         metrics.reset()
+        # trace window matches the metrics window: the waterfall must
+        # describe the measured pods, not init/warmup cycles
+        tracer.reset()
         # the reset wiped the inflight-max gauge, but the scheduler only
         # republishes it when the peak GROWS — zero the peak too, or the
         # measured window can never re-reach the warmup burst's depth and
@@ -303,6 +318,13 @@ def run_latency_benchmark(
     pod_h = metrics.histogram("pod_scheduling_duration_seconds")
     e2e_h = metrics.histogram("e2e_scheduling_duration_seconds")
     q = lambda h, p: (h.quantile(p) * 1000 if h else 0.0)  # noqa: E731
+    waterfall, vs_e2e = _stage_waterfall(e2e_h)
+    p99_tid, p99_trace = "", None
+    if e2e_h is not None:
+        ex = e2e_h.exemplar_near(0.99)
+        if ex is not None:
+            p99_tid = ex[1]
+            p99_trace = tracer.get(p99_tid)
     return LatencyResult(
         workload=cfg.name,
         num_nodes=cfg.num_nodes,
@@ -317,7 +339,45 @@ def run_latency_benchmark(
         max_waves_inflight=int(
             metrics.gauge("scheduler_wave_inflight_max") or 0
         ),
+        stage_waterfall=waterfall,
+        waterfall_vs_e2e=vs_e2e,
+        p99_trace_id=p99_tid,
+        p99_trace=p99_trace,
     )
+
+
+# pod-trace stages INSIDE the scheduling cycle (everything after the
+# queue wait): their per-trace sum must reconcile with what the
+# e2e_scheduling_duration_seconds histogram measured for the same pods.
+# outage.wait is deliberately absent: only outcome=="bound" traces enter
+# the numerator (below) because only those pods observe e2e — a
+# ride-through "landed"/"rebound" pod never does, and its multi-second
+# outage span would poison the ratio without any matching e2e sample.
+_CYCLE_STAGES = (
+    "encode", "device", "readback", "guard", "assume", "bind", "algo",
+)
+
+
+def _stage_waterfall(e2e_h) -> tuple:
+    """(stage waterfall dict, mean in-cycle stage sum / e2e mean) from
+    the tracer ring's completed pod traces. The ratio is the built-in
+    honesty check: spans are contiguous stamps of the same wall interval
+    the e2e histogram observes, so a drift past a few percent means the
+    span chain has a hole (a stage nobody attributes)."""
+    waterfall = tracer.stage_stats(kind="pod")
+    if e2e_h is None or not e2e_h.n:
+        return waterfall, 0.0
+    sums = []
+    for d in tracer.slowest(10**6, kind="pod"):
+        stages = d.get("stages_ms", {})
+        if d.get("outcome") != "bound":
+            continue
+        sums.append(
+            sum(v for k, v in stages.items() if k in _CYCLE_STAGES) / 1e3
+        )
+    if not sums:
+        return waterfall, 0.0
+    return waterfall, (sum(sums) / len(sums)) / e2e_h.avg
 
 
 @dataclass

@@ -119,6 +119,14 @@ class CacheDebugger:
                 f"(this replica: {getattr(self.sched, '_ha_identity', '?')}):"
             )
             lines.extend(ha)
+        from ...utils import tracing as tracing_mod
+
+        lines.append("Dump of per-pod scheduling traces (slowest first):")
+        lines.extend(tracing_mod.tracer.render_lines(8))
+        trc = tracing_mod.health_lines()
+        if trc:
+            lines.append("Dump of tracing pipeline state:")
+            lines.extend(trc)
         return "\n".join(lines)
 
     # -- signal hookup (signal.go:25) ---------------------------------------

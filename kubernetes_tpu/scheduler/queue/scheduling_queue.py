@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional
 
 from ...api import objects as v1
 from ...testing.lockgraph import named_lock, track_attrs
+from ...utils.tracing import tracer
 from .heap import Heap
 
 
@@ -31,6 +32,15 @@ class QueuedPodInfo:
     attempts: int = 0
     initial_attempt_timestamp: float = field(default_factory=time.monotonic)
     backoff_expiry: float = 0.0
+    # minted at queue admission (utils/tracing.py): the id every span of
+    # this pod's lifecycle — and its cross-process bind stamp — lands under
+    trace_id: str = ""
+    # when this pod LAST entered a queue, for the `queue` span only:
+    # readd() must refresh it without touching `timestamp` (which orders
+    # the heap — resetting it would demote a deferred pod behind fresh
+    # arrivals), or a deferred pod's next queue span re-spans from its
+    # original admission and double-counts the prior cycle as queue wait
+    trace_queued_at: float = field(default_factory=time.monotonic)
 
     @property
     def key(self) -> str:
@@ -92,8 +102,20 @@ class PriorityQueue:
     # -- adds ---------------------------------------------------------------
 
     def add(self, pod: v1.Pod) -> None:
+        # mint the trace OUTSIDE the queue lock (tracing.ring is a leaf,
+        # but the admit itself needs nothing the lock guards).
+        # admit_lag_s: object creation (wall) -> queue admit — the
+        # store->watch->cacher->informer delivery leg, recorded as an
+        # ATTRIBUTE (wall-clock delta), never mixed into monotonic spans
+        tid = tracer.start(
+            "pod",
+            pod.metadata.key,
+            admit_lag_s=round(
+                max(time.time() - pod.metadata.creation_timestamp, 0.0), 6
+            ),
+        )
         with self._cond:
-            pi = QueuedPodInfo(pod)
+            pi = QueuedPodInfo(pod, trace_id=tid)
             self._active.add(pi)
             self._backoff.delete_by_key(pi.key)
             self._unschedulable.pop(pi.key, None)
@@ -106,6 +128,7 @@ class PriorityQueue:
         so no backoff and no attempt decay)."""
         with self._cond:
             pi.attempts = max(pi.attempts - 1, 0)
+            pi.trace_queued_at = time.monotonic()
             self._active.add(pi)
             self._cond.notify()
 
@@ -115,11 +138,13 @@ class PriorityQueue:
         """Failed pod re-entry (AddUnschedulableIfNotPresent:300): if a move
         event fired while the pod was being scheduled, it goes to backoffQ
         (something changed — retry soon); else unschedulableQ."""
+        tracer.event(pi.trace_id, "queue.unschedulable")
         with self._cond:
             key = pi.key
             if key in self._active or key in self._backoff or key in self._unschedulable:
                 return
             pi.timestamp = time.monotonic()
+            pi.trace_queued_at = pi.timestamp
             if self.moves != moves_at_failure:
                 pi.backoff_expiry = self._backoff_time(pi)
                 self._backoff.add(pi)
@@ -146,6 +171,7 @@ class PriorityQueue:
         all-deferred hard-spread batch) — an immediate readd would hot-loop
         the identical conflict, and unschedulableQ would mislabel it (and
         sit out the flush interval). Backoff retries in 1-10 s."""
+        tracer.event(pi.trace_id, "queue.backoff")
         with self._cond:
             if (
                 pi.key in self._active
@@ -154,6 +180,7 @@ class PriorityQueue:
             ):
                 return
             pi.timestamp = time.monotonic()
+            pi.trace_queued_at = pi.timestamp
             pi.backoff_expiry = self._backoff_time(pi)
             self._backoff.add(pi)
 
@@ -289,10 +316,20 @@ class PriorityQueue:
     def delete(self, pod: v1.Pod) -> None:
         with self._cond:
             key = pod.metadata.key
+            tid = ""
+            for q in (self._active, self._backoff):
+                pi = q.get(key)
+                if pi is not None:
+                    tid = pi.trace_id
+            pi = self._unschedulable.get(key)
+            if pi is not None:
+                tid = pi.trace_id
             self._active.delete_by_key(key)
             self._backoff.delete_by_key(key)
             self._unschedulable.pop(key, None)
             self.delete_nominated_if_exists(pod)
+        # pod deleted while queued: no lifecycle left to attribute
+        tracer.discard(tid)
 
     def delete_if_uid(self, pod: v1.Pod) -> bool:
         """Delete the queued entry for pod's key ONLY while it still

@@ -68,6 +68,7 @@ from ..parallel.sharded import (
 )
 from ..utils.metrics import metrics
 from ..utils.trace import Trace
+from ..utils.tracing import tracer
 from .cache.cache import SchedulerCache
 from .config import KubeSchedulerConfiguration
 from .core import FitError, GenericScheduler
@@ -129,12 +130,12 @@ class _InFlightBatch:
 
     __slots__ = (
         "pis", "eb", "row_names", "res", "moves0", "trace", "t_start",
-        "snapshot", "launch_gen",
+        "snapshot", "launch_gen", "wave_tid", "t_launched",
     )
 
     def __init__(
         self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None,
-        launch_gen=0,
+        launch_gen=0, wave_tid="", t_launched=0.0,
     ):
         self.pis = pis
         self.eb = eb
@@ -143,6 +144,11 @@ class _InFlightBatch:
         self.moves0 = moves0
         self.trace = trace
         self.t_start = t_start
+        # per-wave trace (utils/tracing.py): the fan-in id the N pod
+        # traces of this batch reference, plus the launch-complete stamp
+        # the resolve path closes the shared `device` span against
+        self.wave_tid = wave_tid
+        self.t_launched = t_launched
         # host snapshot captured AT LAUNCH (verify_cycles only): the state
         # the device encoding was built from — verifying against resolve-
         # time state would report informer churn as device/host mismatches
@@ -724,6 +730,7 @@ class Scheduler:
             # read-back must not lose its FRESH queue entry to a stale key
             if cur is None:
                 self.queue.delete_if_uid(pod)
+                tracer.discard(pi.trace_id)
                 outcome = "gone"
             elif cur.spec.node_name:
                 # the dead leader's bind landed: finish it — the cache
@@ -731,6 +738,9 @@ class Scheduler:
                 # the queue forgets the pod, and it is never re-placed
                 self.queue.delete_if_uid(pod)
                 self.cache.add_pod(cur)
+                tracer.finish(
+                    pi.trace_id, outcome="adopted", node=cur.spec.node_name
+                )
                 outcome = "bound"
             else:
                 outcome = "pending"
@@ -952,6 +962,8 @@ class Scheduler:
     def _buffer_pending_binds(self, entries: List[PendingBind]) -> None:
         accepted, overflow = self._ridethrough.buffer(entries)
         if accepted:
+            for e in accepted:
+                tracer.event(e.pi.trace_id, "bind.parked")
             logger.warning(
                 "store degraded: buffered %d pending binds "
                 "(dispatch paused until writes reopen)", len(accepted),
@@ -1017,16 +1029,27 @@ class Scheduler:
         self, e: PendingBind, still_degraded: List[PendingBind]
     ) -> None:
         pod = e.pi.pod
+
+        def outage_span() -> None:
+            # the parked bind's whole outage wait is a first-class span:
+            # a pod that rode through a degraded store shows WHERE the
+            # seconds went instead of an unexplained e2e tail
+            tracer.add_span(
+                e.pi.trace_id, "outage.wait", e.buffered_at, time.monotonic()
+            )
+
         cur = self._read_back_pod(pod)
         if cur is None:
             # deleted while buffered, or lost with a failed primary
             self.cache.forget_pod(pod)
             self._release_permits(pod)
             metrics.inc(COUNTER_RECONCILED, {"outcome": "gone"})
+            tracer.discard(e.pi.trace_id)
             return
         if cur.spec.node_name:
             if cur.spec.node_name == e.node_name:
                 # the bind LANDED — only its ack was lost
+                outage_span()
                 self._record_bound(
                     e.pi, e.node_name, e.profile, outcome="landed"
                 )
@@ -1036,6 +1059,7 @@ class Scheduler:
                 self.cache.forget_pod(pod)
                 self._release_permits(pod)
                 metrics.inc(COUNTER_RECONCILED, {"outcome": "foreign"})
+                tracer.finish(e.pi.trace_id, outcome="foreign")
             return
         # not bound: the write never applied (or didn't survive
         # failover) — replay once, uid-fenced
@@ -1057,6 +1081,7 @@ class Scheduler:
         if isinstance(err, DegradedWrites):
             still_degraded.append(e)
         elif err is None:
+            outage_span()
             self._record_bound(
                 e.pi, e.node_name, e.profile, outcome="rebound"
             )
@@ -1067,6 +1092,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
             self._release_permits(pod)
             metrics.inc(COUNTER_RECONCILED, {"outcome": "gone"})
+            tracer.discard(e.pi.trace_id)
         else:
             self.cache.forget_pod(pod)
             metrics.inc(COUNTER_RECONCILED, {"outcome": "lost_requeued"})
@@ -1129,6 +1155,10 @@ class Scheduler:
             len(entries), self._ha_identity,
         )
         for pi in entries:
+            # the zombie's view of its own fencing: the store-side stamp
+            # under the same id is recorded by the store process
+            tracer.event(pi.trace_id, "bind.fenced")
+            tracer.finish(pi.trace_id, outcome="fenced")
             self.cache.forget_pod(pi.pod)
             self._release_permits(pi.pod)
 
@@ -1159,8 +1189,10 @@ class Scheduler:
         metrics.observe(
             "pod_scheduling_duration_seconds",
             time.monotonic() - pi.initial_attempt_timestamp,
+            exemplar=pi.trace_id or None,
         )
         metrics.inc("schedule_attempts_total", {"result": "scheduled"})
+        tracer.finish(pi.trace_id, outcome=outcome or "bound", node=node_name)
         if outcome:
             metrics.inc(COUNTER_RECONCILED, {"outcome": outcome})
         prof.recorder.eventf(
@@ -1171,6 +1203,15 @@ class Scheduler:
     def schedule_pod_batch(self, pis: List[QueuedPodInfo]) -> None:
         trace = Trace("schedule_batch", pods=len(pis))
         t_start = time.monotonic()
+        # close every pod's queue-wait span (last queue ENTRY -> cycle
+        # start) in ONE ring acquisition; requeued pods accumulate one
+        # `queue` span per attempt, which is the honest attribution
+        # (trace_queued_at, not timestamp: readd() refreshes only the
+        # former — see QueuedPodInfo)
+        tracer.add_spans(
+            [(pi.trace_id, "queue", pi.trace_queued_at, t_start)
+             for pi in pis]
+        )
         moves0 = self.queue.moves_snapshot()
         known: List[QueuedPodInfo] = []
         extender_pis: List[QueuedPodInfo] = []
@@ -1661,6 +1702,7 @@ class Scheduler:
                 has_pinned,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
+        t_launch0 = time.monotonic()
         try:
             new_snap, res = self._launch_wave_kernel(
                 kern, snap, eb.batch, ptab, np.asarray(self._weights), sub
@@ -1670,12 +1712,25 @@ class Scheduler:
                 self.cache.encoder.invalidate_device()
             raise
         trace.step("launch")
+        t_launched = time.monotonic()
+        # wave-level trace: ONE record for the kernel launch the whole
+        # batch shares — each pod's span chain carries `wave=<id>` so a
+        # slow wave explains its N slow pods in one lookup
+        wave_tid = tracer.start(
+            "wave", f"wave/{len(pis)}pods", t0=t_start, pods=len(pis)
+        )
+        tracer.add_span(wave_tid, "encode", t_start, t_launch0)
+        tracer.add_span(wave_tid, "launch", t_launch0, t_launched)
+        tracer.add_span_many(
+            [pi.trace_id for pi in pis], "encode", t_start, t_launched,
+            wave=wave_tid,
+        )
         # the donation lease inside _launch_wave_kernel already installed
         # new_snap as the live generation — nothing to publish here
         self._pending.append(
             _InFlightBatch(
                 pis, eb, row_names, res, moves0, trace, t_start, verify_snap,
-                launch_gen,
+                launch_gen, wave_tid, t_launched,
             )
         )
         metrics.inc("scheduler_wave_batches_total")
@@ -1706,6 +1761,7 @@ class Scheduler:
             return
         batches, self._pending = self._pending[:k], self._pending[k:]
         metrics.set_gauge(GAUGE_WAVE_INFLIGHT, float(len(self._pending)))
+        t_rb0 = time.monotonic()
         with _stage_timer("kernel"):
             try:
                 # transient device/tunnel blips get bounded jittered
@@ -1722,6 +1778,10 @@ class Scheduler:
                 metrics.inc("scheduler_wave_readbacks_total")
                 self._consecutive_device_loss = 0
             except Exception as e:
+                for b in batches:
+                    tracer.finish(b.wave_tid, outcome="readback_failed")
+                    for pi in b.pis:
+                        tracer.event(pi.trace_id, "readback.failed")
                 # device/tunnel error: the kernels' on-device commits are
                 # unknowable — rebuild HBM from the host masters and retry
                 with self.cache.lock:
@@ -1749,6 +1809,16 @@ class Scheduler:
                         else:
                             self.queue.add_unschedulable_if_not_present(pi, moves)
                 return
+        t_rb1 = time.monotonic()
+        for b in batches:
+            # fan-in: the shared device wait (launch -> resolve entry) and
+            # the combined readback land on the wave trace AND on every
+            # pod trace riding it, in two ring acquisitions per batch
+            tracer.add_span(b.wave_tid, "device", b.t_launched, t_rb0)
+            tracer.add_span(b.wave_tid, "readback", t_rb0, t_rb1)
+            tids = [pi.trace_id for pi in b.pis]
+            tracer.add_span_many(tids, "device", b.t_launched, t_rb0)
+            tracer.add_span_many(tids, "readback", t_rb0, t_rb1)
         tails = []
         quarantined = False
         for b, arrays in zip(batches, fetched):
@@ -1763,15 +1833,19 @@ class Scheduler:
                     "kernel_guard_trips_total",
                     {"reason": "sibling_quarantine"},
                 )
+                tracer.finish(b.wave_tid, outcome="sibling_quarantine")
                 tails.append(None)
                 for pi in b.pis:
+                    tracer.event(pi.trace_id, "wave.quarantined")
                     self.queue.readd(pi)
                 continue
             try:
-                tails.append(self._commit_batch(b, arrays))
+                tails.append(self._commit_batch(b, arrays, t_rb1))
                 self._consecutive_guard_trips = 0
+                tracer.finish(b.wave_tid, outcome="committed")
             except KernelGuardTrip as trip:
                 quarantined = True
+                tracer.finish(b.wave_tid, outcome=f"guard_trip:{trip.reason}")
                 self._on_guard_trip(trip)
                 # the violating batch degrades to the host path (nothing
                 # was assumed for it): _finish_batch host-schedules every
@@ -1780,6 +1854,7 @@ class Scheduler:
                 tails.append((list(b.pis), []))
             except Exception:
                 logger.exception("committing wave batch failed")
+                tracer.finish(b.wave_tid, outcome="commit_failed")
                 tails.append(None)
                 moves = self.queue.moves_snapshot()
                 for pi in b.pis:
@@ -1799,11 +1874,17 @@ class Scheduler:
                 for pi, _i in tail[1]:
                     self.queue.add_unschedulable_if_not_present(pi, moves)
 
-    def _commit_batch(self, p: "_InFlightBatch", arrays) -> tuple:
+    def _commit_batch(
+        self, p: "_InFlightBatch", arrays, t_rb1: Optional[float] = None
+    ) -> tuple:
         """Act on one read-back batch's placements: assume + bind, re-add
         deferred pods. Returns (fallback_pis, failed) for _finish_batch.
         Raises KernelGuardTrip when the batch's outputs fail validation —
-        BEFORE any placement is assumed or any pod requeued."""
+        BEFORE any placement is assumed or any pod requeued.
+
+        t_rb1: the combined readback's completion stamp — the pod traces'
+        `guard` span runs from it to the assume hand-off, so waiting out
+        an earlier sibling's commit is attributed, not lost in a gap."""
         pis, eb, row_names = p.pis, p.eb, p.row_names
         chosen, placed, deferred, score = arrays
         trace, t_start = p.trace, p.t_start
@@ -1873,10 +1954,18 @@ class Scheduler:
         # unschedulable: no condition/event, 1-10 s retry, and move events
         # re-activate backoffQ normally).
         for pi in deferred_pis:
+            tracer.event(pi.trace_id, "wave.deferred")
             if to_bind:
                 self.queue.readd(pi)
             else:
                 self.queue.requeue_backoff(pi)
+        if t_rb1 is not None:
+            # guard = readback done -> assume hand-off (output validation,
+            # decode, oracle sample, and any elder-sibling commit wait)
+            tracer.add_span_many(
+                [pi.trace_id for pi, _n, _b, _p in to_bind],
+                "guard", t_rb1, time.monotonic(),
+            )
 
         if self.cfg.verify_cycles and to_bind:
             try:
@@ -2106,7 +2195,9 @@ class Scheduler:
             metrics.inc(
                 "kernel_guard_trips_total", {"reason": "sibling_quarantine"}
             )
+            tracer.finish(b.wave_tid, outcome="sibling_quarantine")
             for pi in b.pis:
+                tracer.event(pi.trace_id, "wave.quarantined")
                 self.queue.readd(pi)
         self._consecutive_guard_trips += 1
         if self._consecutive_guard_trips >= self.cfg.device_loss_disable_after:
@@ -2261,6 +2352,7 @@ class Scheduler:
         goroutine-per-bind at scheduler.go:666)."""
         if not to_bind:
             return
+        t_a0 = time.monotonic()
         # ONE lock acquisition + vectorized encoder scatters for the whole
         # wave (device_synced path); the host fallback path still assumes
         # per pod through the same cache method semantics
@@ -2283,6 +2375,12 @@ class Scheduler:
                     errors.append(None)
                 except ValueError as e:
                     errors.append(str(e))
+        tracer.add_span_many(
+            [pi.trace_id
+             for (pi, _n, _b, _p), err in zip(to_bind, errors)
+             if err is None],
+            "assume", t_a0, time.monotonic(),
+        )
         simple: List = []
         for (pi, node_name, band, proto), err in zip(to_bind, errors):
             pod = pi.pod
@@ -2336,13 +2434,25 @@ class Scheduler:
             # Nothing applied — drop every placement and stand down.
             self._on_fenced_binds([pi for pi, _n, _p in simple])
             return
-        bind_dur = time.monotonic() - b0
-        e2e = time.monotonic() - t_start
+        t_b1 = time.monotonic()
+        bind_dur = t_b1 - b0
+        e2e = t_b1 - t_start
+        tracer.add_span_many(
+            [pi.trace_id
+             for (pi, _n, _p), err in zip(simple, errors)
+             if err is None],
+            "bind", b0, t_b1,
+        )
         to_buffer: List[PendingBind] = []
         for (pi, node_name, prof), err in zip(simple, errors):
             if err is None:
                 metrics.observe("binding_duration_seconds", bind_dur)
-                metrics.observe("e2e_scheduling_duration_seconds", e2e)
+                # exemplar: the tail samples carry the trace id, so the
+                # histogram's p99 resolves to this pod's full waterfall
+                metrics.observe(
+                    "e2e_scheduling_duration_seconds", e2e,
+                    exemplar=pi.trace_id or None,
+                )
                 # queue-entry → bound, incl. queue wait (reference
                 # pod_scheduling_duration_seconds, metrics.go:51-231) — the
                 # honest per-pod number the latency bench reports
@@ -2367,6 +2477,7 @@ class Scheduler:
     ) -> None:
         """Plugin-bearing profile: run reserve/permit then async bind (the
         pod is already assumed)."""
+        t_a0 = time.monotonic()
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
         fw = prof.framework
@@ -2382,6 +2493,7 @@ class Scheduler:
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message)
             return
+        self._stamp_bind_submit(pi, t_a0)
         try:
             self._bind_pool.submit(
                 self._bind_async, pi, node_name, state, t_start
@@ -2421,6 +2533,9 @@ class Scheduler:
             self._handle_failure(pi, moves0, message=str(e), error=True)
             return
         metrics.observe("scheduling_algorithm_duration_seconds", time.monotonic() - t0)
+        # the span starts at cycle ENTRY (t0), not at algo.schedule: the
+        # per-cycle snapshot clone is real latency and must be attributed
+        tracer.add_span(pi.trace_id, "algo", t0, time.monotonic())
         self._assume_and_bind(pi, result.suggested_host, t0)
 
     def _nominated_pods_for_node(self, node_name: str) -> List[v1.Pod]:
@@ -2457,7 +2572,17 @@ class Scheduler:
             return False
         return True
 
+    def _stamp_bind_submit(self, pi: QueuedPodInfo, t_a0: float) -> None:
+        """Close the per-pod `assume` span (reserve/assume/permit work on
+        the scheduling thread) and stamp the bind-pool hand-off moment:
+        _bind_async starts its `bind` span there, so pool queue wait is
+        attributed to `bind` instead of vanishing into a span hole."""
+        now = time.monotonic()
+        tracer.add_span(pi.trace_id, "assume", t_a0, now)
+        pi._bind_submitted_at = now
+
     def _assume_and_bind(self, pi: QueuedPodInfo, node_name: str, t_start: float) -> None:
+        t_a0 = time.monotonic()
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
         fw = prof.framework
@@ -2483,6 +2608,7 @@ class Scheduler:
             fw.run_unreserve_plugins(state, pod, node_name)
             self._handle_failure(pi, self.queue.moves_snapshot(), message=st.message)
             return
+        self._stamp_bind_submit(pi, t_a0)
         try:
             self._bind_pool.submit(
                 self._bind_async, pi, node_name, state, t_start
@@ -2502,6 +2628,9 @@ class Scheduler:
         prof = self.profiles.for_pod(pod)
         fw = prof.framework
         b0 = time.monotonic()
+        # span start: the hand-off stamp (pool queue wait belongs to the
+        # bind stage); the binding_duration metric keeps b0 semantics
+        t_span0 = getattr(pi, "_bind_submitted_at", None) or b0
         try:
             st = fw.wait_on_permit(pod)
             if not is_success(st):
@@ -2538,15 +2667,20 @@ class Scheduler:
                     raise RuntimeError(f"bind: {st.message}")
             self.cache.finish_binding(pod)
             fw.run_post_bind_plugins(state, pod, node_name)
-            metrics.observe("binding_duration_seconds", time.monotonic() - b0)
+            t_done = time.monotonic()
+            tracer.add_span(pi.trace_id, "bind", t_span0, t_done)
+            metrics.observe("binding_duration_seconds", t_done - b0)
             metrics.observe(
-                "e2e_scheduling_duration_seconds", time.monotonic() - t_start
+                "e2e_scheduling_duration_seconds", t_done - t_start,
+                exemplar=pi.trace_id or None,
             )
             metrics.observe(
                 "pod_scheduling_duration_seconds",
-                time.monotonic() - pi.initial_attempt_timestamp,
+                t_done - pi.initial_attempt_timestamp,
+                exemplar=pi.trace_id or None,
             )
             metrics.inc("schedule_attempts_total", {"result": "scheduled"})
+            tracer.finish(pi.trace_id, outcome="bound", node=node_name)
             prof.recorder.eventf(
                 pod, "Normal", "Scheduled", "Binding",
                 f"Successfully assigned {pod.metadata.key} to {node_name}",
@@ -2593,6 +2727,9 @@ class Scheduler:
         """Returns True iff a preemption was performed (cluster mutated)."""
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
+        tracer.event(
+            pi.trace_id, "error" if error else "unschedulable", message
+        )
         metrics.inc(
             "schedule_attempts_total",
             {"result": "error" if error else "unschedulable"},

@@ -51,6 +51,8 @@ def _ledger_store(ledger_path: str):
     lock = threading.Lock()
     fh = open(ledger_path, "a", encoding="utf-8")
 
+    from ..utils.tracing import trace_for_binding
+
     class LedgerStore(APIServer):
         def _ledger(self, record: dict) -> None:
             with lock:
@@ -58,6 +60,10 @@ def _ledger_store(ledger_path: str):
                 fh.flush()
 
         def bind_pods(self, bindings, fence=None):
+            # trace context (re-established by the REST route from the
+            # X-Trace-Context header) resolves per binding: the ledger
+            # proves a scheduler-minted trace id survived the wire
+            traces = {id(b): trace_for_binding(b) for b in bindings}
             try:
                 errors = super().bind_pods(bindings, fence=fence)
             except LeaderFenced:
@@ -67,6 +73,7 @@ def _ledger_store(ledger_path: str):
                         "identity": getattr(fence, "identity", None),
                         "transitions": getattr(fence, "transitions", None),
                         "uids": [b.pod_uid for b in bindings],
+                        "traces": [traces[id(b)] for b in bindings],
                     }
                 )
                 raise
@@ -80,6 +87,7 @@ def _ledger_store(ledger_path: str):
                             "event": "applied",
                             "uid": b.pod_uid,
                             "node": b.target_node,
+                            "trace": traces[id(b)],
                         }
                     )
                     self._ledger(
@@ -87,6 +95,7 @@ def _ledger_store(ledger_path: str):
                             "event": "acked",
                             "uid": b.pod_uid,
                             "node": b.target_node,
+                            "trace": traces[id(b)],
                         }
                     )
             return errors
@@ -121,6 +130,17 @@ class _DebugHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
+        if self.path.startswith("/traces"):
+            # this replica's trace ring (slowest-N / by-id): the test's
+            # window into which process actually minted a given id
+            from urllib.parse import parse_qs, urlparse
+
+            from ..utils.debugserver import traces_payload
+
+            u = urlparse(self.path)
+            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            code, payload = traces_payload(q)
+            return self._json(code, payload)
         if self.path != "/status":
             return self._json(404, {"error": "unknown path"})
         from ..utils.metrics import metrics
@@ -148,6 +168,7 @@ class _DebugHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": "unknown path"})
         from ..api.objects import Binding
         from ..client.apiserver import LeaderFenced
+        from ..utils.tracing import tracer
 
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
@@ -156,6 +177,12 @@ class _DebugHandler(BaseHTTPRequestHandler):
             pod_namespace=body.get("namespace", "default"),
             pod_uid=body.get("uid", ""),
             target_node=body["node"],
+        )
+        # mint a trace for the forced bind so the cross-process trace
+        # assertion holds for the FENCED path too: the ledger record the
+        # store writes must carry this id
+        trace_id = tracer.start(
+            "pod", f"{binding.pod_namespace}/{binding.pod_name}"
         )
         # the replica's OWN fence-attaching seam: exactly the write a
         # zombie's late wave would issue — including the wave path's
@@ -177,22 +204,33 @@ class _DebugHandler(BaseHTTPRequestHandler):
                                 namespace=binding.pod_namespace,
                                 uid=binding.pod_uid,
                             )
-                        )
+                        ),
+                        trace_id=trace_id,
                     )
                 ]
             )
+            tracer.finish(trace_id, outcome="fenced")
             return self._json(
-                200, {"result": "LeaderFenced", "message": str(e)}
+                200,
+                {"result": "LeaderFenced", "message": str(e),
+                 "trace": trace_id},
             )
         except Exception as e:
+            tracer.finish(trace_id, outcome=type(e).__name__)
             return self._json(
-                200, {"result": type(e).__name__, "message": str(e)}
+                200,
+                {"result": type(e).__name__, "message": str(e),
+                 "trace": trace_id},
             )
         err = errs[0] if errs else None
         if err is None:
-            return self._json(200, {"result": "ok"})
+            tracer.finish(trace_id, outcome="bound")
+            return self._json(200, {"result": "ok", "trace": trace_id})
+        tracer.finish(trace_id, outcome=type(err).__name__)
         return self._json(
-            200, {"result": type(err).__name__, "message": str(err)}
+            200,
+            {"result": type(err).__name__, "message": str(err),
+             "trace": trace_id},
         )
 
 

@@ -10,6 +10,7 @@ binding_duration_seconds, pending_pods, queue_incoming_pods_total, etc.
 from __future__ import annotations
 
 import bisect
+import random
 import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
@@ -21,21 +22,55 @@ _DEF_BUCKETS = [
 
 
 class Histogram:
-    def __init__(self, buckets: Optional[List[float]] = None):
+    # exemplar slots: the largest-valued observations that carried a
+    # trace id — enough to resolve "show me the p99 pod" without storing
+    # an id per sample
+    _MAX_EXEMPLARS = 8
+
+    def __init__(
+        self,
+        buckets: Optional[List[float]] = None,
+        max_samples: int = 100000,
+        seed: int = 0x5EED,
+    ):
         self.buckets = buckets or _DEF_BUCKETS
         self.counts = [0] * (len(self.buckets) + 1)
         self.total = 0.0
         self.n = 0
-        self._samples: List[float] = []  # bounded reservoir for quantiles
-        self._max_samples = 100000
+        # true bounded reservoir (Algorithm R, deterministic seed): every
+        # observation — first or ten-millionth — has equal probability of
+        # being in the sample, so a long-run p99 tracks the live
+        # distribution instead of freezing at the warmup one. Each slot
+        # remembers the OBSERVATION INDEX it came from so quantiles_since
+        # can still window out warmup samples.
+        self._samples: List[float] = []
+        self._sample_obs: List[int] = []
+        self._max_samples = max_samples
+        self._rng = random.Random(seed)
+        # (value, exemplar) pairs, tail-biased (see observe)
+        self._exemplars: List[Tuple[float, str]] = []
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, v)
         self.counts[i] += 1
         self.total += v
         self.n += 1
         if len(self._samples) < self._max_samples:
             self._samples.append(v)
+            self._sample_obs.append(self.n - 1)
+        else:
+            j = self._rng.randrange(self.n)
+            if j < self._max_samples:
+                self._samples[j] = v
+                self._sample_obs[j] = self.n - 1
+        if exemplar:
+            ex = self._exemplars
+            if len(ex) < self._MAX_EXEMPLARS:
+                ex.append((v, exemplar))
+            else:
+                mi = min(range(len(ex)), key=lambda k: ex[k][0])
+                if v > ex[mi][0]:
+                    ex[mi] = (v, exemplar)
 
     def quantile(self, q: float) -> float:
         return self.quantiles([q])[0]
@@ -48,13 +83,32 @@ class Histogram:
         return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]
 
     def quantiles_since(self, n0: int, qs) -> List[float]:
-        """Quantiles over samples observed AFTER the first n0 — lets a
-        measurement window exclude warmup/compile-laden samples the same
-        way callers baseline `total`/`n` (bench stage breakdown)."""
-        s = sorted(self._samples[n0:])
+        """Quantiles over samples whose observation index is >= n0 — lets
+        a measurement window exclude warmup/compile-laden samples the
+        same way callers baseline `total`/`n` (bench stage breakdown).
+        Algorithm R keeps every slot's inclusion probability identical,
+        so the surviving suffix samples are an unbiased window sample."""
+        s = sorted(
+            v for v, oi in zip(self._samples, self._sample_obs) if oi >= n0
+        )
         if not s:
             return [0.0] * len(qs)
         return [s[min(int(q * len(s)), len(s) - 1)] for q in qs]
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """(value, trace_id) pairs, largest value first."""
+        return sorted(self._exemplars, reverse=True)
+
+    def exemplar_near(self, q: float) -> Optional[Tuple[float, str]]:
+        """The exemplar closest ABOVE the q-quantile (falling back to the
+        largest below it): "what is the p99" becomes "show me the p99
+        pod's waterfall" through the returned trace id."""
+        ex = self.exemplars()
+        if not ex:
+            return None
+        target = self.quantile(q)
+        at_or_above = [e for e in ex if e[0] >= target]
+        return at_or_above[-1] if at_or_above else ex[0]
 
     @property
     def avg(self) -> float:
@@ -86,13 +140,22 @@ class Metrics:
         with self._lock:
             self._gauges.pop(self._k(name, labels), None)
 
-    def observe(self, name: str, value: float, labels: Optional[dict] = None) -> None:
+    def observe(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[dict] = None,
+        exemplar: Optional[str] = None,
+    ) -> None:
+        """exemplar: a trace id to ride along with this observation —
+        tail observations keep theirs, so the histogram's p99 resolves
+        to an inspectable per-pod trace (utils/tracing.py)."""
         with self._lock:
             k = self._k(name, labels)
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = Histogram()
-            h.observe(value)
+            h.observe(value, exemplar=exemplar)
 
     def counter(self, name: str, labels: Optional[dict] = None) -> float:
         with self._lock:
@@ -210,6 +273,13 @@ class Metrics:
                     lines.append(f"{name}{fmt_labels(ql)} {val}")
                 lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
                 lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+                for val, tid in h.exemplars():
+                    # OpenMetrics-style exemplar, emitted as a comment so
+                    # plain text-format 0.0.4 scrapers stay unbroken
+                    lines.append(
+                        f"# exemplar {name}{fmt_labels(labels)} {val} "
+                        f'trace_id="{esc(tid)}"'
+                    )
         return "\n".join(lines) + "\n"
 
     def dump(self) -> dict:
@@ -221,13 +291,17 @@ class Metrics:
                 out[f"{name}{dict(labels)}"] = v
             for (name, labels), h in self._hists.items():
                 p50, p90, p99 = h.quantiles((0.50, 0.90, 0.99))
-                out[f"{name}{dict(labels)}"] = {
+                entry = {
                     "count": h.n,
                     "avg": h.avg,
                     "p50": p50,
                     "p90": p90,
                     "p99": p99,
                 }
+                ex = h.exemplar_near(0.99)
+                if ex is not None:
+                    entry["p99_exemplar"] = ex[1]
+                out[f"{name}{dict(labels)}"] = entry
             return out
 
 

@@ -1,0 +1,600 @@
+"""Per-pod scheduling traces: spans from queue admit to store ack.
+
+The metrics registry answers "what is the p99"; nothing in the system
+could answer "WHERE did the p99 pod spend its time". This module is the
+tail-latency attribution layer:
+
+  * a **trace** is minted per pod at queue admission (and per wave at
+    kernel launch) and accumulates **spans** — named `[t0, t1)`
+    monotonic intervals (`queue`, `encode`, `device`, `readback`,
+    `guard`, `assume`, `bind`, `outage.wait`, ...) — plus point
+    **events** (`bind.parked`, `unschedulable`, `bind.fenced`, ...);
+  * **wave traces** fan-in the N pod traces sharing one kernel launch:
+    each pod span chain carries its wave's trace id, so one slow wave
+    explains N slow pods;
+  * completed traces land in a bounded per-process **ring buffer**
+    served by the SIGUSR2 "traces" dump section, the `/debug/traces`
+    REST view (slowest-N, by-id lookup), and the `--debug-port`
+    listener on scheduler/controller-manager processes;
+  * trace context **propagates across process boundaries**: the REST
+    client attaches an ``X-Trace-Context`` header to every `/binding`
+    POST, the route re-establishes the context thread-locally, and the
+    store stamps the apply — or the LeaderFenced rejection — under the
+    same id into a bounded store-side ledger (`stamp_bind`), so a
+    zombie's fenced bind is visible as a trace event in the store
+    process.
+
+Span API contract (machine-enforced by graftlint's tracing pass): a
+span is either recorded atomically with measured endpoints
+(`add_span`/`add_spans`/`add_span_many` — nothing is left open) or
+opened through the ``span()`` context manager, which MUST be used as a
+``with`` statement so every started span is finished on all exits.
+
+Clock discipline: every timestamp in a span is `time.monotonic()` —
+never wall clock (deflake guard: NTP steps and clock skew must not
+produce negative or inflated stages). Wall time appears only as trace
+attributes (`since_created_s`) for cross-referencing API objects.
+
+Concurrency: one named lock (``tracing.ring``) guards the active table,
+the ring, and the store ledger; the lock is a leaf (nothing else is
+acquired under it) and the shared attributes are Eraser-tracked
+(`track_attrs`) so the chaos suites' lockset sanitizer machine-checks
+the guard from day one. Disabled (``KTPU_TRACING=0`` or
+``set_enabled(False)``) every entry point is one attribute test.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from ..testing.lockgraph import named_lock, track_attrs
+
+# the cross-process propagation header (attached by RESTClient
+# bind_pod/bind_pods, validated/consumed by the /binding route)
+TRACE_HEADER = "X-Trace-Context"
+
+COUNTER_STARTED = "tracing_traces_total"
+COUNTER_COMPLETED = "tracing_traces_completed_total"
+COUNTER_DROPPED = "tracing_traces_dropped_total"
+COUNTER_STORE_STAMPS = "tracing_store_stamps_total"
+GAUGE_RING_DEPTH = "tracing_ring_depth"
+GAUGE_ACTIVE = "tracing_active_traces"
+GAUGE_ENABLED = "tracing_enabled"
+
+# pod-trace span names in waterfall order (the bench stage waterfall and
+# the SIGUSR2 renderer both order stages by this, unknown names last)
+STAGE_ORDER = (
+    "queue",
+    "encode",
+    "device",
+    "readback",
+    "guard",
+    "assume",
+    "bind",
+    "ack",
+    "outage.wait",
+    "algo",
+    "launch",
+    "commit",
+)
+
+_tls = threading.local()
+
+
+class _TraceRecord:
+    __slots__ = (
+        "trace_id",
+        "kind",
+        "key",
+        "t0",
+        "t1",
+        "attrs",
+        "spans",
+        "events",
+        "outcome",
+    )
+
+    def __init__(
+        self,
+        trace_id: str,
+        kind: str,
+        key: str,
+        attrs: dict,
+        t0: Optional[float] = None,
+    ):
+        self.trace_id = trace_id
+        self.kind = kind
+        self.key = key
+        # t0 may be backdated (monotonic): a wave trace is minted only
+        # once its launch succeeds, but its lifetime starts at cycle
+        # entry — without this, its own encode span would predate it
+        # (negative offsets) and total_s would omit encode+launch
+        self.t0 = t0 if t0 is not None else time.monotonic()
+        self.t1: Optional[float] = None
+        self.attrs = attrs
+        # (name, t0, t1, attrs-or-None) — atomic, never half-open
+        self.spans: List[Tuple[str, float, float, Optional[dict]]] = []
+        self.events: List[Tuple[float, str, str]] = []
+        self.outcome = ""
+
+    def total_s(self) -> float:
+        end = self.t1 if self.t1 is not None else time.monotonic()
+        return end - self.t0
+
+    def stages(self) -> Dict[str, float]:
+        """Per-stage wall, summed over same-named spans (a requeued pod
+        legitimately has several `queue` spans)."""
+        out: Dict[str, float] = {}
+        for name, s0, s1, _a in self.spans:
+            out[name] = out.get(name, 0.0) + (s1 - s0)
+        return out
+
+    def to_dict(self) -> dict:
+        """JSON-renderable form; span times become offsets (ms) from the
+        trace start so they are meaningful outside this process."""
+        order = {n: i for i, n in enumerate(STAGE_ORDER)}
+        return {
+            "trace_id": self.trace_id,
+            "kind": self.kind,
+            "key": self.key,
+            "finished": self.t1 is not None,
+            "outcome": self.outcome,
+            "total_ms": round(self.total_s() * 1e3, 3),
+            "attrs": dict(self.attrs),
+            "stages_ms": {
+                k: round(v * 1e3, 3)
+                for k, v in sorted(
+                    self.stages().items(),
+                    key=lambda kv: order.get(kv[0], len(order)),
+                )
+            },
+            "spans": [
+                {
+                    "name": name,
+                    "start_ms": round((s0 - self.t0) * 1e3, 3),
+                    "dur_ms": round((s1 - s0) * 1e3, 3),
+                    **({"attrs": a} if a else {}),
+                }
+                for name, s0, s1, a in self.spans
+            ],
+            "events": [
+                {
+                    "at_ms": round((t - self.t0) * 1e3, 3),
+                    "name": name,
+                    **({"detail": detail} if detail else {}),
+                }
+                for t, name, detail in self.events
+            ],
+        }
+
+
+class Tracer:
+    """Process-global span pipeline: active traces, completed ring,
+    store-side stamp ledger. All shared state under ONE leaf lock."""
+
+    # spans/events per trace are capped: a pod stuck in a requeue storm
+    # must not grow an unbounded span list
+    MAX_SPANS = 96
+    MAX_EVENTS = 64
+
+    def __init__(
+        self,
+        ring_size: int = 1024,
+        max_active: int = 65536,
+        stamp_ledger_size: int = 4096,
+    ):
+        # one attribute test per entry point when disabled; flipped only
+        # by set_enabled — a torn read is impossible for a bool
+        self._enabled = os.environ.get("KTPU_TRACING", "1").lower() not in (  # graftlint: unguarded(single-writer bool flag, atomic read by design — same contract as lockgraph._enabled)
+            "0",
+            "false",
+        )
+        # named + Eraser-tracked: the ring enters the race-sanitizer
+        # contract from day one (lock is a leaf — nothing acquired under)
+        self._lock = named_lock("tracing.ring")
+        self._active: Dict[str, _TraceRecord] = {}
+        self._by_key: Dict[str, str] = {}  # pod key -> active trace id
+        self._ring: deque = deque(maxlen=ring_size)
+        self._store_ledger: deque = deque(maxlen=stamp_ledger_size)
+        self._max_active = max_active
+        # trace ids: one random per-process prefix + a counter — globally
+        # unique like uuid4 but ~10x cheaper to mint on the admit path
+        # (ids are minted per pod CREATE; a uuid4 per pod measurably taxes
+        # a 4096-pod burst admit). next() on a count() is GIL-atomic.
+        self._id_prefix = uuid.uuid4().hex[:8]
+        self._id_counter = itertools.count(1)
+        # counter/gauge deltas accumulate HERE (plain dict bumps under
+        # the already-held trace lock) and publish to the metrics
+        # registry in batches: per-op metrics.inc from the admit/finish
+        # hot paths measurably taxed burst scheduling — the registry
+        # lock is contended by the scheduler's own histogram observes
+        # (measured: ~16% of a 6k-pod burst wall went to per-op
+        # inc/set_gauge lock hops; batched, it is noise)
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._last_pub = 0.0  # graftlint: unguarded(single-float publish throttle; a torn read double-publishes at worst)
+        self._pub_interval_s = 1.0
+
+    # -- enable/disable -------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        from .metrics import metrics
+
+        self._enabled = on
+        metrics.set_gauge(GAUGE_ENABLED, 1.0 if on else 0.0)
+
+    # -- trace lifecycle ------------------------------------------------------
+
+    def start(
+        self, kind: str, key: str, t0: Optional[float] = None, **attrs
+    ) -> str:
+        """Mint a trace; returns "" when disabled (every other entry
+        point treats "" as a no-op id, so call sites stay unconditional).
+        t0 (monotonic) backdates the trace start for records minted
+        after their first span's interval began."""
+        if not self._enabled:
+            return ""
+        seq = next(self._id_counter)
+        trace_id = f"{self._id_prefix}{seq:08x}"
+        rec = _TraceRecord(trace_id, kind, key, attrs, t0)
+        with self._lock:
+            if len(self._active) >= self._max_active:
+                # evict the oldest active trace (dict preserves insertion
+                # order) — bounded memory beats a complete tail under a
+                # pathological backlog
+                old_id, old = next(iter(self._active.items()))
+                del self._active[old_id]
+                if self._by_key.get(old.key) == old_id:
+                    del self._by_key[old.key]
+                self._bump_locked("dropped", "active_overflow")
+            self._active[trace_id] = rec
+            if kind == "pod":
+                self._by_key[key] = trace_id
+            self._bump_locked("started", kind)
+        self._maybe_publish()
+        return trace_id
+
+    def finish(self, trace_id: str, outcome: str = "", **attrs) -> None:
+        """Complete a trace: stamp t1, move it into the ring."""
+        if not self._enabled or not trace_id:
+            return
+        with self._lock:
+            rec = self._active.pop(trace_id, None)
+            if rec is None:
+                return
+            if self._by_key.get(rec.key) == trace_id:
+                del self._by_key[rec.key]
+            rec.t1 = time.monotonic()
+            rec.outcome = outcome
+            if attrs:
+                rec.attrs.update(attrs)
+            self._ring.append(rec)
+            self._bump_locked("completed", rec.kind)
+        self._maybe_publish()
+
+    def discard(self, trace_id: str) -> None:
+        """Drop an active trace without completing it (pod deleted while
+        queued — there is no lifecycle left to attribute)."""
+        if not trace_id:
+            return
+        with self._lock:
+            rec = self._active.pop(trace_id, None)
+            if rec is not None:
+                if self._by_key.get(rec.key) == trace_id:
+                    del self._by_key[rec.key]
+                self._bump_locked("dropped", "discarded")
+
+    # -- span & event recording ----------------------------------------------
+
+    def add_span(
+        self, trace_id: str, name: str, t0: float, t1: float, **attrs
+    ) -> None:
+        """Record one closed span [t0, t1) (time.monotonic endpoints)."""
+        if not self._enabled or not trace_id:
+            return
+        with self._lock:
+            self._add_span_locked(trace_id, name, t0, t1, attrs or None)
+
+    def add_spans(
+        self, items: List[Tuple[str, str, float, float]]
+    ) -> None:
+        """Batch form — (trace_id, name, t0, t1) tuples, ONE lock
+        acquisition for a whole wave's worth of per-pod spans."""
+        if not self._enabled or not items:
+            return
+        with self._lock:
+            for trace_id, name, t0, t1 in items:
+                self._add_span_locked(trace_id, name, t0, t1, None)
+
+    def add_span_many(
+        self,
+        trace_ids: List[str],
+        name: str,
+        t0: float,
+        t1: float,
+        **attrs,
+    ) -> None:
+        """The wave fan-in: one identical span recorded into N pod
+        traces (e.g. the shared `device` interval) in one acquisition."""
+        if not self._enabled or not trace_ids:
+            return
+        a = attrs or None
+        with self._lock:
+            for trace_id in trace_ids:
+                self._add_span_locked(trace_id, name, t0, t1, a)
+
+    def _add_span_locked(
+        self,
+        trace_id: str,
+        name: str,
+        t0: float,
+        t1: float,
+        attrs: Optional[dict],
+    ) -> None:
+        rec = self._active.get(trace_id)
+        if rec is None or len(rec.spans) >= self.MAX_SPANS:
+            return
+        rec.spans.append((name, t0, t1, attrs))
+
+    @contextmanager
+    def span(self, trace_id: str, name: str, **attrs):
+        """Inline span over a code region. MUST be used as a `with`
+        statement (graftlint's tracing pass enforces it), so the span is
+        closed on every exit path, exceptions included."""
+        if not self._enabled or not trace_id:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_span(trace_id, name, t0, time.monotonic(), **attrs)
+
+    def event(self, trace_id: str, name: str, detail: str = "") -> None:
+        """Point-in-time annotation on an active trace."""
+        if not self._enabled or not trace_id:
+            return
+        t = time.monotonic()
+        with self._lock:
+            rec = self._active.get(trace_id)
+            if rec is None or len(rec.events) >= self.MAX_EVENTS:
+                return
+            rec.events.append((t, name, detail[:160]))
+
+    # -- cross-process store-side stamps --------------------------------------
+
+    def stamp(self, trace_id: str, event: str, **attrs) -> None:
+        """Store-side ledger entry under a (possibly foreign) trace id:
+        the apply/fence record a scheduler's trace resolves to after the
+        REST hop. Kept even when the id was minted in another process —
+        that is the point."""
+        if not self._enabled or not trace_id:
+            return
+        with self._lock:
+            self._store_ledger.append(
+                {
+                    "trace_id": trace_id,
+                    "event": event,
+                    "t": time.monotonic(),
+                    **attrs,
+                }
+            )
+            self._bump_locked("stamp", event)
+        self._maybe_publish()
+
+    def stamps_for(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return [
+                dict(s)
+                for s in self._store_ledger
+                if s["trace_id"] == trace_id
+            ]
+
+    # -- lookup / rendering ---------------------------------------------------
+
+    def trace_for_pod(self, key: str) -> str:
+        """The trace id owning pod `key` right now: the thread-local
+        bind context (re-established from the REST header on the server
+        side) wins; else the in-process active-trace index."""
+        if not self._enabled:
+            return ""
+        ctx = getattr(_tls, "bind_ctx", None)
+        if ctx:
+            tid = ctx.get(key)
+            if tid:
+                return tid
+        with self._lock:
+            return self._by_key.get(key, "")
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        """By-id lookup across active + ring, with any store-side stamps
+        attached."""
+        with self._lock:
+            rec = self._active.get(trace_id)
+            if rec is None:
+                rec = next(
+                    (r for r in self._ring if r.trace_id == trace_id), None
+                )
+            out = rec.to_dict() if rec is not None else None
+            stamps = [
+                dict(s)
+                for s in self._store_ledger
+                if s["trace_id"] == trace_id
+            ]
+        if out is None:
+            if not stamps:
+                return None
+            # a foreign trace known only by its store stamps (the store
+            # process's view of a scheduler-minted trace)
+            out = {"trace_id": trace_id, "kind": "foreign", "spans": []}
+        if stamps:
+            out["store_stamps"] = stamps
+        return out
+
+    def slowest(self, n: int = 10, kind: str = "pod") -> List[dict]:
+        with self._lock:
+            recs = [r for r in self._ring if not kind or r.kind == kind]
+            recs.sort(key=lambda r: r.total_s(), reverse=True)
+            return [r.to_dict() for r in recs[:n]]
+
+    def stage_stats(self, kind: str = "pod") -> Dict[str, dict]:
+        """Aggregate per-stage durations over the ring's completed
+        traces of `kind`: the bench stage waterfall's data source."""
+        per_stage: Dict[str, List[float]] = {}
+        with self._lock:
+            recs = [r for r in self._ring if r.kind == kind]
+            for r in recs:
+                for name, dur in r.stages().items():
+                    per_stage.setdefault(name, []).append(dur)
+        out: Dict[str, dict] = {}
+        for name, durs in per_stage.items():
+            durs.sort()
+            n = len(durs)
+            out[name] = {
+                "count": n,
+                "total_s": round(sum(durs), 6),
+                "p50_ms": round(durs[min(n // 2, n - 1)] * 1e3, 3),
+                "p99_ms": round(
+                    durs[min(int(0.99 * n), n - 1)] * 1e3, 3
+                ),
+            }
+        order = {s: i for i, s in enumerate(STAGE_ORDER)}
+        return dict(
+            sorted(out.items(), key=lambda kv: order.get(kv[0], len(order)))
+        )
+
+    def render_lines(self, n: int = 5) -> List[str]:
+        """The SIGUSR2 "traces" section: slowest-N completed pod traces
+        as waterfall lines, plus ring/active occupancy."""
+        with self._lock:
+            active, ring = len(self._active), len(self._ring)
+        lines = [
+            f"  enabled: {self._enabled}  active: {active}  "
+            f"ring: {ring}  (lookup: /debug/traces?id=<trace_id>)"
+        ]
+        for d in self.slowest(n):
+            stages = "  ".join(
+                f"{k}={v:.1f}ms" for k, v in d["stages_ms"].items()
+            )
+            lines.append(
+                f"  {d['trace_id']} {d['key']} total={d['total_ms']:.1f}ms "
+                f"[{d.get('outcome') or '?'}] {stages}"
+            )
+        return lines
+
+    def _bump_locked(self, what: str, label: str) -> None:
+        """Caller holds self._lock: accumulate one counter delta for the
+        next batched publish (a plain dict bump — no registry lock)."""
+        k = (what, label)
+        self._counts[k] = self._counts.get(k, 0) + 1
+
+    def _maybe_publish(self) -> None:
+        """Time-throttled flush of accumulated deltas into the metrics
+        registry (called OUTSIDE the trace lock)."""
+        now = time.monotonic()
+        if now - self._last_pub >= self._pub_interval_s:
+            self._last_pub = now
+            self.publish_gauges()
+
+    def publish_gauges(self) -> None:
+        """Flush accumulated counter deltas and refresh the occupancy
+        gauges. Dump/scrape paths call this so a reader never sees stale
+        tracing series; the hot paths only bump plain dicts and flush
+        through here at most once per second."""
+        with self._lock:
+            depth, active = len(self._ring), len(self._active)
+            deltas, self._counts = self._counts, {}
+        from .metrics import metrics
+
+        for (what, label), n in sorted(deltas.items()):
+            by = float(n)
+            if what == "started":
+                metrics.inc(COUNTER_STARTED, {"kind": label}, by=by)
+            elif what == "completed":
+                metrics.inc(COUNTER_COMPLETED, {"kind": label}, by=by)
+            elif what == "dropped":
+                metrics.inc(COUNTER_DROPPED, {"reason": label}, by=by)
+            elif what == "stamp":
+                metrics.inc(COUNTER_STORE_STAMPS, {"outcome": label}, by=by)
+        metrics.set_gauge(GAUGE_RING_DEPTH, float(depth))
+        metrics.set_gauge(GAUGE_ACTIVE, float(active))
+        metrics.set_gauge(GAUGE_ENABLED, 1.0 if self._enabled else 0.0)
+
+    def reset(self) -> None:
+        """Test/bench-window helper: drop every trace and stamp."""
+        with self._lock:
+            self._active.clear()
+            self._by_key.clear()
+            self._ring.clear()
+            self._store_ledger.clear()
+            self._counts.clear()
+
+
+# lockset sanitizer (testing/lockgraph.py Eraser mode): the active
+# table, pod-key index, completed ring, and store-stamp ledger are
+# shared by scheduler/informer/bind-pool/REST-handler threads — all
+# guarded by the one `tracing.ring` leaf lock, machine-checked in chaos
+track_attrs(Tracer, "_active", "_by_key", "_ring", "_store_ledger", "_counts")
+
+
+tracer = Tracer()  # process-global tracer (one ring per process)
+
+
+# -- cross-process bind context ------------------------------------------------
+
+
+@contextmanager
+def bind_context(mapping: Dict[str, str]):
+    """Establish pod-key -> trace-id context for the current thread (the
+    REST /binding route enters this from the X-Trace-Context header so
+    the store's stamps land under the scheduler-minted id)."""
+    prev = getattr(_tls, "bind_ctx", None)
+    _tls.bind_ctx = mapping
+    try:
+        yield
+    finally:
+        _tls.bind_ctx = prev
+
+
+def stamp_bind(binding, event: str, **attrs) -> None:
+    """Stamp a bind outcome for one Binding under whatever trace id owns
+    the pod (thread-local context from the REST hop, or the in-process
+    active index). No-op when nobody is tracing the pod."""
+    key = f"{binding.pod_namespace}/{binding.pod_name}"
+    tid = tracer.trace_for_pod(key)
+    if tid:
+        tracer.stamp(
+            tid, event, key=key, node=getattr(binding, "target_node", ""),
+            **attrs,
+        )
+
+
+def trace_for_binding(binding) -> str:
+    """The trace id to attach to a /binding POST for this Binding."""
+    return tracer.trace_for_pod(
+        f"{binding.pod_namespace}/{binding.pod_name}"
+    )
+
+
+def health_lines() -> List[str]:
+    """Tracing counters/gauges for the SIGUSR2 dump (covers the
+    `tracing_` dump-required metric family)."""
+    from .metrics import metrics
+
+    tracer.publish_gauges()
+    lines: List[str] = []
+    for name, labels, value in metrics.snapshot_gauges("tracing_"):
+        lines.append(metrics.format_series_line(name, labels, value))
+    for name, labels, value in metrics.snapshot_counters("tracing_"):
+        lines.append(metrics.format_series_line(name, labels, value))
+    return lines

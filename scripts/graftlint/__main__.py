@@ -11,8 +11,8 @@ Usage (from the repo root):
 
 Passes: donation-safety (1), dispatch-blocking (2), metrics-contract
 (3), degraded-write (4), bind-fence seam (5), guarded-by inference (6),
-thread-hygiene, and the stale-pragma audit (always last — it fails any
-suppression pragma no pass consulted).
+tracing span lifecycle (7), thread-hygiene, and the stale-pragma audit
+(always last — it fails any suppression pragma no pass consulted).
 
 Findings print as ``file:line: [pass] message`` and the process exits
 nonzero when any unsuppressed finding (or any STALE suppression) exists.
@@ -44,6 +44,7 @@ import guardedby
 import metrics_contract
 import pragmas
 import threads
+import tracingpass
 
 BASELINE = os.path.join(_HERE, "baseline.txt")
 
@@ -56,6 +57,7 @@ PASSES = (
     ("degraded", lambda tree, root: degraded.run(tree)),
     ("fenceseam", lambda tree, root: fenceseam.run(tree)),
     ("guardedby", lambda tree, root: guardedby.run(tree, root)),
+    ("tracing", lambda tree, root: tracingpass.run(tree)),
     ("threads", lambda tree, root: threads.run(tree)),
     ("pragmas", lambda tree, root: pragmas.run(tree)),
 )

@@ -111,6 +111,7 @@ METRICS_DOC = "README.md"
 DUMP_REQUIRED_FAMILIES = (
     "snapshot_",
     "kernel_guard_",
+    "tracing_",
     "scheduler_device_",
     "scheduler_mesh_",
     "scheduler_wave_",
@@ -197,6 +198,7 @@ GUARDEDBY_CLASSES = (
     "PriorityQueue",
     "BindRideThrough",
     "LeaderElector",
+    "Tracer",
 )
 
 # canonicalization of lock spellings to the runtime watchdog names
@@ -219,6 +221,7 @@ GUARD_LOCK_ALIASES = {
     # the anti-entropy auditor is handed the scheduler cache lock at
     # construction: its `with self.lock` IS the cache lock
     "SnapshotAntiEntropy.lock": "scheduler.cache",
+    "Tracer._lock": "tracing.ring",
 }
 
 # the human-facing attr→lock reference the inferred guard map must
@@ -239,6 +242,7 @@ AUDITED_PRAGMAS = (
     "unguarded",
     "guarded-by",
     "thread-ok",
+    "span-ok",
 )
 AUDITED_PRAGMA_PREFIXES = ("holds-",)
 
@@ -255,3 +259,13 @@ FENCE_SEAM_FUNCS = ("_bind_pods_fenced",)
 # method names that are bind writes when called on a store-ish receiver
 # (WRITE_RECEIVERS above)
 FENCE_BIND_METHODS = {"bind_pod", "bind_pods"}
+
+# -- pass 7: tracing span lifecycle -------------------------------------------
+
+# methods that OPEN a span (context managers): a call must be the
+# context expression of a `with` item so the span closes on all exits
+# (add_span/add_spans/add_span_many record closed intervals — exempt)
+TRACING_SPAN_METHODS = ("span",)
+
+# receiver trailing names identifying the tracer (utils/tracing.py)
+TRACING_RECEIVERS = {"tracer", "tracing"}

@@ -1,0 +1,103 @@
+"""Pass 7: tracing span lifecycle.
+
+A span that is opened but never closed is worse than no span: the trace
+renders with a hole exactly where the latency went, and the waterfall's
+reconciliation against the e2e histogram silently drifts. The tracing
+API (utils/tracing.py) is shaped so the hazard is statically checkable:
+
+  * ``add_span`` / ``add_spans`` / ``add_span_many`` record CLOSED
+    intervals atomically — nothing to leak;
+  * ``span(...)`` is the only way to OPEN a span over a code region,
+    and it is a context manager whose ``finally`` closes it — but only
+    if it is actually entered.
+
+This pass enforces the entry: every ``.span(`` call on a tracer-ish
+receiver (``config.TRACING_RECEIVERS``) must BE the context expression
+of a ``with`` item. Assigning the manager (``s = tracer.span(...)``),
+passing it along, or calling it bare leaves the span unopened or
+unclosed on some exit path — a finding either way. A deliberate
+exception (e.g. an ExitStack composition) carries
+``# graftlint: span-ok(reason)``; the reason is mandatory and the
+stale-pragma audit retires it when the code moves.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from core import Finding, Tree, dotted_name
+import config
+
+PASS = "tracing"
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules:
+        if mod.rel.endswith(os.path.join("utils", "tracing.py")):
+            continue  # the API implementation itself
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in config.TRACING_SPAN_METHODS
+            ):
+                continue
+            recv = dotted_name(f.value)
+            if (
+                not recv
+                or recv.rsplit(".", 1)[-1] not in config.TRACING_RECEIVERS
+            ):
+                continue
+            parent = mod.parents.get(node)
+            if (
+                isinstance(parent, ast.withitem)
+                and parent.context_expr is node
+            ):
+                continue
+            if mod.node_has(node, "span-ok"):
+                p = next(
+                    (
+                        pr
+                        for ln in range(
+                            node.lineno,
+                            getattr(node, "end_lineno", node.lineno) + 1,
+                        )
+                        for pr in mod.pragmas.get(ln, ())
+                        if pr.directive == "span-ok"
+                    ),
+                    None,
+                )
+                if p is not None and not p.reason:
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            PASS,
+                            "span-ok-no-reason",
+                            "span-ok requires a reason: "
+                            "`# graftlint: span-ok(why this span cannot "
+                            "be a with-statement)`",
+                        )
+                    )
+                continue
+            fn = mod.enclosing_function(node)
+            where = getattr(fn, "name", "<module>")
+            findings.append(
+                Finding(
+                    mod.rel,
+                    node.lineno,
+                    PASS,
+                    f"unclosed-span:{where}",
+                    f"`{recv}.{f.attr}(...)` opens a span outside a "
+                    "`with` statement: the span is not closed on every "
+                    "exit path. Use `with ...span(...):` (or record a "
+                    "closed interval via add_span), or carry "
+                    "`# graftlint: span-ok(reason)`",
+                )
+            )
+    return findings

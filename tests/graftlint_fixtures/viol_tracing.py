@@ -1,0 +1,41 @@
+"""Fixture: tracing span-lifecycle violations (pass 7).
+
+Expected findings:
+  * `leaked_assignment` — span manager assigned, never entered: the span
+    is either never opened or never closed.
+  * `bare_call` — span manager called and dropped on the floor.
+  * `_ok_with` / `_ok_add_span` / `_ok_marked` must stay clean.
+"""
+
+import time
+
+from kubernetes_tpu.utils.tracing import tracer
+
+
+def leaked_assignment(tid):
+    s = tracer.span(tid, "encode")  # finding: not a with-statement
+    return s
+
+
+def bare_call(tid):
+    tracer.span(tid, "device")  # finding: manager dropped, span never opens
+
+
+def suppressed_no_reason(tid):
+    s = tracer.span(tid, "guard")  # graftlint: span-ok
+    return s
+
+
+def _ok_with(tid):
+    with tracer.span(tid, "bind"):
+        pass
+
+
+def _ok_add_span(tid):
+    t0 = time.monotonic()
+    tracer.add_span(tid, "assume", t0, time.monotonic())
+
+
+def _ok_marked(tid, stack):
+    s = stack.enter_context(tracer.span(tid, "readback"))  # graftlint: span-ok(ExitStack composition closes it with the stack)
+    return s

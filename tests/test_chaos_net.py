@@ -417,6 +417,22 @@ def _ledger_fenced_identities(path: str) -> list:
     return out
 
 
+def _ledger_records(path: str, event: str) -> list:
+    with open(path) as fh:
+        return [
+            rec
+            for rec in (json.loads(line) for line in fh)
+            if rec["event"] == event
+        ]
+
+
+def _get_trace(port: int, trace_id: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/traces?id={trace_id}", timeout=5
+    ) as r:
+        return json.loads(r.read())
+
+
 class _MultiProc:
     """apiserver + leader + standby as real OS processes. The leader
     talks REST through a NetChaosProxy; the standby and the test talk
@@ -527,6 +543,60 @@ def test_multiproc_partition_promote_heal_zombie_rest_binds_fenced():
         )
         # the zombie counted its rejection (path=rest)
         assert _status(mp.leader_debug)["fenced_binds"] >= 1
+    finally:
+        mp.stop()
+
+
+@pytest.mark.slow
+def test_multiproc_trace_ids_survive_rest_hop():
+    """ISSUE-13 acceptance: a trace id minted in the LEADER process (at
+    queue admission) crosses the REST /binding hop in X-Trace-Context
+    and appears in the store process's JSONL ledger — for normal binds
+    AND for a fenced zombie bind — and resolves back to a complete
+    per-pod trace on the leader's debug port."""
+    mp = _MultiProc(leader_zombie_hold=True, leader_via_proxy=False)
+    try:
+        for i in range(4):
+            mp.client.create("nodes", make_node(f"net-{i}"))
+        for i in range(6):
+            mp.client.create("pods", make_pod(f"traced-{i}"))
+        assert wait_until(lambda: mp.all_bound(6), 60)
+        applied = _ledger_records(mp.ledger, "applied")
+        assert len(applied) == 6
+        # every apply record carries the scheduler-minted trace id
+        for rec in applied:
+            assert rec.get("trace"), f"untraced apply record: {rec}"
+        # ... and the id resolves to a COMPLETE trace in the leader: the
+        # store's view and the scheduler's view agree on identity
+        tid = applied[0]["trace"]
+        trace = _get_trace(mp.leader_debug, tid)
+        assert trace["trace_id"] == tid and trace["finished"], trace
+        assert trace["outcome"] == "bound"
+        assert "bind" in trace["stages_ms"] and "queue" in trace["stages_ms"]
+        # zombie path: freeze the leader through lease expiry, promote
+        # the standby, resume — the zombie's late REST bind is fenced
+        # and the ledger's fence record carries ITS trace id
+        sigstop(mp.leader.proc)
+        assert wait_until(
+            lambda: _status(mp.standby_debug)["promoted"], 60
+        ), "standby never promoted after SIGSTOP"
+        sigcont(mp.leader.proc)
+        target = mp.client.create("pods", make_pod("traced-late"))
+        out = _force_bind(
+            mp.leader_debug, "traced-late", "net-0", target.metadata.uid
+        )
+        assert out["result"] == "LeaderFenced", out
+        assert out.get("trace"), "forced bind minted no trace"
+        assert wait_until(
+            lambda: bool(_ledger_records(mp.ledger, "fenced")), 30
+        ), "the zombie's fenced bind never reached the ledger"
+        fenced = _ledger_records(mp.ledger, "fenced")
+        assert any(
+            out["trace"] in rec.get("traces", []) for rec in fenced
+        ), (out["trace"], fenced)
+        # the fenced trace is inspectable in the zombie process too
+        ztrace = _get_trace(mp.leader_debug, out["trace"])
+        assert ztrace["outcome"] == "fenced", ztrace
     finally:
         mp.stop()
 

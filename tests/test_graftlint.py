@@ -32,6 +32,7 @@ import guardedby  # noqa: E402
 import metrics_contract  # noqa: E402
 import pragmas as gl_pragmas  # noqa: E402
 import threads as gl_threads  # noqa: E402
+import tracingpass as gl_tracing  # noqa: E402
 
 FIXTURES = "tests/graftlint_fixtures"
 FIXTURE_DOC = os.path.join(REPO, FIXTURES, "fixtures_metrics.md")
@@ -246,6 +247,34 @@ def test_unaudited_directives_ignored(tmp_path):
     assert gl_pragmas.run(tree) == []
 
 
+# -- pass 7: tracing span lifecycle ------------------------------------------
+
+
+def test_tracing_fixture_exact_findings():
+    found = gl_tracing.run(_tree("viol_tracing.py"))
+    assert _keys(found) == [
+        "span-ok-no-reason",
+        "unclosed-span:bare_call",
+        "unclosed-span:leaked_assignment",
+    ]
+
+
+def test_tracing_with_statement_and_add_span_clean():
+    found = gl_tracing.run(_tree("viol_tracing.py"))
+    bad_lines = {f.line for f in found}
+    src = _tree("viol_tracing.py").modules[0].source.splitlines()
+    for i, line in enumerate(src, 1):
+        if "_ok_" in line and "def " in line:
+            # nothing inside the _ok_* functions may be flagged
+            assert all(abs(b - i) > 2 for b in bad_lines), (i, bad_lines)
+
+
+def test_tracing_production_tree_clean():
+    rels = core.discover(REPO, gl_config.PACKAGES, gl_config.EXCLUDE_DIRS)
+    tree = core.Tree(REPO, rels)
+    assert gl_tracing.run(tree) == []
+
+
 # -- the clean fixture passes every pass -------------------------------------
 
 
@@ -257,6 +286,7 @@ def test_clean_fixture_no_findings():
     assert degraded.run(src, dirs=(FIXTURES,)) == []
     assert fenceseam.run(src, dirs=(FIXTURES,)) == []
     assert guardedby.run(src) == []
+    assert gl_tracing.run(src) == []
     assert gl_threads.run(src) == []
     # every pragma in clean.py is consulted by the passes above
     assert gl_pragmas.run(src) == []

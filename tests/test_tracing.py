@@ -1,0 +1,406 @@
+"""Per-pod scheduling traces (ISSUE 13): the span pipeline, tail
+exemplars, reservoir-sampled histograms, the debug listeners, and
+cross-process trace propagation over the REST /binding hop.
+
+Covers the tentpole acceptance shape end to end, in-process:
+
+  * a pod admitted to the queue gets a trace whose span chain covers
+    queue -> encode -> device -> readback -> guard -> assume -> bind,
+    the store stamps the apply under the same id, and the trace is
+    retrievable by id from the ring, the SIGUSR2 dump, and
+    /debug/traces;
+  * the `e2e_scheduling_duration_seconds` p99 exemplar resolves to a
+    complete per-pod trace whose in-cycle stage sum reconciles with the
+    histogram within 5%;
+  * a trace id attached to a /binding POST (X-Trace-Context) survives
+    the wire and appears in the server-side stamp ledger — for a
+    normal bind AND for a LeaderFenced zombie bind;
+  * Histogram._samples is a true seeded reservoir: late-arriving
+    outliers shift the reported p99 (the first-100k freeze is gone)
+    while `quantiles_since` windowing keeps working.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Binding,
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.client import RESTClient
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.client.apiserver import APIServer, LeaderFenced
+from kubernetes_tpu.client.leaderelection import BindFence
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.scheduler.cache.debugger import CacheDebugger
+from kubernetes_tpu.utils.debugserver import serve_debug
+from kubernetes_tpu.utils.metrics import Histogram, metrics
+from kubernetes_tpu.utils.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    bind_context,
+    tracer,
+)
+
+
+def make_node(name, cpu="32"):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable={"cpu": cpu, "memory": "64Gi", "pods": 110}
+        ),
+    )
+
+
+def make_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(containers=[Container(requests={"cpu": "100m"})]),
+    )
+
+
+def wait_until(cond, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracer.reset()
+    yield
+    tracer.reset()
+
+
+# -- reservoir sampling (the _samples satellite) ------------------------------
+
+
+def test_reservoir_late_outliers_shift_p99():
+    """The seed bug: the old code kept only the FIRST max_samples
+    observations, freezing long-run quantiles at the warmup
+    distribution. With true reservoir sampling the late outlier regime
+    must move the reported p99."""
+    h = Histogram(max_samples=200)
+    for _ in range(2000):
+        h.observe(0.01)
+    frozen_p99 = h.quantile(0.99)
+    assert frozen_p99 == pytest.approx(0.01)
+    # the workload shifts: a late 10x-slower tail the old reservoir
+    # would never have admitted (its first 200 slots were taken forever)
+    for _ in range(2000):
+        h.observe(0.1)
+    assert h.quantile(0.99) == pytest.approx(0.1), (
+        "late-arriving outliers must shift the reported p99 — the "
+        "first-N freeze is back"
+    )
+    # deterministic: same seed, same sequence, same reservoir
+    h2 = Histogram(max_samples=200)
+    for _ in range(2000):
+        h2.observe(0.01)
+    for _ in range(2000):
+        h2.observe(0.1)
+    assert h2._samples == h._samples
+
+
+def test_reservoir_quantiles_since_windows_out_warmup():
+    h = Histogram(max_samples=1000)
+    for _ in range(500):
+        h.observe(5.0)  # compile-laden warmup
+    n0 = h.n
+    for _ in range(500):
+        h.observe(0.002)
+    assert h.quantiles_since(n0, (0.99,))[0] == pytest.approx(0.002)
+    # and the all-time quantile still sees both regimes
+    assert h.quantile(0.2) in (pytest.approx(0.002), pytest.approx(5.0))
+
+
+def test_histogram_exemplars_track_the_tail():
+    h = Histogram()
+    for i in range(100):
+        h.observe(0.001 * (i + 1), exemplar=f"t{i}")
+    ex = h.exemplars()
+    assert ex[0] == (pytest.approx(0.1), "t99")
+    near = h.exemplar_near(0.99)
+    assert near is not None and near[1] in {f"t{i}" for i in range(90, 100)}
+    # render_prometheus carries the exemplar as a comment line
+    metrics.observe("tracing_test_series_seconds", 1.5, exemplar="deadbeef")
+    text = metrics.render_prometheus()
+    assert '# exemplar tracing_test_series_seconds 1.5 trace_id="deadbeef"' in text
+
+
+# -- tracer unit behavior ------------------------------------------------------
+
+
+def test_tracer_span_chain_and_ring():
+    t = Tracer(ring_size=4)
+    tid = t.start("pod", "default/x")
+    t0 = time.monotonic()
+    t.add_span(tid, "queue", t0 - 0.05, t0)
+    with t.span(tid, "bind"):
+        time.sleep(0.002)
+    t.event(tid, "unschedulable", "0/5 nodes")
+    t.finish(tid, outcome="bound", node="n-1")
+    got = t.get(tid)
+    assert got["finished"] and got["outcome"] == "bound"
+    assert set(got["stages_ms"]) == {"queue", "bind"}
+    assert got["stages_ms"]["queue"] == pytest.approx(50, rel=0.2)
+    assert got["events"][0]["name"] == "unschedulable"
+    # ring is bounded: oldest completed traces fall off
+    for i in range(10):
+        tid_i = t.start("pod", f"default/y{i}")
+        t.finish(tid_i)
+    assert t.get(tid) is None
+    assert len(t.slowest(100)) == 4
+
+
+def test_tracer_span_closes_on_exception():
+    t = Tracer()
+    tid = t.start("pod", "default/exc")
+    with pytest.raises(RuntimeError):
+        with t.span(tid, "bind"):
+            raise RuntimeError("boom")
+    t.finish(tid)
+    assert "bind" in t.get(tid)["stages_ms"]
+
+
+def test_tracer_disabled_is_inert():
+    t = Tracer()
+    t.set_enabled(False)
+    try:
+        assert t.start("pod", "default/z") == ""
+        t.add_span("", "queue", 0.0, 1.0)
+        t.finish("")
+        assert t.slowest(5) == []
+        assert t.trace_for_pod("default/z") == ""
+    finally:
+        t.set_enabled(True)
+
+
+def test_tracer_active_overflow_evicts_oldest():
+    t = Tracer(max_active=3)
+    tids = [t.start("pod", f"default/a{i}") for i in range(5)]
+    assert t.get(tids[0]) is None and t.get(tids[1]) is None
+    assert all(t.get(tid) is not None for tid in tids[2:])
+
+
+def test_bind_context_overrides_active_index():
+    t0 = tracer.start("pod", "default/ctx")
+    with bind_context({"default/ctx": "feedface"}):
+        assert tracer.trace_for_pod("default/ctx") == "feedface"
+    assert tracer.trace_for_pod("default/ctx") == t0
+
+
+# -- in-process end-to-end: scheduler -> store, host path ---------------------
+
+
+@pytest.fixture
+def bound_cluster():
+    metrics.reset()
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_device=False))
+    for i in range(6):
+        server.create("nodes", make_node(f"tr-{i}"))
+    sched.start()
+    try:
+        for i in range(8):
+            server.create("pods", make_pod(f"tp-{i}"))
+
+        def bound():
+            pods, _ = server.list("pods")
+            return sum(1 for p in pods if p.spec.node_name)
+
+        assert wait_until(lambda: bound() >= 8, 30)
+        yield server, sched
+    finally:
+        sched.stop()
+
+
+def test_pod_trace_complete_and_store_stamped(bound_cluster):
+    server, sched = bound_cluster
+    slow = tracer.slowest(20)
+    assert len(slow) >= 8
+    d = next(t for t in slow if t["key"].startswith("default/tp-"))
+    assert d["outcome"] == "bound"
+    # host path chain: queue wait, algorithm, bind — all monotonic spans
+    assert {"queue", "algo", "bind"} <= set(d["stages_ms"])
+    # the store stamped the apply under the SAME id
+    full = tracer.get(d["trace_id"])
+    stamps = full.get("store_stamps", [])
+    assert any(s["event"] == "applied" for s in stamps), stamps
+    assert full["attrs"].get("node", "").startswith("tr-")
+
+
+def test_p99_exemplar_resolves_to_full_trace(bound_cluster):
+    h = metrics.histogram("e2e_scheduling_duration_seconds")
+    assert h is not None and h.n >= 8
+    ex = h.exemplar_near(0.99)
+    assert ex is not None
+    val, tid = ex
+    d = tracer.get(tid)
+    assert d is not None and d["finished"], "p99 exemplar must resolve"
+    # reconciliation: the trace's in-cycle stage sum vs the histogram
+    # observation it rode in on (within 5%)
+    cycle = sum(
+        v
+        for k, v in d["stages_ms"].items()
+        if k in ("algo", "assume", "bind", "encode", "device", "readback",
+                 "guard")
+    )
+    assert cycle / 1e3 == pytest.approx(val, rel=0.05), (cycle, val)
+
+
+def test_sigusr2_dump_has_traces_section(bound_cluster):
+    server, sched = bound_cluster
+    dump = CacheDebugger(sched).dump()
+    assert "Dump of per-pod scheduling traces (slowest first):" in dump
+    assert "total=" in dump
+    assert "Dump of tracing pipeline state:" in dump
+    assert "tracing_traces_completed_total" in dump
+
+
+def test_debug_listener_serves_metrics_and_traces(bound_cluster):
+    srv = serve_debug(0)
+    port = srv.server_address[1]
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            body = r.read().decode()
+        assert "e2e_scheduling_duration_seconds" in body
+        assert "tracing_traces_total" in body
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?n=5", timeout=5
+        ) as r:
+            payload = json.loads(r.read())
+        assert payload["slowest"] and payload["stages"]
+        tid = payload["slowest"][0]["trace_id"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?id={tid}", timeout=5
+        ) as r:
+            one = json.loads(r.read())
+        assert one["trace_id"] == tid and one["spans"]
+    finally:
+        srv.shutdown()
+
+
+# -- the bench stage waterfall (device wave path) ------------------------------
+
+
+def test_latency_bench_waterfall_reconciles_with_e2e():
+    """ISSUE-13 acceptance: the steady-state bench reports a per-stage
+    waterfall from REAL spans whose in-cycle stage sums reconcile with
+    e2e_scheduling_duration_seconds within 5%, and the p99 exemplar
+    resolves to a complete per-pod trace retrievable by id."""
+    from kubernetes_tpu.perf.harness import run_latency_benchmark
+    from kubernetes_tpu.perf.workloads import WORKLOADS
+
+    lat = run_latency_benchmark(
+        WORKLOADS["SchedulingBasic/500"], rate_pods_per_s=120.0, n_pods=60
+    )
+    assert lat.scheduled == 60
+    wf = lat.stage_waterfall
+    # wave-path chain: every in-cycle stage attributed
+    for stage in ("queue", "encode", "device", "readback", "guard",
+                  "assume", "bind"):
+        assert stage in wf, (stage, wf)
+        assert wf[stage]["count"] >= 50
+    assert 0.95 <= lat.waterfall_vs_e2e <= 1.05, lat.waterfall_vs_e2e
+    assert lat.p99_trace_id, "no p99 exemplar"
+    assert lat.p99_trace is not None and lat.p99_trace["finished"]
+    assert tracer.get(lat.p99_trace_id) is not None
+
+
+# -- the REST hop: X-Trace-Context survives the wire ---------------------------
+
+
+@pytest.fixture
+def rest_stack():
+    srv, port, store = serve(store=APIServer(), port=0)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=5.0)
+    yield srv, port, store, client
+    srv.shutdown()
+
+
+def test_trace_header_survives_rest_bind(rest_stack):
+    srv, port, store, client = rest_stack
+    store.create("nodes", make_node("rest-0"))
+    store.create("pods", make_pod("rp-0"))
+    # a trace id the SERVER process cannot know from its own active
+    # index: only the X-Trace-Context header can deliver it
+    with bind_context({"default/rp-0": "feedbeefcafe0001"}):
+        errs = client.bind_pods(
+            [Binding(pod_name="rp-0", pod_namespace="default",
+                     target_node="rest-0")]
+        )
+    assert errs == [None]
+    stamps = tracer.stamps_for("feedbeefcafe0001")
+    assert any(
+        s["event"] == "applied" and s["node"] == "rest-0" for s in stamps
+    ), stamps
+    # /debug/traces on the API server resolves the foreign id too
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/traces?id=feedbeefcafe0001",
+        timeout=5,
+    ) as r:
+        payload = json.loads(r.read())
+    assert payload["store_stamps"][0]["event"] == "applied"
+
+
+def test_trace_header_stamps_fenced_zombie_bind(rest_stack):
+    srv, port, store, client = rest_stack
+    store.create("nodes", make_node("rest-1"))
+    store.create("pods", make_pod("rp-1"))
+    stale = BindFence(
+        namespace="kube-system", name="kube-scheduler",
+        identity="zombie-a", transitions=41,
+    )
+    with bind_context({"default/rp-1": "feedbeefcafe0002"}):
+        with pytest.raises(LeaderFenced):
+            client.bind_pods(
+                [Binding(pod_name="rp-1", pod_namespace="default",
+                         target_node="rest-1")],
+                fence=stale,
+            )
+    stamps = tracer.stamps_for("feedbeefcafe0002")
+    assert any(
+        s["event"] == "fenced" and s["identity"] == "zombie-a"
+        for s in stamps
+    ), stamps
+    # the fenced bind applied NOTHING
+    assert store.get("pods", "default", "rp-1").spec.node_name == ""
+
+
+def test_apiserver_rest_metrics_endpoint(rest_stack):
+    srv, port, store, client = rest_stack
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as r:
+        assert "# TYPE" in r.read().decode()
+
+
+# -- monotonic discipline ------------------------------------------------------
+
+
+def test_spans_use_monotonic_never_wall_clock():
+    """Deflake guard: tracing.py must never call time.time() for span
+    timestamps (wall-clock steps would fabricate negative stages)."""
+    import inspect
+
+    import kubernetes_tpu.utils.tracing as tracing_mod
+
+    src = inspect.getsource(tracing_mod)
+    assert "time.time()" not in src
+    assert "time.monotonic()" in src
