@@ -23,6 +23,11 @@
 #                         partitions, half-open watches) + the multi-process
 #                         leader/standby/zombie topology (SIGSTOP, fenced
 #                         late REST binds, cross-process exactly-once ledger)
+#   make chaos-serving    serving-tier chaos: multi-process frontend/follower
+#                         fleet behind the balancer under mixed read/write
+#                         storm with a frontend AND the read-serving follower
+#                         SIGKILLed — zero acked-write loss, zero stale
+#                         consistent reads, watchers resume with zero relists
 #   make tracing-ab       same-process tracing-overhead A/B (on vs off):
 #                         acceptance rail — enabled-mode steady-state
 #                         throughput regresses <3%, disabled ≈ noise
@@ -51,7 +56,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	tracing-ab lint-slow lint-static lint-fast lint
+	chaos-serving tracing-ab lint-slow lint-static lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -63,7 +68,8 @@ chaos: lint
 		tests/test_chaos_pipeline.py tests/test_chaos_device.py \
 		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
 		tests/test_watchcache.py tests/test_chaos_ha.py \
-		tests/test_chaos_net.py -q
+		tests/test_chaos_net.py tests/test_serving.py \
+		tests/test_chaos_serving.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -81,6 +87,9 @@ chaos-ha:
 
 chaos-net:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_net.py -q
+
+chaos-serving:
+	$(CACHED) $(PY) -m pytest tests/test_serving.py tests/test_chaos_serving.py -q
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

@@ -259,6 +259,17 @@ class KindCache:
                 backoff = min(backoff * 2, 5.0)
 
     def _apply(self, ev: Event) -> None:
+        if ev.type == BOOKMARK:
+            # the upstream may itself be a cacher fan-out (a stateless
+            # frontend watching the primary over REST): its bookmarks are
+            # rv-only progress notifies, NOT state — storing the carrier
+            # object would serve a ghost in every list. Advance the rv
+            # (freshness waits see the progress) and drop the event; this
+            # cache's own bookmark ticker keeps its clients advancing.
+            with self._lock:
+                self.rv = max(self.rv, ev.resource_version)
+                self._lock.notify_all()
+            return
         key = ev.object.metadata.key
         ev.ts = time.monotonic()
         with self._lock:
@@ -557,10 +568,16 @@ class Cacher:
         window: int = DEFAULT_WINDOW,
         bookmark_period_s: float = DEFAULT_BOOKMARK_PERIOD_S,
         watcher_queue_size: int = 0,
+        freshness_timeout_s: float = 5.0,
     ):
         self._store = store
         self.window = window
         self.bookmark_period_s = bookmark_period_s
+        # how long a consistent list waits for the cache to catch the
+        # demanded rv before 504ing (follower frontends: the commit-
+        # index wait) — configurable so lagging replicas fail fast
+        # where the deployment wants them to
+        self.freshness_timeout_s = freshness_timeout_s
         self._watcher_queue_size = watcher_queue_size
         self._caches: Dict[str, KindCache] = {}
         # named for the lock-order watchdog + lockset sanitizer
@@ -620,7 +637,9 @@ class Cacher:
         fresh_rv: Optional[int] = None,
     ) -> Tuple[List[Any], int, Optional[str]]:
         kc = self.cache_for(kind)
-        if fresh_rv and not kc.wait_until_fresh(fresh_rv):
+        if fresh_rv and not kc.wait_until_fresh(
+            fresh_rv, timeout=self.freshness_timeout_s
+        ):
             # never serve stale data labeled consistent: the reference's
             # waitUntilFreshAndList times out ("Too large resource
             # version") instead — callers surface it as a retryable 504

@@ -4,18 +4,36 @@ The typed-clientset role of client-go (staging/src/k8s.io/client-go
 kubernetes.Interface): every component that takes an `APIServer` (scheduler,
 informers, controllers, kubectl) can take a RESTClient instead and run
 against a remote API process. Watch uses the newline-delimited JSON stream
-and feeds a local Watcher, exactly how Reflector consumes watch responses
-(client-go/tools/cache/reflector.go:210).
+(or the length-prefixed binary watch codec when the server speaks it —
+apiserver/watchcodec.py) and feeds a local Watcher, exactly how Reflector
+consumes watch responses (client-go/tools/cache/reflector.go:210).
+
+Transport: a bounded per-host pool of persistent HTTP/1.1 connections
+(client-go's http.Transport keep-alive role). PERFORMANCE.md round-11
+measured accept+connect dominating the REST bind cost when every request
+opened a fresh TCP connection; `_request`, watch streams, and bind POSTs
+all draw from the same pool now. A pooled socket the server closed while
+idle is detected at acquire time (pending FIN/EOF) and discarded; the
+narrow race where the close lands mid-request reopens ONCE for
+idempotent GETs only — a reused connection that dies anywhere in a
+bind POST (send or response phase; see the _RETRYABLE_METHODS note for
+why a send-phase death is NOT proof of non-delivery) classifies as
+QuorumLost through `_classify_bind_transport`: outcome unknown, read
+back before any retry, never a blind replay.
 """
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
+import select
+import socket
 import threading
 import time
 import urllib.error
-import urllib.request
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 from ..api import serialization as codec
 from ..client.apiserver import (
@@ -25,11 +43,150 @@ from ..client.apiserver import (
     LeaderFenced,
     NotFound,
     NotPrimary,
+    TooManyRequests,
 )
 from ..client.leaderelection import FENCE_HEADER, fence_header_value
 from ..runtime.consensus import DegradedWrites, QuorumLost
-from ..runtime.watch import Event, Watcher
+from ..runtime.watch import BOOKMARK, Event, Watcher
+from ..utils.metrics import metrics
 from ..utils.tracing import TRACE_HEADER, trace_for_binding
+
+# connection-pool observability (SIGUSR2 "serving / REST client" section;
+# the serving A/B reads opened vs reused to prove the pool is actually on
+# the hot path): opened counts real HTTPConnection creations, reused
+# counts requests served on a pooled socket, pool_size is idle sockets
+COUNTER_CONN_OPENED = "restclient_connections_opened_total"
+COUNTER_CONN_REUSED = "restclient_connections_reused_total"
+GAUGE_POOL_SIZE = "restclient_pool_size"
+# watch-pump resumes: the pump transparently reconnects a died stream at
+# its last delivered rv (labels: reason = error|eof|truncated) — through
+# a balancer this is what lets a watcher ride a frontend death with zero
+# informer-visible relists (the replacement frontend's cache replays)
+COUNTER_WATCH_RECONNECTS = "restclient_watch_reconnects_total"  # {reason}
+
+# replay safety: methods whose transparent one-shot retry after a reused
+# connection died cannot double-apply. Deliberately NOT send-phase-gated
+# for writes: an EPIPE mid-send proves an RST arrived between our two
+# writes, not that the peer ignored the bytes it already had — a proxy
+# (or server) killing the connection BECAUSE of this request looks
+# identical to an idle close racing it. Idle-closed pooled sockets are
+# instead caught at acquire time (pending-EOF check), which is where the
+# no-double-send guarantee for binds actually lives.
+_RETRYABLE_METHODS = ("GET", "HEAD")
+
+_WATCH_RESUME_ATTEMPTS = 4
+
+
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """HTTPConnection with Nagle disabled. http.client writes the header
+    block and the body as two separate sends; with Nagle on, the second
+    small write stalls behind the peer's delayed ACK (~40 ms) — measured
+    as the DOMINANT cost of a pooled bind POST on loopback. TCP_NODELAY
+    turns a bind round trip from a delayed-ACK artifact into an actual
+    network round trip."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+class HTTPConnectionPool:
+    """Bounded per-host idle pool of persistent http.client connections.
+
+    acquire() pops an idle connection for the host (discarding stale ones
+    the server closed while they sat idle — a readable socket with a
+    pending EOF), else hands out a fresh one; release() returns a healthy
+    keep-alive connection; discard() closes one that died or was consumed
+    by a stream. Thread-safe; the pool never blocks a caller waiting for
+    a slot — the bound is on IDLE sockets kept, not on concurrency."""
+
+    def __init__(self, max_idle_per_host: int = 8, timeout: float = 30.0):
+        self.max_idle_per_host = max_idle_per_host
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._idle: Dict[Tuple[str, int], List[http.client.HTTPConnection]] = {}
+        self._idle_count = 0
+
+    @staticmethod
+    def _stale(conn: http.client.HTTPConnection) -> bool:
+        """An idle keep-alive socket must have NOTHING to say. Readable
+        means the server closed it (pending FIN) or broke protocol
+        (unsolicited bytes) — either way it cannot carry a request."""
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            readable, _, errored = select.select([sock], [], [sock], 0)
+        except (OSError, ValueError):
+            return True
+        return bool(readable or errored)
+
+    def acquire(
+        self, host: str, port: int
+    ) -> Tuple[http.client.HTTPConnection, bool]:
+        """(connection, reused): reused=True means it already carried at
+        least one request on this socket (retry policy branches on it)."""
+        key = (host, port)
+        while True:
+            with self._lock:
+                idle = self._idle.get(key)
+                conn = idle.pop() if idle else None
+                if conn is not None:
+                    self._idle_count -= 1
+                    metrics.set_gauge(GAUGE_POOL_SIZE, self._idle_count)
+            if conn is None:
+                break
+            if self._stale(conn):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            metrics.inc(COUNTER_CONN_REUSED)
+            return conn, True
+        conn = _NoDelayHTTPConnection(host, port, timeout=self.timeout)
+        metrics.inc(COUNTER_CONN_OPENED)
+        return conn, False
+
+    def release(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            idle = self._idle.setdefault((host, port), [])
+            if len(idle) >= self.max_idle_per_host:
+                pass  # over the idle bound: close below instead
+            else:
+                idle.append(conn)
+                self._idle_count += 1
+                metrics.set_gauge(GAUGE_POOL_SIZE, self._idle_count)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def discard(self, conn) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            conns = [c for idle in self._idle.values() for c in idle]
+            self._idle.clear()
+            self._idle_count = 0
+            metrics.set_gauge(GAUGE_POOL_SIZE, 0)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def size(self) -> int:
+        with self._lock:
+            return self._idle_count
 
 
 class RESTClient:
@@ -40,7 +197,11 @@ class RESTClient:
     to degraded_retries attempts before surfacing DegradedWrites. A
     "WriteQuorumLost" 503 (the write applied locally but missed quorum:
     outcome unknown) surfaces as QuorumLost without replay, and a 503
-    with no Retry-After (fenced ex-primary) surfaces as NotPrimary."""
+    with no Retry-After (fenced ex-primary) surfaces as NotPrimary.
+
+    pool_connections: idle keep-alive sockets kept per host (0 disables
+    the pool entirely — every request opens and closes its own
+    connection, the pre-pool behavior the serving A/B baselines)."""
 
     def __init__(
         self,
@@ -48,14 +209,20 @@ class RESTClient:
         timeout: float = 30.0,
         degraded_retries: int = 3,
         degraded_retry_cap_s: float = 2.0,
+        pool_connections: int = 8,
     ):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.degraded_retries = degraded_retries
         self.degraded_retry_cap_s = degraded_retry_cap_s
         self._headers: dict = {}
+        self.pool: Optional[HTTPConnectionPool] = (
+            HTTPConnectionPool(pool_connections, timeout=timeout)
+            if pool_connections
+            else None
+        )
 
-    # -- plumbing ------------------------------------------------------------
+    # -- transport -----------------------------------------------------------
 
     def _url(self, resource: str, namespace: str, name: str = "") -> str:
         # empty namespace = cluster-scoped path (the store keys by the
@@ -68,44 +235,170 @@ class RESTClient:
             path += f"/{name}"
         return self.base + path
 
-    def get_text(self, resource: str, namespace: str, name: str) -> str:
-        """Plain-text GET of a subresource (pods/{name}/log): same URL
-        scheme, headers, timeout, and HTTP error mapping as the JSON
-        path (get_raw is the JSON variant for aggregated API paths)."""
-        req = urllib.request.Request(
-            self._url(resource, namespace, name), headers=self._headers
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read().decode()
-        except urllib.error.HTTPError as e:
-            msg = e.read().decode() or str(e)
-            if e.code == 404:
-                raise NotFound(msg) from None
-            raise RuntimeError(msg) from None
+    def _acquire(self, host: str, port: int):
+        if self.pool is not None:
+            return self.pool.acquire(host, port)
+        conn = _NoDelayHTTPConnection(host, port, timeout=self.timeout)
+        metrics.inc(COUNTER_CONN_OPENED)
+        return conn, False
 
-    def post_text(self, resource: str, namespace: str, name: str, body: dict) -> str:
-        """Plain-text POST to a subresource (pods/{name}/exec): same URL
-        scheme, headers, timeout, and error mapping as the JSON path."""
-        req = urllib.request.Request(
-            self._url(resource, namespace, name),
-            data=json.dumps(body).encode(),
-            method="POST",
-            headers={"Content-Type": "application/json", **self._headers},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read().decode()
-        except urllib.error.HTTPError as e:
+    def _park(self, host: str, port: int, conn, resp) -> None:
+        """Return a connection after a fully-read response: back to the
+        pool when the response allows reuse, closed otherwise."""
+        if self.pool is None or resp.will_close:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self.pool.release(host, port, conn)
+
+    def _discard(self, conn) -> None:
+        if self.pool is not None:
+            self.pool.discard(conn)
+        else:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _http(
+        self,
+        method: str,
+        url: str,
+        data: Optional[bytes] = None,
+        headers: Optional[dict] = None,
+        stream: bool = False,
+    ):
+        """One HTTP exchange over a pooled connection.
+
+        Non-stream: (status, reason, headers, body) with the connection
+        returned to the pool. stream=True: (response, conn, host, port)
+        with the UNREAD response and connection owned by the caller (a
+        watch stream holds its socket for its lifetime and discards it).
+
+        Stale-reuse retry contract: a REUSED connection that dies under
+        a GET/HEAD reopens once transparently; under a write it raises —
+        the request may have been applied with the ack lost (for a bind
+        POST that is exactly the QuorumLost shape: the caller's
+        read-back reconciler resolves it, never a blind replay).
+        Fresh-connection failures never retry here."""
+        u = urlsplit(url)
+        host, port = u.hostname or "127.0.0.1", u.port or 80
+        path = u.path + (f"?{u.query}" if u.query else "")
+        hdrs = dict(headers or {})
+        if self.pool is None:
+            hdrs.setdefault("Connection", "close")
+        retried = False
+        while True:
+            conn, reused = self._acquire(host, port)
+            try:
+                conn.request(method, path, body=data, headers=hdrs)
+                resp = conn.getresponse()
+            except (ConnectionResetError, BrokenPipeError,
+                    http.client.BadStatusLine) as e:
+                # RemoteDisconnected subclasses both BadStatusLine and
+                # ConnectionResetError: the server closed without a
+                # response — the stale-pooled-socket signature
+                self._discard(conn)
+                if reused and not retried and method in _RETRYABLE_METHODS:
+                    retried = True
+                    continue
+                if isinstance(e, http.client.BadStatusLine) and not isinstance(
+                    e, http.client.RemoteDisconnected
+                ):
+                    raise OSError(f"malformed response: {e}") from e
+                raise
+            except (OSError, http.client.HTTPException) as e:
+                self._discard(conn)
+                if isinstance(e, OSError):
+                    raise
+                raise OSError(str(e)) from e
+            if stream:
+                return resp, conn, host, port
+            try:
+                body = resp.read()
+            except OSError:
+                self._discard(conn)
+                raise
+            self._park(host, port, conn, resp)
+            return resp.status, resp.reason, resp.headers, body
+
+    def _request_raw(
+        self,
+        method: str,
+        url: str,
+        body: Optional[dict] = None,
+        headers: Optional[dict] = None,
+    ) -> bytes:
+        """Shared request plumbing: pooled transport, degraded-503 retry,
+        and the full HTTP error taxonomy. get_text/post_text ride this
+        too — a degraded store no longer fast-fails log/exec
+        subresources with an unmapped error."""
+        data = json.dumps(body).encode() if body is not None else None
+        attempt = 0
+        while True:
+            status, reason, hdrs, raw = self._http(
+                method,
+                url,
+                data,
+                {
+                    "Content-Type": "application/json",
+                    **self._headers,
+                    **(headers or {}),
+                },
+            )
+            if 200 <= status < 300:
+                return raw
             payload = {}
             try:
-                payload = json.loads(e.read().decode() or "{}")
-            except Exception:
+                payload = json.loads(raw.decode() or "{}")
+            except (ValueError, UnicodeDecodeError):
                 pass
-            msg = payload.get("message", str(e))
-            if e.code == 404:
-                raise NotFound(msg) from None
-            raise RuntimeError(msg) from None
+            msg = payload.get("message", f"HTTP Error {status}: {reason}")
+            if status == 404:
+                raise NotFound(msg)
+            if status == 409:
+                err_reason = payload.get("reason", "")
+                if err_reason == "AlreadyExists":
+                    raise AlreadyExists(msg)
+                if err_reason == "LeaderFenced":
+                    # leadership fence rejection: the caller's lease
+                    # grant was superseded — non-retryable (the caller
+                    # is not the leader anymore), nothing was applied
+                    raise LeaderFenced(msg)
+                raise Conflict(msg)
+            if status == 503:
+                # three distinct 503 contracts (rest.py):
+                #   "Degraded"        gate refused before applying:
+                #                     replaying is safe — honor
+                #                     Retry-After (capped) and retry;
+                #                     the store re-opens the moment
+                #                     followers catch the commit up
+                #   "WriteQuorumLost" THIS request applied locally but
+                #                     missed quorum: outcome unknown —
+                #                     a blind replay would 409 against
+                #                     its own first attempt; surface it
+                #   no Retry-After    fenced primary (permanent for
+                #                     that process): never hammer it —
+                #                     callers must re-discover the
+                #                     leader
+                err_reason = payload.get("reason", "")
+                retry_after = hdrs.get("Retry-After")
+                if retry_after is None:
+                    raise NotPrimary(msg)
+                if err_reason == "WriteQuorumLost":
+                    raise QuorumLost(msg)
+                if attempt < self.degraded_retries:
+                    attempt += 1
+                    try:
+                        delay = float(retry_after)
+                    except ValueError:
+                        delay = 0.5
+                    time.sleep(min(delay, self.degraded_retry_cap_s))
+                    continue
+                raise DegradedWrites(msg)
+            raise urllib.error.HTTPError(url, status, msg, hdrs, io.BytesIO(raw))
 
     def _request(
         self,
@@ -114,76 +407,32 @@ class RESTClient:
         body: Optional[dict] = None,
         headers: Optional[dict] = None,
     ) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
-        attempt = 0
-        while True:
-            req = urllib.request.Request(
-                url,
-                data=data,
-                method=method,
-                headers={
-                    "Content-Type": "application/json",
-                    **self._headers,
-                    **(headers or {}),
-                },
-            )
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return json.loads(resp.read().decode() or "{}")
-            except urllib.error.HTTPError as e:
-                payload = {}
-                try:
-                    payload = json.loads(e.read().decode() or "{}")
-                except Exception:
-                    pass
-                msg = payload.get("message", str(e))
-                if e.code == 404:
-                    raise NotFound(msg) from None
-                if e.code == 409:
-                    reason = payload.get("reason", "")
-                    if reason == "AlreadyExists":
-                        raise AlreadyExists(msg) from None
-                    if reason == "LeaderFenced":
-                        # leadership fence rejection: the caller's lease
-                        # grant was superseded — non-retryable (the caller
-                        # is not the leader anymore), nothing was applied
-                        raise LeaderFenced(msg) from None
-                    raise Conflict(msg) from None
-                if e.code == 503:
-                    # three distinct 503 contracts (rest.py):
-                    #   "Degraded"        gate refused before applying:
-                    #                     replaying is safe — honor
-                    #                     Retry-After (capped) and retry;
-                    #                     the store re-opens the moment
-                    #                     followers catch the commit up
-                    #   "WriteQuorumLost" THIS request applied locally but
-                    #                     missed quorum: outcome unknown —
-                    #                     a blind replay would 409 against
-                    #                     its own first attempt; surface it
-                    #   no Retry-After    fenced primary (permanent for
-                    #                     that process): never hammer it —
-                    #                     callers must re-discover the
-                    #                     leader
-                    reason = payload.get("reason", "")
-                    retry_after = e.headers.get("Retry-After")
-                    if retry_after is None:
-                        raise NotPrimary(msg) from None
-                    if reason == "WriteQuorumLost":
-                        raise QuorumLost(msg) from None
-                    if attempt < self.degraded_retries:
-                        attempt += 1
-                        try:
-                            delay = float(retry_after)
-                        except ValueError:
-                            delay = 0.5
-                        time.sleep(min(delay, self.degraded_retry_cap_s))
-                        continue
-                    raise DegradedWrites(msg) from None
-                raise
+        return json.loads(self._request_raw(method, url, body, headers) or b"{}")
+
+    def get_text(self, resource: str, namespace: str, name: str) -> str:
+        """Plain-text GET of a subresource (pods/{name}/log): shared
+        plumbing with the JSON path — same pool, same degraded-503
+        retry, same typed error taxonomy (get_raw is the JSON variant
+        for aggregated API paths)."""
+        return self._request_raw(
+            "GET", self._url(resource, namespace, name)
+        ).decode()
+
+    def post_text(self, resource: str, namespace: str, name: str, body: dict) -> str:
+        """Plain-text POST to a subresource (pods/{name}/exec): same
+        shared plumbing as get_text."""
+        return self._request_raw(
+            "POST", self._url(resource, namespace, name), body
+        ).decode()
 
     def get_raw(self, path: str) -> dict:
         """GET an arbitrary API path (aggregated APIs like metrics.k8s.io)."""
         return self._request("GET", self.base + path)
+
+    def close(self) -> None:
+        """Drop the idle connection pool (tests / process teardown)."""
+        if self.pool is not None:
+            self.pool.close()
 
     # -- the APIServer interface ---------------------------------------------
 
@@ -232,75 +481,214 @@ class RESTClient:
             items = [o for o in items if o.metadata.namespace == namespace]
         return items, rv
 
+    def kind_resource_version(self, kind: str) -> int:
+        """rv of the newest event OF THIS KIND at the server (the
+        freshness target for consistent cache-served lists — see
+        APIServer.kind_resource_version). Served by a dedicated cheap
+        query (?kindResourceVersion=1, no object payload); a frontend
+        chain forwards it upstream to the primary."""
+        out = self._request(
+            "GET", self._url(kind, "") + "?kindResourceVersion=1"
+        )
+        return int(out.get("kindResourceVersion", 0) or 0)
+
+    def pod_logs(
+        self, namespace: str, name: str, tail_lines: Optional[int] = None
+    ) -> str:
+        """pods/{name}/log over REST (the store surface rest.py serves a
+        frontend from)."""
+        url = self._url("pods", namespace, f"{name}/log")
+        if tail_lines is not None:
+            url += f"?tailLines={tail_lines}"
+        return self._request_raw("GET", url).decode()
+
+    def pod_exec(self, namespace: str, name: str, command) -> str:
+        """pods/{name}/exec over REST (frontend store surface)."""
+        return self.post_text(
+            "pods", namespace, f"{name}/exec", {"command": list(command)}
+        )
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """pods/{name}/eviction over REST; a PDB/ratelimit refusal (429)
+        maps back to TooManyRequests like the in-process store."""
+        try:
+            self._request(
+                "POST",
+                self._url("pods", namespace, f"{name}/eviction"),
+                {"podName": name, "podNamespace": namespace},
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise TooManyRequests(str(e)) from None
+            raise
+
+    # -- watch ---------------------------------------------------------------
+
+    def _open_watch(self, kind: str, from_version: int):
+        """One watch stream connect. Returns (resp, conn) with the codec
+        decided by the RESPONSE Content-Type: the request offers the
+        binary watch codec via Accept, an old server ignores it and
+        answers JSON lines — negotiation degrades to the universal wire.
+        Raises Expired on 410 (resume position outside the window) and
+        OSError on transport/HTTP-level failure."""
+        from .watchcodec import WATCH_CONTENT_TYPE
+
+        url = self._url(kind, "") + f"?watch=1&resourceVersion={from_version}"
+        resp, conn, _host, _port = self._http(
+            "GET",
+            url,
+            None,
+            {**self._headers, "Accept": WATCH_CONTENT_TYPE},
+            stream=True,
+        )
+        if resp.status != 200:
+            try:
+                body = resp.read().decode()
+            except OSError:
+                body = ""
+            self._discard(conn)
+            if resp.status == 410:
+                raise Expired(body or "resourceVersion too old")
+            raise OSError(f"watch connect failed: HTTP {resp.status} {body}")
+        # the STREAM clears the socket timeout: an idle but healthy watch
+        # must not be killed by a read timeout (the connect itself was
+        # bounded by the client timeout)
+        sock = conn.sock
+        if sock is not None:
+            sock.settimeout(None)
+        return resp, conn
+
+    def _pump_stream(self, kind: str, resp, w: Watcher, last_rv: int) -> Tuple[int, str]:
+        """Drain one watch stream into the Watcher until it ends.
+        Returns (last delivered rv, end reason for the reconnect
+        counter). Decodes binary frames when the server negotiated the
+        compact codec, newline-JSON otherwise."""
+        from . import watchcodec
+        from .cacher import bookmark_object
+
+        ctype = resp.headers.get("Content-Type") or ""
+        try:
+            if watchcodec.WATCH_CONTENT_TYPE in ctype:
+                while not w.stopped:
+                    frame = watchcodec.read_frame(resp)
+                    if frame is None:
+                        return last_rv, "eof"
+                    ev_type, rv, obj = frame
+                    if ev_type == BOOKMARK:
+                        w.push(Event(BOOKMARK, bookmark_object(kind, rv), rv))
+                    else:
+                        if isinstance(obj, dict):
+                            obj = codec.decode(kind, obj)  # 'J' fallback frame
+                        w.push(Event(ev_type, obj, rv))
+                    last_rv = max(last_rv, rv)
+                return last_rv, "stopped"
+            for line in resp:
+                if w.stopped:
+                    return last_rv, "stopped"
+                line = line.strip()
+                if not line:
+                    continue
+                msg = json.loads(line)
+                if msg["type"] == BOOKMARK:
+                    # rv-only progress notify from the watch cache
+                    # (idle heartbeat / window keep-alive): carry
+                    # the rv through; informers advance their
+                    # resume position on it, other consumers skip
+                    # unknown event types
+                    rv = int(
+                        (msg.get("object") or {})
+                        .get("metadata", {})
+                        .get("resourceVersion", 0)
+                    )
+                    w.push(Event(BOOKMARK, bookmark_object(kind, rv), rv))
+                    last_rv = max(last_rv, rv)
+                    continue
+                obj = codec.decode(kind, msg["object"])
+                rv = obj.metadata.resource_version
+                w.push(Event(msg["type"], obj, rv))
+                last_rv = max(last_rv, rv)
+            return last_rv, "eof"
+        except ValueError:
+            return last_rv, "truncated"
+        except Exception:
+            return last_rv, "error"
+
     def watch(self, kind: str, from_version: int = 0) -> Watcher:
         w = Watcher()
-        url = self._url(kind, "") + f"?watch=1&resourceVersion={from_version}"
         # open SYNCHRONOUSLY so a 410 Gone ("resourceVersion too old")
         # surfaces to the caller as Expired — informers re-list on it; a
         # silent pump-thread death would hand them a gapped stream. Other
         # connection errors keep the old contract (a stopped watcher, not
-        # an exception), and the connect itself is bounded by the client
-        # timeout; the STREAM then clears the socket timeout (an idle but
-        # healthy watch must not be killed by a read timeout).
-        req = urllib.request.Request(url, headers=dict(self._headers))
+        # an exception).
         try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout)
-        except urllib.error.HTTPError as e:
-            if e.code == 410:
-                raise Expired(e.read().decode() or "resourceVersion too old") from None
+            resp, conn = self._open_watch(kind, from_version)
+        except Expired:
+            raise
+        except OSError:
             w.stop()
             return w
-        except (urllib.error.URLError, OSError):
-            w.stop()
-            return w
-        try:
-            resp.fp.raw._sock.settimeout(None)  # stream: no read timeout
-        except AttributeError:
-            pass  # CPython internals moved: 30s idle kills the stream,
-            # and the consumer's relist path recovers
 
-        def pump():
-            from ..runtime.watch import BOOKMARK
-
-            try:
-                with resp:
-                    for line in resp:
+        def pump(resp, conn):
+            last_rv = from_version
+            stalled = 0  # consecutive resumes that delivered nothing new
+            while True:
+                rv_before = last_rv
+                try:
+                    last_rv, reason = self._pump_stream(kind, resp, w, last_rv)
+                finally:
+                    self._discard(conn)  # a stream's socket is never reused
+                if w.stopped or reason == "stopped":
+                    break
+                # a resume is only "transparent" while it makes progress:
+                # a poison event pinned at a fixed rv (decode raises, rv
+                # never advances) would otherwise reconnect successfully
+                # at full speed forever — _open_watch succeeding means the
+                # connect backoff below never engages. Bound consecutive
+                # zero-progress resumes and back off between them; hitting
+                # the bound stops the watcher, handing the consumer its
+                # relist path (same contract as falling out of the window).
+                if last_rv > rv_before:
+                    stalled = 0
+                else:
+                    stalled += 1
+                    if stalled >= _WATCH_RESUME_ATTEMPTS:
+                        w.stop()
+                        return
+                    time.sleep(min(0.05 * (2 ** (stalled - 1)), 1.0))
+                    if w.stopped:
+                        return
+                # transparent resume at the last delivered rv: through a
+                # balancer this lands on ANY healthy frontend, whose
+                # watch cache replays the gap from its event window —
+                # the consumer sees one continuous stream, no relist
+                metrics.inc(COUNTER_WATCH_RECONNECTS, {"reason": reason})
+                backoff = 0.05
+                for _attempt in range(_WATCH_RESUME_ATTEMPTS):
+                    try:
+                        resp, conn = self._open_watch(kind, last_rv)
+                        break
+                    except Expired:
+                        # fell out of the window mid-death: stopping the
+                        # watcher hands the consumer its relist path
+                        w.stop()
+                        return
+                    except OSError:
                         if w.stopped:
-                            break
-                        line = line.strip()
-                        if not line:
-                            continue
-                        msg = json.loads(line)
-                        if msg["type"] == BOOKMARK:
-                            # rv-only progress notify from the watch cache
-                            # (idle heartbeat / window keep-alive): carry
-                            # the rv through; informers advance their
-                            # resume position on it, other consumers skip
-                            # unknown event types
-                            rv = int(
-                                (msg.get("object") or {})
-                                .get("metadata", {})
-                                .get("resourceVersion", 0)
-                            )
-                            from .cacher import bookmark_object
+                            return
+                        time.sleep(backoff)
+                        backoff = min(backoff * 2, 1.0)
+                else:
+                    w.stop()
+                    return
 
-                            w.push(Event(BOOKMARK, bookmark_object(kind, rv), rv))
-                            continue
-                        obj = codec.decode(kind, msg["object"])
-                        w.push(
-                            Event(
-                                msg["type"],
-                                obj,
-                                obj.metadata.resource_version,
-                            )
-                        )
-            except Exception:
-                pass
-            finally:
-                w.stop()
+            w.stop()
 
-        threading.Thread(target=pump, daemon=True).start()
+        threading.Thread(
+            target=pump, args=(resp, conn), daemon=True, name=f"watch-{kind}"
+        ).start()
         return w
+
+    # -- binds ---------------------------------------------------------------
 
     @staticmethod
     def _fence_headers(fence) -> Optional[dict]:
@@ -331,7 +719,11 @@ class RESTClient:
         the request MAY have been processed with its response lost: the
         one honest classification is QuorumLost — the caller must read
         the pod back before any retry, never blindly replay (a netchaos
-        blackhole is exactly this shape: write applied, ack dropped)."""
+        blackhole is exactly this shape: write applied, ack dropped).
+        The pool's stale-reuse reopen never reaches here for binds at
+        all: _http's transparent one-shot retry covers idempotent GETs
+        only (_RETRYABLE_METHODS) — every reused-connection death on a
+        bind POST, send-phase included, lands in this classifier."""
         cause = getattr(e, "reason", e)  # URLError wraps the socket error
         if isinstance(cause, ConnectionRefusedError):
             return DegradedWrites(f"api server unreachable: {cause}")
@@ -437,6 +829,21 @@ class RESTClient:
             except Exception as e:
                 errors.append(str(e))
         return errors
+
+
+def serving_health_lines() -> List[str]:
+    """REST-client transport state for the SIGUSR2 dump: pool occupancy,
+    opened-vs-reused connection counts, and watch-pump resume counters —
+    whether the serving tier's keep-alive path is actually hot is
+    diagnosable from one signal."""
+    lines: List[str] = []
+    for snap in (
+        metrics.snapshot_gauges("restclient_"),
+        metrics.snapshot_counters("restclient_"),
+    ):
+        for name, labels, value in snap:
+            lines.append(metrics.format_series_line(name, labels, value))
+    return lines
 
 
 class AuthRESTClient(RESTClient):

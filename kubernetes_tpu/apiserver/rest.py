@@ -51,6 +51,11 @@ _WATCH_POLL_S = 0.5
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kube-apiserver-tpu"
+    # TCP_NODELAY on every accepted socket: response header/body go out
+    # as separate small writes, and with Nagle on the second stalls
+    # behind the client's delayed ACK (~40 ms per request — measured as
+    # the dominant pooled-bind cost before the serving-tier work)
+    disable_nagle_algorithm = True
 
     def log_message(self, *args):
         pass
@@ -209,6 +214,12 @@ class _Handler(BaseHTTPRequestHandler):
         # kinds live in client/*): a process whose import chain swallowed
         # the eager registration must not 404 those resources forever
         codec.ensure_late_registration()
+        if group is None and resource in codec.RESOURCE_KINDS:
+            # built-in fast path, BEFORE the CRD lookup: on a stateless
+            # frontend the store is a RESTClient and that lookup is a
+            # remote list — paying it per request would put the primary
+            # back on every read's critical path
+            return True
         try:
             crds, _ = self.store.list("customresourcedefinitions")
         except Exception:
@@ -217,9 +228,7 @@ class _Handler(BaseHTTPRequestHandler):
             # the core path also serves established CRD plurals: the typed
             # REST client and kubectl build /api/v1 paths for every
             # resource (single internal version — no per-group clients)
-            return resource in codec.RESOURCE_KINDS or any(
-                c.spec.names.plural == resource for c in crds
-            )
+            return any(c.spec.names.plural == resource for c in crds)
         version = self._version_of_path()
         for c in crds:
             if c.spec.group != group or c.spec.names.plural != resource:
@@ -711,6 +720,22 @@ class _Handler(BaseHTTPRequestHandler):
             if name:
                 obj = self.store.get(resource, ns or "", name)
                 return self._respond_obj(200, obj)
+            if query.get("kindResourceVersion") in ("1", "true"):
+                # cheap freshness probe (no object payload): the rv of
+                # this kind's newest event — what a frontend's consistent
+                # list waits for before serving from its cache. Forwarded
+                # upstream when this server is itself a frontend
+                # (RESTClient.kind_resource_version chains).
+                return self._json(
+                    200,
+                    {
+                        "kind": "KindResourceVersion",
+                        "resource": resource,
+                        "kindResourceVersion": self.store.kind_resource_version(
+                            resource
+                        ),
+                    },
+                )
             if query.get("watch") in ("1", "true"):
                 return self._serve_watch(resource, ns, query)
             try:
@@ -836,25 +861,59 @@ class _Handler(BaseHTTPRequestHandler):
         # bookmarks, which flow queue-ordered with the events.
         last_rv_sent = from_rv
 
-        def write_line(payload: dict) -> None:
+        # codec negotiation: a client offering the compact binary watch
+        # codec in Accept gets length-prefixed frames (the object payload
+        # encoded ONCE per event and shared across every stream of this
+        # kind's fan-out — apiserver/watchcodec.py); everyone else gets
+        # the newline-JSON wire, which stays the default and the
+        # mixed-version fallback (an old client never offers, an old
+        # server never answers binary)
+        from . import watchcodec
+
+        binary = watchcodec.WATCH_CONTENT_TYPE in (
+            self.headers.get("Accept") or ""
+        )
+
+        def write_chunk(payload: bytes) -> None:
             nonlocal last_write
-            line = json.dumps(payload).encode() + b"\n"
-            self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))
             self.wfile.flush()
             last_write = _time.monotonic()
 
-        def bookmark_payload(rv: int) -> dict:
-            return {
-                "type": BOOKMARK,
-                "object": {"metadata": {"resourceVersion": rv}},
-            }
+        def write_event(ev) -> None:
+            if binary:
+                write_chunk(watchcodec.event_frame(ev))
+            else:
+                write_chunk(
+                    json.dumps(
+                        {"type": ev.type, "object": codec.encode(ev.object)}
+                    ).encode()
+                    + b"\n"
+                )
+
+        def write_bookmark(rv: int) -> None:
+            if binary:
+                write_chunk(watchcodec.bookmark_frame(rv))
+            else:
+                write_chunk(
+                    json.dumps(
+                        {
+                            "type": BOOKMARK,
+                            "object": {"metadata": {"resourceVersion": rv}},
+                        }
+                    ).encode()
+                    + b"\n"
+                )
 
         # everything from the header write on lives inside the
         # try/finally: a client that dropped before the headers flush
         # must still unwind the watcher and the stream gauge
         try:
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header(
+                "Content-Type",
+                watchcodec.WATCH_CONTENT_TYPE if binary else "application/json",
+            )
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             while not self.server.stopping.is_set():
@@ -871,7 +930,7 @@ class _Handler(BaseHTTPRequestHandler):
                         bookmark_period
                         and _time.monotonic() - last_write >= bookmark_period
                     ):
-                        write_line(bookmark_payload(last_rv_sent))
+                        write_bookmark(last_rv_sent)
                     continue
                 if replay_left > 0:
                     replay_left -= 1
@@ -882,7 +941,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # the ns/selector filters (it carries no object).
                     # Queue-ordered behind the events it covers, so its
                     # rv is safe to advertise
-                    write_line(bookmark_payload(ev.resource_version))
+                    write_bookmark(ev.resource_version)
                     last_rv_sent = max(last_rv_sent, ev.resource_version)
                     continue
                 obj = ev.object
@@ -890,11 +949,20 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if pred is not None and not pred(obj):
                     continue
-                write_line({"type": ev.type, "object": codec.encode(obj)})
+                write_event(ev)
                 last_rv_sent = max(last_rv_sent, ev.resource_version)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            try:
+                # terminate the chunked body: without the trailer a
+                # keep-alive client blocks on the half-finished stream
+                # forever instead of seeing EOF and resuming (server
+                # shutdown / cacher stop must look like a stream END)
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except OSError:
+                pass
             watcher.stop()
             self.server.watch_streams_adjust(resource, -1)
 
@@ -1193,6 +1261,7 @@ class APIServerHTTP(ThreadingHTTPServer):
         watch_cache: bool = True,
         bookmark_period_s: float = 2.0,
         watch_cache_window: int = 0,
+        freshness_timeout_s: float = 5.0,
     ):
         super().__init__(addr, _Handler)
         self.store = store
@@ -1211,6 +1280,7 @@ class APIServerHTTP(ThreadingHTTPServer):
                 store,
                 window=watch_cache_window or DEFAULT_WINDOW,
                 bookmark_period_s=bookmark_period_s,
+                freshness_timeout_s=freshness_timeout_s,
             )
         self._watch_streams_lock = threading.Lock()
         self._watch_streams: dict = {}
@@ -1266,6 +1336,7 @@ def serve(
     watch_cache: bool = True,
     bookmark_period_s: float = 2.0,
     watch_cache_window: int = 0,
+    freshness_timeout_s: float = 5.0,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store).
     max_in_flight=0 disables the in-flight limiter. watch_cache=False
@@ -1282,6 +1353,7 @@ def serve(
         watch_cache=watch_cache,
         bookmark_period_s=bookmark_period_s,
         watch_cache_window=watch_cache_window,
+        freshness_timeout_s=freshness_timeout_s,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

@@ -22,6 +22,21 @@ def main(argv=None) -> int:
     parser.add_argument("--watch-cache", type=int, default=1)
     parser.add_argument("--watch-cache-window", type=int, default=0)
     parser.add_argument("--bookmark-period", type=float, default=2.0)
+    # serving-tier scale-out (apiserver/frontend.py): --frontend-of runs
+    # this process as a STATELESS frontend over a remote primary (own
+    # watch cache, writes delegated upstream); --follower-of tails a
+    # primary's replication listener and serves commit-gated follower
+    # reads (requires --primary for the write/point-get delegate);
+    # --repl-port/--cluster-size arm the primary's replication listener
+    # so followers/frontend fleets have something to attach to.
+    parser.add_argument("--frontend-of", default="")
+    parser.add_argument("--follower-of", default="",
+                        help="primary replication address host:port")
+    parser.add_argument("--primary", default="",
+                        help="primary REST url (follower mode)")
+    parser.add_argument("--node-id", type=int, default=1)
+    parser.add_argument("--repl-port", type=int, default=0)
+    parser.add_argument("--cluster-size", type=int, default=0)
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
@@ -35,17 +50,57 @@ def main(argv=None) -> int:
     from ..utils.compilation_cache import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
-    from ..apiserver.rest import serve
-
-    srv, port, _store = serve(
+    log = logging.getLogger("kubernetes_tpu.cmd.apiserver")
+    serve_kwargs = dict(
         port=args.port,
         watch_cache=bool(args.watch_cache),
         watch_cache_window=args.watch_cache_window,
         bookmark_period_s=args.bookmark_period,
     )
-    logging.getLogger("kubernetes_tpu.cmd.apiserver").info(
-        "serving /api/v1 on :%d", port
-    )
+    if args.frontend_of:
+        from ..apiserver.frontend import serve_frontend
+
+        srv, port, _client = serve_frontend(args.frontend_of, **serve_kwargs)
+        log.info(
+            "serving /api/v1 on :%d (stateless frontend of %s)",
+            port, args.frontend_of,
+        )
+    elif args.follower_of:
+        if not args.primary:
+            # no derivable fallback exists: --follower-of names the
+            # REPLICATION listener, whose port says nothing about the
+            # primary's REST port
+            parser.error("--follower-of requires --primary (the primary's "
+                         "REST url for the write/point-get delegate)")
+        host, _, rport = args.follower_of.partition(":")
+        from ..apiserver.frontend import serve_follower_frontend
+        from ..runtime.replication import Follower
+
+        follower = Follower((host, int(rport)), node_id=args.node_id).start()
+        if not follower.wait_synced(30.0):
+            log.error("follower never synced to %s", args.follower_of)
+            return 1
+        srv, port, _store = serve_follower_frontend(
+            follower, args.primary, **serve_kwargs,
+        )
+        log.info(
+            "serving /api/v1 on :%d (follower reads of %s)",
+            port, args.follower_of,
+        )
+    else:
+        from ..apiserver.rest import serve
+
+        srv, port, store = serve(**serve_kwargs)
+        if args.repl_port or args.cluster_size:
+            from ..runtime.replication import ReplicationListener
+
+            listener = ReplicationListener(
+                port=args.repl_port,
+                cluster_size=args.cluster_size or None,
+            )
+            listener.attach(store)
+            log.info("replication listener on :%d", listener.address[1])
+        log.info("serving /api/v1 on :%d", port)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

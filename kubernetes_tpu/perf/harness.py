@@ -615,3 +615,216 @@ def _wait_all_scheduled(server: APIServer, count: int, timeout_s: float) -> None
             return
         time.sleep(0.05)
     raise TimeoutError("init pods did not all schedule")
+
+
+@dataclass
+class ServingBenchResult:
+    """The `serving` bench workload: a MULTI-PROCESS frontend fleet
+    behind the balancer — bind RTT through the pooled REST chain
+    (client -> balancer -> frontend -> primary) and watch fan-out
+    across hollow watchers attached to the frontends' own caches."""
+
+    n_frontends: int
+    n_watchers: int
+    n_events: int
+    n_binds: int
+    duration_s: float
+    bind_p50_ms: float
+    bind_p99_ms: float
+    delivery_p99_ms: float
+    fanout_deliveries: int
+    fanout_deliveries_per_s: float
+    conn_opened: int
+    conn_reused: int
+
+
+def run_serving_benchmark(
+    n_watchers: int = 100_000,
+    n_frontends: int = 2,
+    n_pods: int = 100,
+    timeout_s: float = 240.0,
+) -> ServingBenchResult:
+    """Serving-tier fleet benchmark, real OS processes end to end.
+
+    A primary apiserver and n_frontends stateless frontends are spawned
+    as child processes (testing/netchaos_procs.py roles); each frontend
+    attaches n_watchers/n_frontends hollow watchers to its OWN watch
+    cache (the kubemark discipline: real fan-out queues, a sampled drain
+    pool). The bench then drives n_pods creates + n_pods binds through
+    an in-process LoadBalancerProxy on ONE pooled RESTClient, timing
+    every bind POST round trip, and reads each frontend's delivery
+    stats back over its /bench-stats endpoint."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from ..api.objects import Binding, Container, Node, NodeSpec, NodeStatus, ObjectMeta, PodSpec
+    from ..apiserver.client import (
+        COUNTER_CONN_OPENED,
+        COUNTER_CONN_REUSED,
+        RESTClient,
+    )
+    from ..testing.netchaos import LoadBalancerProxy
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    tmp_paths: List[str] = []  # stderr logs + ledger, removed in finally
+
+    def spawn(args, tag):
+        err = tempfile.NamedTemporaryFile(
+            "w+", prefix=f"serving-bench-{tag}-", suffix=".log", delete=False
+        )
+        tmp_paths.append(err.name)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.testing.netchaos_procs",
+             *args],
+            cwd=repo, stdout=subprocess.PIPE, stderr=err, text=True, env=env,
+        )
+        err.close()  # the child holds its own duped fd
+        procs.append(p)
+        lines: List[str] = []
+
+        def read():
+            for line in p.stdout:
+                lines.append(line.strip())
+
+        threading.Thread(target=read, daemon=True).start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            ready = [l for l in lines if l.startswith("READY")]
+            if ready:
+                return ready[0].split()
+            if p.poll() is not None:
+                raise RuntimeError(f"{tag} exited rc={p.returncode}")
+            time.sleep(0.05)
+        raise TimeoutError(f"{tag} never became ready")
+
+    per_frontend = max(1, n_watchers // n_frontends)
+    lb = None
+    client = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False) as lf:
+            ledger = lf.name
+        tmp_paths.append(ledger)
+        ready = spawn(["apiserver", "--port", "0", "--ledger", ledger],
+                      "primary")
+        primary_port = int(ready[2])
+        primary_url = f"http://127.0.0.1:{primary_port}"
+        stats_ports = []
+        backends = []
+        for i in range(n_frontends):
+            r = spawn(
+                ["frontend", "--primary", primary_url,
+                 "--hollow-watchers", str(per_frontend)],
+                f"frontend-{i}",
+            )
+            backends.append(("127.0.0.1", int(r[2])))
+            stats_ports.append(int(r[3]))
+        lb = LoadBalancerProxy(backends).start()
+        client = RESTClient(f"http://127.0.0.1:{lb.port}", timeout=30.0)
+        client.create(
+            "nodes",
+            Node(
+                metadata=ObjectMeta(name="bench-n1", namespace=""),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={"cpu": "512", "memory": "2Ti", "pods": 100000}
+                ),
+            ),
+        )
+        opened0 = metrics.counter(COUNTER_CONN_OPENED)
+        reused0 = metrics.counter(COUNTER_CONN_REUSED)
+        t0 = time.monotonic()
+        bind_lat: List[float] = []
+        for i in range(n_pods):
+            client.create(
+                "pods",
+                Pod(
+                    metadata=ObjectMeta(name=f"sv-{i}", namespace="default"),
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": "1m"})]
+                    ),
+                ),
+            )
+        for i in range(n_pods):
+            b = Binding(
+                pod_name=f"sv-{i}", pod_namespace="default",
+                target_node="bench-n1",
+            )
+            bt0 = time.monotonic()
+            errs = client.bind_pods([b])
+            if errs[0] is None:
+                bind_lat.append(time.monotonic() - bt0)
+        n_events = 2 * n_pods  # each pod: one ADDED + one bind MODIFIED
+
+        def stats(port):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ) as r:
+                return _json.loads(r.read())
+
+        # the storm ends when every frontend's cache consumed every event
+        deadline = time.monotonic() + timeout_s
+        snaps = []
+        while time.monotonic() < deadline:
+            snaps = [stats(p) for p in stats_ports]
+            if all(s["cache_events"] >= n_events for s in snaps):
+                break
+            time.sleep(0.1)
+        duration = time.monotonic() - t0
+        # drain window: sampled watchers finish their queues for honest
+        # percentiles
+        sample_target = sum(s["sampled"] for s in snaps) * n_events
+        drain_deadline = time.monotonic() + 20.0
+        while time.monotonic() < drain_deadline:
+            snaps = [stats(p) for p in stats_ports]
+            if sum(s["drained"] for s in snaps) >= sample_target:
+                break
+            time.sleep(0.1)
+        deliveries = sum(
+            int(s["cache_events"]) * s["watchers"] for s in snaps
+        )
+        blat = sorted(bind_lat)
+        return ServingBenchResult(
+            n_frontends=n_frontends,
+            n_watchers=sum(s["watchers"] for s in snaps),
+            n_events=n_events,
+            n_binds=len(bind_lat),
+            duration_s=duration,
+            bind_p50_ms=(blat[len(blat) // 2] * 1e3) if blat else 0.0,
+            bind_p99_ms=(
+                blat[min(int(0.99 * len(blat)), len(blat) - 1)] * 1e3
+                if blat
+                else 0.0
+            ),
+            delivery_p99_ms=max(
+                (s["delivery_p99_ms"] for s in snaps), default=0.0
+            ),
+            fanout_deliveries=deliveries,
+            fanout_deliveries_per_s=(
+                deliveries / duration if duration else 0.0
+            ),
+            conn_opened=int(metrics.counter(COUNTER_CONN_OPENED) - opened0),
+            conn_reused=int(metrics.counter(COUNTER_CONN_REUSED) - reused0),
+        )
+    finally:
+        if client is not None:
+            client.close()
+        if lb is not None:
+            lb.stop()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for path in tmp_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

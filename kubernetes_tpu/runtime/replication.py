@@ -682,6 +682,13 @@ class Follower:
         self.commit_index = 0
         self.objects: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        # read-path observers (apiserver/frontend.FollowerReadStore):
+        # notified off-lock after records apply / the commit index moves /
+        # a snapshot resets state — how a follower-attached watch cache
+        # learns of changes without polling. Commit waits park on the
+        # condition (notified from _learn_commit).
+        self._observers: List[Any] = []
+        self._commit_cond = threading.Condition()
         self._stopped = threading.Event()
         self._compacting = threading.Event()
         self._last_seen: Optional[float] = None  # None until first frame
@@ -722,6 +729,47 @@ class Follower:
 
     def wait_synced(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
+
+    def register_observer(self, obs: Any) -> None:
+        """Attach a read-path observer. Duck interface (all optional):
+        ``on_records(recs)`` with recs = [(rv, verb, kind, obj-copy)]
+        after a batch durably applies, ``on_commit(commit_index)`` when
+        the learned commit index advances, ``on_snapshot()`` after a full
+        state transfer replaced the replica state (the observer's
+        incremental view is invalid — resync from list)."""
+        self._observers.append(obs)
+
+    def _observe(self, method: str, *args) -> None:
+        for obs in self._observers:
+            fn = getattr(obs, method, None)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:
+                logger.exception("follower observer %s failed", method)
+
+    def list_kind(self, kind: str) -> Tuple[List[Any], int]:
+        """(deep-copied objects of kind, replica rv) under the replica
+        lock: the follower-read seed list (FollowerReadStore.list)."""
+        import copy as _copy
+
+        with self._lock:
+            d = self.objects.get(kind, {})
+            return [_copy.deepcopy(o) for o in d.values()], self.rv
+
+    def wait_commit(self, rv: int, timeout: float = 5.0) -> bool:
+        """Block until the learned commit index covers rv (or timeout).
+        The follower-read freshness gate: a consistent read demanding rv
+        R is served only once a quorum durably holds R."""
+        deadline = time.monotonic() + timeout
+        with self._commit_cond:
+            while self.commit_index < rv:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped.is_set():
+                    return self.commit_index >= rv
+                self._commit_cond.wait(remaining)
+        return True
 
     @property
     def ejected(self) -> bool:
@@ -834,6 +882,9 @@ class Follower:
                 c = max(c, frame[key].get("commit", 0) or 0)
         if c and int(c) > self.commit_index:
             self.commit_index = int(c)
+            with self._commit_cond:
+                self._commit_cond.notify_all()
+            self._observe("on_commit", self.commit_index)
 
     def _apply_snapshot(self, snap: dict) -> None:
         with self._lock:
@@ -851,6 +902,9 @@ class Follower:
             # must rebuild the FULL replicated state, not just the records
             # streamed after the connection (review r4)
             self.wal.write_snapshot(*self._snapshot_state())
+        # a full state transfer invalidates any incremental read-path
+        # view built from the record stream: observers resync from list
+        self._observe("on_snapshot")
 
     def _snapshot_state(self):
         """(rv, {kind: [DEEP-COPIED objects]}) under the lock: a promotion
@@ -904,6 +958,19 @@ class Follower:
             # follower's own job (the primary's doesn't cross the wire)
             self.wal.append_batch(wal_batch)
             self._maybe_compact()
+        if wal_batch and self._observers:
+            # observers get COPIES: the stored objects are live replica
+            # state (a promotion shares self.objects with the promoted
+            # APIServer) and the read path hands its view to watch queues
+            import copy as _copy
+
+            self._observe(
+                "on_records",
+                [
+                    (rv, verb, kind, _copy.deepcopy(obj))
+                    for rv, verb, kind, obj in wal_batch
+                ],
+            )
 
     # -- election endpoint ----------------------------------------------------
 

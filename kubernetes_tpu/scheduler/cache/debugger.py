@@ -110,6 +110,16 @@ class CacheDebugger:
         if readpath:
             lines.append("Dump of read-path (watch cache / flow control) state:")
             lines.extend(readpath)
+        from ...apiserver.client import serving_health_lines
+        from ...apiserver.frontend import frontend_health_lines
+
+        serving = serving_health_lines() + frontend_health_lines()
+        if serving:
+            lines.append(
+                "Dump of serving-tier (REST connection pool / follower "
+                "read) state:"
+            )
+            lines.extend(serving)
         from ..ha import ha_health_lines
 
         ha = ha_health_lines()

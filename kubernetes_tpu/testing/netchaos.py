@@ -441,6 +441,12 @@ class NetChaosProxy:
             name="netchaos-down",
         ).start()
 
+    @staticmethod
+    def _looks_like_request_head(chunk: bytes) -> bool:
+        return chunk.split(b" ", 1)[0] in (
+            b"GET", b"POST", b"PUT", b"DELETE", b"HEAD", b"PATCH",
+        )
+
     def _pump(self, pair: _Pair, direction: str) -> None:
         src = pair.client if direction == "up" else pair.upstream
         dst = pair.upstream if direction == "up" else pair.client
@@ -456,13 +462,40 @@ class NetChaosProxy:
                     # partition landed while we were blocked in recv:
                     # silently drop the data — both sides now hang
                     return  # pair closed by heal()/stop()
+                if (
+                    direction == "up"
+                    and self._faults
+                    and self._looks_like_request_head(chunk)
+                ):
+                    # keep-alive clients carry MANY requests per
+                    # connection: armed faults must match each request
+                    # head, not just the connection's first (accept-time)
+                    # one. A body-continuation chunk never starts with a
+                    # method verb and is skipped.
+                    fault = self._take_fault(chunk)
+                    if fault == "reset":
+                        # dropped BEFORE forwarding: the server never saw
+                        # this request (the client, on its reused socket,
+                        # cannot know that — honest classification there
+                        # is unknown-outcome)
+                        metrics.inc(COUNTER_FAULTS, {"kind": "reset"})
+                        self._terminate_pair(pair)
+                        return
+                    if fault == "blackhole":
+                        metrics.inc(COUNTER_FAULTS, {"kind": "blackhole"})
+                        pair.blackhole_down = True
                 metrics.inc(
                     COUNTER_BYTES, {"direction": direction},
                     by=float(len(chunk)),
                 )
                 self._shape(len(chunk))
                 if direction == "down" and pair.blackhole_down:
-                    continue  # response discarded: write applied, ack lost
+                    # response discarded: write applied, ack lost. The
+                    # pair dies NOW — a keep-alive upstream never EOFs on
+                    # its own, and the client must see a dead connection,
+                    # not a stall
+                    self._terminate_pair(pair)
+                    return
                 try:
                     dst.sendall(chunk)
                 except OSError:
@@ -484,6 +517,210 @@ class NetChaosProxy:
             with self._lock:
                 if pair in self._pairs and pair._pumps_left == 0:
                     self._pairs.remove(pair)
+
+    def _terminate_pair(self, pair: _Pair) -> None:
+        """RST both legs of a pair from inside a pump (injected fault).
+        Marking the pair stale FIRST makes both pumps' cleanup paths
+        stand down (stale = sockets owned elsewhere — here): the sibling
+        wakes on its dead socket and simply exits."""
+        pair.stale = True
+        _rst_close(pair.client)
+        _rst_close(pair.upstream)
+        with self._lock:
+            if pair in self._pairs:
+                self._pairs.remove(pair)
+
+
+# -- load balancer: the proxy machinery run in reverse -----------------------
+
+# client connections relayed per backend, and backends skipped because a
+# connect failed (the backend is cooling down / dead)
+COUNTER_BALANCER_CONNS = "netchaos_balancer_connections_total"  # {backend}
+COUNTER_BALANCER_SKIPS = "netchaos_balancer_backend_skips_total"  # {backend}
+
+
+class LoadBalancerProxy:
+    """One listener, N upstream backends: the serving-tier balancer.
+
+    The same accept/pump machinery as :class:`NetChaosProxy`, inverted —
+    instead of one upstream with injected faults, each accepted client
+    connection is relayed verbatim to a backend chosen by policy:
+
+      * ``round_robin``: rotate through the backend list;
+      * ``least_conn`` (default): the backend with the fewest live
+        relayed connections — watch streams hold their connection for
+        life, so connection count is the honest load signal for a mixed
+        request/stream fleet.
+
+    A backend whose connect fails is put on a cooldown
+    (``retry_cooldown_s``) and the next candidate is tried in the same
+    accept — a killed frontend drains out of rotation within one failed
+    connect, and its in-flight streams RST so clients resume (the
+    RESTClient watch pump reconnects through the balancer and lands on a
+    healthy sibling, whose watch cache replays the gap).
+
+    Deliberately a dumb L4 relay: HTTP keep-alive, chunked watch
+    streams, and the binary watch codec all pass through untouched.
+    """
+
+    def __init__(
+        self,
+        backends: List[Tuple[str, int]],
+        listen_host: str = "127.0.0.1",
+        policy: str = "least_conn",
+        retry_cooldown_s: float = 1.0,
+        connect_timeout_s: float = 2.0,
+    ):
+        if policy not in ("round_robin", "least_conn"):
+            raise ValueError(f"unknown balance policy {policy!r}")
+        self.backends = [tuple(b) for b in backends]
+        self.listen_host = listen_host
+        self.policy = policy
+        self.retry_cooldown_s = retry_cooldown_s
+        self.connect_timeout_s = connect_timeout_s
+        self.port: int = 0
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._pairs: List[tuple] = []  # (backend, _Pair)
+        self._cooldown: dict = {}  # backend -> monotonic deadline
+        self._rr = 0
+
+    def start(self) -> "LoadBalancerProxy":
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind((self.listen_host, self.port))
+        lst.listen(512)
+        self.port = lst.getsockname()[1]
+        self._listener = lst
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="lb-accept"
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            lst, self._listener = self._listener, None
+            pairs = [p for _b, p in self._pairs]
+            self._pairs.clear()
+        _close_listener(lst)
+        for p in pairs:
+            _rst_close(p.client)
+            _rst_close(p.upstream)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            lst = self._listener
+            if lst is None:
+                return
+            try:
+                client, _ = lst.accept()
+            except OSError:
+                continue
+            threading.Thread(
+                target=self._serve_conn, args=(client,), daemon=True,
+                name="lb-conn",
+            ).start()
+
+    def _candidates(self) -> List[Tuple[str, int]]:
+        """Backends in try-order for one accept, cooled-down ones last
+        (still tried: with every backend cooling, a liveness probe beats
+        refusing service)."""
+        now = time.monotonic()
+        with self._lock:
+            live = [
+                (b, sum(1 for bb, p in self._pairs if bb == b))
+                for b in self.backends
+            ]
+            if self.policy == "round_robin":
+                self._rr += 1
+                n = len(self.backends)
+                order = [live[(self._rr + i) % n][0] for i in range(n)]
+            else:
+                order = [b for b, _cnt in sorted(live, key=lambda x: x[1])]
+            cooling = {
+                b for b, dl in self._cooldown.items() if dl > now
+            }
+        return [b for b in order if b not in cooling] + [
+            b for b in order if b in cooling
+        ]
+
+    def _serve_conn(self, client: socket.socket) -> None:
+        for backend in self._candidates():
+            try:
+                upstream = socket.create_connection(
+                    backend, timeout=self.connect_timeout_s
+                )
+            except OSError:
+                metrics.inc(
+                    COUNTER_BALANCER_SKIPS,
+                    {"backend": f"{backend[0]}:{backend[1]}"},
+                )
+                with self._lock:
+                    self._cooldown[backend] = (
+                        time.monotonic() + self.retry_cooldown_s
+                    )
+                continue
+            upstream.settimeout(None)
+            metrics.inc(
+                COUNTER_BALANCER_CONNS,
+                {"backend": f"{backend[0]}:{backend[1]}"},
+            )
+            with self._lock:
+                self._cooldown.pop(backend, None)
+            pair = _Pair(client, upstream, blackhole_down=False)
+            with self._lock:
+                self._pairs.append((backend, pair))
+            threading.Thread(
+                target=self._pump, args=(pair, "up"), daemon=True,
+                name="lb-up",
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(pair, "down"), daemon=True,
+                name="lb-down",
+            ).start()
+            return
+        _rst_close(client)  # every backend down: fail fast, not hang
+
+    def _pump(self, pair: _Pair, direction: str) -> None:
+        src = pair.client if direction == "up" else pair.upstream
+        dst = pair.upstream if direction == "up" else pair.client
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = src.recv(_CHUNK)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                try:
+                    dst.sendall(chunk)
+                except OSError:
+                    break
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)  # relay the EOF
+            except OSError:
+                pass
+            pair.pump_done()
+            with self._lock:
+                self._pairs = [
+                    (b, p)
+                    for b, p in self._pairs
+                    if not (p is pair and p._pumps_left == 0)
+                ]
+
+    def live_connections(self) -> int:
+        with self._lock:
+            return len(self._pairs)
+
+    def connections_per_backend(self) -> dict:
+        with self._lock:
+            out: dict = {}
+            for b, _p in self._pairs:
+                out[b] = out.get(b, 0) + 1
+            return out
 
 
 # -- process chaos -----------------------------------------------------------

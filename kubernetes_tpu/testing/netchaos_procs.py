@@ -86,6 +86,7 @@ def _ledger_store(ledger_path: str):
                         {
                             "event": "applied",
                             "uid": b.pod_uid,
+                            "name": f"{b.pod_namespace}/{b.pod_name}",
                             "node": b.target_node,
                             "trace": traces[id(b)],
                         }
@@ -94,6 +95,7 @@ def _ledger_store(ledger_path: str):
                         {
                             "event": "acked",
                             "uid": b.pod_uid,
+                            "name": f"{b.pod_namespace}/{b.pod_name}",
                             "node": b.target_node,
                             "trace": traces[id(b)],
                         }
@@ -103,12 +105,161 @@ def _ledger_store(ledger_path: str):
     return LedgerStore()
 
 
-def run_apiserver(port: int, ledger: str) -> None:
+def run_apiserver(
+    port: int, ledger: str, repl_port: int = 0, cluster_size: int = 0
+) -> None:
     from ..apiserver.rest import serve
 
     store = _ledger_store(ledger)
+    repl_bound = 0
+    if repl_port or cluster_size:
+        # the serving-tier fleet: followers tail this listener and serve
+        # commit-gated reads (apiserver/frontend.FollowerReadStore)
+        from ..runtime.replication import ReplicationListener
+
+        listener = ReplicationListener(
+            port=repl_port, cluster_size=cluster_size or None
+        )
+        listener.attach(store)
+        repl_bound = listener.address[1]
     srv, bound_port, _ = serve(store=store, port=port, bookmark_period_s=0.5)
-    print(f"READY apiserver {bound_port}", flush=True)
+    print(f"READY apiserver {bound_port} {repl_bound}", flush=True)
+    threading.Event().wait()
+
+
+# -- serving-tier children (frontend / follower) ------------------------------
+
+
+class _BenchStatsHandler(BaseHTTPRequestHandler):
+    """Tiny stats endpoint for the serving bench: the hollow-watcher
+    drain pool's delivery latencies + counts, as JSON."""
+
+    server_version = "serving-bench-stats"
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        stats = self.server.stats_fn()
+        body = json.dumps(stats).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _hollow_watcher_pool(cacher, kind: str, n_watchers: int, n_sampled: int = 64):
+    """Attach n hollow watchers to this frontend's OWN cache fan-out
+    (the kubemark discipline: real queues, a shared drain pool instead
+    of n threads) and return a stats closure for /bench-stats."""
+    import time as _time
+
+    from ..runtime.watch import BOOKMARK
+
+    watchers = [cacher.watch(kind) for _ in range(n_watchers)]
+    sampled = watchers[: min(n_sampled, n_watchers)]
+    latencies: list = []
+    drained = [0]
+    lock = threading.Lock()
+
+    def drain_loop(ws):
+        while True:
+            idle = True
+            for w in ws:
+                ev = w.get(timeout=0)
+                while ev is not None:
+                    idle = False
+                    if ev.type != BOOKMARK and ev.ts:
+                        with lock:
+                            latencies.append(_time.monotonic() - ev.ts)
+                            drained[0] += 1
+                    ev = w.get(timeout=0)
+            if idle:
+                _time.sleep(0.001)
+
+    drainers = 4
+    chunk = max(1, len(sampled) // drainers)
+    for i in range(0, len(sampled), chunk):
+        threading.Thread(
+            target=drain_loop, args=(sampled[i : i + chunk],), daemon=True
+        ).start()
+
+    def stats():
+        from ..utils.metrics import metrics
+
+        with lock:
+            lat = sorted(latencies)
+        events = metrics.counter(
+            "watch_cache_events_total", {"kind": kind}
+        )
+        return {
+            "watchers": n_watchers,
+            "sampled": len(sampled),
+            "drained": drained[0],
+            "cache_events": events,
+            "delivery_p50_ms": lat[int(0.5 * len(lat))] * 1e3 if lat else 0.0,
+            "delivery_p99_ms": (
+                lat[min(int(0.99 * len(lat)), len(lat) - 1)] * 1e3
+                if lat
+                else 0.0
+            ),
+        }
+
+    return stats
+
+
+def _serve_stats(stats_fn) -> int:
+    dbg = ThreadingHTTPServer(("127.0.0.1", 0), _BenchStatsHandler)
+    dbg.daemon_threads = True
+    dbg.stats_fn = stats_fn
+    threading.Thread(target=dbg.serve_forever, daemon=True).start()
+    return dbg.server_address[1]
+
+
+def run_frontend(
+    primary: str, port: int, hollow_watchers: int, watch_kind: str
+) -> None:
+    from ..apiserver.frontend import serve_frontend
+
+    srv, bound, _client = serve_frontend(
+        primary, port=port, bookmark_period_s=0.5
+    )
+    stats_port = 0
+    if hollow_watchers:
+        stats_fn = _hollow_watcher_pool(
+            srv.cacher, watch_kind, hollow_watchers
+        )
+        stats_port = _serve_stats(stats_fn)
+    print(f"READY frontend {bound} {stats_port}", flush=True)
+    threading.Event().wait()
+
+
+def run_follower(
+    primary: str,
+    repl_host: str,
+    repl_port: int,
+    port: int,
+    node_id: int,
+    hollow_watchers: int,
+    watch_kind: str,
+) -> None:
+    from ..apiserver.frontend import serve_follower_frontend
+    from ..runtime.replication import Follower
+
+    follower = Follower((repl_host, repl_port), node_id=node_id).start()
+    if not follower.wait_synced(15.0):
+        raise SystemExit("follower never synced")
+    srv, bound, _store = serve_follower_frontend(
+        follower, primary, port=port, bookmark_period_s=0.5
+    )
+    stats_port = 0
+    if hollow_watchers:
+        stats_fn = _hollow_watcher_pool(
+            srv.cacher, watch_kind, hollow_watchers
+        )
+        stats_port = _serve_stats(stats_fn)
+    print(f"READY follower {bound} {stats_port}", flush=True)
     threading.Event().wait()
 
 
@@ -313,6 +464,21 @@ def main(argv=None) -> int:
     ap = sub.add_parser("apiserver")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--ledger", required=True)
+    ap.add_argument("--repl-port", type=int, default=0)
+    ap.add_argument("--cluster-size", type=int, default=0)
+    fr = sub.add_parser("frontend")
+    fr.add_argument("--primary", required=True)
+    fr.add_argument("--port", type=int, default=0)
+    fr.add_argument("--hollow-watchers", type=int, default=0)
+    fr.add_argument("--watch-kind", default="pods")
+    fo = sub.add_parser("follower")
+    fo.add_argument("--primary", required=True)
+    fo.add_argument("--repl-host", default="127.0.0.1")
+    fo.add_argument("--repl-port", type=int, required=True)
+    fo.add_argument("--port", type=int, default=0)
+    fo.add_argument("--node-id", type=int, default=1)
+    fo.add_argument("--hollow-watchers", type=int, default=0)
+    fo.add_argument("--watch-kind", default="pods")
     sp = sub.add_parser("scheduler")
     sp.add_argument("--server", required=True)
     sp.add_argument("--identity", required=True)
@@ -324,7 +490,23 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.role == "apiserver":
-        run_apiserver(args.port, args.ledger)
+        run_apiserver(
+            args.port, args.ledger, args.repl_port, args.cluster_size
+        )
+    elif args.role == "frontend":
+        run_frontend(
+            args.primary, args.port, args.hollow_watchers, args.watch_kind
+        )
+    elif args.role == "follower":
+        run_follower(
+            args.primary,
+            args.repl_host,
+            args.repl_port,
+            args.port,
+            args.node_id,
+            args.hollow_watchers,
+            args.watch_kind,
+        )
     else:
         run_scheduler(
             args.server,

@@ -32,6 +32,8 @@ CHAOS_SUITE_FILES = [
     "tests/test_watchcache.py",
     "tests/test_chaos_ha.py",
     "tests/test_chaos_net.py",
+    "tests/test_serving.py",
+    "tests/test_chaos_serving.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -124,6 +126,8 @@ DUMP_REQUIRED_FAMILIES = (
     "informer_",
     "scheduler_ha_",
     "leader_election_",
+    "restclient_",
+    "follower_read_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------

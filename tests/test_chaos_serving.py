@@ -1,0 +1,367 @@
+"""Serving-tier chaos: the multi-process frontend/follower fleet under
+sustained mixed read/write traffic while members are killed mid-storm.
+
+Topology (all REAL OS processes except the in-test balancer):
+
+    clients -> LoadBalancerProxy -> [frontend-1, frontend-2, follower-A]
+                                         |             |          |
+                                         +--- REST ----+----------+--> primary
+                                                             (repl) --> follower-A
+                                                                    --> follower-B
+
+The primary runs a consensus ReplicationListener (cluster_size=3) and a
+JSONL LedgerStore; follower-A serves commit-gated reads over REST
+(apiserver/frontend.FollowerReadStore), follower-B is a quorum peer.
+Mid-storm, frontend-1 AND follower-A are SIGKILLed. Acceptance (ISSUE 14):
+
+  * zero acked-write loss: every create/bind the client saw acked is in
+    the surviving store, binds applied exactly once on the ledger;
+  * zero stale-served consistent reads: every consistent (limit) list
+    through the balancer contains every write acked BEFORE the list was
+    issued — no matter which backend served it;
+  * watchers resume through the balancer with zero relists: the client
+    watch pump reconnects onto a surviving frontend whose cache replays
+    the gap — consumer-visible Watchers never stop, every pod's ADDED
+    arrives exactly once.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+from collections import Counter
+
+import pytest
+
+from test_chaos_net import _Proc, _free_port
+from test_chaos_pipeline import wait_until
+
+from kubernetes_tpu.api.objects import (
+    Binding,
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.client import RESTClient
+from kubernetes_tpu.runtime.consensus import DegradedWrites, QuorumLost
+from kubernetes_tpu.runtime.watch import ADDED, BOOKMARK
+from kubernetes_tpu.testing.netchaos import LoadBalancerProxy, sigkill
+
+
+def make_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(containers=[Container(requests={"cpu": "1m"})]),
+    )
+
+
+def make_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable={"cpu": "64", "memory": "256Gi", "pods": 500}
+        ),
+    )
+
+
+class _Fleet:
+    """primary(+repl+ledger) / follower-A(read REST) / follower-B(quorum)
+    / two stateless frontends, one in-test balancer over the read tier."""
+
+    def __init__(self, tmp_path):
+        self.ledger = str(tmp_path / "serving_ledger.jsonl")
+        api_port = _free_port()
+        self.procs = {}
+        self.procs["primary"] = _Proc(
+            [
+                "apiserver",
+                "--port", str(api_port),
+                "--ledger", self.ledger,
+                "--repl-port", "0",
+                "--cluster-size", "3",
+            ],
+            "primary",
+        )
+        ready = self.procs["primary"].wait_ready().split()
+        self.primary_port, self.repl_port = int(ready[2]), int(ready[3])
+        self.primary_url = f"http://127.0.0.1:{self.primary_port}"
+        self.procs["follower-a"] = _Proc(
+            [
+                "follower",
+                "--primary", self.primary_url,
+                "--repl-port", str(self.repl_port),
+                "--node-id", "1",
+            ],
+            "follower-a",
+        )
+        self.procs["follower-b"] = _Proc(
+            [
+                "follower",
+                "--primary", self.primary_url,
+                "--repl-port", str(self.repl_port),
+                "--node-id", "2",
+            ],
+            "follower-b",
+        )
+        self.procs["frontend-1"] = _Proc(
+            ["frontend", "--primary", self.primary_url], "frontend-1"
+        )
+        self.procs["frontend-2"] = _Proc(
+            ["frontend", "--primary", self.primary_url], "frontend-2"
+        )
+        self.follower_a_port = int(
+            self.procs["follower-a"].wait_ready().split()[2]
+        )
+        self.procs["follower-b"].wait_ready()
+        self.fe1_port = int(self.procs["frontend-1"].wait_ready().split()[2])
+        self.fe2_port = int(self.procs["frontend-2"].wait_ready().split()[2])
+        self.balancer = LoadBalancerProxy(
+            [
+                ("127.0.0.1", self.fe1_port),
+                ("127.0.0.1", self.fe2_port),
+                ("127.0.0.1", self.follower_a_port),
+            ],
+            retry_cooldown_s=0.3,
+        ).start()
+        self.url = f"http://127.0.0.1:{self.balancer.port}"
+
+    def client(self, timeout=10.0) -> RESTClient:
+        return RESTClient(self.url, timeout=timeout)
+
+    def stop(self):
+        self.balancer.stop()
+        for p in self.procs.values():
+            p.kill()
+
+    def ledger_applied(self) -> Counter:
+        applied = Counter()
+        with open(self.ledger) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec.get("event") == "applied":
+                    applied[rec["uid"] or rec.get("name", "")] += 1
+        return applied
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = _Fleet(tmp_path)
+    yield f
+    f.stop()
+
+
+@pytest.mark.slow
+def test_fleet_serves_reads_and_routes_writes(fleet):
+    """Sanity before chaos: writes through ANY balancer backend land on
+    the primary; rv=0 and consistent lists serve from every backend's
+    cache/replica at the primary's kind rv."""
+    c = fleet.client()
+    for i in range(6):
+        c.create("pods", make_pod(f"sanity-{i}"))
+    # every backend, asked directly, serves the consistent view
+    for port in (fleet.fe1_port, fleet.fe2_port, fleet.follower_a_port):
+        cc = RESTClient(f"http://127.0.0.1:{port}", timeout=10.0)
+        out = cc._request("GET", cc._url("pods", "") + "?limit=50")
+        names = {i["metadata"]["name"] for i in out["items"]}
+        assert names == {f"sanity-{i}" for i in range(6)}, (port, names)
+        cc.close()
+    # and a watch through the balancer replays current state
+    w = c.watch("pods", from_version=0)
+    seen = set()
+
+    def replayed():
+        ev = w.get(timeout=0.2)
+        if ev is not None and ev.type == ADDED:
+            seen.add(ev.object.metadata.name)
+        return len(seen) >= 6
+
+    assert wait_until(replayed, 10.0), seen
+    w.stop()
+    c.close()
+
+
+@pytest.mark.slow
+def test_storm_kill_frontend_and_follower_mid_storm(fleet):
+    """The acceptance storm: sustained mixed create/bind/consistent-list
+    /watch traffic through the balancer while frontend-1 AND the
+    read-serving follower are SIGKILLed."""
+    N_PODS = 120
+    KILL_AT = 35  # pods acked before the kill lands
+    c = fleet.client()
+    c.create("nodes", make_node("n1"))
+
+    acked_lock = threading.Lock()
+    acked_creates: dict = {}  # name -> rv at ack time
+    acked_binds: set = set()
+    stale_reads: list = []
+    read_errors = [0]
+    stop_readers = threading.Event()
+
+    # -- watchers (before the storm: they must ride the kills) -----------
+    watch_client = fleet.client()
+    watchers = [watch_client.watch("pods", from_version=0) for _ in range(3)]
+    watcher_names = [Counter() for _ in watchers]
+    watcher_stop = threading.Event()
+
+    def drain(w, names):
+        while not watcher_stop.is_set():
+            ev = w.get(timeout=0.2)
+            if ev is not None and ev.type == ADDED:
+                names[ev.object.metadata.name] += 1
+
+    drain_threads = [
+        threading.Thread(target=drain, args=(w, n), daemon=True)
+        for w, n in zip(watchers, watcher_names)
+    ]
+    for t in drain_threads:
+        t.start()
+
+    # -- consistent readers ----------------------------------------------
+    def reader():
+        rc = fleet.client(timeout=15.0)
+        while not stop_readers.is_set():
+            with acked_lock:
+                demanded = set(acked_creates)
+            try:
+                out = rc._request(
+                    "GET", rc._url("pods", "") + "?limit=1000"
+                )
+            except (urllib.error.HTTPError, OSError, DegradedWrites):
+                read_errors[0] += 1  # 504/transport during the kill: retry
+                time.sleep(0.1)
+                continue
+            names = {i["metadata"]["name"] for i in out["items"]}
+            missing = demanded - names
+            if missing:
+                stale_reads.append(sorted(missing))
+            time.sleep(0.05)
+        rc.close()
+
+    readers = [threading.Thread(target=reader, daemon=True) for _ in range(2)]
+    for t in readers:
+        t.start()
+
+    # -- the write storm -------------------------------------------------
+    killed = threading.Event()
+
+    def create_one(name) -> bool:
+        for _attempt in range(8):
+            try:
+                out = c.create("pods", make_pod(name))
+                with acked_lock:
+                    acked_creates[name] = out.metadata.resource_version
+                return True
+            except (DegradedWrites, OSError):
+                time.sleep(0.15)
+            except urllib.error.HTTPError:
+                time.sleep(0.15)
+        return False
+
+    def bind_one(name) -> None:
+        b = Binding(
+            pod_name=name, pod_namespace="default", target_node="n1"
+        )
+        for _attempt in range(8):
+            try:
+                errs = c.bind_pods([b])
+            except OSError:
+                time.sleep(0.15)
+                continue
+            err = errs[0]
+            if err is None:
+                with acked_lock:
+                    acked_binds.add(name)
+                return
+            if isinstance(err, QuorumLost):
+                # outcome unknown: read back like the reconciler — if it
+                # landed, it is acked-equivalent (the server applied it)
+                try:
+                    pod = c.get("pods", "default", name)
+                    if pod.spec.node_name:
+                        with acked_lock:
+                            acked_binds.add(name)
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.15)
+            elif isinstance(err, DegradedWrites):
+                time.sleep(0.15)
+            else:
+                return  # Conflict (already bound by an earlier attempt)
+
+    for i in range(N_PODS):
+        name = f"storm-{i}"
+        if create_one(name):
+            bind_one(name)
+        if i == KILL_AT and not killed.is_set():
+            sigkill(fleet.procs["frontend-1"].proc)
+            sigkill(fleet.procs["follower-a"].proc)
+            killed.set()
+
+    assert killed.is_set()
+    with acked_lock:
+        assert len(acked_creates) >= N_PODS * 0.9, (
+            f"storm mostly failed: {len(acked_creates)}/{N_PODS} acked"
+        )
+
+    # -- drain + verify ---------------------------------------------------
+    stop_readers.set()
+    for t in readers:
+        t.join(timeout=5.0)
+
+    # zero stale-served consistent reads
+    assert not stale_reads, f"consistent reads missed acked writes: {stale_reads[:3]}"
+
+    # zero acked-write loss: every acked create/bind is in the surviving
+    # store (read via the healthy frontend, consistent)
+    final = RESTClient(f"http://127.0.0.1:{fleet.fe2_port}", timeout=15.0)
+    out = final._request("GET", final._url("pods", "") + "?limit=2000")
+    by_name = {i["metadata"]["name"]: i for i in out["items"]}
+    with acked_lock:
+        missing = set(acked_creates) - set(by_name)
+        assert not missing, f"acked creates lost: {sorted(missing)[:5]}"
+        unbound = [
+            n
+            for n in acked_binds
+            if not by_name[n]["spec"].get("nodeName")
+        ]
+        assert not unbound, f"acked binds lost: {unbound[:5]}"
+
+    # binds applied exactly once on the cross-process ledger
+    applied = fleet.ledger_applied()
+    multi = {k: v for k, v in applied.items() if v > 1}
+    assert not multi, f"double-applied binds: {multi}"
+
+    # watchers rode the kills: never stopped (zero relists), and every
+    # acked pod's ADDED arrived exactly once
+    def watchers_caught_up():
+        with acked_lock:
+            want = set(acked_creates)
+        return all(want <= set(names) for names in watcher_names)
+
+    assert wait_until(watchers_caught_up, 30.0), (
+        "watchers missed events: "
+        + str(
+            [
+                sorted(set(acked_creates) - set(n))[:5]
+                for n in watcher_names
+            ]
+        )
+    )
+    for w in watchers:
+        assert not w.stopped, "a watcher died (relist would be forced)"
+    for names in watcher_names:
+        dups = {n: k for n, k in names.items() if k > 1}
+        assert not dups, f"duplicate deliveries after resume: {dups}"
+    watcher_stop.set()
+    for w in watchers:
+        w.stop()
+    # the balancer routed around the corpses
+    assert fleet.balancer.live_connections() >= 0  # (machinery intact)
+    c.close()
+    watch_client.close()
