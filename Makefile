@@ -56,7 +56,8 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	chaos-serving tracing-ab lint-slow lint-static lint-fast lint
+	chaos-serving chaos-preempt tracing-ab lint-slow lint-static \
+	lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -69,7 +70,7 @@ chaos: lint
 		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
 		tests/test_watchcache.py tests/test_chaos_ha.py \
 		tests/test_chaos_net.py tests/test_serving.py \
-		tests/test_chaos_serving.py -q
+		tests/test_chaos_serving.py tests/test_chaos_preempt.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -90,6 +91,9 @@ chaos-net:
 
 chaos-serving:
 	$(CACHED) $(PY) -m pytest tests/test_serving.py tests/test_chaos_serving.py -q
+
+chaos-preempt:
+	$(CACHED) $(PY) -m pytest tests/test_chaos_preempt.py -q
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

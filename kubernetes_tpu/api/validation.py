@@ -329,3 +329,62 @@ def validate_object(
             _validate_pod_spec(tspec, what + ".jobTemplate.template")
     elif resource == "resourcequotas":
         validate_quantities(obj.spec.hard, what + ".hard")
+    elif resource == "priorityclasses":
+        _validate_priority_class(obj, what)
+
+
+# the reference's HighestUserDefinablePriority (scheduling/types.go): user
+# classes stay below it; values above are reserved for the system-* tier
+# (system-cluster-critical / system-node-critical). The system tier has
+# its own ceiling (SystemCriticalPriority = 2e9): anything approaching
+# int32 range would overflow the encoder's priority-band columns, and
+# exactly 2^31-1 collides with the preempt kernel's empty-band sentinel.
+HIGHEST_USER_DEFINABLE_PRIORITY = 1_000_000_000
+HIGHEST_SYSTEM_PRIORITY = 2_000_000_000
+_PREEMPTION_POLICIES = frozenset({"Never", "PreemptLowerPriority"})
+
+
+def _validate_priority_class(pc: Any, what: str) -> None:
+    """PriorityClass field validation (apis/scheduling/validation): the
+    user-value cap and the preemptionPolicy enum are hard 400s — an
+    unknown policy must never silently default to PreemptLowerPriority
+    (admission copies the class's policy onto pods; a typo'd "never"
+    would quietly make a tier preempting)."""
+    value = _as_int(pc.value, what + ".value")
+    if (
+        value > HIGHEST_USER_DEFINABLE_PRIORITY
+        and not pc.metadata.name.startswith("system-")
+    ):
+        _bad(
+            f"{what}: value {value} exceeds the user-definable maximum "
+            f"{HIGHEST_USER_DEFINABLE_PRIORITY} (reserved for system-* "
+            "classes)"
+        )
+    if value > HIGHEST_SYSTEM_PRIORITY:
+        _bad(
+            f"{what}: value {value} exceeds the system maximum "
+            f"{HIGHEST_SYSTEM_PRIORITY}"
+        )
+    policy = pc.preemption_policy
+    if policy is not None and policy not in _PREEMPTION_POLICIES:
+        _bad(
+            f"{what}: unknown preemptionPolicy {policy!r} "
+            f"(must be one of {sorted(_PREEMPTION_POLICIES)})"
+        )
+
+
+def validate_single_global_default(pc: Any, existing) -> None:
+    """At most ONE PriorityClass may carry globalDefault: true — called
+    by the store's create/update under its lock with every OTHER stored
+    class, so two racing creates cannot both land a default. (The
+    admission resolver picks `next(global_default)`; with two defaults
+    the winner would be storage-order luck.)"""
+    if not pc.global_default:
+        return
+    for cur in existing:
+        if getattr(cur, "global_default", False):
+            _bad(
+                f"priorityclasses/{pc.metadata.name}: globalDefault is "
+                f"already held by {cur.metadata.name!r}; only one "
+                "PriorityClass may be the global default"
+            )

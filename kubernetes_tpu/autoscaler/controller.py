@@ -61,6 +61,11 @@ COUNTER_BLOCKED = "autoscaler_scale_down_blocked_total"
 COUNTER_STORE_SKIPS = "autoscaler_degraded_write_skips_total"
 COUNTER_UNPLACED = "autoscaler_unplaced_pods_total"
 COUNTER_TRUNCATED = "autoscaler_truncated_pods_total"
+# heterogeneity/cost observability: per-shape catalog price and the live
+# fleet's aggregate cost-per-hour (the hetero bench's acceptance metric —
+# cheapest-feasible-shape packing must show up as a strictly cheaper fleet)
+GAUGE_SHAPE_COST = "autoscaler_shape_cost_per_hour"
+GAUGE_SHAPE_COST_FLEET = "autoscaler_shape_cost_fleet_per_hour"
 
 # stamped alongside the cordon so a restarted autoscaler can tell ITS
 # drains from operator cordons: the in-memory _draining set dies with the
@@ -83,6 +88,7 @@ class ClusterAutoscaler:
         eviction_qps: float = 10.0,
         eviction_burst: int = 5,
         provision_register_timeout_s: float = 30.0,
+        cost_aware: bool = True,
     ):
         self.server = server
         self.scheduler = scheduler
@@ -97,7 +103,15 @@ class ClusterAutoscaler:
         self.sim = WhatIfSimulator(
             scheduler.cache,
             hard_pod_affinity_weight=scheduler.cfg.hard_pod_affinity_weight,
+            cost_aware=cost_aware,
         )
+        # shape economics: each group's cost-per-hour published once (the
+        # fleet gauge tracks the live bill each pass, run_once)
+        self._group_cost = {g.name: g.cost_per_hour() for g in catalog.groups}
+        for g in catalog.groups:
+            metrics.set_gauge(
+                GAUGE_SHAPE_COST, self._group_cost[g.name], {"group": g.name}
+            )
         # provisioned-but-not-yet-registered node names (+ deadline): while
         # non-empty, scale-up pauses — re-simulating against a snapshot
         # that can't see the nodes we JUST added would double-provision
@@ -152,6 +166,18 @@ class ClusterAutoscaler:
             self._scale_down_pass()
         metrics.set_gauge(GAUGE_PROVISIONING, float(len(self._provisioning)))
         metrics.set_gauge(GAUGE_DRAINING, float(len(self._draining)))
+        # live fleet cost-per-hour from the cache's node set and the
+        # catalog's shape prices (unlabeled / out-of-catalog nodes cost 0)
+        fleet = 0.0
+        try:
+            for ni in self.scheduler.cache.node_infos().values():
+                if ni.node is None:
+                    continue
+                gname = ni.node.metadata.labels.get(LABEL_NODEGROUP, "")
+                fleet += self._group_cost.get(gname, 0.0)
+        except Exception:
+            logger.exception("fleet cost gauge pass failed")
+        metrics.set_gauge(GAUGE_SHAPE_COST_FLEET, round(fleet, 6))
 
     def _reap_registered(self) -> None:
         """Drop provisioned nodes once the scheduler cache sees them (the

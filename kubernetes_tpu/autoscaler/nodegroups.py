@@ -31,14 +31,33 @@ def machine_shape(
     pods: int = 110,
     labels: Optional[dict] = None,
     taints: Optional[list] = None,
+    cost_per_hour: Optional[float] = None,
+    accelerator_class: Optional[str] = None,
+    energy_watts: Optional[float] = None,
 ) -> Callable[[str], v1.Node]:
     """Node template for a homogeneous machine shape (the moral equivalent
-    of cloudprovider TemplateNodeInfo)."""
+    of cloudprovider TemplateNodeInfo). cost_per_hour / accelerator_class /
+    energy_watts stamp the encoder's heterogeneity-column labels
+    (ops/encoding.LABEL_*), so the SAME columns drive live scoring
+    policies and the autoscaler's cheapest-feasible-shape packing."""
+    from ..ops.encoding import (
+        LABEL_ACCELERATOR_CLASS,
+        LABEL_COST_PER_HOUR,
+        LABEL_ENERGY_WATTS,
+    )
+
+    shape_labels = dict(labels or {})
+    if cost_per_hour is not None:
+        shape_labels[LABEL_COST_PER_HOUR] = str(cost_per_hour)
+    if accelerator_class is not None:
+        shape_labels[LABEL_ACCELERATOR_CLASS] = accelerator_class
+    if energy_watts is not None:
+        shape_labels[LABEL_ENERGY_WATTS] = str(energy_watts)
 
     def template(name: str) -> v1.Node:
         return v1.Node(
             metadata=v1.ObjectMeta(
-                name=name, namespace="", labels=dict(labels or {})
+                name=name, namespace="", labels=dict(shape_labels)
             ),
             spec=v1.NodeSpec(taints=list(taints or [])),
             status=v1.NodeStatus(
@@ -78,6 +97,20 @@ class NodeGroup:
         node = self.template(name)
         node.metadata.labels[LABEL_NODEGROUP] = self.name
         return node
+
+    def cost_per_hour(self) -> float:
+        """The shape's cost-per-hour from its template's heterogeneity
+        label (0.0 when unlabeled) — the autoscaler_shape_cost_* metric
+        source and the hetero bench's fleet-cost accounting."""
+        from ..ops.encoding import LABEL_COST_PER_HOUR
+
+        raw = self.template("__shape__").metadata.labels.get(
+            LABEL_COST_PER_HOUR
+        )
+        try:
+            return float(raw) if raw else 0.0
+        except (TypeError, ValueError):
+            return 0.0
 
     def next_name(self, taken) -> str:
         """Next collision-free node name for this group."""

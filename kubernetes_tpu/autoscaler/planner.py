@@ -38,6 +38,7 @@ from ..api import objects as v1
 from ..ops.batch import encode_pod_batch
 from ..ops.lattice import (
     NUM_SCORE_COMPONENTS,
+    SC_COST,
     SC_MOST_ALLOC,
     SC_TAINT,
     make_schedule_batch,
@@ -52,17 +53,26 @@ HIST_SIMULATION = "autoscaler_simulation_duration_seconds"
 COUNTER_SIMULATIONS = "autoscaler_simulation_passes_total"
 
 
-def pack_weights() -> np.ndarray:
+def pack_weights(cost_aware: bool = False) -> np.ndarray:
     """Score weights for what-if passes. Feasibility is entirely the
     kernel's filter mask; the score only has to (a) PACK — MostAllocated
     funnels successive pods onto the fullest feasible node, so the scan
     carry greedily fills the fewest new nodes — and (b) prefer REAL rows:
     virtual rows carry a simulation-only PreferNoSchedule taint
     (VIRTUAL_BIAS_TAINT), and the dominant TaintToleration weight makes an
-    existing feasible node always beat opening a fresh virtual one."""
+    existing feasible node always beat opening a fresh virtual one.
+
+    cost_aware adds (c): CHEAPEST-feasible-shape packing — the cost
+    column (normalized-inverted over the feasible set) sits between the
+    real-row bias and the pack score, so among feasible virtual shapes
+    the cheaper one wins and MostAllocated only breaks cost ties. With an
+    unlabeled catalog the cost component is constant (inert), so
+    cost-aware stays safe to leave on."""
     w = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
     w[SC_MOST_ALLOC] = 1.0
-    w[SC_TAINT] = 100.0
+    w[SC_TAINT] = 1000.0
+    if cost_aware:
+        w[SC_COST] = 10.0
     return w
 
 
@@ -115,12 +125,15 @@ class WhatIfSimulator:
     PAD_BUCKETS = (64, 256)
 
     def __init__(self, cache: "SchedulerCache", hard_pod_affinity_weight: float = 1.0,
-                 max_pods_per_pass: int = 1024):
+                 max_pods_per_pass: int = 1024, cost_aware: bool = True):
         self.cache = cache
         self.hard_w = hard_pod_affinity_weight
         self.max_pods = max_pods_per_pass
         self._rng = jax.random.PRNGKey(7)
-        self._weights = pack_weights()
+        # cost_aware: cheapest-feasible-shape packing through the cost
+        # column (inert on unlabeled fleets); False = pure MostAllocated
+        # (the pre-ISSUE-15 behavior, kept for A/B benches)
+        self._weights = pack_weights(cost_aware=cost_aware)
 
     def _pad(self, n: int) -> int:
         for b in self.PAD_BUCKETS:

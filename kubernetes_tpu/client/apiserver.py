@@ -270,6 +270,12 @@ class APIServer:
             key = self._key(obj)
             if key in store:
                 raise AlreadyExists(f"{kind} {key} already exists")
+            if kind == "priorityclasses":
+                # stateful uniqueness checks need the store lock (two
+                # racing creates must not both land globalDefault: true)
+                validation.validate_single_global_default(
+                    obj, store.values()
+                )
             self._bump(obj)
             stored = copy.deepcopy(obj)
             store[key] = stored
@@ -309,6 +315,10 @@ class APIServer:
                     f"{cur.metadata.resource_version}"
                 )
             validation.validate_object("update", kind, obj, old=cur)
+            if kind == "priorityclasses":
+                validation.validate_single_global_default(
+                    obj, (o for k, o in store.items() if k != key)
+                )
             self._bump(obj)
             stored = copy.deepcopy(obj)
             # graceful deletion completes when the last finalizer is

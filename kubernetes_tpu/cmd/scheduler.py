@@ -270,6 +270,14 @@ def main(argv=None) -> int:
         "catalog: semicolon-separated 'name:cpu,memory,maxPods,maxSize' "
         "entries (e.g. 'small:4,32Gi,110,100;big:32,256Gi,110,20')",
     )
+    parser.add_argument(
+        "--score-policy",
+        default="",
+        help="named score policy (ops/lattice.WEIGHT_PROFILES: 'default', "
+        "'pack', 'cheapest', 'energy', ...): a runtime weight VECTOR over "
+        "the score components — swapping policies never recompiles the "
+        "kernels (Scheduler.set_score_policy swaps live)",
+    )
     parser.add_argument("-v", "--verbosity", type=int, default=1)
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -296,6 +304,9 @@ def main(argv=None) -> int:
         cfg.leader_election = LeaderElectionConfig()
     if args.leader_elect_identity and cfg.leader_election is not None:
         cfg.leader_election.identity = args.leader_elect_identity
+    if args.score_policy:
+        cfg.score_policy = args.score_policy
+        cfg.validate()  # unknown names fail here, not mid-wave
     catalog = None
     if args.autoscale_shapes:
         from ..autoscaler import NodeGroup, NodeGroupCatalog, machine_shape

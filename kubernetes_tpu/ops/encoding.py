@@ -121,6 +121,29 @@ RES_STORAGE = 2
 RES_PODS = 3
 N_BASE_RES = 4
 
+# Heterogeneity/cost column family: per-node economics fed from node
+# labels (the autoscaler's NodeGroup templates stamp them; operators may
+# label real fleets the same way). Costs/energy are encoded in MILLI
+# units (int32) so a $2.4/h node is 2400 — float labels parse once at
+# encode time, the kernel sees integers. Unlabeled nodes read 0
+# (= free/no-data); score components normalize within the feasible set,
+# so an all-unlabeled cluster scores flat and the policy is inert.
+LABEL_COST_PER_HOUR = "kubernetes-tpu.io/cost-per-hour"
+LABEL_ACCELERATOR_CLASS = "kubernetes-tpu.io/accelerator-class"
+LABEL_ENERGY_WATTS = "kubernetes-tpu.io/energy-watts"
+
+
+def _milli_of_label(labels: Dict[str, str], key: str) -> int:
+    """Parse a float-valued node label into int32 milli-units (0 when
+    absent or malformed — a bad label must not fail node encode)."""
+    raw = labels.get(key)
+    if not raw:
+        return 0
+    try:
+        return int(min(max(float(raw), 0.0) * 1000.0, float(I32_MAX)))
+    except (TypeError, ValueError):
+        return 0
+
 _KIB = 1024
 I32_MAX = np.int32(2**31 - 1)
 
@@ -455,6 +478,19 @@ class DeviceSnapshot(NamedTuple):
     # a masked band sum (SURVEY §7.6 batched masked what-if)
     prio_req: Any  # [N, PB, R] int32 requested by pods in priority band b
     band_prio: Any  # [PB] int32 priority of band b (I32_MAX = empty band)
+    # PDB budget column (ops/preemptlattice.py): count of pods in band b
+    # on node n whose eviction would violate a PodDisruptionBudget at the
+    # disruption controller's CURRENT published budgets (a pod matching
+    # any PDB with disruptions_allowed <= 0 counts). Refreshed host-side
+    # from PDB events (update_pdb_blocked); the victim-selection kernel
+    # uses it to DEPRIORITIZE nodes whose minimal victim prefix spends
+    # disruption budget — the exact per-victim countdown stays host-side
+    # in the reprieve loop, so this is a ranking column, never an oracle.
+    pdb_blocked: Any  # [N, PB] int32
+    # heterogeneity/cost columns (node-static, from the labels above):
+    cost_milli: Any  # [N] int32 cost-per-hour in milli-units
+    accel_class: Any  # [N] int32 interned accelerator-class value id, -1 none
+    energy_milli: Any  # [N] int32 energy proxy (watts) in milli-units
 
 
 class PodBatch(NamedTuple):
@@ -566,6 +602,11 @@ class SnapshotEncoder:
         self._row_by_name: Dict[str, int] = {}
         self._free_rows: List[int] = []
         self._pods: Dict[int, Dict[str, _PodEntry]] = {}  # row -> pod-key -> entry
+        # True iff the last update_pdb_blocked pass saw any exhausted
+        # budget: lets the no-PDB-pressure common case skip the per-row
+        # recompute entirely (it runs under the cache lock per failed
+        # batch)
+        self._pdb_any_blocked = False
 
         self._alloc_masters()
         # generation bookkeeping lock: guards ONLY the pin/seal/install
@@ -778,6 +819,10 @@ class SnapshotEncoder:
         self.m_avoid = np.zeros((n, c.av_cap), np.bool_)
         self.m_prio_req = np.zeros((n, c.pb_cap, c.r_cap), np.int32)
         self.m_band_prio = np.full(c.pb_cap, I32_MAX, np.int32)
+        self.m_pdb_blocked = np.zeros((n, c.pb_cap), np.int32)
+        self.m_cost = np.zeros(n, np.int32)
+        self.m_accel = np.full(n, -1, np.int32)
+        self.m_energy = np.zeros(n, np.int32)
 
     def _grow(self, **caps: int) -> None:
         """Grow one or more capacities; copies masters, forces full upload."""
@@ -801,6 +846,10 @@ class SnapshotEncoder:
             "m_avoid": self.m_avoid,
             "m_prio_req": self.m_prio_req,
             "m_band_prio": self.m_band_prio,
+            "m_pdb_blocked": self.m_pdb_blocked,
+            "m_cost": self.m_cost,
+            "m_accel": self.m_accel,
+            "m_energy": self.m_energy,
         }
         self.cfg = replace(self.cfg, **caps)
         self._alloc_masters()
@@ -1064,6 +1113,7 @@ class SnapshotEncoder:
         avoid = np.zeros(c.av_cap, np.bool_)
         for ai in avoids:
             avoid[ai] = True
+        accel_raw = labels.get(LABEL_ACCELERATOR_CLASS)
         return {
             "valid": np.bool_(True),
             "unschedulable": np.bool_(node.spec.unschedulable),
@@ -1075,6 +1125,13 @@ class SnapshotEncoder:
             "taint_effect": taint_eff,
             "image_bytes": image_bytes,
             "avoid": avoid,
+            # heterogeneity/cost columns (already interned above via the
+            # generic label path; accel re-interns idempotently)
+            "cost_milli": np.int32(_milli_of_label(labels, LABEL_COST_PER_HOUR)),
+            "accel_class": np.int32(
+                self.intern_val(accel_raw) if accel_raw else -1
+            ),
+            "energy_milli": np.int32(_milli_of_label(labels, LABEL_ENERGY_WATTS)),
         }
 
     def _write_node_row(self, row: int, node: v1.Node) -> None:
@@ -1091,6 +1148,9 @@ class SnapshotEncoder:
         self.m_taint_eff[row, :] = vals["taint_effect"]
         self.m_image_bytes[row, :] = vals["image_bytes"]
         self.m_avoid[row, :] = vals["avoid"]
+        self.m_cost[row] = vals["cost_milli"]
+        self.m_accel[row] = vals["accel_class"]
+        self.m_energy[row] = vals["energy_milli"]
         self._dirty_rows.add(row)
         self.generation += 1
 
@@ -1108,6 +1168,7 @@ class SnapshotEncoder:
         self.m_nonzero[row, :] = 0
         self.m_port_counts[row, :] = 0
         self.m_prio_req[row, :, :] = 0
+        self.m_pdb_blocked[row, :] = 0
         self._dirty_rows.add(row)
         self.generation += 1
 
@@ -1318,6 +1379,47 @@ class SnapshotEncoder:
             out[i] = pred.matches(namespace, labels)
         return out
 
+    def update_pdb_blocked(self, pdbs: List["v1.PodDisruptionBudget"]) -> int:
+        """Recompute the PDB budget column family (`pdb_blocked[N, PB]`)
+        from the disruption controller's CURRENT published budgets: a
+        placed pod counts as blocked when it matches any PDB whose
+        status.disruptions_allowed is already spent (<= 0). This is the
+        vectorized victim-selection kernel's node-DEPRIORITIZER, not the
+        oracle — the per-victim budget countdown (list-order consumption
+        across overlapping PDBs) stays in the host reprieve loop that
+        validates every candidate before eviction. Caller holds the cache
+        lock. Returns the number of rows whose column changed (each is
+        marked dirty for the next flush)."""
+        from ..api.selectors import match_labels as _match_labels
+
+        blocked = [
+            (pdb.metadata.namespace, pdb.spec.selector)
+            for pdb in pdbs
+            if pdb.status.disruptions_allowed <= 0
+        ]
+        if not blocked and not self._pdb_any_blocked:
+            # common case (no exhausted budgets, column already clear):
+            # skip the per-row matching entirely — this runs under the
+            # cache lock on every failed batch
+            return 0
+        changed = 0
+        for row, pods in self._pods.items():
+            want = np.zeros(self.cfg.pb_cap, np.int32)
+            if blocked:
+                for e in pods.values():
+                    for ns, sel in blocked:
+                        if ns == e.namespace and _match_labels(sel, e.labels):
+                            want[e.prio_band] += 1
+                            break
+            if not np.array_equal(self.m_pdb_blocked[row], want):
+                self.m_pdb_blocked[row] = want
+                self._dirty_rows.add(row)
+                changed += 1
+        self._pdb_any_blocked = bool(blocked)
+        if changed:
+            self.generation += 1
+        return changed
+
     # -- anti-entropy hooks (scheduler/antientropy.py) -----------------------
     #
     # The pod-aggregate columns are maintained INCREMENTALLY (add/remove
@@ -1356,6 +1458,10 @@ class SnapshotEncoder:
             "image_bytes": self.m_image_bytes,
             "avoid": self.m_avoid,
             "prio_req": self.m_prio_req,
+            "pdb_blocked": self.m_pdb_blocked,
+            "cost_milli": self.m_cost,
+            "accel_class": self.m_accel,
+            "energy_milli": self.m_energy,
         }[field]
 
     def expected_row_aggregates(self, row: int) -> Dict[str, np.ndarray]:
@@ -1506,6 +1612,10 @@ class SnapshotEncoder:
             avoid=self.m_avoid,
             prio_req=self.m_prio_req,
             band_prio=self.m_band_prio,
+            pdb_blocked=self.m_pdb_blocked,
+            cost_milli=self.m_cost,
+            accel_class=self.m_accel,
+            energy_milli=self.m_energy,
         )
 
     def flush(self, donate: bool = True) -> DeviceSnapshot:

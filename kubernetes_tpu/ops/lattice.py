@@ -72,7 +72,12 @@ SC_PREFER_AVOID = 7
 SC_TOPO_SPREAD = 8
 SC_INTERPOD = 9
 SC_SELECTOR_SPREAD = 10  # DefaultPodTopologySpread (same-service pod count)
-NUM_SCORE_COMPONENTS = 11
+# heterogeneity/cost components (encoding's per-node column family):
+# normalized-inverted within the feasible set, so a cheaper / lower-energy
+# node scores higher; an unlabeled (all-zero) cluster scores flat
+SC_COST = 11  # cost-per-hour (snap.cost_milli)
+SC_ENERGY = 12  # energy proxy (snap.energy_milli)
+NUM_SCORE_COMPONENTS = 13
 
 # Default profile weights: all 1 except NodePreferAvoidPods=10000
 # (algorithmprovider/registry.go:61-131).
@@ -81,6 +86,59 @@ DEFAULT_WEIGHTS[SC_PREFER_AVOID] = 10000.0
 # MostAllocated / RequestedToCapacityRatio are not in the default profile.
 DEFAULT_WEIGHTS[SC_MOST_ALLOC] = 0.0
 DEFAULT_WEIGHTS[SC_REQ_TO_CAP] = 0.0
+# cost/energy are policy opt-ins, never part of the reference default
+DEFAULT_WEIGHTS[SC_COST] = 0.0
+DEFAULT_WEIGHTS[SC_ENERGY] = 0.0
+
+
+def _profile(**overrides) -> np.ndarray:
+    w = DEFAULT_WEIGHTS.copy()
+    for name, val in overrides.items():
+        w[globals()[name]] = val
+    return w
+
+
+# Named score policies: pluggable score matrices selected by a RUNTIME
+# weight vector (a kernel input, not a compile-time constant — swapping
+# policies never recompiles). `Scheduler.set_score_policy` accepts a name
+# here or a raw [NUM_SCORE_COMPONENTS] vector; the ROADMAP-5 policy gym
+# tunes these same vectors online.
+WEIGHT_PROFILES = {
+    "default": DEFAULT_WEIGHTS.copy(),
+    # bin-pack: fill the fullest feasible node first
+    "pack": _profile(SC_LEAST_ALLOC=0.0, SC_MOST_ALLOC=1.0),
+    # spread: the default profile's LeastAllocated already spreads; name it
+    "spread": DEFAULT_WEIGHTS.copy(),
+    # heterogeneity/cost: cheapest feasible node dominates, pack breaks ties
+    "cheapest": _profile(
+        SC_LEAST_ALLOC=0.0, SC_MOST_ALLOC=1.0, SC_COST=100.0
+    ),
+    # energy-aware: minimize the fleet energy proxy, pack breaks ties
+    "energy": _profile(
+        SC_LEAST_ALLOC=0.0, SC_MOST_ALLOC=1.0, SC_ENERGY=100.0
+    ),
+}
+
+
+def weights_for_policy(policy) -> np.ndarray:
+    """Resolve a policy name or raw vector into a weight vector. Unknown
+    names raise (a typo'd policy must fail loudly at config time, not
+    schedule with silently-default weights)."""
+    if isinstance(policy, str):
+        try:
+            return WEIGHT_PROFILES[policy].copy()
+        except KeyError:
+            raise ValueError(
+                f"unknown score policy {policy!r}; known: "
+                f"{sorted(WEIGHT_PROFILES)}"
+            ) from None
+    w = np.asarray(policy, np.float32)
+    if w.shape != (NUM_SCORE_COMPONENTS,):
+        raise ValueError(
+            f"score weight vector must have shape ({NUM_SCORE_COMPONENTS},), "
+            f"got {w.shape}"
+        )
+    return w.copy()
 
 IMG_MIN_THRESHOLD = 23.0 * 1024 * 1024  # imagelocality minThreshold
 IMG_MAX_THRESHOLD = 1000.0 * 1024 * 1024
@@ -459,6 +517,10 @@ def make_schedule_batch_raw(v_cap: int, hard_pod_affinity_weight: float = 1.0):
                 norm_invert(spread_penalty),
                 ip_norm,
                 norm_invert(svc_cnt),
+                # heterogeneity/cost columns: cheaper / lower-energy nodes
+                # score higher within the feasible set
+                norm_invert(snap.cost_milli.astype(jnp.float32)),
+                norm_invert(snap.energy_milli.astype(jnp.float32)),
             ]
         )  # [K, N]
         total_score = jnp.sum(comps * weights[:, None], axis=0)

@@ -377,6 +377,14 @@ def make_wave_kernel(
             axis=-1,
         )  # [TPL, N]
 
+        # heterogeneity/cost columns are per-node; broadcast over templates
+        # so the same norm_invert (per-template over feasible) applies
+        cost_col = jnp.broadcast_to(
+            snap.cost_milli.astype(jnp.float32)[None, :], least.shape
+        )
+        energy_col = jnp.broadcast_to(
+            snap.energy_milli.astype(jnp.float32)[None, :], least.shape
+        )
         comps = jnp.stack(
             [
                 least,
@@ -390,6 +398,8 @@ def make_wave_kernel(
                 norm_invert(spread_pen0, feasible0),
                 ip_norm,
                 norm_invert(svc_cnt, feasible0),
+                norm_invert(cost_col, feasible0),
+                norm_invert(energy_col, feasible0),
             ]
         )  # [K, TPL, N]
         total_score = jnp.einsum("k,ktn->tn", weights, comps)

@@ -111,4 +111,8 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         avoid=row2,
         prio_req=row3,
         band_prio=rep,
+        pdb_blocked=row2,
+        cost_milli=row,
+        accel_class=row,
+        energy_milli=row,
     )

@@ -828,3 +828,231 @@ def run_serving_benchmark(
                 os.unlink(path)
             except OSError:
                 pass
+
+
+@dataclass
+class PreemptionBenchResult:
+    """The `preemption` bench workload: a high-priority burst over a FULL
+    cluster — every placement requires displacing lower-priority victims.
+    The acceptance shape (ISSUE 15): victims resolve through the batched
+    vectorized pass (select_batches stays per-wave, not per-pod; zero
+    full host walks on the happy path)."""
+
+    num_nodes: int
+    burst_pods: int
+    scheduled: int
+    time_to_all_bound_s: float
+    victims_evicted: int
+    select_batches: int  # batched preempt_select launches (per-wave)
+    vector_attempts: int  # preemption attempts served by the batched pass
+    host_walk_fallbacks: int  # full per-pod host walks (happy path: 0)
+    guard_trips: int
+    oracle_divergences: int
+    select_p50_ms: float
+    select_p99_ms: float
+
+
+def run_preemption_benchmark(
+    n_nodes: int = 1000,
+    burst: int = 1000,
+    timeout_s: float = 600.0,
+) -> PreemptionBenchResult:
+    """1k-pending high-priority burst over a full 1k-node cluster: every
+    node carries 4x 1-cpu priority-0 pods (pre-bound, store-acked), the
+    burst pods need 2 cpu each at priority 100 — nothing places without
+    victim selection. Reports time-to-all-bound plus the engine's
+    batched-pass accounting."""
+    from ..api import objects as v1
+
+    metrics.reset()
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    for i in range(n_nodes):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=f"pn{i}", namespace=""),
+                status=v1.NodeStatus(
+                    allocatable={"cpu": "4", "memory": "32Gi", "pods": 110}
+                ),
+            ),
+        )
+    # the resident victims arrive PRE-BOUND (store-acked like the
+    # throughput harness): the bench measures displacement, not the
+    # initial fill
+    for i in range(n_nodes):
+        for k in range(4):
+            p = Pod(
+                metadata=v1.ObjectMeta(name=f"low-{i}-{k}"),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "1"})],
+                    priority=0,
+                    node_name=f"pn{i}",
+                ),
+            )
+            server.create("pods", p)
+    sched.start()
+    try:
+        for i in range(burst):
+            server.create(
+                "pods",
+                Pod(
+                    metadata=v1.ObjectMeta(name=f"hi-{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "2"})],
+                        priority=100,
+                    ),
+                ),
+            )
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        bound = 0
+        while time.monotonic() < deadline:
+            pods, _ = server.list("pods")
+            bound = sum(
+                1
+                for p in pods
+                if p.metadata.name.startswith("hi-") and p.spec.node_name
+            )
+            if bound >= burst:
+                break
+            time.sleep(0.25)
+        elapsed = time.monotonic() - t0
+    finally:
+        sched.stop()
+
+    def _count(name, label_filter=None):
+        return int(
+            sum(
+                v
+                for _n, labels, v in metrics.snapshot_counters(name)
+                if label_filter is None or label_filter(labels)
+            )
+        )
+
+    sel_h = metrics.histogram("scheduler_preemption_select_duration_seconds")
+    p50, p99 = sel_h.quantiles((0.5, 0.99)) if sel_h else (0.0, 0.0)
+    return PreemptionBenchResult(
+        num_nodes=n_nodes,
+        burst_pods=burst,
+        scheduled=bound,
+        time_to_all_bound_s=elapsed,
+        victims_evicted=_count("preemption_victims_total"),
+        select_batches=_count("scheduler_preemption_batches_total"),
+        vector_attempts=_count("scheduler_preemption_vector_hits_total"),
+        # only the reasons that actually run a full host walk count —
+        # batch_saturated is a skip (no walk), retried next wave
+        host_walk_fallbacks=_count(
+            "scheduler_preemption_fallback_total",
+            lambda labels: labels.get("reason")
+            in ("oracle_reject", "kernel_error", "group_overflow"),
+        ),
+        guard_trips=_count("scheduler_preemption_guard_trips_total"),
+        oracle_divergences=_count(
+            "scheduler_preemption_oracle_divergence_total"
+        ),
+        select_p50_ms=p50 * 1e3,
+        select_p99_ms=p99 * 1e3,
+    )
+
+
+@dataclass
+class HeteroBenchResult:
+    """The `hetero` bench workload: one pending burst autoscaled twice —
+    cheapest-feasible-shape packing vs cost-blind MostAllocated — on the
+    mixed-cost catalog. Equal feasibility (same pods bound), strictly
+    cheaper fleet is the acceptance bar."""
+
+    num_pods: int
+    num_shapes: int
+    cost_aware_scheduled: int
+    cost_aware_nodes: Dict[str, int]
+    cost_aware_fleet_per_hour: float
+    cost_aware_time_s: float
+    blind_scheduled: int
+    blind_nodes: Dict[str, int]
+    blind_fleet_per_hour: float
+    blind_time_s: float
+
+    @property
+    def strictly_cheaper(self) -> bool:
+        return (
+            self.cost_aware_scheduled >= self.blind_scheduled
+            and self.cost_aware_fleet_per_hour < self.blind_fleet_per_hour
+        )
+
+
+def run_hetero_benchmark(
+    n_pods: int = 300, timeout_s: float = 300.0, period_s: float = 0.5
+) -> HeteroBenchResult:
+    """Run the same pending burst through the autoscaler twice on the
+    mixed-cost catalog (perf/workloads.hetero_candidate_shapes):
+    cost_aware=True (cheapest-feasible-shape) vs cost_aware=False (pure
+    MostAllocated pack, the pre-ISSUE-15 behavior)."""
+    from ..api import objects as v1
+    from ..autoscaler import ClusterAutoscaler, NodeGroupCatalog
+    from .workloads import hetero_candidate_shapes
+
+    def one_arm(cost_aware: bool):
+        metrics.reset()
+        server = APIServer()
+        sched = Scheduler(server, KubeSchedulerConfiguration())
+        groups = hetero_candidate_shapes()
+        auto = ClusterAutoscaler(
+            server,
+            sched,
+            NodeGroupCatalog(groups),
+            period_s=period_s,
+            scale_down_enabled=False,
+            cost_aware=cost_aware,
+        )
+        for i in range(n_pods):
+            server.create(
+                "pods",
+                Pod(
+                    metadata=v1.ObjectMeta(name=f"h-{i}"),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(requests={"cpu": "1"})]
+                    ),
+                ),
+            )
+        sched.start()
+        t0 = time.monotonic()
+        auto.start()
+        try:
+            deadline = time.monotonic() + timeout_s
+            scheduled = 0
+            while time.monotonic() < deadline:
+                scheduled = _count_scheduled(server)
+                if scheduled >= n_pods:
+                    break
+                time.sleep(0.1)
+            elapsed = time.monotonic() - t0
+        finally:
+            auto.stop()
+            sched.stop()
+        nodes, _ = server.list("nodes")
+        catalog = NodeGroupCatalog(groups)
+        by_group: Dict[str, int] = {}
+        fleet = 0.0
+        for n in nodes:
+            g = catalog.group_of_node(n)
+            if g is not None:
+                by_group[g.name] = by_group.get(g.name, 0) + 1
+                fleet += g.cost_per_hour()
+        return scheduled, by_group, round(fleet, 3), elapsed
+
+    aware = one_arm(True)
+    blind = one_arm(False)
+    return HeteroBenchResult(
+        num_pods=n_pods,
+        num_shapes=len(hetero_candidate_shapes()),
+        cost_aware_scheduled=aware[0],
+        cost_aware_nodes=aware[1],
+        cost_aware_fleet_per_hour=aware[2],
+        cost_aware_time_s=round(aware[3], 3),
+        blind_scheduled=blind[0],
+        blind_nodes=blind[1],
+        blind_fleet_per_hour=blind[2],
+        blind_time_s=round(blind[3], 3),
+    )

@@ -271,6 +271,52 @@ def autoscaler_candidate_shapes():
     ]
 
 
+def hetero_candidate_shapes():
+    """The mixed-cost fleet of the `hetero` bench workload (bench.py):
+    two shape PAIRS where each pair is equally feasible for the pending
+    pods but priced very differently (the heterogeneity-column labels) —
+    so cheapest-feasible-shape packing is separable from capacity
+    effects. Catalog order puts the expensive shape first: a
+    cost-blind MostAllocated planner has no reason to prefer the cheap
+    twin."""
+    from ..autoscaler import NodeGroup, machine_shape
+
+    return [
+        NodeGroup(
+            name="premium8",
+            template=machine_shape(
+                cpu="8", memory="32Gi", cost_per_hour=8.0,
+                accelerator_class="tpu-v5p", energy_watts=700.0,
+            ),
+            max_size=48,
+        ),
+        NodeGroup(
+            name="spot8",
+            template=machine_shape(
+                cpu="8", memory="32Gi", cost_per_hour=1.6,
+                accelerator_class="tpu-v5e", energy_watts=300.0,
+            ),
+            max_size=48,
+        ),
+        NodeGroup(
+            name="premium16",
+            template=machine_shape(
+                cpu="16", memory="64Gi", cost_per_hour=15.0,
+                accelerator_class="tpu-v5p", energy_watts=1300.0,
+            ),
+            max_size=24,
+        ),
+        NodeGroup(
+            name="spot16",
+            template=machine_shape(
+                cpu="16", memory="64Gi", cost_per_hour=3.1,
+                accelerator_class="tpu-v5e", energy_watts=550.0,
+            ),
+            max_size=24,
+        ),
+    ]
+
+
 WORKLOADS: Dict[str, WorkloadConfig] = {
     "SchedulingBasic/500": WorkloadConfig("SchedulingBasic", 500, 250, 1000),
     "SchedulingBasic/5000": WorkloadConfig("SchedulingBasic", 5000, 1000, 5000),

@@ -120,6 +120,12 @@ class CacheDebugger:
                 "read) state:"
             )
             lines.extend(serving)
+        from ..preemption import preemption_health_lines
+
+        preempt = preemption_health_lines()
+        if preempt:
+            lines.append("Dump of priority/preemption engine state:")
+            lines.extend(preempt)
         from ..ha import ha_health_lines
 
         ha = ha_health_lines()

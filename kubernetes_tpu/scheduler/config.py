@@ -155,6 +155,24 @@ class KubeSchedulerConfiguration:
     # must not retry forever)
     device_retry_attempts: int = 2
     device_loss_disable_after: int = 3
+    # --- priority & preemption (ops/preemptlattice.py) ----------------------
+    # named score policy (ops/lattice.WEIGHT_PROFILES: "default", "pack",
+    # "cheapest", "energy") or "" = derive weights from the profile's
+    # score-plugin set. Policies are runtime weight VECTORS (a kernel
+    # input), swappable live via Scheduler.set_score_policy.
+    score_policy: str = ""
+    # vectorized victim selection: one batched device pass ranks candidate
+    # (node, victim-band) choices for a whole wave of unschedulable pods;
+    # the host oracle (Preemptor._select_victims_on_node) still validates
+    # the chosen node and selects the EXACT victim set before any
+    # eviction. False = the per-pod host scan only (the pre-ISSUE-15 path)
+    vector_preemption: bool = True
+    # unschedulable pods per batch whose vector choice is ALSO checked
+    # against the full host-path Preemptor scan (the sampled differential
+    # oracle; a divergence beyond the documented tie-breaks counts in
+    # scheduler_preemption_oracle_divergence_total and the oracle's answer
+    # wins). 0 disables sampling (the per-node exact check stays on)
+    preempt_verify_sample: int = 2
 
     def validate(self) -> None:
         if self.percentage_of_nodes_to_score < 0 or self.percentage_of_nodes_to_score > 100:
@@ -186,5 +204,11 @@ class KubeSchedulerConfiguration:
             raise ValueError("device_retry_attempts must be >= 0")
         if self.device_loss_disable_after < 1:
             raise ValueError("device_loss_disable_after must be >= 1")
+        if self.preempt_verify_sample < 0:
+            raise ValueError("preempt_verify_sample must be >= 0")
+        if self.score_policy:
+            from ..ops.lattice import weights_for_policy
+
+            weights_for_policy(self.score_policy)  # raises on unknown names
         if self.leader_election is not None:
             self.leader_election.validate()

@@ -206,6 +206,24 @@ def pod_eligible_to_preempt_others(pod: v1.Pod, snapshot: Snapshot) -> bool:
     return True
 
 
+def preemption_health_lines() -> List[str]:
+    """The priority/preemption engine's counters/gauges (batched victim-
+    selection passes, vector hits vs host fallbacks, guard trips, sampled
+    oracle divergences, legacy preemption_* counters) rendered for the
+    SIGUSR2 dump: whether the engine is on the vector happy path or
+    degraded to the host walk is diagnosable from one signal. Empty until
+    the first preemption attempt publishes a series."""
+    from ..utils.metrics import metrics
+
+    lines: List[str] = []
+    for prefix in ("scheduler_preemption_", "preemption_"):
+        for name, labels, value in metrics.snapshot_counters(prefix):
+            lines.append(metrics.format_series_line(name, labels, value))
+        for name, labels, value in metrics.snapshot_gauges(prefix):
+            lines.append(metrics.format_series_line(name, labels, value))
+    return lines
+
+
 def pick_one_node_for_preemption(
     victims_by_node: Dict[str, List[v1.Pod]],
     snapshot: Snapshot,

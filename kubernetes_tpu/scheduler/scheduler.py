@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..api import objects as v1
@@ -42,6 +43,7 @@ from ..controller.volume_scheduling import VolumeBinder
 from ..api.objects import Binding
 from ..ops.batch import encode_pod_batch
 from ..ops.encoding import ETERM_ANTI_REQ as _ETERM_ANTI_REQ
+from ..ops.preemptlattice import validate_preempt_outputs
 from ..ops.templates import TemplateCache, build_pair_table
 from ..ops.wavelattice import make_wave_kernel_jit
 from ..ops.lattice import (
@@ -60,6 +62,7 @@ from ..ops.lattice import (
     SC_TOPO_SPREAD,
     make_schedule_batch,
     validate_batch_outputs,
+    weights_for_policy,
 )
 from ..parallel.sharded import (
     call_with_device_retry,
@@ -297,6 +300,9 @@ class Scheduler:
             )
             for name, p in self.profiles.items()
         }
+        # one home for the PDB read both the vectorized engine (budget
+        # column refresh) and the divergence key share with the Preemptors
+        self._list_pdbs = list_pdbs
         self._bind_pool = ThreadPoolExecutor(
             max_workers=self.cfg.bind_workers, thread_name_prefix="binder"
         )
@@ -404,6 +410,11 @@ class Scheduler:
         ]
 
     def _build_weights(self) -> np.ndarray:
+        # an explicit score policy (name or raw vector) overrides the
+        # profile-derived weights wholesale: policies ARE weight vectors
+        # (ops/lattice.WEIGHT_PROFILES), a kernel input — never a recompile
+        if self.cfg.score_policy:
+            return weights_for_policy(self.cfg.score_policy)
         w = np.zeros(NUM_SCORE_COMPONENTS, np.float32)
         default = next(iter(self.profiles.values()))
         for name, weight in default.framework.plugin_set.score:
@@ -411,6 +422,16 @@ class Scheduler:
             if idx is not None:
                 w[idx] = weight
         return w
+
+    def set_score_policy(self, policy) -> None:
+        """Swap the live score policy at runtime: `policy` is a name from
+        ops/lattice.WEIGHT_PROFILES or a raw [NUM_SCORE_COMPONENTS]
+        vector. The weight vector is a per-launch kernel INPUT, so the
+        swap takes effect on the next wave with zero recompilation —
+        the seam the ROADMAP-5 policy gym promotes tuned vectors through.
+        In-flight waves keep the vector they launched with."""
+        self._weights = weights_for_policy(policy)
+        metrics.inc("scheduler_score_policy_swaps_total")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -2027,11 +2048,21 @@ class Scheduler:
         resolvable_tpl = jax.device_get(res.resolvable_tpl)
         pod_tpl = eb.pod_tpl_np
         pod_prio = eb.pod_prio_np
-        # batched masked what-if (one device call for ALL failed pods):
-        # per-template optimistic preemption mask, priority = max over
-        # the batch's pods of that template so the mask stays a superset
-        # for every pod; the host reprieve loop is the exact check
-        whatif_tpl = self._preempt_whatif_tpl(eb, failed, pod_tpl)
+        # vectorized victim selection (ops/preemptlattice): ONE batched
+        # pass over a (template, priority)-grouped gather of the batch
+        # ranks candidate nodes and minimal victim-band prefixes for
+        # every failed pod; the per-pod host work below shrinks to the
+        # exact oracle check on the chosen node. None (disabled / guard
+        # trip / kernel error) falls back to the optimistic what-if mask
+        # + the per-pod host walk — the pre-ISSUE-15 path.
+        vec = self._vector_preempt_batch(eb, failed, pod_tpl, pod_prio)
+        whatif_tpl = None
+        if vec is None:
+            # batched masked what-if (one device call for ALL failed
+            # pods): per-template optimistic preemption mask, priority =
+            # max over the batch's pods of that template so the mask
+            # stays a superset for every pod
+            whatif_tpl = self._preempt_whatif_tpl(eb, failed, pod_tpl)
         # (template, priority) groups whose scan on the CURRENT snapshot
         # found no viable node: siblings share the spec, so their scans
         # are provably identical — skip them. A successful preemption
@@ -2039,20 +2070,117 @@ class Scheduler:
         # groups: clear the memo.
         hopeless: set = set()
         scans = 0
+        verified = 0
+        # in-batch fan-out: a wave's failed pods are overwhelmingly
+        # sibling specs, and within one batch `self._snapshot` is stale —
+        # victims already claimed by an earlier sibling still look
+        # evictable, so without this every sibling would nominate the
+        # SAME node and the batch would free exactly one node per wave
+        # (measured: 89/1000 burst pods bound in 25 min). `targeted`
+        # tracks nodes whose victims this batch already claimed; each
+        # sibling consumes the next untargeted candidate from its group's
+        # kernel ranking, so a 1k-pod burst nominates ~1k DISTINCT nodes
+        # in one batched pass.
+        targeted: set = set()
+        group_cands: Dict[tuple, List[str]] = {}
+        # the wave's resolvable masks live in the LAUNCH row space; the
+        # preempt kernel ran on the post-flush one. Intersecting the two
+        # is only meaningful when no churn remapped rows in between —
+        # otherwise the helpful mask must not narrow the (oracle-
+        # validated) fallback candidate list against the wrong nodes.
+        vec_same_rows = (
+            vec is not None
+            and vec["row_names"][: len(row_names)] == list(row_names)
+        )
         for pi, i in failed:
             t = int(pod_tpl[i])
+            group = (t, int(pod_prio[i]))
             rows_mask = resolvable_tpl[t]
-            if (
+            vector_choice = None
+            saturated = False
+            if vec is not None:
+                g = vec["group_of"].get(group)
+                helpful = vec["helpful"]
+                # vec_names is the row space the preempt kernel actually
+                # ran on (captured under the lock WITH its flush) — the
+                # wave-launch row_names may be stale if informer churn
+                # remapped rows while the wave was in flight
+                vec_names = vec["row_names"]
+                if (
+                    g is not None
+                    and vec_same_rows
+                    and helpful.shape[1] == rows_mask.shape[0]
+                ):
+                    rows_mask = rows_mask & helpful[g]
+                if g is not None and int(vec["node"][g]) >= 0:
+                    if group not in group_cands:
+                        # the group's full candidate ranking: the kernel's
+                        # top-K rows first, then every other helpful row
+                        # in row order — the fan-out tail for groups with
+                        # more siblings than K
+                        ranked = [
+                            int(r)
+                            for r in vec["cand"][g]
+                            if 0 <= int(r) < len(vec_names)
+                            and vec_names[int(r)]
+                        ]
+                        seen = set(ranked)
+                        tail = [
+                            int(r)
+                            for r in np.nonzero(helpful[g])[0]
+                            if int(r) < len(vec_names)
+                            and vec_names[int(r)]
+                            and int(r) not in seen
+                        ]
+                        group_cands[group] = [
+                            vec_names[r] for r in ranked + tail
+                        ]
+                    avail = [
+                        n for n in group_cands[group] if n not in targeted
+                    ]
+                    if avail:
+                        # the oracle's exact selection runs on just these
+                        # (≤K) untargeted rows instead of every
+                        # resolvable node
+                        vector_choice = avail[: len(vec["cand"][g])]
+                    else:
+                        # every node this group's eviction could free is
+                        # already claimed by an earlier sibling: skip this
+                        # round — the pod retries next wave against a
+                        # snapshot that reflects the evictions
+                        saturated = True
+                        metrics.inc(
+                            "scheduler_preemption_fallback_total",
+                            {"reason": "batch_saturated"},
+                        )
+            elif (
                 whatif_tpl is not None
                 and whatif_tpl.shape[1] == rows_mask.shape[0]
             ):
                 rows_mask = rows_mask & whatif_tpl[t]
             rows = np.nonzero(rows_mask)[0]
-            candidates = [row_names[r] for r in rows if row_names[r]]
-            group = (t, int(pod_prio[i]))
-            scan_would_run = bool(candidates)
-            skip = scan_would_run and (
-                group in hopeless or scans >= self._MAX_PREEMPT_SCANS_PER_BATCH
+            candidates = [
+                row_names[r]
+                for r in rows
+                if row_names[r] and row_names[r] not in targeted
+            ]
+            # an attempt with a vector choice costs an exact check on ≤K
+            # nodes; a full host scan runs only on fallback (no vector
+            # answer) or for the sampled differential oracle below. The
+            # hopeless memo covers both: siblings of a rejected group
+            # would re-fail identically on the unchanged snapshot.
+            attempt_would_run = bool(candidates) or vector_choice is not None
+            skip = saturated or (
+                attempt_would_run
+                and (
+                    group in hopeless
+                    or scans >= self._MAX_PREEMPT_SCANS_PER_BATCH
+                )
+            )
+            verify_full = (
+                vector_choice is not None
+                and not skip
+                and verified < self.cfg.preempt_verify_sample
             )
             preempted = self._handle_failure(
                 pi,
@@ -2060,9 +2188,16 @@ class Scheduler:
                 message=f"0/{self.cache.node_count} nodes are available",
                 candidate_nodes=candidates,
                 skip_preemption=skip,
+                vector_choice=vector_choice,
+                verify_full=verify_full,
             )
-            if scan_would_run and not skip:
-                scans += 1
+            if verify_full:
+                verified += 1
+            if preempted:
+                targeted.add(preempted)
+            if attempt_would_run and not skip:
+                if vector_choice is None or verify_full:
+                    scans += 1  # bound the expensive full walks only
                 if preempted:
                     hopeless.clear()
                 else:
@@ -2317,6 +2452,144 @@ class Scheduler:
         from ..parallel.mesh import _default_probe
 
         return _default_probe(device)
+
+    # pad buckets for the (template, priority)-grouped preemption batch:
+    # every distinct pad is a kernel compile, and failed-group counts are
+    # small (distinct specs x priority tiers, not pods)
+    _PREEMPT_PAD_BUCKETS = (16, 128)
+
+    def _run_preempt_kernel(self, snap, batch, prios: np.ndarray) -> dict:
+        """Launch + readback of the vectorized victim-selection kernel —
+        one synchronous call, split out as an injectable seam for the
+        differential tests' seeded-disagreement corruption (mirrors
+        _run_serial_kernel)."""
+        from ..ops.preemptlattice import preempt_select
+
+        res = preempt_select(snap, batch, np.asarray(prios, np.int32))
+        node, cand, thr, vic, viol, helpful = jax.device_get(
+            (res.node, res.cand, res.threshold_prio, res.victims,
+             res.violations, res.helpful)
+        )
+        return {
+            "node": np.asarray(node),
+            "cand": np.asarray(cand),
+            "threshold": np.asarray(thr),
+            "victims": np.asarray(vic),
+            "violations": np.asarray(viol),
+            "helpful": np.asarray(helpful),
+        }
+
+    def _vector_preempt_batch(
+        self, eb, failed: List, pod_tpl: np.ndarray, pod_prio: np.ndarray
+    ) -> Optional[dict]:
+        """ONE batched victim-selection pass for a resolved wave's failed
+        pods (ops/preemptlattice.preempt_select): failed pods group by
+        (template, priority) — siblings share the whole answer — the
+        template tensors gather into a [G]-row PodBatch, and the kernel
+        ranks (node, minimal victim-band prefix) per group against a
+        freshly-flushed snapshot whose PDB budget column was just
+        refreshed from the disruption controller's published budgets.
+        Readback passes through validate_preempt_outputs (the kernel-
+        output guard discipline) — a trip, a kernel error, or the config
+        gate returns None and the caller falls back to the host walk;
+        nothing is ever evicted from this result without the per-node
+        host-oracle check in _attempt_preemption."""
+        if (
+            not self.cfg.vector_preemption
+            or self.cfg.disable_preemption
+            or self._device_down
+            or not self.cfg.use_device
+        ):
+            return None
+        try:
+            groups: Dict[tuple, int] = {}
+            t_idx: List[int] = []
+            g_prio: List[int] = []
+            for pi, i in failed:
+                if i < 0:
+                    continue  # decode anomaly: host walk handles it
+                key = (int(pod_tpl[i]), int(pod_prio[i]))
+                if key not in groups:
+                    groups[key] = len(t_idx)
+                    t_idx.append(key[0])
+                    g_prio.append(key[1])
+            if not groups:
+                return None
+            pad = self._PREEMPT_PAD_BUCKETS[-1]
+            for b in self._PREEMPT_PAD_BUCKETS:
+                if len(t_idx) <= b:
+                    pad = b
+                    break
+            if len(t_idx) > pad:
+                # more distinct groups than the widest bucket: the tail
+                # falls back to the host walk (counted, never silent)
+                metrics.inc(
+                    "scheduler_preemption_fallback_total",
+                    {"reason": "group_overflow"},
+                )
+                t_idx, g_prio = t_idx[:pad], g_prio[:pad]
+                groups = {k: g for k, g in groups.items() if g < pad}
+            idx = np.zeros(pad, np.int32)
+            idx[: len(t_idx)] = t_idx
+            prios = np.zeros(pad, np.int32)
+            prios[: len(g_prio)] = g_prio
+            # the PDB list can be a store round-trip (REST-backed server):
+            # never hold the cache lock across it
+            pdbs = list(self._list_pdbs()) if self._list_pdbs else []
+            with self.cache.lock:
+                # _finish_batch drains the pipeline before failure
+                # handling, so no newer batch's un-replayed device commits
+                # can be erased by this flush
+                assert not self._pending
+                self.cache.encoder.update_pdb_blocked(pdbs)
+                snap = self.cache.encoder.flush()
+                # decode rows against the SAME row space the kernel ran
+                # on: informer churn during the in-flight wave can remap
+                # encoder rows, so the wave-launch row_names must never
+                # decode this pass's output (the serial-path re-encode
+                # discipline, PR-4 second review)
+                vec_row_names = list(self.cache.encoder.row_names)
+                n_rows = len(vec_row_names)
+            gathered = jax.tree.map(
+                lambda a: jnp.take(a, idx, axis=0), eb.batch.tpl
+            )
+            gathered = gathered._replace(
+                valid=gathered.valid & (jnp.arange(pad) < len(t_idx))
+            )
+            t0 = time.monotonic()
+            vec = self._run_preempt_kernel(snap, gathered, prios)
+            dt = time.monotonic() - t0
+            metrics.inc("scheduler_preemption_batches_total")
+            metrics.observe("scheduler_preemption_select_duration_seconds", dt)
+            metrics.set_gauge(
+                "scheduler_preemption_last_select_ms", round(dt * 1e3, 3)
+            )
+            reason = validate_preempt_outputs(
+                vec["node"], vec["victims"], n_rows, cand=vec["cand"]
+            )
+            if reason:
+                metrics.inc(
+                    "scheduler_preemption_guard_trips_total",
+                    {"reason": reason},
+                )
+                logger.error(
+                    "preemption kernel output guard tripped (%s): victim "
+                    "selection for this batch degrades to the host walk",
+                    reason,
+                )
+                return None
+            vec["group_of"] = groups
+            vec["row_names"] = vec_row_names
+            return vec
+        except Exception:
+            logger.exception(
+                "vectorized victim selection failed; host walk"
+            )
+            metrics.inc(
+                "scheduler_preemption_fallback_total",
+                {"reason": "kernel_error"},
+            )
+            return None
 
     def _preempt_whatif_tpl(self, eb, failed: List, pod_tpl: np.ndarray):
         """[TPL, N] optimistic preemption mask for the batch's templates
@@ -2723,8 +2996,13 @@ class Scheduler:
         candidate_nodes: Optional[List[str]] = None,
         error: bool = False,
         skip_preemption: bool = False,
-    ) -> bool:
-        """Returns True iff a preemption was performed (cluster mutated)."""
+        vector_choice: Optional[List[str]] = None,
+        verify_full: bool = False,
+    ) -> str:
+        """Returns the nominated node name when a preemption was
+        performed (cluster mutated), else '' — callers that only care
+        whether the cluster changed use it as a bool; _finish_batch's
+        fan-out also needs WHICH node to mark targeted."""
         pod = pi.pod
         prof = self.profiles.for_pod(pod)
         tracer.event(
@@ -2749,15 +3027,20 @@ class Scheduler:
                 except Exception:
                     logger.exception("permit failure hook %s", name)
         self._set_pod_unschedulable_condition(pod, message)
-        preempted = False
+        preempted = ""
         if not error and not self.cfg.disable_preemption and not skip_preemption:
             try:
-                preempted = bool(
-                    self._attempt_preemption(pod, prof, fit_error, candidate_nodes)
+                preempted = self._attempt_preemption(
+                    pod, prof, fit_error, candidate_nodes,
+                    vector_choice=vector_choice,
+                    verify_full=verify_full,
                 )
             except (DegradedWrites, NotPrimary):
                 # degraded store: victim deletes / nominations can't land;
-                # the pod requeues and preemption retries after recovery
+                # the pod requeues and preemption retries after recovery —
+                # the skip stamps the pod's OWN trace id so a preemption-
+                # delayed pod's waterfall shows where the time went
+                tracer.event(pi.trace_id, "preempt.degraded_skip")
                 metrics.inc(
                     "scheduler_degraded_write_skips_total",
                     {"write": "preemption"},
@@ -2809,60 +3092,165 @@ class Scheduler:
                 "scheduler_degraded_write_skips_total", {"write": "condition"}
             )
 
+    def _preempt_choice_cooptimal(
+        self, victims: List, ovictims: List
+    ) -> bool:
+        """Documented tie-break check for the sampled differential
+        oracle: the vector engine's choice counts as AGREEING with the
+        full host walk when the two exact victim sets tie on
+        pickOneNodeForPreemption criteria 1-4 (PDB violations, max
+        victim priority, priority sum, victim count) — the engine breaks
+        such ties by row order where the oracle uses start time / name
+        order, and the band-prefix ranking may legitimately land on a
+        co-optimal node. Anything beyond that is a real divergence."""
+        from .preemption import filter_pods_with_pdb_violation
+
+        pdbs = list(self._list_pdbs()) if self._list_pdbs else []
+
+        def key(vs):
+            violating, _ = filter_pods_with_pdb_violation(list(vs), pdbs)
+            return (
+                len(violating),
+                max((v.priority for v in vs), default=-(2 ** 31)),
+                sum(v.priority for v in vs),
+                len(vs),
+            )
+
+        return key(victims) == key(ovictims)
+
     def _attempt_preemption(
-        self, pod, prof, fit_error, candidate_nodes: Optional[List[str]]
+        self,
+        pod,
+        prof,
+        fit_error,
+        candidate_nodes: Optional[List[str]],
+        vector_choice: Optional[List[str]] = None,
+        verify_full: bool = False,
     ) -> str:
         """sched.preempt (scheduler.go:392): find victims, delete them, set
-        NominatedNodeName. Returns the nominated node ('' if none)."""
+        NominatedNodeName. Returns the nominated node ('' if none).
+
+        vector_choice = the batched kernel pass's ranked candidate node
+        names (ops/preemptlattice top-K): the host oracle then runs its
+        EXACT selection (filters + reprieve + PDB countdown + the full
+        5-criterion node pick) on those K nodes instead of walking every
+        candidate — a fully-rejected candidate set is a counted
+        disagreement that falls back to the full walk, so a kernel
+        ranking error costs time, never a wrong eviction. verify_full
+        additionally runs the full walk and compares (the sampled
+        differential oracle); on divergence beyond the documented
+        tie-breaks the oracle's answer wins."""
         if self._snapshot is None:
             self._snapshot = self.cache.update_snapshot()
         preemptor = self._preemptors[prof.name]
-        # candidate_nodes semantics: None = unknown (scan per fit_error /
-        # all nodes); a list — possibly empty — is the device what-if's
-        # narrowed candidate set and is authoritative (empty = hopeless)
-        node, victims = preemptor.preempt(
-            pod, self._snapshot, fit_error, candidate_nodes
-        )
+        tid = tracer.trace_for_pod(pod.metadata.key)
+        node, victims = "", []
+        with tracer.span(tid, "preempt.select"):
+            if vector_choice is not None:
+                node, victims = preemptor.preempt(
+                    pod, self._snapshot, fit_error, vector_choice
+                )
+                if node:
+                    metrics.inc("scheduler_preemption_vector_hits_total")
+                else:
+                    # the exact oracle rejected the kernel's ranked
+                    # winner (reprieve/PDB refinement, or a seeded
+                    # disagreement in tests): host walk, zero evictions
+                    # from the rejected proposal
+                    metrics.inc(
+                        "scheduler_preemption_fallback_total",
+                        {"reason": "oracle_reject"},
+                    )
+            if verify_full or not node:
+                # candidate_nodes semantics: None = unknown (scan per
+                # fit_error / all nodes); a list — possibly empty — is the
+                # device pass's narrowed candidate set and is
+                # authoritative (empty = hopeless). The VERIFY walk (node
+                # already accepted) must see the same universe the engine
+                # drew from — candidate_nodes was intersected with the
+                # wave-launch resolvable mask, so a node the wave's own
+                # binds just filled can be in vector_choice but not
+                # candidates; comparing across different universes would
+                # count a legitimate pick as a divergence and discard it
+                verify_nodes = candidate_nodes
+                if node and candidate_nodes is not None:
+                    verify_nodes = sorted(
+                        set(candidate_nodes) | set(vector_choice or [])
+                    )
+                onode, ovictims = preemptor.preempt(
+                    pod, self._snapshot, fit_error, verify_nodes
+                )
+                if not node:
+                    node, victims = onode, ovictims
+                elif onode != node or (
+                    {v.metadata.key for v in ovictims}
+                    != {v.metadata.key for v in victims}
+                ):
+                    if not onode or not self._preempt_choice_cooptimal(
+                        victims, ovictims
+                    ):
+                        metrics.inc(
+                            "scheduler_preemption_oracle_divergence_total"
+                        )
+                        logger.warning(
+                            "vector preemption diverged from the host "
+                            "oracle for %s (vector %s, oracle %s): using "
+                            "the oracle's answer",
+                            pod.metadata.key, node, onode or "<none>",
+                        )
+                        node, victims = onode, ovictims
         if not node:
             return ""
-        for victim in victims:
-            try:
-                self.server.delete(
-                    "pods", victim.metadata.namespace, victim.metadata.name
-                )
-                prof.recorder.eventf(
-                    victim, "Normal", "Preempted", "Preempting",
-                    f"by {pod.metadata.key} on node {node}",
-                )
-                metrics.inc("preemption_victims_total")
-            except NotFound:
-                pass
-            except (DegradedWrites, NotPrimary):
-                # read-only store: abort the attempt (counted skip, the
-                # PR-3 discipline) — the preemptor pod stays pending and
-                # retries once writes reopen; pressing on would nominate
-                # a node whose victims were never actually evicted
-                metrics.inc(
-                    "scheduler_degraded_write_skips_total",
-                    {"write": "preempt_delete"},
-                )
-                return ""
+        # zombie-fence pre-check (the PR-10 _check_fence_live seam):
+        # victim deletes are plain store writes with no atomic fence
+        # validation, so a superseded leader re-reads the lease before
+        # evicting — the new leader's scheduler owns preemption now
+        try:
+            self._check_fence_live()
+        except LeaderFenced:
+            metrics.inc("scheduler_preemption_fenced_total")
+            return ""
+        with tracer.span(tid, "preempt.delete", victims=len(victims)):
+            for victim in victims:
+                try:
+                    self.server.delete(
+                        "pods", victim.metadata.namespace, victim.metadata.name
+                    )
+                    prof.recorder.eventf(
+                        victim, "Normal", "Preempted", "Preempting",
+                        f"by {pod.metadata.key} on node {node}",
+                    )
+                    metrics.inc("preemption_victims_total")
+                except NotFound:
+                    pass
+                except (DegradedWrites, NotPrimary):
+                    # read-only store: abort the attempt (counted skip, the
+                    # PR-3 discipline) — the preemptor pod stays pending and
+                    # retries once writes reopen; pressing on would nominate
+                    # a node whose victims were never actually evicted
+                    metrics.inc(
+                        "scheduler_degraded_write_skips_total",
+                        {"write": "preempt_delete"},
+                    )
+                    return ""
         metrics.inc("preemption_attempts_total")
 
         def mutate(p):
             p.status.nominated_node_name = node
             return p
 
-        try:
-            self.server.guaranteed_update(
-                "pods", pod.metadata.namespace, pod.metadata.name, mutate
-            )
-        except NotFound:
-            return node
-        except (DegradedWrites, NotPrimary):
-            metrics.inc(
-                "scheduler_degraded_write_skips_total", {"write": "nominate"}
-            )
-            return node  # victims are gone; the nomination is best-effort
-        self.queue.add_nominated_pod(pod, node)
+        with tracer.span(tid, "preempt.nominate"):
+            try:
+                self.server.guaranteed_update(
+                    "pods", pod.metadata.namespace, pod.metadata.name, mutate
+                )
+            except NotFound:
+                return node
+            except (DegradedWrites, NotPrimary):
+                metrics.inc(
+                    "scheduler_degraded_write_skips_total",
+                    {"write": "nominate"},
+                )
+                return node  # victims are gone; nomination is best-effort
+            self.queue.add_nominated_pod(pod, node)
         return node

@@ -34,6 +34,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_net.py",
     "tests/test_serving.py",
     "tests/test_chaos_serving.py",
+    "tests/test_chaos_preempt.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -121,6 +122,14 @@ DUMP_REQUIRED_FAMILIES = (
     "scheduler_bind_breaker",
     "node_lifecycle_",
     "autoscaler_",
+    # heterogeneity/cost shape economics (subset of autoscaler_, listed
+    # explicitly: the cheapest-feasible-shape acceptance metric must stay
+    # dumpable even if the broad family ever narrows)
+    "autoscaler_shape_cost_",
+    # the vectorized priority/preemption engine + the legacy preemption
+    # counters it extends
+    "scheduler_preemption_",
+    "preemption_",
     "watch_cache_",
     "apiserver_flowcontrol_",
     "informer_",

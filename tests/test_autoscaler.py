@@ -235,6 +235,100 @@ def test_plan_scale_up_picks_shape_that_fits():
     assert len(plan.nodes["big"]) == 1
 
 
+def test_cheapest_feasible_shape_beats_most_allocated_on_cost():
+    """ISSUE-15 hetero acceptance: with two equally-feasible shapes at
+    different cost-per-hour (the heterogeneity column family), the
+    cost-aware planner must provision ONLY the cheaper shape; the pure
+    MostAllocated planner (cost_aware=False, catalog ordered
+    expensive-first) lands a strictly more expensive fleet at equal
+    feasibility."""
+
+    def catalog():
+        return NodeGroupCatalog(
+            [
+                NodeGroup(
+                    name="pricey",
+                    template=machine_shape(
+                        cpu="8", cost_per_hour=9.5,
+                        accelerator_class="tpu-v5p",
+                        energy_watts=800.0,
+                    ),
+                    max_size=20,
+                ),
+                NodeGroup(
+                    name="cheap",
+                    template=machine_shape(
+                        cpu="8", cost_per_hour=0.8,
+                        accelerator_class="tpu-v5e",
+                        energy_watts=250.0,
+                    ),
+                    max_size=20,
+                ),
+            ]
+        )
+
+    def fleet_cost(plan, cat):
+        return sum(
+            cat.group(g).cost_per_hour() * len(names)
+            for g, names in plan.nodes.items()
+        )
+
+    pending = [make_pod(f"p{i}", cpu="2") for i in range(12)]
+    plans = {}
+    for aware in (True, False):
+        cache = SchedulerCache()
+        cache.add_node(make_node("seed-0", cpu="1"))  # nothing fits here
+        sim = WhatIfSimulator(cache, cost_aware=aware)
+        cat = catalog()
+        plans[aware] = (
+            plan_scale_up(
+                sim, cat, pending, {"pricey": 0, "cheap": 0}, {"seed-0"}
+            ),
+            cat,
+        )
+    aware_plan, aware_cat = plans[True]
+    blind_plan, blind_cat = plans[False]
+    assert aware_plan.placed == 12 and blind_plan.placed == 12
+    assert aware_plan.total_nodes == blind_plan.total_nodes  # equal feasibility
+    assert list(aware_plan.nodes) == ["cheap"]
+    assert fleet_cost(aware_plan, aware_cat) < fleet_cost(
+        blind_plan, blind_cat
+    )
+    # the shape-cost metric source reads the heterogeneity label
+    assert aware_cat.group("cheap").cost_per_hour() == 0.8
+    assert aware_cat.group("pricey").cost_per_hour() == 9.5
+
+
+def test_fleet_cost_gauge_sums_labeled_nodes():
+    """`autoscaler_shape_cost_fleet_per_hour` (run_once's per-pass gauge)
+    sums catalog prices over the cache's LIVE node set — pinning the
+    node_infos() iteration (a dict: values, not keys) and the
+    out-of-catalog-costs-zero rule."""
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.autoscaler.controller import GAUGE_SHAPE_COST_FLEET
+    from kubernetes_tpu.client.apiserver import APIServer
+    from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration())
+    groups = [
+        NodeGroup(
+            name="spot",
+            template=machine_shape(cpu="8", cost_per_hour=1.5),
+            max_size=4,
+        )
+    ]
+    auto = ClusterAutoscaler(
+        server, sched, NodeGroupCatalog(groups), scale_down_enabled=False
+    )
+    for i in range(3):
+        sched.cache.add_node(groups[0].make_node(f"spot-{i}"))
+    sched.cache.add_node(make_node("unlabeled"))  # out of catalog: $0
+    auto.run_once()
+    assert metrics.gauge(GAUGE_SHAPE_COST_FLEET) == pytest.approx(4.5)
+
+
 # -- drain simulation ---------------------------------------------------------
 
 

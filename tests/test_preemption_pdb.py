@@ -62,6 +62,64 @@ def test_filter_pods_with_pdb_violation_budget_countdown():
     assert [p.metadata.name for p in violating] == ["p1", "p2"]
 
 
+def test_filter_pods_with_pdb_violation_overlapping_budgets():
+    """A pod matching SEVERAL PDBs consumes budget from each in list
+    order, and one exhausted budget among its matches is enough to mark
+    it violating (the any() rule) — the reference's per-PDB countdown,
+    previously untested for the overlap case."""
+    pdb_a = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="a"),
+        spec=v1.PodDisruptionBudgetSpec(min_available=1, selector={"app": "a"}),
+        status=v1.PodDisruptionBudgetStatus(disruptions_allowed=2),
+    )
+    pdb_tier = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="tier"),
+        spec=v1.PodDisruptionBudgetSpec(
+            min_available=1, selector={"tier": "gold"}
+        ),
+        status=v1.PodDisruptionBudgetStatus(disruptions_allowed=1),
+    )
+    both = [
+        make_pod(f"b{i}", labels={"app": "a", "tier": "gold"})
+        for i in range(2)
+    ]
+    only_a = make_pod("only-a", labels={"app": "a"})
+    # b0 consumes a budget unit from BOTH pdbs; b1 then violates `tier`
+    # (exhausted) even though `app: a` still has budget; only-a passes on
+    # a's remaining unit
+    violating, ok = filter_pods_with_pdb_violation(
+        both + [only_a], [pdb_a, pdb_tier]
+    )
+    assert [p.metadata.name for p in ok] == ["b0", "only-a"]
+    assert [p.metadata.name for p in violating] == ["b1"]
+    # list order decides WHO gets the budget: reversed candidates flip
+    # the survivor — pinning the reference's order-dependent countdown
+    violating_r, ok_r = filter_pods_with_pdb_violation(
+        [both[1], both[0], only_a], [pdb_a, pdb_tier]
+    )
+    assert [p.metadata.name for p in ok_r] == ["b1", "only-a"]
+    assert [p.metadata.name for p in violating_r] == ["b0"]
+
+
+def test_active_queue_equal_priority_fifo_tie_break():
+    """activeQ orders by priority DESC then admission FIFO: equal-priority
+    pods must pop in arrival order (the -timestamp half of the default
+    less function, previously untested)."""
+    from kubernetes_tpu.scheduler.queue.scheduling_queue import PriorityQueue
+
+    q = PriorityQueue()
+    for i in range(5):
+        p = make_pod(f"fifo-{i}", prio=7)
+        q.add(p)
+        time.sleep(0.002)  # monotonic timestamps must strictly order
+    late_high = make_pod("late-high", prio=50)
+    q.add(late_high)
+    order = [q.pop(timeout=1).pod.metadata.name for _ in range(6)]
+    assert order[0] == "late-high"  # priority beats arrival
+    assert order[1:] == [f"fifo-{i}" for i in range(5)]  # FIFO within tier
+    q.close()
+
+
 def test_pick_one_node_prefers_fewest_pdb_violations():
     victims = {
         "a": [make_pod("v1", prio=0)],

@@ -1,0 +1,541 @@
+"""Vectorized victim selection (ops/preemptlattice) vs the host oracle.
+
+The differential corpus is the acceptance gate for ISSUE 15: ≥ 500
+randomized (cluster, preemptor-pod) cases — full clusters, mixed priority
+bands, taints/selectors/unschedulable statics, PDB-constrained cases with
+exhausted AND positive budgets — comparing the engine's composition
+(kernel top-K ranking → exact ``Preemptor`` selection on the K rows,
+full-walk fallback on rejection, exactly as scheduler.py wires it)
+against the unrestricted host-path ``Preemptor`` oracle. Agreement is
+"same victim sets modulo documented tie-breaks" (preemptlattice module
+docstring): equal-oracle-key node ties, and the band-prefix-vs-reprieve
+ranking class where the oracle's winner falls outside the kernel's top-K
+— in which case the engine's victim set must still be its own node's
+EXACT oracle selection (wrong evictions structurally impossible).
+
+The seeded-disagreement test drives a REAL scheduler with a corrupted
+kernel seam and asserts the output guard trips, the host path takes
+over, and nothing wrong is ever evicted.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.resources import cpu_to_millis
+from kubernetes_tpu.client import APIServer
+from kubernetes_tpu.ops.batch import encode_pod_batch
+from kubernetes_tpu.ops.encoding import (
+    LABEL_COST_PER_HOUR,
+    SnapshotEncoder,
+)
+from kubernetes_tpu.ops.preemptlattice import (
+    GUARD_PREEMPT_EMPTY,
+    GUARD_PREEMPT_ROW,
+    PREEMPT_TOP_K,
+    preempt_select,
+    validate_preempt_outputs,
+)
+from kubernetes_tpu.scheduler import KubeSchedulerConfiguration, Scheduler
+from kubernetes_tpu.scheduler.cache.nodeinfo import NodeInfo, Snapshot
+from kubernetes_tpu.scheduler.framework.registry import (
+    default_plugin_set,
+    default_registry,
+)
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.preemption import (
+    Preemptor,
+    filter_pods_with_pdb_violation,
+)
+from kubernetes_tpu.utils.metrics import metrics
+
+APPS = ["web", "db", "cache"]
+ZONES = ["za", "zb"]
+
+
+def _framework(holder):
+    ps = default_plugin_set()
+    ps.filter = [
+        n
+        for n in ps.filter
+        if n
+        not in (
+            "VolumeRestrictions", "NodeVolumeLimits", "EBSLimits",
+            "GCEPDLimits", "AzureDiskLimits", "VolumeBinding", "VolumeZone",
+        )
+    ]
+    ctx = {
+        "snapshot_getter": lambda: holder[0],
+        "hard_pod_affinity_weight": 1.0,
+        "ignored_extended_resources": frozenset(),
+    }
+    return Framework(default_registry(), ps, ctx)
+
+
+def make_node(name, cpu="4", labels=None, taints=None, unschedulable=False):
+    return v1.Node(
+        metadata=v1.ObjectMeta(name=name, namespace="", labels=labels or {}),
+        spec=v1.NodeSpec(taints=list(taints or []), unschedulable=unschedulable),
+        status=v1.NodeStatus(
+            allocatable={"cpu": cpu, "memory": "16Gi", "pods": 32}
+        ),
+    )
+
+
+def make_pod(name, cpu="1", prio=0, labels=None, node_selector=None,
+             tolerations=None):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, labels=labels or {}),
+        spec=v1.PodSpec(
+            containers=[v1.Container(requests={"cpu": cpu})],
+            priority=prio,
+            node_selector=dict(node_selector or {}),
+            tolerations=list(tolerations or []),
+        ),
+    )
+
+
+def _pdb(name, app, allowed):
+    return v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name=name),
+        spec=v1.PodDisruptionBudgetSpec(selector={"app": app}),
+        status=v1.PodDisruptionBudgetStatus(disruptions_allowed=allowed),
+    )
+
+
+def wait_until(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# kernel unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_select_minimal_band_prefix_and_node_ranking():
+    """Three full nodes: low-priority victims beat mid-priority ones
+    (criterion 2), a node whose pods all outrank the preemptor is never
+    helpful, and the victim count is the minimal fitting BAND prefix."""
+    enc = SnapshotEncoder()
+    for n in ("a", "b", "c"):
+        enc.add_node(make_node(n))
+    for i in range(4):
+        enc.add_pod("a", make_pod(f"la{i}", "1", prio=0))
+        enc.add_pod("b", make_pod(f"hb{i}", "1", prio=50))
+        enc.add_pod("c", make_pod(f"mc{i}", "1", prio=5))
+    snap = enc.flush()
+    eb = encode_pod_batch(enc, [make_pod("pre", "2", prio=10)], pad_to=16)
+    res = preempt_select(snap, eb.batch, eb.batch.priority)
+    node = int(np.asarray(res.node)[0])
+    assert enc.row_names[node] == "a"  # prio-0 victims beat prio-5
+    assert int(np.asarray(res.threshold_prio)[0]) == 0
+    # band granularity: the whole prio-0 band is the minimal PREFIX (the
+    # host reprieve trims within it — documented division of labor)
+    assert int(np.asarray(res.victims)[0]) == 4
+    helpful = np.asarray(res.helpful)[0]
+    by_name = {enc.row_names[r]: bool(helpful[r]) for r in range(3)}
+    assert by_name == {"a": True, "b": False, "c": True}
+    # ranked candidates: c follows a; b never appears
+    names = [enc.row_names[int(r)] for r in np.asarray(res.cand)[0] if r >= 0]
+    assert names[:2] == ["a", "c"]
+    assert "b" not in names
+
+
+def test_pdb_budget_column_deprioritizes_blocked_nodes():
+    """Two otherwise-identical nodes; one's victims are PDB-blocked
+    (exhausted budget, via update_pdb_blocked). Criterion 1 must rank the
+    unblocked node first."""
+    enc = SnapshotEncoder()
+    enc.add_node(make_node("blocked"))
+    enc.add_node(make_node("free"))
+    for i in range(4):
+        enc.add_pod("blocked", make_pod(f"pb{i}", "1", prio=0,
+                                        labels={"app": "web"}))
+        enc.add_pod("free", make_pod(f"pf{i}", "1", prio=0,
+                                     labels={"app": "db"}))
+    changed = enc.update_pdb_blocked([_pdb("b", "web", 0)])
+    assert changed == 1  # only the blocked node's column moved
+    snap = enc.flush()
+    eb = encode_pod_batch(enc, [make_pod("pre", "2", prio=10)], pad_to=16)
+    res = preempt_select(snap, eb.batch, eb.batch.priority)
+    assert enc.row_names[int(np.asarray(res.node)[0])] == "free"
+    assert int(np.asarray(res.violations)[0]) == 0
+    # budget recovery flips the ranking input back
+    assert enc.update_pdb_blocked([_pdb("b", "web", 2)]) == 1
+    assert enc.m_pdb_blocked.sum() == 0
+
+
+def test_validate_preempt_outputs_guards():
+    ok = np.array([0, -1, 2], np.int32)
+    vic = np.array([2, 0, 1], np.int32)
+    assert validate_preempt_outputs(ok, vic, 3) is None
+    assert (
+        validate_preempt_outputs(np.array([5], np.int32), np.array([1]), 3)
+        == GUARD_PREEMPT_ROW
+    )
+    assert (
+        validate_preempt_outputs(np.array([-7], np.int32), np.array([1]), 3)
+        == GUARD_PREEMPT_ROW
+    )
+    assert (
+        validate_preempt_outputs(np.array([1], np.int32), np.array([0]), 3)
+        == GUARD_PREEMPT_EMPTY
+    )
+    # candidate plane rows are validated too
+    assert (
+        validate_preempt_outputs(
+            np.array([1], np.int32), np.array([1]), 3,
+            cand=np.array([[1, 9]], np.int32),
+        )
+        == GUARD_PREEMPT_ROW
+    )
+    assert (
+        validate_preempt_outputs(np.array([-1], np.int32), None, 3) is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# the differential corpus
+# ---------------------------------------------------------------------------
+
+
+def _random_case(seed: int):
+    """One randomized FULL cluster + a batch of preemptor pods, mirroring
+    production preemption preconditions (pods genuinely unschedulable on
+    resources). Odd seeds add positive-budget PDBs (the countdown regime);
+    even seeds exhausted (budget-0) ones."""
+    rng = random.Random(seed)
+    n_nodes = rng.choice([6, 8, 10])
+    enc = SnapshotEncoder()
+    infos = {}
+    nodes = []
+    for i in range(n_nodes):
+        taints = (
+            [v1.Taint("dedicated", "infra", "NoSchedule")]
+            if rng.random() < 0.15
+            else []
+        )
+        n = make_node(
+            f"n{i}",
+            cpu=str(rng.choice([2, 4])),
+            labels={"zone": rng.choice(ZONES)},
+            taints=taints,
+            unschedulable=(rng.random() < 0.05),
+        )
+        nodes.append(n)
+        enc.add_node(n)
+        infos[n.metadata.name] = NodeInfo(n)
+    j = 0
+    for n in nodes:
+        free = cpu_to_millis(n.status.allocatable["cpu"])
+        while free >= 500:
+            cpu = rng.choice(["500m", "1"]) if free >= 1000 else "500m"
+            p = make_pod(
+                f"pre-{j}", cpu, prio=rng.choice([0, 5, 10, 50]),
+                labels={"app": rng.choice(APPS)},
+            )
+            p.spec.node_name = n.metadata.name
+            p.status.start_time = float(j)
+            enc.add_pod(n.metadata.name, p)
+            infos[n.metadata.name].add_pod(p)
+            free -= cpu_to_millis(cpu)
+            j += 1
+    pdbs = []
+    if seed % 2 == 0:
+        for app in rng.sample(APPS, rng.randrange(0, 3)):
+            pdbs.append(_pdb(f"pdb-{app}", app, 0))
+    else:
+        for app in rng.sample(APPS, rng.randrange(1, 3)):
+            pdbs.append(_pdb(f"pdb-{app}", app, rng.choice([0, 1, 2])))
+    enc.update_pdb_blocked(pdbs)
+    preemptors = [
+        make_pod(
+            f"hi-{k}",
+            cpu=rng.choice(["1", "2", "3"]),
+            prio=rng.choice([20, 100]),
+            labels={"app": rng.choice(APPS)},
+            node_selector=(
+                {"zone": rng.choice(ZONES)} if rng.random() < 0.2 else None
+            ),
+            tolerations=(
+                [v1.Toleration(key="dedicated", operator="Exists")]
+                if rng.random() < 0.3
+                else None
+            ),
+        )
+        for k in range(8)
+    ]
+    return enc, infos, pdbs, preemptors
+
+
+def _oracle_key(victims, pdbs):
+    violating, _ = filter_pods_with_pdb_violation(list(victims), pdbs)
+    return (
+        len(violating),
+        max((v.priority for v in victims), default=-(2 ** 31)),
+        sum(v.priority for v in victims),
+        len(victims),
+    )
+
+
+def test_differential_corpus_vs_host_oracle():
+    """≥ 500 randomized cases: the engine composition (kernel top-K →
+    exact Preemptor on the K rows → full-walk fallback) vs the
+    unrestricted host oracle. Every case must land in a documented
+    class; the strict-agreement classes must cover ≥ 500 cases on their
+    own; possibility disagreements (one side finds preemption viable,
+    the other doesn't) must be ZERO; and in every case the engine's
+    victim set must be its chosen node's exact oracle selection."""
+    strict = 0  # exact victim-set equality / equal-key tie / both-none
+    ranked_refinement = 0  # oracle winner outside kernel top-K (documented)
+    total = 0
+    seed = 0
+    while total < 560:
+        enc, infos, pdbs, preemptors = _random_case(seed)
+        seed += 1
+        snap = enc.flush()
+        holder = [Snapshot(list(infos.values()))]
+        pre = Preemptor(_framework(holder), pdb_lister=lambda: pdbs)
+        eb = encode_pod_batch(enc, preemptors, pad_to=16)
+        res = preempt_select(snap, eb.batch, eb.batch.priority)
+        cand = np.asarray(res.cand)
+        n_rows = len(enc.row_names)
+        assert validate_preempt_outputs(
+            np.asarray(res.node), np.asarray(res.victims), n_rows, cand=cand
+        ) is None
+        for k, pod in enumerate(preemptors):
+            if eb.fallback[k]:
+                continue
+            total += 1
+            onode, ovic = pre.preempt(pod, holder[0], None, None)
+            names = [
+                enc.row_names[int(r)]
+                for r in cand[k]
+                if r >= 0 and enc.row_names[int(r)]
+            ]
+            if names:
+                enode, evic = pre.preempt(pod, holder[0], None, names)
+                if not enode:  # the production oracle-reject fallback
+                    enode, evic = onode, ovic
+            else:
+                enode, evic = "", []
+            # possibility agreement is unconditional
+            assert bool(onode) == bool(enode), (
+                f"seed {seed - 1} pod {pod.metadata.name}: oracle "
+                f"{onode!r} vs engine {enode!r}"
+            )
+            if not onode:
+                strict += 1
+                continue
+            # the engine's victim set is ALWAYS its node's exact oracle
+            # selection (the structural zero-wrong-evictions guarantee)
+            rnode, rvic = pre.preempt(pod, holder[0], None, [enode])
+            assert rnode == enode
+            assert {v.metadata.key for v in rvic} == {
+                v.metadata.key for v in evic
+            }
+            if enode == onode and {v.metadata.key for v in evic} == {
+                v.metadata.key for v in ovic
+            }:
+                strict += 1
+            elif _oracle_key(evic, pdbs) == _oracle_key(ovic, pdbs):
+                strict += 1  # equal-key tie: documented tie-break 1
+            else:
+                # documented class 2: the oracle's winner must be outside
+                # the kernel's K candidates (band-prefix vs reprieve
+                # refinement) — a winner INSIDE the list resolving
+                # differently would be an engine bug
+                assert onode not in names, (
+                    f"seed {seed - 1} pod {pod.metadata.name}: oracle "
+                    f"winner {onode} was in the candidate list {names} "
+                    "but the engine picked a worse-keyed node"
+                )
+                ranked_refinement += 1
+    assert total >= 560
+    assert strict >= 500, (
+        f"only {strict}/{total} strict agreements "
+        f"({ranked_refinement} ranked-refinement cases)"
+    )
+    # the documented refinement class stays a small tail, not a regime
+    assert ranked_refinement <= total * 0.08
+
+
+# ---------------------------------------------------------------------------
+# seeded disagreement: guard trip → host fallback, zero wrong evictions
+# ---------------------------------------------------------------------------
+
+
+def _fill_cluster(srv, n_nodes=5, per_node=4, prio=0):
+    for i in range(n_nodes):
+        srv.create("nodes", make_node(f"n{i}"))
+    for i in range(n_nodes):
+        for k in range(per_node):
+            p = make_pod(f"low-{i}-{k}", "1", prio=prio,
+                         labels={"app": "web"})
+            srv.create("pods", p)
+
+
+def _all_bound(srv, prefix, n):
+    pods, _ = srv.list("pods")
+    mine = [p for p in pods if p.metadata.name.startswith(prefix)]
+    return len(mine) == n and all(p.spec.node_name for p in mine)
+
+
+@pytest.mark.parametrize("corruption", ["row_out_of_range", "empty_victims"])
+def test_seeded_disagreement_trips_guard_and_falls_back(corruption):
+    """Corrupt the kernel readback seam on a live scheduler: the output
+    guard must trip (counted), every pod must still preempt + bind via
+    the host walk, and no eviction may touch a node the oracle wouldn't
+    have chosen (here: every victim is a genuinely lower-priority pod)."""
+    srv = APIServer()
+    sched = Scheduler(srv, KubeSchedulerConfiguration())
+    real = sched._run_preempt_kernel
+
+    def corrupted(snap, batch, prios):
+        out = real(snap, batch, prios)
+        if corruption == "row_out_of_range":
+            out["node"] = out["node"].copy()
+            out["node"][out["node"] >= 0] = 10_000
+        else:
+            out["victims"] = np.zeros_like(out["victims"])
+        return out
+
+    sched._run_preempt_kernel = corrupted
+    _fill_cluster(srv)
+    sched.start()
+    try:
+        assert wait_until(lambda: _all_bound(srv, "low-", 20), 60)
+        # batch of 6 > small_batch_host_max keeps the burst on the wave
+        # (device) path where the vector engine lives
+        for i in range(6):
+            srv.create("pods", make_pod(f"hi-{i}", "2", prio=100))
+        assert wait_until(lambda: _all_bound(srv, "hi-", 6), 90)
+        trips = sum(
+            v
+            for _n, _l, v in metrics.snapshot_counters(
+                "scheduler_preemption_guard_trips_total"
+            )
+        )
+        assert trips >= 1
+        # zero vector evictions: every attempt fell back to the host walk
+        assert metrics.counter("scheduler_preemption_vector_hits_total") == 0
+        # no high-priority pod was ever evicted (wrong-eviction check)
+        pods, _ = srv.list("pods")
+        assert sum(1 for p in pods if p.metadata.name.startswith("hi-")) == 6
+    finally:
+        sched.stop()
+
+
+def test_vector_preemption_end_to_end_happy_path():
+    """The ISSUE-15 happy path: a high-priority burst over a full cluster
+    resolves victims through the batched pass (scheduler_preemption_
+    batches_total advances, vector hits land) with zero divergences from
+    the sampled differential oracle."""
+    srv = APIServer()
+    sched = Scheduler(srv, KubeSchedulerConfiguration())
+    _fill_cluster(srv, n_nodes=6)
+    sched.start()
+    try:
+        assert wait_until(lambda: _all_bound(srv, "low-", 24), 60)
+        for i in range(6):
+            srv.create("pods", make_pod(f"hi-{i}", "2", prio=100))
+        assert wait_until(lambda: _all_bound(srv, "hi-", 6), 90)
+        assert metrics.counter("scheduler_preemption_batches_total") >= 1
+        assert metrics.counter("scheduler_preemption_vector_hits_total") >= 1
+        assert (
+            metrics.counter("scheduler_preemption_oracle_divergence_total")
+            == 0
+        )
+    finally:
+        sched.stop()
+
+
+def test_sibling_burst_fans_out_across_distinct_nodes():
+    """In-batch fan-out regression: a burst of SIBLING preemptors bigger
+    than the kernel's top-K must nominate DISTINCT nodes within few
+    batched passes — without the `targeted` fan-out every sibling picked
+    the same node against the batch-stale snapshot and a wave freed
+    exactly one node (measured at bench scale: 89/1000 pods in 25 min)."""
+    srv = APIServer()
+    sched = Scheduler(srv, KubeSchedulerConfiguration())
+    # FULL cluster, victims PRE-BOUND, the whole burst present before the
+    # scheduler starts: the first wave carries all 10 siblings in ONE
+    # batch (pad bucket 16), which is the scope the fan-out guarantee
+    # covers — across waves a refreshed snapshot may legitimately re-use
+    # a node (evicting its remaining victims)
+    for i in range(10):
+        srv.create("nodes", make_node(f"n{i}"))
+        for k in range(4):
+            p = make_pod(f"low-{i}-{k}", "1", prio=0, labels={"app": "web"})
+            p.spec.node_name = f"n{i}"
+            srv.create("pods", p)
+    # 10 identical 2-cpu pods need 10 nodes' victims: > top-K (4), so the
+    # fan-out tail (helpful rows beyond the ranked K) must engage too
+    for i in range(10):
+        srv.create("pods", make_pod(f"hi-{i}", "2", prio=100))
+    sched.start()
+    try:
+        assert wait_until(lambda: _all_bound(srv, "hi-", 10), 90)
+        pods, _ = srv.list("pods")
+        hi_nodes = {
+            p.spec.node_name
+            for p in pods
+            if p.metadata.name.startswith("hi-")
+        }
+        assert len(hi_nodes) == 10  # one preemption per node, no pile-up
+        # distinct targets per batch: the whole burst resolves in a few
+        # select batches, not one-node-per-wave convergence
+        assert metrics.counter("scheduler_preemption_batches_total") <= 5
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# score policies (runtime weight vectors)
+# ---------------------------------------------------------------------------
+
+
+def test_score_policy_cheapest_prefers_cheap_nodes():
+    """The 'cheapest' policy (cost column + pack) must steer placements
+    onto the cheaper node with both feasible; swapping policies at
+    runtime needs no restart (the vector is a kernel input)."""
+    from kubernetes_tpu.ops.lattice import weights_for_policy
+
+    with pytest.raises(ValueError):
+        weights_for_policy("no-such-policy")
+    with pytest.raises(ValueError):
+        weights_for_policy([1.0, 2.0])  # wrong shape
+
+    srv = APIServer()
+    sched = Scheduler(
+        srv, KubeSchedulerConfiguration(score_policy="cheapest")
+    )
+    srv.create(
+        "nodes",
+        make_node("pricey", cpu="8", labels={LABEL_COST_PER_HOUR: "9.5"}),
+    )
+    srv.create(
+        "nodes",
+        make_node("cheap", cpu="8", labels={LABEL_COST_PER_HOUR: "0.4"}),
+    )
+    sched.start()
+    try:
+        for i in range(6):
+            srv.create("pods", make_pod(f"p{i}", "500m"))
+        assert wait_until(lambda: _all_bound(srv, "p", 6), 60)
+        pods, _ = srv.list("pods")
+        on_cheap = sum(1 for p in pods if p.spec.node_name == "cheap")
+        assert on_cheap == 6
+        # runtime swap: no exception, takes effect next wave
+        sched.set_score_policy("default")
+        sched.set_score_policy("energy")
+    finally:
+        sched.stop()

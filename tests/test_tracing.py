@@ -243,6 +243,48 @@ def test_pod_trace_complete_and_store_stamped(bound_cluster):
     assert full["attrs"].get("node", "").startswith("tr-")
 
 
+def test_preempt_span_chain_on_preemptor_trace():
+    """ISSUE-15 satellite: a preemption-delayed pod's waterfall must show
+    where the time went — the preempt.select → preempt.delete →
+    preempt.nominate chain lands on the PREEMPTOR pod's own trace id."""
+    metrics.reset()
+    server = APIServer()
+    sched = Scheduler(server, KubeSchedulerConfiguration(use_device=False))
+    server.create("nodes", make_node("pr-0", cpu="2"))
+    sched.start()
+    try:
+        victim = make_pod("victim")
+        victim.spec.priority = 0
+        victim.spec.containers[0].requests = {"cpu": "2"}
+        server.create("pods", victim)
+        assert wait_until(
+            lambda: server.get("pods", "default", "victim").spec.node_name,
+            30,
+        )
+        hi = make_pod("preemptor")
+        hi.spec.priority = 100
+        hi.spec.containers[0].requests = {"cpu": "2"}
+        server.create("pods", hi)
+        assert wait_until(
+            lambda: server.get(
+                "pods", "default", "preemptor"
+            ).status.nominated_node_name
+            == "pr-0",
+            30,
+        )
+        tid = tracer.trace_for_pod("default/preemptor")
+        assert tid
+        full = tracer.get(tid)
+    finally:
+        sched.stop()
+    assert full is not None
+    stages = {s["name"] for s in full["spans"]}
+    assert {"preempt.select", "preempt.delete", "preempt.nominate"} <= stages
+    # the delete span records how many victims the eviction covered
+    delete = next(s for s in full["spans"] if s["name"] == "preempt.delete")
+    assert delete["attrs"].get("victims") == 1
+
+
 def test_p99_exemplar_resolves_to_full_trace(bound_cluster):
     h = metrics.histogram("e2e_scheduling_duration_seconds")
     assert h is not None and h.n >= 8

@@ -350,3 +350,65 @@ def test_topology_spread_max_skew_validated():
                 ),
             ),
         )
+
+
+# -- PriorityClass (ISSUE 15 satellite: REST validation fixes) ---------------
+
+
+def _pc(name, value=100, global_default=False, policy="PreemptLowerPriority"):
+    return v1.PriorityClass(
+        metadata=v1.ObjectMeta(name=name),
+        value=value,
+        global_default=global_default,
+        preemption_policy=policy,
+    )
+
+
+def test_priorityclass_user_value_range():
+    server = APIServer()
+    server.create("priorityclasses", _pc("edge", value=1_000_000_000))
+    with pytest.raises(ValidationError):
+        server.create("priorityclasses", _pc("too-big", value=1_000_000_001))
+    # the system tier is exempt from the user cap (reference
+    # system-cluster-critical sits at 2e9)...
+    server.create(
+        "priorityclasses", _pc("system-cluster-critical", value=2_000_000_000)
+    )
+    # ...but NOT from the system ceiling: int32-range values would
+    # overflow the encoder's priority-band columns (2^31-1 is the preempt
+    # kernel's empty-band sentinel)
+    with pytest.raises(ValidationError):
+        server.create("priorityclasses", _pc("system-huge", value=2**31 - 1))
+    with pytest.raises(ValidationError):
+        server.create("priorityclasses", _pc("system-wild", value=2**31))
+
+
+def test_priorityclass_unknown_preemption_policy_is_400():
+    server = APIServer()
+    for bad in ("never", "PreemptAll", "lower", ""):
+        with pytest.raises(ValidationError):
+            server.create("priorityclasses", _pc(f"x-{bad or 'empty'}", policy=bad))
+    server.create("priorityclasses", _pc("ok-never", policy="Never"))
+    server.create("priorityclasses", _pc("ok-default"))
+
+
+def test_priorityclass_single_global_default():
+    server = APIServer()
+    server.create("priorityclasses", _pc("first", global_default=True))
+    with pytest.raises(ValidationError):
+        server.create("priorityclasses", _pc("second", global_default=True))
+    # flipping the flag on via update while another class holds it: same
+    with pytest.raises(ValidationError):
+        b = server.create("priorityclasses", _pc("b"))
+        b.global_default = True
+        server.update("priorityclasses", b)
+    # the holder may update ITSELF (self-exclusion by key)
+    first = server.get("priorityclasses", "", "first")
+    first.value = 200
+    server.update("priorityclasses", first)
+    # releasing then re-assigning works
+    first.global_default = False
+    server.update("priorityclasses", first)
+    c = server.get("priorityclasses", "", "b")
+    c.global_default = True
+    server.update("priorityclasses", c)
