@@ -28,6 +28,11 @@
 #                         storm with a frontend AND the read-serving follower
 #                         SIGKILLed — zero acked-write loss, zero stale
 #                         consistent reads, watchers resume with zero relists
+#   make chaos-tuner      policy-gym chaos: workload-mix flip re-convergence,
+#                         kill-leader mid-shadow (no double promotion, the
+#                         new leader adopts the persisted vector), NaN
+#                         candidate rejected at the gate, degraded-store
+#                         promotion pause
 #   make tracing-ab       same-process tracing-overhead A/B (on vs off):
 #                         acceptance rail — enabled-mode steady-state
 #                         throughput regresses <3%, disabled ≈ noise
@@ -56,7 +61,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	chaos-serving chaos-preempt tracing-ab lint-slow lint-static \
+	chaos-serving chaos-preempt chaos-tuner tracing-ab lint-slow lint-static \
 	lint-fast lint
 
 test:
@@ -70,7 +75,8 @@ chaos: lint
 		tests/test_chaos_autoscaler.py tests/test_chaos_readpath.py \
 		tests/test_watchcache.py tests/test_chaos_ha.py \
 		tests/test_chaos_net.py tests/test_serving.py \
-		tests/test_chaos_serving.py tests/test_chaos_preempt.py -q
+		tests/test_chaos_serving.py tests/test_chaos_preempt.py \
+		tests/test_chaos_tuner.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -94,6 +100,9 @@ chaos-serving:
 
 chaos-preempt:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_preempt.py -q
+
+chaos-tuner:
+	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_tuner.py -q
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

@@ -103,6 +103,7 @@ def main() -> int:
             run_preemption_benchmark,
             run_readpath_benchmark,
             run_serving_benchmark,
+            run_tuner_benchmark,
         )
         from kubernetes_tpu.perf.workloads import WORKLOADS
 
@@ -323,6 +324,35 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
 
+        # tuner workload (ISSUE 16): the policy gym through a workload-mix
+        # flip on a mixed-cost fleet — pre-flip full-width waves must NOT
+        # promote (no arm can win); the flip to small bursts must promote
+        # a cost-aware vector. Reports re-convergence time + the
+        # steady-state scheduling overhead of running the gym at all.
+        tuner = None
+        try:
+            tres = run_tuner_benchmark()
+            tuner = {
+                "workload": "Tuner/mixed-cost-flip-8-nodes",
+                "nodes": tres.num_nodes,
+                "pre_flip_rounds": tres.pre_flip_rounds,
+                "pre_flip_promotions": tres.pre_flip_promotions,
+                "baseline_pods_per_s": tres.baseline_pods_per_s,
+                "tuner_on_pods_per_s": tres.tuner_on_pods_per_s,
+                "steady_state_overhead_pct": tres.overhead_pct,
+                "converged": tres.converged,
+                "time_to_converge_s": tres.time_to_converge_s,
+                "promoted_policy": tres.promoted_policy,
+                "promoted_cost_weight": tres.promoted_cost_weight,
+                "promotions": tres.promotions,
+                "waves_recorded": tres.waves_recorded,
+                "gym_passes": tres.gym_passes,
+                "gym_pass_p50_ms": tres.gym_pass_p50_ms,
+                "gym_pass_p99_ms": tres.gym_pass_p99_ms,
+            }
+        except Exception:
+            traceback.print_exc()
+
         # CPU fallback: attach the round's checkpointed on-TPU artifact (if
         # one landed earlier — the watchdog self-checkpoints every real-TPU
         # pass) so the official round artifact carries the hardware evidence
@@ -413,6 +443,7 @@ def main() -> int:
                 "serving": serving,
                 "preemption": preemption,
                 "hetero": hetero,
+                "tuner": tuner,
                 "steady_state_latency": (
                     {
                         "rate_pods_per_s": round(lat.rate_pods_per_s, 1),
@@ -542,6 +573,18 @@ def main() -> int:
                 he.get("most_allocated") or {}
             ).get("fleet_per_hour"),
             "strictly_cheaper": he.get("strictly_cheaper"),
+        }
+    tu = detail.get("tuner") or {}
+    if tu:
+        # compact tuner line item: workload-flip re-convergence + the
+        # cost of running the gym (full segment breakdown in detail_file)
+        compact["tuner"] = {
+            "converged": tu.get("converged"),
+            "time_to_converge_s": tu.get("time_to_converge_s"),
+            "promoted_policy": tu.get("promoted_policy"),
+            "pre_flip_promotions": tu.get("pre_flip_promotions"),
+            "steady_state_overhead_pct": tu.get("steady_state_overhead_pct"),
+            "gym_pass_p99_ms": tu.get("gym_pass_p99_ms"),
         }
     if "error" in out:
         compact["error"] = out["error"]

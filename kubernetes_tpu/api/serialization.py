@@ -85,6 +85,7 @@ CLUSTER_SCOPED = frozenset(
         "runtimeclasses",
         "podsecuritypolicies",
         "ingressclasses",
+        "scorepolicies",
     }
 )
 
@@ -286,6 +287,7 @@ def ensure_late_registration() -> None:
     try:
         from ..client.events import ClusterEvent
         from ..client.leaderelection import Lease
+        from ..tuner.policy import ScorePolicy
     except ImportError:
         return
     RESOURCE_KINDS["events"] = ClusterEvent
@@ -293,6 +295,8 @@ def ensure_late_registration() -> None:
     KIND_TO_RESOURCE["Event"] = "events"
     RESOURCE_KINDS["leases"] = Lease
     KIND_TO_RESOURCE["Lease"] = "leases"
+    RESOURCE_KINDS["scorepolicies"] = ScorePolicy
+    KIND_TO_RESOURCE["ScorePolicy"] = "scorepolicies"
     _late_registered = True
 
 

@@ -151,11 +151,23 @@ def run(
             server, sched, autoscaler_catalog, **(autoscaler_kwargs or {})
         )
         sched._autoscaler = autoscaler
+    tuner = None
+    if cfg.tune_policy:
+        # the policy gym follows leadership like the autoscaler: only the
+        # leader records waves, replays candidates, and promotes. It
+        # talks to the RAW store (never the cacher) — the persisted
+        # ScorePolicy object is the failover-adoption authority
+        from ..tuner.controller import PolicyTuner
+
+        tuner = PolicyTuner(sched, server)
+        sched._tuner = tuner
 
     def start_scheduling():
         sched.start()
         if autoscaler is not None:
             autoscaler.start()
+        if tuner is not None:
+            tuner.start()
         live.set()
         ready.set()
 
@@ -172,6 +184,8 @@ def run(
             sched.promote(fence=elector.fence())
             if autoscaler is not None:
                 autoscaler.start()
+            if tuner is not None:
+                tuner.start()
             ready.set()
 
         def on_stopped():
@@ -179,6 +193,8 @@ def run(
             logger.error("leader election lost; shutting down scheduling")
             ready.clear()
             live.clear()
+            if tuner is not None:
+                tuner.stop()
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
@@ -214,6 +230,8 @@ def run(
                 elector.stop()
                 if elector_thread is not None:
                     elector_thread.join(timeout=5.0)
+            if tuner is not None:
+                tuner.stop()
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
@@ -278,6 +296,16 @@ def main(argv=None) -> int:
         "the score components — swapping policies never recompiles the "
         "kernels (Scheduler.set_score_policy swaps live)",
     )
+    parser.add_argument(
+        "--tune-policy",
+        action="store_true",
+        default=False,
+        help="run the policy gym (tuner/): record real scheduling waves, "
+        "replay candidate weight vectors against them in the background, "
+        "and promote winners through a shadow A/B gate — the promoted "
+        "vector persists as the ScorePolicy API object so failover adopts "
+        "it instead of reverting to the default",
+    )
     parser.add_argument("-v", "--verbosity", type=int, default=1)
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -307,6 +335,8 @@ def main(argv=None) -> int:
     if args.score_policy:
         cfg.score_policy = args.score_policy
         cfg.validate()  # unknown names fail here, not mid-wave
+    if args.tune_policy:
+        cfg.tune_policy = True
     catalog = None
     if args.autoscale_shapes:
         from ..autoscaler import NodeGroup, NodeGroupCatalog, machine_shape

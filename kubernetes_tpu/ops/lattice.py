@@ -123,7 +123,12 @@ WEIGHT_PROFILES = {
 def weights_for_policy(policy) -> np.ndarray:
     """Resolve a policy name or raw vector into a weight vector. Unknown
     names raise (a typo'd policy must fail loudly at config time, not
-    schedule with silently-default weights)."""
+    schedule with silently-default weights). Raw vectors are validated
+    for shape, dtype-coercibility AND finiteness here — a NaN/inf weight
+    would otherwise poison every score in the next kernel launch and
+    surface as an inscrutable guard trip instead of a ValueError at the
+    call that introduced it (the seam the policy-gym promotion gate
+    rejects poisoned candidates through)."""
     if isinstance(policy, str):
         try:
             return WEIGHT_PROFILES[policy].copy()
@@ -132,13 +137,58 @@ def weights_for_policy(policy) -> np.ndarray:
                 f"unknown score policy {policy!r}; known: "
                 f"{sorted(WEIGHT_PROFILES)}"
             ) from None
-    w = np.asarray(policy, np.float32)
+    try:
+        w = np.asarray(policy, np.float32)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"score weight vector is not float32-coercible: {e}"
+        ) from None
     if w.shape != (NUM_SCORE_COMPONENTS,):
         raise ValueError(
             f"score weight vector must have shape ({NUM_SCORE_COMPONENTS},), "
             f"got {w.shape}"
         )
+    if not np.isfinite(w).all():
+        bad = np.flatnonzero(~np.isfinite(w)).tolist()
+        raise ValueError(
+            f"score weight vector has non-finite components at {bad}"
+        )
     return w.copy()
+
+
+# Names a promoted/tuned vector may never shadow: the built-in profiles
+# are documented identities ("cheapest" must keep meaning cheapest).
+_BUILTIN_PROFILES = frozenset(WEIGHT_PROFILES)
+
+
+def register_weight_profile(
+    name: str, vec, overwrite: bool = False
+) -> np.ndarray:
+    """Register a named weight profile at runtime so promoted vectors get
+    STABLE names in metrics labels, SIGUSR2 dumps and the persisted
+    score-policy object (the policy gym calls this before
+    ``set_score_policy``; an HA standby calls it while adopting the
+    persisted policy). The vector passes the full ``weights_for_policy``
+    raw-vector validation; built-in profile names are reserved, and
+    re-registering a tuned name requires ``overwrite=True`` unless the
+    vector is unchanged (idempotent re-adoption)."""
+    if not name or not isinstance(name, str):
+        raise ValueError("profile name must be a non-empty string")
+    w = weights_for_policy(np.asarray(vec))
+    if name in _BUILTIN_PROFILES:
+        raise ValueError(
+            f"profile name {name!r} is reserved (built-in profile)"
+        )
+    existing = WEIGHT_PROFILES.get(name)
+    if existing is not None and not overwrite and not np.array_equal(
+        existing, w
+    ):
+        raise ValueError(
+            f"profile {name!r} already registered with different weights "
+            "(pass overwrite=True to replace)"
+        )
+    WEIGHT_PROFILES[name] = w.copy()
+    return w
 
 IMG_MIN_THRESHOLD = 23.0 * 1024 * 1024  # imagelocality minThreshold
 IMG_MAX_THRESHOLD = 1000.0 * 1024 * 1024

@@ -135,6 +135,12 @@ class CacheDebugger:
                 f"(this replica: {getattr(self.sched, '_ha_identity', '?')}):"
             )
             lines.extend(ha)
+        from ...tuner.policy import tuner_health_lines
+
+        tuner = tuner_health_lines()
+        if tuner:
+            lines.append("Dump of policy-gym (self-tuning scheduler) state:")
+            lines.extend(tuner)
         from ...utils import tracing as tracing_mod
 
         lines.append("Dump of per-pod scheduling traces (slowest first):")

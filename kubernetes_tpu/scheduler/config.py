@@ -161,6 +161,12 @@ class KubeSchedulerConfiguration:
     # score-plugin set. Policies are runtime weight VECTORS (a kernel
     # input), swappable live via Scheduler.set_score_policy.
     score_policy: str = ""
+    # policy gym (tuner/): record real waves, replay candidate weight
+    # vectors against them in a background loop, and promote winners
+    # through a shadow A/B gate (persisted as the ScorePolicy API object
+    # so failover adopts the tuned vector). Off by default — the tuner is
+    # an opt-in control loop, not a scheduling dependency.
+    tune_policy: bool = False
     # vectorized victim selection: one batched device pass ranks candidate
     # (node, victim-band) choices for a whole wave of unschedulable pods;
     # the host oracle (Preemptor._select_victims_on_node) still validates

@@ -133,12 +133,13 @@ class _InFlightBatch:
 
     __slots__ = (
         "pis", "eb", "row_names", "res", "moves0", "trace", "t_start",
-        "snapshot", "launch_gen", "wave_tid", "t_launched",
+        "snapshot", "launch_gen", "wave_tid", "t_launched", "weights",
+        "rng_key",
     )
 
     def __init__(
         self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None,
-        launch_gen=0, wave_tid="", t_launched=0.0,
+        launch_gen=0, wave_tid="", t_launched=0.0, weights=None, rng_key=None,
     ):
         self.pis = pis
         self.eb = eb
@@ -163,6 +164,12 @@ class _InFlightBatch:
         # commits — state the device chain already saw — keep their nodes
         # eligible for the check
         self.launch_gen = launch_gen
+        # the exact weight vector + PRNG key the kernel launched with:
+        # the policy-gym replay buffer records them at commit so a
+        # differential replay reproduces THIS launch, not whatever the
+        # live policy is by then
+        self.weights = weights
+        self.rng_key = rng_key
 
 
 _SCORE_NAME_TO_COMPONENT = {
@@ -371,6 +378,15 @@ class Scheduler:
         self._consecutive_device_loss = 0
         self._consecutive_guard_trips = 0
         self._weights = self._build_weights()
+        self._score_policy_name = (
+            self.cfg.score_policy
+            if isinstance(self.cfg.score_policy, str) and self.cfg.score_policy
+            else "default"
+        )
+        # policy-gym attachment point (tuner/waves.WaveRingBuffer when a
+        # PolicyTuner is running): device paths record committed waves
+        # here; None = recording off, zero hot-path cost
+        self.wave_recorder = None
         self._tpl_cache = TemplateCache(self.cache.encoder)
         self._pair_cache: Optional[tuple] = None  # (sig, table)
         # scheduler HA (ha.py): the leadership fencing token armed by
@@ -431,7 +447,41 @@ class Scheduler:
         the seam the ROADMAP-5 policy gym promotes tuned vectors through.
         In-flight waves keep the vector they launched with."""
         self._weights = weights_for_policy(policy)
+        previous = self._score_policy_name
+        self._score_policy_name = (
+            policy if isinstance(policy, str) else "custom"
+        )
         metrics.inc("scheduler_score_policy_swaps_total")
+        from ..tuner.policy import set_active_policy_gauge
+
+        set_active_policy_gauge(self._score_policy_name, previous)
+
+    def _adopt_persisted_score_policy(self) -> None:
+        """Adopt the ScorePolicy API object the policy gym persisted, if
+        one exists and validates — the restart/failover half of the
+        promotion gate (a tuned vector must survive its promoter). Never
+        raises: a degraded store or invalid object is a counted skip
+        (tuner_policy_adoptions_total{outcome=...}) and the current
+        weights stand."""
+        from ..tuner.policy import adopt_persisted_policy
+
+        try:
+            name = adopt_persisted_policy(self.server)
+        except Exception:
+            logger.exception("persisted score-policy adoption failed")
+            return
+        if name is None:
+            return
+        changed = name != self._score_policy_name
+        # apply even when the name matches: adoption just re-registered
+        # the persisted VECTOR under that name, and this process's copy
+        # may predate the promotion that wrote it
+        self.set_score_policy(name)
+        if changed:
+            logger.warning(
+                "scheduler %s adopted persisted score policy %r",
+                self._ha_identity, name,
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -508,6 +558,11 @@ class Scheduler:
                 counts["bound"], counts["pending"], counts["gone"],
             )
         metrics.set_gauge(GAUGE_ROLE, 1.0, {"identity": self._ha_identity})
+        # adopt the persisted tuned score policy (tuner/policy.py): both
+        # cold starts (start() routes through here) and HA promotions
+        # pick up the gym's promoted vector instead of reverting to the
+        # config default — degraded/absent store is a counted skip
+        self._adopt_persisted_score_policy()
         if self.cfg.use_device and self.cfg.antientropy_period_s > 0:
             from .antientropy import SnapshotAntiEntropy
 
@@ -1324,15 +1379,17 @@ class Scheduler:
                 snap = self.cache.encoder.flush()
                 enc_cfg = self.cache.encoder.cfg
                 row_names = list(self.cache.encoder.row_names)
+                launch_gen = self.cache._ext_generation
             trace.step("encoded+flushed")
             kern = make_schedule_batch(
                 enc_cfg.v_cap, self.cfg.hard_pod_affinity_weight
             )
             self._rng_key, sub = jax.random.split(self._rng_key)
+            w_launch = np.asarray(self._weights)
             try:
                 with _stage_timer("kernel"):
                     res, chosen, score = self._run_serial_kernel(
-                        kern, snap, eb.batch, sub
+                        kern, snap, eb.batch, sub, w_launch
                     )
                 self._consecutive_device_loss = 0
                 break
@@ -1416,6 +1473,7 @@ class Scheduler:
         fallback_pis: List[QueuedPodInfo] = []
         failed: List = []  # (pi, batch_index or -1)
         resolvable = None
+        serial_placed: dict = {}  # id(pi) -> node (tuner wave record)
         for i, pi in enumerate(pis):
             if eb.fallback[i]:
                 fallback_pis.append(pi)
@@ -1432,6 +1490,10 @@ class Scheduler:
                 continue
             metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
             self._assume_and_bind(pi, node_name, t_start)
+            serial_placed[id(pi)] = node_name
+        self._record_wave_for_tuner(
+            pis, serial_placed, w_launch, sub, launch_gen, path="serial"
+        )
         if fallback_pis or failed:
             self._snapshot = self.cache.update_snapshot()
         for pi in fallback_pis:
@@ -1723,10 +1785,11 @@ class Scheduler:
                 has_pinned,
             )
         self._rng_key, sub = jax.random.split(self._rng_key)
+        w_launch = np.asarray(self._weights)
         t_launch0 = time.monotonic()
         try:
             new_snap, res = self._launch_wave_kernel(
-                kern, snap, eb.batch, ptab, np.asarray(self._weights), sub
+                kern, snap, eb.batch, ptab, w_launch, sub
             )
         except Exception:
             with self.cache.lock:
@@ -1751,7 +1814,7 @@ class Scheduler:
         self._pending.append(
             _InFlightBatch(
                 pis, eb, row_names, res, moves0, trace, t_start, verify_snap,
-                launch_gen, wave_tid, t_launched,
+                launch_gen, wave_tid, t_launched, w_launch, sub,
             )
         )
         metrics.inc("scheduler_wave_batches_total")
@@ -1998,7 +2061,39 @@ class Scheduler:
                 logger.exception("verify_cycles cross-check failed")
         self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
         trace.step("assume+bind")
+        self._record_wave_for_tuner(
+            p.pis,
+            {id(pi): node for pi, node, _b, _pr in to_bind},
+            p.weights,
+            p.rng_key,
+            p.launch_gen,
+            path="wave",
+        )
         return fallback_pis, failed
+
+    def _record_wave_for_tuner(
+        self, pis, placed_by_id, weights, rng_key, launch_gen, path
+    ) -> None:
+        """Feed the policy gym's replay ring (tuner/waves.py) with a
+        committed batch: pod specs, the launch weight vector + PRNG key,
+        and the placements production actually took. Outside every lock,
+        one guarded append — recording must never perturb scheduling."""
+        rec = self.wave_recorder
+        if rec is None or weights is None:
+            return
+        try:
+            pods = [pi.pod for pi in pis]
+            placements = [placed_by_id.get(id(pi), "") for pi in pis]
+            rec.record_wave(
+                pods,
+                weights,
+                placements,
+                rng_key=rng_key,
+                launch_gen=launch_gen,
+                path=path,
+            )
+        except Exception:
+            logger.exception("wave recording failed (scheduling unaffected)")
 
     # Bound on full preemption scans per resolved batch: with the
     # per-(template, priority) dedup below the bound only engages when a
@@ -2437,11 +2532,15 @@ class Scheduler:
             pass
         self._set_device_down()
 
-    def _run_serial_kernel(self, kern, snap, batch, key):
+    def _run_serial_kernel(self, kern, snap, batch, key, weights=None):
         """Launch + readback of the serial batch kernel — one synchronous
         call, split out as an injectable seam for the chaos fault
-        injector (mirrors _launch_wave_kernel/_fetch_wave_results)."""
-        res = kern(snap, batch, np.asarray(self._weights), key)
+        injector (mirrors _launch_wave_kernel/_fetch_wave_results).
+        ``weights`` pins the exact launch vector (the tuner records it
+        for differential replay); None reads the live policy."""
+        if weights is None:
+            weights = np.asarray(self._weights)
+        res = kern(snap, batch, weights, key)
         chosen, score = jax.device_get((res.chosen, res.score))
         return res, chosen, score
 

@@ -137,7 +137,7 @@ class DeviceFaultInjector:
             )
         return self._real_launch(kern, snap, batch, ptab, weights, key)
 
-    def _serial(self, kern, snap, batch, key):
+    def _serial(self, kern, snap, batch, key, weights=None):
         with self._lock:
             n = self.serial_calls
             self.serial_calls += 1
@@ -148,7 +148,7 @@ class DeviceFaultInjector:
             raise DeviceLossError(
                 f"injected: device lost on serial kernel call #{n}"
             )
-        return self._real_serial(kern, snap, batch, key)
+        return self._real_serial(kern, snap, batch, key, weights)
 
     def _fetch(self, batches):
         with self._lock:

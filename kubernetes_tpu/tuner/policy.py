@@ -1,0 +1,228 @@
+"""The persisted score policy + the tuner metric surface.
+
+The policy gym's promotion gate ends in TWO durable effects: the live
+swap (``Scheduler.set_score_policy`` — a kernel-input change, zero
+recompile) and this module's **ScorePolicy API object**. The object is
+the one that survives the process: a leader failover or a restart reads
+it back during ``Scheduler.promote()`` and adopts the tuned vector
+instead of silently reverting to ``default`` (the failure the chaos-ha
+regression pins). Promotion persists FIRST and applies second, so a
+vector the store never accepted can never become the only copy.
+
+Import discipline: this module is deliberately jax-free (stdlib + numpy
++ api objects) — ``api/serialization.ensure_late_registration`` imports
+it from arbitrary processes (kubectl, REST frontends) that must decode
+``scorepolicies`` without paying a jax import. Weight validation defers
+to ``ops.lattice`` lazily, inside the scheduler-side helpers only.
+
+Like scheduler/ha.py, this is also the one home for the ``tuner_*``
+series names and the SIGUSR2 dump section, so the metrics contract
+(graftlint pass 3) and the cache debugger read one surface.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..api.objects import ObjectMeta
+from ..client.apiserver import NotPrimary
+from ..runtime.consensus import DegradedWrites
+from ..utils.metrics import metrics
+
+logger = logging.getLogger("kubernetes_tpu.tuner")
+
+# the well-known singleton object name: there is ONE active policy per
+# cluster, adopted by whoever leads
+ACTIVE_POLICY_NAME = "active"
+
+# -- the tuner_* metric surface (graftlint pass 3 reads these names) ---------
+
+# waves the scheduler recorded into the replay ring, by producing path
+COUNTER_WAVES_RECORDED = "tuner_waves_recorded_total"  # {path}
+# current replay-ring depth
+GAUGE_WAVE_RING_DEPTH = "tuner_wave_ring_depth"
+# completed gym passes (one batched overlay replay per candidate set)
+COUNTER_GYM_PASSES = "tuner_gym_passes_total"
+# candidate vectors evaluated, by generator
+COUNTER_GYM_CANDIDATES = "tuner_gym_candidates_total"  # {source}
+# candidates refused before they could ever reach shadow/promotion
+COUNTER_CANDIDATES_REJECTED = "tuner_candidates_rejected_total"  # {reason}
+# shadow-window verdicts for the current challenger
+COUNTER_SHADOW_WINDOWS = "tuner_shadow_windows_total"  # {outcome}
+# fraction of pods the shadow vector would place DIFFERENTLY from
+# production in the latest window (1.0 = fully divergent hypothetically)
+GAUGE_SHADOW_DIVERGENCE = "tuner_shadow_divergence"
+# promotions applied (persist landed + live swap done)
+COUNTER_POLICY_PROMOTIONS = "tuner_promotions_total"
+# post-promotion regressions that rolled the incumbent back
+COUNTER_ROLLBACKS = "tuner_rollbacks_total"
+# store writes refused while degraded — the tuner pauses (counted skip,
+# promotion retried once the store heals)
+COUNTER_DEGRADED_SKIPS = "tuner_degraded_write_skips_total"  # {write}
+# persisted-policy adoption attempts at promote()/startup
+COUNTER_POLICY_ADOPTIONS = "tuner_policy_adoptions_total"  # {outcome}
+# background ticks that died (exception contained, loop keeps running)
+COUNTER_TICK_ERRORS = "tuner_tick_errors_total"
+# 1 for the active policy name, 0 for a policy this process retired
+GAUGE_ACTIVE_POLICY = "tuner_active_policy_info"  # {policy}
+# wall-clock of one full gym pass (encode + overlay + K kernel launches)
+HIST_GYM_PASS_SECONDS = "tuner_gym_pass_duration_seconds"
+# mean utility per arm over the latest scored window
+GAUGE_ARM_UTILITY = "tuner_arm_utility"  # {arm}
+
+
+@dataclass
+class ScorePolicy:
+    """The persisted active score policy (cluster-scoped, singleton
+    ``active``). ``weights`` is the full raw vector — the authoritative
+    copy; ``policy_name`` is the stable registered profile name metrics
+    and dumps use; ``promotions`` counts gate passages over the object's
+    lifetime (monotonic — a zombie's replayed promotion can't rewind
+    it)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    weights: List[float] = field(default_factory=list)
+    policy_name: str = "default"
+    promoted_by: str = ""
+    promotions: int = 0
+    kind: str = "ScorePolicy"
+
+
+def persist_active_policy(
+    server, name: str, weights: np.ndarray, identity: str = ""
+) -> bool:
+    """Write the promoted vector as the singleton ScorePolicy object.
+    Returns False on a degraded store (counted skip — the caller pauses
+    and retries; promotion must NOT apply a vector the store refused,
+    or failover would silently revert it)."""
+    vec = [float(x) for x in np.asarray(weights, np.float32)]
+
+    def mutate(cur: ScorePolicy) -> ScorePolicy:
+        cur.weights = vec
+        cur.policy_name = name
+        cur.promoted_by = identity
+        cur.promotions = int(cur.promotions) + 1
+        return cur
+
+    try:
+        try:
+            server.guaranteed_update(
+                "scorepolicies", "", ACTIVE_POLICY_NAME, mutate
+            )
+            return True
+        except KeyError:
+            pass  # NotFound subclasses KeyError: first promotion creates
+        server.create(
+            "scorepolicies",
+            ScorePolicy(
+                metadata=ObjectMeta(name=ACTIVE_POLICY_NAME, namespace=""),
+                weights=vec,
+                policy_name=name,
+                promoted_by=identity,
+                promotions=1,
+            ),
+        )
+        return True
+    except (DegradedWrites, NotPrimary, OSError) as e:
+        metrics.inc(COUNTER_DEGRADED_SKIPS, {"write": "policy_persist"})
+        logger.warning(
+            "score-policy persist refused (%s); tuner pauses promotion", e
+        )
+        return False
+
+
+def read_persisted_policy(server) -> Optional[Tuple[str, np.ndarray]]:
+    """Read + validate the persisted active policy. None when absent or
+    unreadable (degraded-tolerant: a failed read is a counted skip, never
+    a crash — the caller keeps its current weights, which for a fresh
+    process means ``default``)."""
+    from ..ops.lattice import weights_for_policy
+
+    try:
+        obj = server.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+    except KeyError:
+        metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "none"})
+        return None
+    except Exception as e:  # degraded / partitioned store: skip, don't die
+        metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "skipped"})
+        logger.warning("persisted score policy unreadable (%s); skipped", e)
+        return None
+    weights = getattr(obj, "weights", None) or getattr(obj, "content", {}).get(
+        "weights"
+    )
+    name = getattr(obj, "policy_name", "") or getattr(obj, "content", {}).get(
+        "policyName", ""
+    )
+    if not weights or not name:
+        metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "invalid"})
+        return None
+    try:
+        vec = weights_for_policy(np.asarray(weights, np.float32))
+    except ValueError as e:
+        metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "invalid"})
+        logger.error("persisted score policy invalid (%s); ignored", e)
+        return None
+    return str(name), vec
+
+
+def adopt_persisted_policy(server) -> Optional[str]:
+    """The promote()/startup adoption path: read the persisted policy,
+    register its stable name (idempotent overwrite — re-adoption after a
+    failover must not conflict with the dead leader's registration), and
+    return the name for ``set_score_policy``. None = keep current
+    weights."""
+    from ..ops.lattice import WEIGHT_PROFILES, register_weight_profile
+
+    got = read_persisted_policy(server)
+    if got is None:
+        return None
+    name, vec = got
+    if name not in WEIGHT_PROFILES or not np.array_equal(
+        WEIGHT_PROFILES.get(name), vec
+    ):
+        try:
+            register_weight_profile(name, vec, overwrite=True)
+        except ValueError as e:
+            # a persisted name colliding with a built-in profile: the
+            # built-in identity wins, the persisted VECTOR still applies
+            # if the built-in already equals it; otherwise refuse
+            if not np.array_equal(WEIGHT_PROFILES.get(name), vec):
+                metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "invalid"})
+                logger.error("persisted policy rejected (%s)", e)
+                return None
+    metrics.inc(COUNTER_POLICY_ADOPTIONS, {"outcome": "adopted"})
+    return name
+
+
+def set_active_policy_gauge(policy: str, previous: str = "") -> None:
+    """Flip the active-policy info gauge: the new name reads 1, the
+    retired name reads 0 (series linger by design — a dump shows the
+    succession, not just the survivor)."""
+    if previous and previous != policy:
+        metrics.set_gauge(GAUGE_ACTIVE_POLICY, 0.0, {"policy": previous})
+    metrics.set_gauge(GAUGE_ACTIVE_POLICY, 1.0, {"policy": policy})
+
+
+def tuner_health_lines() -> List[str]:
+    """Policy-gym state for the SIGUSR2 dump: ring depth, gym/shadow
+    progress, promotion/rollback/adoption counters, degraded skips and
+    the active-policy succession — whether (and why) the tuner is or
+    isn't converging is diagnosable from one signal. Empty when no tuner
+    has published state yet."""
+    lines: List[str] = []
+    for snap in (
+        metrics.snapshot_gauges("tuner_"),
+        metrics.snapshot_counters("tuner_"),
+    ):
+        for name, labels, value in snap:
+            annotation = ""
+            if name == GAUGE_ACTIVE_POLICY:
+                annotation = "ACTIVE" if value else "retired"
+            lines.append(
+                metrics.format_series_line(name, labels, value, annotation)
+            )
+    return lines

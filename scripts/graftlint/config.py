@@ -35,6 +35,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_serving.py",
     "tests/test_chaos_serving.py",
     "tests/test_chaos_preempt.py",
+    "tests/test_chaos_tuner.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -137,6 +138,7 @@ DUMP_REQUIRED_FAMILIES = (
     "leader_election_",
     "restclient_",
     "follower_read_",
+    "tuner_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------
@@ -147,6 +149,7 @@ DEGRADED_DIRS = (
     "kubernetes_tpu/scheduler",
     "kubernetes_tpu/autoscaler",
     "kubernetes_tpu/kubelet",
+    "kubernetes_tpu/tuner",
 )
 
 # method names that are store writes when called on a store-ish receiver
@@ -212,6 +215,8 @@ GUARDEDBY_CLASSES = (
     "BindRideThrough",
     "LeaderElector",
     "Tracer",
+    "WaveRingBuffer",
+    "PolicyTuner",
 )
 
 # canonicalization of lock spellings to the runtime watchdog names
@@ -235,6 +240,8 @@ GUARD_LOCK_ALIASES = {
     # construction: its `with self.lock` IS the cache lock
     "SnapshotAntiEntropy.lock": "scheduler.cache",
     "Tracer._lock": "tracing.ring",
+    "WaveRingBuffer._lock": "tuner.ring",
+    "PolicyTuner._lock": "tuner.state",
 }
 
 # the human-facing attr→lock reference the inferred guard map must

@@ -25,6 +25,7 @@ These scenarios prove the warm-standby design closes that gap:
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from test_chaos_pipeline import (
@@ -739,3 +740,65 @@ def test_plugin_per_pod_bind_path_is_fenced():
         a.stop()
         pool.stop()
         cacher.stop()
+
+
+# -- scenario: failover adopts the persisted tuned score policy ---------------
+
+
+@pytest.mark.slow
+def test_failover_adopts_persisted_score_policy():
+    """The policy gym persists its promoted vector as the singleton
+    ScorePolicy object precisely so a promotion survives its promoter.
+    Regression: a tuned vector is in the store; replica A wins the
+    election and adopts it at promote(); A crashes; the standby that
+    takes over MUST come up running the tuned vector — NOT revert to
+    ``default``, which would silently undo the promotion on every
+    failover. Adoption is read-only on the store object (the promotions
+    ledger must not move)."""
+    from kubernetes_tpu.ops.lattice import (
+        DEFAULT_WEIGHTS,
+        SC_COST,
+        WEIGHT_PROFILES,
+    )
+    from kubernetes_tpu.tuner import ACTIVE_POLICY_NAME, persist_active_policy
+
+    store, cacher, pool = _cluster(n_nodes=2)
+    vec = DEFAULT_WEIGHTS.copy()
+    vec[SC_COST] = 21.0
+    assert persist_active_policy(store, "t-ha-tuned", vec, identity="gym")
+    a = b = None
+    try:
+        a = _Replica(store, cacher, "adopt-a")
+        assert a.promoted.wait(20), "replica A never won the election"
+        assert a.sched._score_policy_name == "t-ha-tuned"
+        assert np.allclose(np.asarray(a.sched._weights), vec)
+
+        b = _Replica(store, cacher, "adopt-b")
+        adopted0 = metrics.dump().get(
+            "tuner_policy_adoptions_total{'outcome': 'adopted'}", 0.0
+        )
+        a.crash()
+        assert b.promoted.wait(30), "standby never took over the lease"
+
+        # the failover winner runs the tuned vector, not `default`
+        assert b.sched._score_policy_name == "t-ha-tuned"
+        assert np.allclose(np.asarray(b.sched._weights), vec)
+        adopted1 = metrics.dump().get(
+            "tuner_policy_adoptions_total{'outcome': 'adopted'}", 0.0
+        )
+        assert adopted1 >= adopted0 + 1, (adopted0, adopted1)
+
+        # adoption reads, never writes: the persisted object is untouched
+        obj = store.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+        assert obj.policy_name == "t-ha-tuned"
+        assert int(obj.promotions) == 1
+        assert [float(x) for x in obj.weights] == [float(x) for x in vec]
+        assert_bind_invariants(store)
+    finally:
+        if b is not None:
+            b.stop()
+        if a is not None:
+            a.stop()
+        pool.stop()
+        cacher.stop()
+        WEIGHT_PROFILES.pop("t-ha-tuned", None)

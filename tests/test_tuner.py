@@ -1,0 +1,480 @@
+"""Policy gym (kubernetes_tpu/tuner/) unit tier: weight validation +
+profile registration, candidate generators, outcome scoring, the wave
+ring, ScorePolicy persistence/adoption, and the shadow A/B gate's state
+machine driven directly (no scheduler, no device) — the end-to-end
+differential corpus and the leadership scenarios live in
+tests/test_chaos_tuner.py / test_chaos_ha.py.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.ops.lattice import (
+    DEFAULT_WEIGHTS,
+    NUM_SCORE_COMPONENTS,
+    SC_COST,
+    WEIGHT_PROFILES,
+    register_weight_profile,
+    weights_for_policy,
+)
+from kubernetes_tpu.runtime.consensus import DegradedWrites
+from kubernetes_tpu.tuner import (
+    ACTIVE_POLICY_NAME,
+    ScorePolicy,
+    adopt_persisted_policy,
+    persist_active_policy,
+    read_persisted_policy,
+    tuner_health_lines,
+)
+from kubernetes_tpu.tuner import candidates as cand_gen
+from kubernetes_tpu.tuner.controller import PolicyTuner
+from kubernetes_tpu.tuner.scoring import (
+    OverlaySnapshot,
+    WaveOutcome,
+    divergence,
+    score_assignment,
+)
+from kubernetes_tpu.tuner.waves import WaveRingBuffer
+from kubernetes_tpu.utils.metrics import metrics
+
+
+# -- satellite 1: call-time validation + profile registration -----------------
+
+
+def test_weights_for_policy_rejects_wrong_length():
+    with pytest.raises(ValueError):
+        weights_for_policy(np.ones(NUM_SCORE_COMPONENTS - 1, np.float32))
+
+
+def test_weights_for_policy_rejects_non_finite():
+    bad = np.ones(NUM_SCORE_COMPONENTS, np.float32)
+    bad[3] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        weights_for_policy(bad)
+    bad[3] = np.inf
+    with pytest.raises(ValueError, match="non-finite"):
+        weights_for_policy(bad)
+
+
+def test_weights_for_policy_rejects_uncoercible():
+    with pytest.raises(ValueError):
+        weights_for_policy(["not", "a", "vector"])
+
+
+def test_register_weight_profile_roundtrip():
+    vec = DEFAULT_WEIGHTS.copy()
+    vec[SC_COST] = 42.0
+    got = register_weight_profile("t-roundtrip", vec)
+    try:
+        assert np.array_equal(got, weights_for_policy("t-roundtrip"))
+        # idempotent same-vector re-register is fine
+        register_weight_profile("t-roundtrip", vec)
+        # silently replacing a DIFFERENT vector is not
+        vec2 = vec.copy()
+        vec2[SC_COST] = 7.0
+        with pytest.raises(ValueError, match="overwrite"):
+            register_weight_profile("t-roundtrip", vec2)
+        register_weight_profile("t-roundtrip", vec2, overwrite=True)
+        assert weights_for_policy("t-roundtrip")[SC_COST] == 7.0
+    finally:
+        WEIGHT_PROFILES.pop("t-roundtrip", None)
+
+
+def test_register_weight_profile_guards_names():
+    with pytest.raises(ValueError, match="reserved"):
+        register_weight_profile("default", DEFAULT_WEIGHTS.copy())
+    with pytest.raises(ValueError):
+        register_weight_profile("", DEFAULT_WEIGHTS.copy())
+    with pytest.raises(ValueError, match="non-finite"):
+        register_weight_profile(
+            "t-nan", np.full(NUM_SCORE_COMPONENTS, np.nan)
+        )
+    assert "t-nan" not in WEIGHT_PROFILES
+
+
+# -- candidate generators -----------------------------------------------------
+
+
+def test_perturbation_candidates_deterministic_and_zero_preserving():
+    a = cand_gen.perturbation_candidates(
+        DEFAULT_WEIGHTS, np.random.default_rng(7), 3
+    )
+    b = cand_gen.perturbation_candidates(
+        DEFAULT_WEIGHTS, np.random.default_rng(7), 3
+    )
+    assert len(a) == 3
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y), "same seed must give same candidates"
+        assert np.isfinite(x).all()
+        # multiplicative jitter: disabled components stay disabled
+        assert (x[DEFAULT_WEIGHTS == 0] == 0).all()
+
+
+def test_topsis_candidates_weight_dispersed_criteria():
+    n = 6
+    cost = np.array([100, 200, 400, 800, 1600, 3200])  # wildly dispersed
+    cands = cand_gen.topsis_candidates(
+        requested=np.zeros((n, 3)),
+        allocatable=np.full((n, 3), 10.0),
+        valid=np.ones(n, bool),
+        cost_milli=cost,
+        energy_milli=np.zeros(n),  # flat criterion: no information
+    )
+    assert len(cands) == 1
+    vec = weights_for_policy(cands[0])  # validates shape/dtype/finiteness
+    assert vec[SC_COST] > 0, "dispersed cost must earn weight"
+
+
+def test_gavel_candidates_inert_without_labels():
+    assert (
+        cand_gen.gavel_candidates(
+            np.zeros(4), np.zeros(4), np.full(4, -1), np.ones(4, bool)
+        )
+        == []
+    )
+    got = cand_gen.gavel_candidates(
+        np.array([100.0, 900.0]),
+        np.zeros(2),
+        np.array([0.0, 1.0]),
+        np.ones(2, bool),
+    )
+    assert len(got) == 1 and weights_for_policy(got[0])[SC_COST] > 0
+
+
+# -- outcome scoring ----------------------------------------------------------
+
+
+def _fake_ov(n=4, p=4, r=2, free=4, cost=None):
+    return OverlaySnapshot(
+        snap=None,
+        batch=None,
+        pod_valid=np.ones(p, bool),
+        req=np.ones((p, r), np.int64),
+        row_names=[f"n{i}" for i in range(n)],
+        v_cap=8,
+        node_valid=np.ones(n, bool),
+        free0=np.full((n, r), free, np.int64),
+        alloc=np.full((n, r), free, np.int64),
+        cost_milli=(
+            np.zeros(n, np.int64) if cost is None else np.asarray(cost)
+        ),
+        energy_milli=np.zeros(n, np.int64),
+        accel_class=np.full(n, -1, np.int64),
+    )
+
+
+def test_score_assignment_placed_fraction_dominates():
+    ov = _fake_ov()
+    all_placed = score_assignment(ov, np.array([0, 0, 1, 1]))
+    none_placed = score_assignment(ov, np.full(4, -1))
+    assert all_placed.placed == 4 and none_placed.placed == 0
+    assert all_placed.utility > none_placed.utility
+    assert none_placed.preempt_pressure == 4
+
+
+def test_score_assignment_fragmentation_prefers_packing():
+    ov = _fake_ov(n=4, p=4)
+    packed = score_assignment(ov, np.array([0, 0, 0, 0]))
+    smeared = score_assignment(ov, np.array([0, 1, 2, 3]))
+    assert packed.fragmentation < smeared.fragmentation
+    assert packed.utility > smeared.utility
+
+
+def test_score_assignment_cost_norm_prefers_cheap_nodes():
+    ov = _fake_ov(cost=[100, 100, 4000, 4000])
+    cheap = score_assignment(ov, np.array([0, 0, 1, 1]))
+    pricey = score_assignment(ov, np.array([2, 2, 3, 3]))
+    assert cheap.cost_norm < pricey.cost_norm
+    assert cheap.utility > pricey.utility
+
+
+def test_divergence():
+    ov = _fake_ov()
+    prod = np.array([0, 1, 2, 3])
+    assert divergence(ov, prod.copy(), prod) == 0.0
+    assert divergence(ov, np.array([0, 1, 2, 0]), prod) == pytest.approx(
+        0.25
+    )
+
+
+# -- the wave ring ------------------------------------------------------------
+
+
+def test_wave_ring_bounded_with_monotonic_seq():
+    ring = WaveRingBuffer(capacity=3)
+    for i in range(5):
+        ring.record_wave([object()], DEFAULT_WEIGHTS, [f"n{i}"])
+    assert len(ring) == 3
+    recs = ring.snapshot()
+    assert [r.seq for r in recs] == [3, 4, 5]
+    assert ring.last_seq() == 5
+    assert [r.seq for r in ring.snapshot(min_seq=4)] == [5]
+    assert [r.seq for r in ring.snapshot(limit=1)] == [5]
+    ring.record_wave([], DEFAULT_WEIGHTS, [])  # empty wave: no record
+    assert ring.last_seq() == 5
+    ring.clear()
+    assert len(ring) == 0
+
+
+# -- ScorePolicy persistence / adoption (satellite 2's unit tier) -------------
+
+
+def test_persist_and_read_roundtrip():
+    server = APIServer()
+    vec = DEFAULT_WEIGHTS.copy()
+    vec[SC_COST] = 33.0
+    assert persist_active_policy(server, "t-persist", vec, "sched-a")
+    obj = server.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+    assert isinstance(obj, ScorePolicy)
+    assert obj.policy_name == "t-persist" and obj.promotions == 1
+    # second promotion UPDATES the singleton, bumping the counter
+    assert persist_active_policy(server, "t-persist2", vec, "sched-b")
+    obj = server.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+    assert obj.policy_name == "t-persist2" and obj.promotions == 2
+    name, got = read_persisted_policy(server)
+    assert name == "t-persist2" and np.array_equal(got, vec)
+
+
+def test_adopt_persisted_policy_registers_profile():
+    server = APIServer()
+    vec = DEFAULT_WEIGHTS.copy()
+    vec[SC_COST] = 11.0
+    persist_active_policy(server, "t-adopt", vec)
+    try:
+        assert adopt_persisted_policy(server) == "t-adopt"
+        assert np.array_equal(weights_for_policy("t-adopt"), vec)
+    finally:
+        WEIGHT_PROFILES.pop("t-adopt", None)
+
+
+def test_adopt_absent_policy_is_none():
+    assert adopt_persisted_policy(APIServer()) is None
+
+
+def test_persist_degraded_store_is_counted_skip():
+    server = APIServer()
+
+    def refuse(*a, **kw):
+        raise DegradedWrites("injected")
+
+    server.guaranteed_update = refuse
+    server.create = refuse
+    skips0 = metrics.counter(
+        "tuner_degraded_write_skips_total", {"write": "policy_persist"}
+    )
+    assert not persist_active_policy(server, "t-deg", DEFAULT_WEIGHTS)
+    assert (
+        metrics.counter(
+            "tuner_degraded_write_skips_total", {"write": "policy_persist"}
+        )
+        == skips0 + 1
+    )
+
+
+def test_persisted_garbage_never_adopted():
+    server = APIServer()
+    server.create(
+        "scorepolicies",
+        ScorePolicy(
+            metadata=__import__(
+                "kubernetes_tpu.api.objects", fromlist=["ObjectMeta"]
+            ).ObjectMeta(name=ACTIVE_POLICY_NAME, namespace=""),
+            weights=[float("nan")] * NUM_SCORE_COMPONENTS,
+            policy_name="t-garbage",
+        ),
+    )
+    assert read_persisted_policy(server) is None
+    assert adopt_persisted_policy(server) is None
+    assert "t-garbage" not in WEIGHT_PROFILES
+
+
+# -- the shadow A/B gate, driven directly -------------------------------------
+
+
+class _StubSched:
+    """Just enough scheduler for PolicyTuner's gate methods."""
+
+    def __init__(self):
+        self._weights = DEFAULT_WEIGHTS.copy()
+        self._score_policy_name = "default"
+        self._ha_identity = "stub-0"
+        self.swaps = []
+        self.wave_recorder = None
+
+    def set_score_policy(self, policy):
+        self.swaps.append(policy)
+        self._weights = weights_for_policy(policy)
+        self._score_policy_name = (
+            policy if isinstance(policy, str) else "custom"
+        )
+
+
+def _outcome(utility, placed=4, total=4):
+    return WaveOutcome(
+        placed=placed,
+        total=total,
+        fragmentation=0.0,
+        preempt_pressure=total - placed,
+        cost_norm=0.0,
+        energy_norm=0.0,
+        utility=utility,
+    )
+
+
+def _arm(source, name, vec, utility, chosen=None):
+    if chosen is None:
+        chosen = np.zeros(4, np.int64)
+    return (source, name, vec, chosen, _outcome(utility))
+
+
+def _mk_tuner(**kw):
+    sched = _StubSched()
+    server = APIServer()
+    kw.setdefault("shadow_windows", 3)
+    kw.setdefault("noise_floor", 0.01)
+    return PolicyTuner(sched, server, **kw), sched, server
+
+
+def test_gate_promotes_only_after_n_consecutive_shadow_wins():
+    tuner, sched, server = _mk_tuner()
+    ov = _fake_ov()
+    inc = DEFAULT_WEIGHTS.copy()
+    pack = WEIGHT_PROFILES["pack"].copy()
+    prod_rows = np.zeros(4, np.int64)
+    prod = _outcome(0.5)
+    # window 1: "pack" beats the incumbent → enters shadow, NOT promoted
+    tuner._decide(
+        "default", inc,
+        [_arm("incumbent", "default", inc, 0.5),
+         _arm("profile", "pack", pack, 0.9)],
+        ov, prod_rows, prod,
+    )
+    assert tuner._shadow is not None and tuner._shadow["wins"] == 1
+    assert sched.swaps == [], "one good window must never promote"
+    shadow_arms = [
+        _arm("incumbent", "default", inc, 0.5),
+        _arm("shadow", "pack", pack, 0.9),
+    ]
+    # window 2: still not enough
+    tuner._decide("default", inc, shadow_arms, ov, prod_rows, prod)
+    assert sched.swaps == []
+    # window 3: third consecutive win → promoted, persisted, watch armed
+    tuner._decide("default", inc, shadow_arms, ov, prod_rows, prod)
+    assert sched.swaps == ["pack"]
+    assert tuner._shadow is None and tuner._post is not None
+    obj = server.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+    assert obj.policy_name == "pack"
+
+
+def test_gate_one_lost_window_discards_challenger():
+    tuner, sched, _server = _mk_tuner()
+    ov = _fake_ov()
+    inc = DEFAULT_WEIGHTS.copy()
+    pack = WEIGHT_PROFILES["pack"].copy()
+    prod_rows = np.zeros(4, np.int64)
+    prod = _outcome(0.5)
+    tuner._decide(
+        "default", inc,
+        [_arm("incumbent", "default", inc, 0.5),
+         _arm("profile", "pack", pack, 0.9)],
+        ov, prod_rows, prod,
+    )
+    assert tuner._shadow is not None
+    wins0 = metrics.counter("tuner_shadow_windows_total", {"outcome": "win"})
+    # the shadow DIVERGES (scores below the incumbent): discarded at once
+    tuner._decide(
+        "default", inc,
+        [_arm("incumbent", "default", inc, 0.5),
+         _arm("shadow", "pack", pack, 0.4)],
+        ov, prod_rows, prod,
+    )
+    assert tuner._shadow is None, "a lost window must discard the shadow"
+    assert sched.swaps == [], "incumbent must be kept"
+    assert (
+        metrics.counter("tuner_shadow_windows_total", {"outcome": "win"})
+        == wins0
+    )
+
+
+def test_gate_rejects_nan_candidate_before_replay():
+    tuner, _sched, _server = _mk_tuner()
+    ov = _fake_ov()
+    rejected0 = metrics.counter(
+        "tuner_candidates_rejected_total", {"reason": "invalid"}
+    )
+    tuner.inject_candidate(
+        np.full(NUM_SCORE_COMPONENTS, np.nan), name="poison"
+    )
+    arms = tuner._assemble_candidates("default", DEFAULT_WEIGHTS.copy(), ov)
+    assert all(source != "injected" for source, _n, _v in arms)
+    for _s, _n, vec in arms:
+        assert np.isfinite(vec).all()
+    assert (
+        metrics.counter(
+            "tuner_candidates_rejected_total", {"reason": "invalid"}
+        )
+        > rejected0
+    )
+
+
+def test_gate_degraded_store_pauses_promotion_keeps_shadow():
+    tuner, sched, server = _mk_tuner()
+
+    def refuse(*a, **kw):
+        raise DegradedWrites("injected")
+
+    server.guaranteed_update = refuse
+    server.create = refuse
+    with tuner._lock:
+        tuner._shadow = {
+            "name": "pack",
+            "vec": WEIGHT_PROFILES["pack"].copy(),
+            "wins": 3,
+            "source": "profile",
+        }
+    tuner._promote(
+        "pack", WEIGHT_PROFILES["pack"].copy(), "default",
+        DEFAULT_WEIGHTS.copy(), _outcome(0.5),
+    )
+    assert sched.swaps == [], "a vector the store refused must not apply"
+    assert tuner._shadow is not None, "shadow survives for the retry"
+    assert tuner._pause_ticks > 0, "tuner pauses while degraded"
+    # a paused tick is a no-op that burns one pause credit
+    tuner.tick()
+    assert tuner._pause_ticks == tuner.degraded_pause_ticks - 1
+
+
+def test_rollback_on_post_promotion_regression():
+    tuner, sched, server = _mk_tuner(rollback_windows=2)
+    ov = _fake_ov()
+    inc = WEIGHT_PROFILES["pack"].copy()  # "pack" was just promoted
+    prod_rows = np.zeros(4, np.int64)
+    with tuner._lock:
+        tuner._post = {
+            "prev_name": "default",
+            "prev_vec": DEFAULT_WEIGHTS.copy(),
+            "baseline": 0.9,
+            "bad": 0,
+            "good": 0,
+            "seq": tuner.ring.last_seq(),
+        }
+    rollbacks0 = metrics.counter("tuner_rollbacks_total")
+    # live waves arrive AFTER the promotion...
+    tuner.ring.record_wave([object()], inc, ["n0"])
+    # ...and production utility cratered vs the 0.9 baseline
+    arms = [_arm("incumbent", "pack", inc, 0.3)]
+    tuner._decide("pack", inc, arms, ov, prod_rows, _outcome(0.3))
+    assert sched.swaps == [], "one bad window must not roll back"
+    tuner._decide("pack", inc, arms, ov, prod_rows, _outcome(0.3))
+    assert sched.swaps == ["default"], "regression must roll back"
+    assert metrics.counter("tuner_rollbacks_total") == rollbacks0 + 1
+    assert tuner._post is None
+    obj = server.get("scorepolicies", "", ACTIVE_POLICY_NAME)
+    assert obj.policy_name == "default", "rollback must persist too"
+
+
+def test_health_lines_render():
+    metrics.inc("tuner_gym_passes_total")
+    lines = tuner_health_lines()
+    assert any("tuner_gym_passes_total" in ln for ln in lines)
