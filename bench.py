@@ -450,7 +450,18 @@ def main() -> int:
                         "pod_p50_ms": round(lat.pod_p50_ms, 3),
                         "pod_p90_ms": round(lat.pod_p90_ms, 3),
                         "pod_p99_ms": round(lat.pod_p99_ms, 3),
+                        "cycle_p50_ms": round(lat.cycle_p50_ms, 3),
                         "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
+                        # the pod latency split: queue wait (real per-pod
+                        # queue spans) vs in-flight (the in-cycle e2e
+                        # histogram) — which half owns the p99
+                        "queue_wait_p50_ms": round(lat.queue_wait_p50_ms, 3),
+                        "queue_wait_p99_ms": round(lat.queue_wait_p99_ms, 3),
+                        "in_flight_p50_ms": round(lat.in_flight_p50_ms, 3),
+                        "in_flight_p99_ms": round(lat.in_flight_p99_ms, 3),
+                        # split-phase acceptance: host-blocking device
+                        # syncs per bound pod over the measured window
+                        "readbacks_per_bind": round(lat.readbacks_per_bind, 4),
                         "scheduled": lat.scheduled,
                         "pipeline_depth": lat.pipeline_depth,
                         "max_waves_inflight": lat.max_waves_inflight,
@@ -500,6 +511,16 @@ def main() -> int:
     lat_d = detail.get("steady_state_latency") or {}
     if lat_d:
         compact["steady_pod_p99_ms"] = lat_d.get("pod_p99_ms")
+        # split-phase readback headline pair (r17): blocking device syncs
+        # per bound pod, and how many measured readback RTTs the steady
+        # pod p99 spans (the one-RTT-per-bind floor broken means this can
+        # approach — or on near-zero-RTT CPU, merely stop tracking — 1.0;
+        # the RTT clamps at 10 µs so a local backend can't divide by ~0)
+        compact["readbacks_per_bind"] = lat_d.get("readbacks_per_bind")
+        rtt = detail.get("device_readback_rtt_ms")
+        p99 = lat_d.get("pod_p99_ms")
+        if rtt is not None and p99 is not None:
+            compact["rtt_floor_ratio"] = round(p99 / max(rtt, 0.01), 2)
         # compact stage waterfall (p99 per stage, ms) + the reconciliation
         # ratio: the one-line answer to "where does the p99 pod spend it"
         wf = lat_d.get("stage_waterfall") or {}

@@ -232,6 +232,19 @@ class GenerationLease:
         if gen is not None:
             self._enc._unpin(gen)
 
+    # Non-lexical hold (split-phase readback): the fast index payload's
+    # source generation must stay pinned until the TRAILING bulk readback
+    # lands, which happens in a later scheduling-loop iteration — a
+    # with-block can't span that. acquire()/release() are __enter__/
+    # __exit__ for holders that outlive their frame; release() is
+    # idempotent-safe in the sense that the lease must be released
+    # exactly once (the scheduler's trailing entry owns it).
+    def acquire(self) -> "GenerationLease":
+        return self.__enter__()
+
+    def release(self) -> None:
+        self.__exit__(None, None, None)
+
 
 class DonationLease:
     """Writer-side generation advance: seal → dispatch → install.

@@ -677,6 +677,10 @@ preempt_whatif = jax.jit(_preempt_whatif)
 
 GUARD_ROW_RANGE = "row_out_of_range"
 GUARD_NONFINITE = "nonfinite_score"
+# split-phase readback: the trailing bulk transfer died after the fast
+# index payload already drove assumes — the batch's device commits are
+# unverifiable and must quarantine/unwind
+GUARD_TRAILING_LOSS = "trailing_readback_loss"
 
 
 class KernelGuardTrip(RuntimeError):
@@ -706,4 +710,19 @@ def validate_batch_outputs(chosen, placed, score, n_rows: int):
         s = np.asarray(score)[placed]
         if not np.isfinite(s).all():
             return GUARD_NONFINITE
+    return None
+
+
+def validate_trailing_score(score, placed):
+    """Split-phase trailing validation: the fast index payload was
+    validated (and acted on) with score=None; when the bulk score vector
+    lands it must agree that every placed pod scored finite — a NaN/Inf
+    here means the argmax the fast payload reported was computed over a
+    poisoned column. Returns a trip reason or None."""
+    placed = np.asarray(placed, dtype=bool)
+    if score is None or not placed.any():
+        return None
+    s = np.asarray(score)[placed]
+    if not np.isfinite(s).all():
+        return GUARD_NONFINITE
     return None

@@ -239,6 +239,18 @@ class LatencyResult:
     pod_p99_ms: float
     cycle_p50_ms: float
     cycle_p99_ms: float
+    # where the pod latency lives: time-in-queue (queue entry → cycle
+    # start, from the real per-pod "queue" spans) vs time-in-flight (the
+    # in-cycle e2e histogram). pod_* ≈ queue_wait_* + in_flight_* at the
+    # mean; the percentiles are each distribution's own, not a sum.
+    queue_wait_p50_ms: float = 0.0
+    queue_wait_p99_ms: float = 0.0
+    in_flight_p50_ms: float = 0.0
+    in_flight_p99_ms: float = 0.0
+    # split-phase readback amortization: host-BLOCKING device syncs per
+    # bound pod over the measured window (< 1.0 means most binds consumed
+    # an already-landed async transfer; the r17 acceptance metric)
+    readbacks_per_bind: float = 0.0
     # wave pipelining over the measured window (see BenchResult)
     pipeline_depth: int = 0
     max_waves_inflight: int = 0
@@ -319,6 +331,8 @@ def run_latency_benchmark(
     e2e_h = metrics.histogram("e2e_scheduling_duration_seconds")
     q = lambda h, p: (h.quantile(p) * 1000 if h else 0.0)  # noqa: E731
     waterfall, vs_e2e = _stage_waterfall(e2e_h)
+    queue_stats = tracer.stage_stats(kind="pod").get("queue") or {}
+    blocking = metrics.counter("scheduler_wave_readbacks_blocking_total")
     p99_tid, p99_trace = "", None
     if e2e_h is not None:
         ex = e2e_h.exemplar_near(0.99)
@@ -335,6 +349,11 @@ def run_latency_benchmark(
         pod_p99_ms=q(pod_h, 0.99),
         cycle_p50_ms=q(e2e_h, 0.5),
         cycle_p99_ms=q(e2e_h, 0.99),
+        queue_wait_p50_ms=float(queue_stats.get("p50_ms", 0.0)),
+        queue_wait_p99_ms=float(queue_stats.get("p99_ms", 0.0)),
+        in_flight_p50_ms=q(e2e_h, 0.5),
+        in_flight_p99_ms=q(e2e_h, 0.99),
+        readbacks_per_bind=(blocking / scheduled if scheduled > 0 else 0.0),
         pipeline_depth=sched._pipeline_depth,
         max_waves_inflight=int(
             metrics.gauge("scheduler_wave_inflight_max") or 0

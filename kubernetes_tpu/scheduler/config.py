@@ -80,6 +80,26 @@ class KubeSchedulerConfiguration:
     # device) or 2 when it is sub-ms (local device / CPU, where deep
     # pipelining only adds latency and host/device CPU contention).
     pipeline_depth: int = 0
+    # split-phase readback (round 17): the kernel's chosen/placed/deferred
+    # index payload (a few KB) streams back through an async device->host
+    # copy started AT DISPATCH, so the bind-critical resolve never joins
+    # with the bulk score/audit tensors — those trail in a second transfer
+    # the guards consume off the critical path (a late disagreement
+    # quarantines + unwinds through the suspect-row machinery). None =
+    # auto (on); False restores the round-16 combined readback.
+    split_phase_readback: Optional[bool] = None
+    # depth-infinity micro-waves (experimental): deliver the fast index
+    # payload through a jax.experimental.io_callback fired ON DEVICE the
+    # moment the kernel resolves, so the host observes wave N without
+    # issuing any device->host sync call at all. Off by default — the
+    # async-copy fast path already removes the readback join, and the
+    # callback variant is a separate jit cache entry per kernel shape.
+    host_callback_binds: bool = False
+    # bound on trailing bulk readbacks awaiting validation: past this the
+    # oldest is force-drained (one blocking readback) rather than letting
+    # unvalidated payloads — and their generation pins — pile up behind a
+    # slow tunnel
+    trailing_readback_max: int = 8
     encoding: EncodingConfig = field(default_factory=EncodingConfig)
     bind_workers: int = 16
     assume_ttl_seconds: float = 30.0
@@ -196,6 +216,8 @@ class KubeSchedulerConfiguration:
             raise ValueError("device_batch_size must be >= 1, or 0 for auto")
         if self.pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 1, or 0 for auto")
+        if self.trailing_readback_max < 1:
+            raise ValueError("trailing_readback_max must be >= 1")
         if self.pending_bind_capacity < 1:
             raise ValueError("pending_bind_capacity must be >= 1")
         if self.guard_sample_per_wave < 0:

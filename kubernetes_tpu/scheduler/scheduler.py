@@ -46,7 +46,9 @@ from ..ops.encoding import ETERM_ANTI_REQ as _ETERM_ANTI_REQ
 from ..ops.preemptlattice import validate_preempt_outputs
 from ..ops.templates import TemplateCache, build_pair_table
 from ..ops.wavelattice import make_wave_kernel_jit
+from ..ops import hostcallback
 from ..ops.lattice import (
+    GUARD_TRAILING_LOSS,
     KernelGuardTrip,
     NUM_SCORE_COMPONENTS,
     SC_BALANCED,
@@ -62,6 +64,7 @@ from ..ops.lattice import (
     SC_TOPO_SPREAD,
     make_schedule_batch,
     validate_batch_outputs,
+    validate_trailing_score,
     weights_for_policy,
 )
 from ..parallel.sharded import (
@@ -100,6 +103,30 @@ logger = logging.getLogger("kubernetes_tpu.scheduler")
 GAUGE_WAVE_INFLIGHT = "scheduler_wave_inflight"
 GAUGE_WAVE_INFLIGHT_MAX = "scheduler_wave_inflight_max"
 GAUGE_WAVE_PIPELINE_DEPTH = "scheduler_wave_pipeline_depth"
+# split-phase readback counters (round 17): fast = index-payload fetches
+# (the bind-critical resolve), blocking = fetches that actually had to
+# wait on the device (the readbacks_per_bind numerator), trailing = bulk
+# score fetches consumed off the critical path, hostcb = fast payloads
+# delivered by the kernel's own io_callback (no host-issued sync at all)
+COUNTER_WAVE_FAST_READBACKS = "scheduler_wave_fast_readbacks_total"
+COUNTER_WAVE_BLOCKING_READBACKS = "scheduler_wave_readbacks_blocking_total"
+COUNTER_WAVE_TRAILING_READBACKS = "scheduler_wave_trailing_readbacks_total"
+COUNTER_WAVE_TRAILING_UNWOUND = "scheduler_wave_trailing_unwound_assumes_total"
+COUNTER_WAVE_HOSTCB = "scheduler_wave_hostcb_deliveries_total"
+GAUGE_WAVE_TRAILING_BACKLOG = "scheduler_wave_trailing_backlog"
+
+
+def _device_ready(arr) -> bool:
+    """True when a device array's value is already materialized (its
+    fetch would not block). Host numpy (or anything without is_ready,
+    e.g. an injector-substituted array) counts as ready."""
+    is_ready = getattr(arr, "is_ready", None)
+    if is_ready is None:
+        return True
+    try:
+        return bool(is_ready())
+    except Exception:
+        return True
 
 
 @contextmanager
@@ -134,12 +161,13 @@ class _InFlightBatch:
     __slots__ = (
         "pis", "eb", "row_names", "res", "moves0", "trace", "t_start",
         "snapshot", "launch_gen", "wave_tid", "t_launched", "weights",
-        "rng_key",
+        "rng_key", "ticket", "trailing",
     )
 
     def __init__(
         self, pis, eb, row_names, res, moves0, trace, t_start, snapshot=None,
         launch_gen=0, wave_tid="", t_launched=0.0, weights=None, rng_key=None,
+        ticket=None,
     ):
         self.pis = pis
         self.eb = eb
@@ -170,6 +198,53 @@ class _InFlightBatch:
         # live policy is by then
         self.weights = weights
         self.rng_key = rng_key
+        # host_callback_binds: the delivery-registry ticket the kernel's
+        # io_callback posts this batch's fast index payload under
+        self.ticket = ticket
+        # split-phase readback: the _TrailingReadback registered at fast
+        # commit (None when nothing was placed, or in combined mode) —
+        # whoever consumes it finishes the wave trace
+        self.trailing = None
+
+
+class _TrailingReadback:
+    """The bulk half of one batch's split-phase resolve: the score
+    vector whose fetch + validation trail the bind-critical commit. The
+    entry holds a generation pin from fast-commit until its readback
+    lands (the graftlint lease discipline: a late disagreement must
+    still be able to name suspect rows in the generation the fast
+    payload committed into), and remembers enough of the fast decision
+    (placed mask + to_bind tuples) to unwind it."""
+
+    __slots__ = (
+        "score", "placed", "to_bind", "launch_gen", "wave_tid", "pin",
+        "binds_issued", "quarantined", "gated", "t_registered", "path",
+    )
+
+    def __init__(
+        self, score, placed, to_bind, launch_gen, wave_tid, pin,
+        path="wave",
+    ):
+        self.score = score
+        self.placed = placed
+        self.to_bind = to_bind
+        self.launch_gen = launch_gen
+        self.wave_tid = wave_tid
+        self.pin = pin
+        # False until this entry's batch dispatched its binds: an unwind
+        # before then reverts assumes (nothing left the process); after,
+        # the bound pods stay and only the snapshot quarantines
+        self.binds_issued = False
+        self.quarantined = False
+        # True only while this entry's own pre-bind gate is draining:
+        # tells _unwind_trailing the gate owns the assume revert (it has
+        # the per-pod assume errors), preventing a double requeue
+        self.gated = False
+        self.t_registered = time.monotonic()
+        self.path = path
+
+    def ready(self) -> bool:
+        return _device_ready(self.score)
 
 
 _SCORE_NAME_TO_COMPONENT = {
@@ -328,6 +403,19 @@ class Scheduler:
         # scheduleOne, scheduler.go:666, taken to its batch conclusion).
         self._pending: List[_InFlightBatch] = []
         self._wave_inflight_peak = 0  # high-water mark of len(_pending)
+        # split-phase readback (round 17): resolve on the fast index
+        # payload alone (async-copied at dispatch), validate the trailing
+        # bulk score off the critical path. auto = on; False restores the
+        # combined readback (the A/B baseline arm).
+        self._split_phase = (
+            self.cfg.split_phase_readback
+            if self.cfg.split_phase_readback is not None
+            else True
+        )
+        # trailing bulk readbacks registered at fast commit, oldest
+        # first; drained non-blocking before each launch and in the
+        # loop's idle beat (scheduling-loop thread only)
+        self._trailing: List[_TrailingReadback] = []
         # resolved by start() when cfg.pipeline_depth == 0 (auto)
         self._pipeline_depth = self.cfg.pipeline_depth or 2
         # auto batch size: TPU backends take the big batch (template-shaped
@@ -868,6 +956,10 @@ class Scheduler:
         # into a shut-down pool
         if self._sched_thread is not None:
             self._sched_thread.join(timeout=10.0)
+        # outstanding trailing readbacks hold generation pins; consume
+        # them (the loop is dead, so nobody else will release them)
+        if self._trailing:
+            self._drain_trailing(block=True)
         if self._owned_read_cache is not None:
             self._owned_read_cache.stop()
         # release parked permit-waiters or the drain below would block on
@@ -898,6 +990,7 @@ class Scheduler:
             return (
                 len(self.queue) == 0
                 and not self._pending
+                and not self._trailing
                 and not self._busy
                 and not self._ridethrough.open
                 and self._ridethrough.depth == 0
@@ -991,6 +1084,15 @@ class Scheduler:
                     self._busy = True
                     try:
                         self._resolve_pending()
+                    finally:
+                        self._busy = False
+                elif self._trailing:
+                    # idle with trailing bulk readbacks outstanding:
+                    # consume them now (blocking — nothing else to do)
+                    # so late validation can't dangle past quiescence
+                    self._busy = True
+                    try:
+                        self._drain_trailing(block=True)
                     finally:
                         self._busy = False
                 else:
@@ -1474,6 +1576,7 @@ class Scheduler:
         failed: List = []  # (pi, batch_index or -1)
         resolvable = None
         serial_placed: dict = {}  # id(pi) -> node (tuner wave record)
+        serial_to_bind: List = []  # (pi, node_name) decode-first, bind after
         for i, pi in enumerate(pis):
             if eb.fallback[i]:
                 fallback_pis.append(pi)
@@ -1488,9 +1591,33 @@ class Scheduler:
             if node_name is None:
                 failed.append((pi, -1))
                 continue
-            metrics.observe("scheduling_algorithm_duration_seconds", algo_dur)
-            self._assume_and_bind(pi, node_name, t_start)
-            serial_placed[id(pi)] = node_name
+            serial_to_bind.append((pi, node_name))
+        # split-phase serial: the fast chosen-index payload was acted on
+        # with score=None; register the trailing bulk validation before
+        # any bind leaves the process, and take one last non-blocking
+        # look — on CPU the score has usually landed by now, so the
+        # common case still validates before the first bind
+        entry = None
+        if self._split_phase and score is None and serial_to_bind:
+            entry = self._register_trailing(
+                res.score,
+                np.asarray(chosen) != -1,
+                [(pi, node, None, None) for pi, node in serial_to_bind],
+                launch_gen, None, path="serial",
+            )
+        if entry is not None and self._trailing_gate(entry):
+            for pi, _node in serial_to_bind:
+                tracer.event(pi.trace_id, "serial.trailing_unwound")
+                self.queue.requeue_backoff(pi)
+        else:
+            for pi, node_name in serial_to_bind:
+                metrics.observe(
+                    "scheduling_algorithm_duration_seconds", algo_dur
+                )
+                self._assume_and_bind(pi, node_name, t_start)
+                serial_placed[id(pi)] = node_name
+            if entry is not None:
+                entry.binds_issued = True
         self._record_wave_for_tuner(
             pis, serial_placed, w_launch, sub, launch_gen, path="serial"
         )
@@ -1672,12 +1799,36 @@ class Scheduler:
             # to static analysis at this call — the marker makes it the
             # checked donation site (graftlint donation pass)
             new_snap, res = kern(dl.snap, batch, ptab, weights, key)  # graftlint: donating-call
+            if self._split_phase:
+                # split-phase: start BOTH device->host copies at dispatch.
+                # The few-KB index payload (chosen/placed/deferred) lands
+                # the moment the kernel resolves — the fast resolve below
+                # never joins with it over a fresh RTT — and the bulk
+                # score streams behind it for the trailing validation.
+                # Inside the donation lease on purpose (graftlint fastpath
+                # rule): the early transfer is tied to the generation
+                # lifecycle it reads from, and the trailing entry keeps a
+                # pin until its half lands.
+                try:
+                    res.chosen.copy_to_host_async()
+                    res.placed.copy_to_host_async()
+                    res.deferred.copy_to_host_async()
+                    res.score.copy_to_host_async()
+                except Exception:
+                    # sharded outputs on exotic meshes may not support the
+                    # async copy; the fetch below degrades to a plain
+                    # (blocking) device_get — correctness unchanged
+                    logger.debug(
+                        "async fast-path copy unavailable", exc_info=True
+                    )
             dl.result = new_snap
         return new_snap, res
 
     def _fetch_wave_results(self, batches: List["_InFlightBatch"]):
         """Seam for the fault injector: the combined device->host readback
-        for k in-flight batches."""
+        for k in-flight batches (the non-split-phase path)."""
+        metrics.inc(COUNTER_WAVE_BLOCKING_READBACKS)
+        metrics.inc("scheduler_wave_readbacks_total")
         return jax.device_get(
             [
                 (b.res.chosen, b.res.placed, b.res.deferred, b.res.score)
@@ -1685,11 +1836,239 @@ class Scheduler:
             ]
         )
 
+    def _fetch_wave_index(self, batches: List["_InFlightBatch"]):
+        """Seam for the fault injector: the split-phase FAST readback —
+        just the index payload (chosen, placed, deferred) per batch. The
+        async copy started at dispatch means this usually consumes an
+        already-landed transfer; a host-callback ticket beats even that
+        (the kernel pushed the payload itself). Blocking fetches (payload
+        not materialized yet — the resolve overtook the kernel) count
+        separately: they are the readbacks_per_bind numerator."""
+        metrics.inc(COUNTER_WAVE_FAST_READBACKS)
+        out: List = []
+        for b in batches:
+            payload = None
+            if b.ticket is not None:
+                payload = hostcallback.take(b.ticket, timeout=2.0)
+                if payload is not None:
+                    metrics.inc(COUNTER_WAVE_HOSTCB)
+            out.append(payload)
+        missing = [i for i, p in enumerate(out) if p is None]
+        if missing:
+            if not all(
+                _device_ready(batches[i].res.chosen)
+                and _device_ready(batches[i].res.placed)
+                and _device_ready(batches[i].res.deferred)
+                for i in missing
+            ):
+                # the resolve overtook the transfer: this fetch is a real
+                # host-blocking device sync — the only kind the legacy
+                # readbacks_total series (and readbacks_per_bind) counts
+                metrics.inc(COUNTER_WAVE_BLOCKING_READBACKS)
+                metrics.inc("scheduler_wave_readbacks_total")
+            got = jax.device_get(
+                [
+                    (
+                        batches[i].res.chosen,
+                        batches[i].res.placed,
+                        batches[i].res.deferred,
+                    )
+                    for i in missing
+                ]
+            )
+            for i, p in zip(missing, got):
+                out[i] = p
+        return out
+
+    def _fetch_wave_bulk(self, entries: List["_TrailingReadback"]):
+        """Seam for the fault injector: the split-phase TRAILING readback
+        — the bulk score payload for registered trailing entries."""
+        return jax.device_get([e.score for e in entries])
+
+    # -- split-phase trailing validation --------------------------------------
+
+    def _register_trailing(
+        self, score, placed, to_bind, launch_gen, wave_tid, path="wave"
+    ) -> "_TrailingReadback":
+        """Register one batch's trailing bulk readback at fast commit.
+        The entry pins the live generation (released when its readback
+        lands) and the backlog is bounded: past trailing_readback_max the
+        oldest entry is force-drained with a blocking fetch."""
+        pin = None
+        try:
+            pin = self.cache.encoder.pin_generation().acquire()
+        except Exception:
+            # pin failure must not block the fast path — the unwind can
+            # still invalidate + mark suspect rows without it
+            logger.exception("trailing generation pin failed")
+        entry = _TrailingReadback(
+            score, np.asarray(placed, dtype=bool), list(to_bind),
+            launch_gen, wave_tid, pin, path,
+        )
+        self._trailing.append(entry)
+        overflow = len(self._trailing) - self.cfg.trailing_readback_max
+        if overflow > 0:
+            metrics.inc(COUNTER_WAVE_BLOCKING_READBACKS)
+            self._drain_trailing(block=True, limit=overflow)
+        metrics.set_gauge(
+            GAUGE_WAVE_TRAILING_BACKLOG, float(len(self._trailing))
+        )
+        return entry
+
+    def _trailing_gate(self, entry: "_TrailingReadback") -> bool:
+        """Pre-bind gate (called by _assume_and_bind_bulk between assume
+        and bind): consume whatever trailing payloads already landed —
+        including this batch's own, when the kernel finished — and report
+        whether THIS batch must unwind. Non-blocking: a slow tunnel's
+        trailing payload is consumed on a later drain instead of stalling
+        the bind-critical path."""
+        entry.gated = True
+        try:
+            self._drain_trailing(block=False)
+        finally:
+            entry.gated = False
+        return entry.quarantined
+
+    def _drain_trailing(
+        self, block: bool = False, limit: Optional[int] = None
+    ) -> None:
+        """Consume registered trailing readbacks, oldest first; never
+        raises. block=False stops at the first entry whose bulk payload
+        hasn't materialized yet."""
+        n = 0
+        while self._trailing:
+            if limit is not None and n >= limit:
+                break
+            entry = self._trailing[0]
+            if not block and not entry.quarantined and not entry.ready():
+                break
+            self._trailing.pop(0)
+            n += 1
+            try:
+                self._consume_trailing(entry)
+            except Exception:
+                logger.exception("trailing readback consumption failed")
+                self._release_trailing_pin(entry)
+        metrics.set_gauge(
+            GAUGE_WAVE_TRAILING_BACKLOG, float(len(self._trailing))
+        )
+
+    def _consume_trailing(self, entry: "_TrailingReadback") -> None:
+        if entry.quarantined:
+            # an elder sibling's trailing trip already condemned this
+            # entry (same suspect snapshot chain): nothing to validate
+            self._release_trailing_pin(entry)
+            tracer.finish(entry.wave_tid, outcome="trailing_sibling")
+            return
+        t0 = time.monotonic()
+        try:
+            with _stage_timer("trailing"):
+                score = call_with_device_retry(
+                    lambda: self._fetch_wave_bulk([entry]),
+                    attempts=self.cfg.device_retry_attempts,
+                    on_retry=lambda n, e: metrics.inc(
+                        "scheduler_device_retries_total",
+                        {"stage": "trailing"},
+                    ),
+                )[0]
+            metrics.inc(COUNTER_WAVE_TRAILING_READBACKS)
+        except Exception as e:
+            logger.exception("trailing bulk readback failed")
+            if is_device_loss_error(e):
+                metrics.inc(
+                    "scheduler_device_loss_total", {"stage": "trailing"}
+                )
+            self._unwind_trailing(entry, GUARD_TRAILING_LOSS, str(e))
+            return
+        finally:
+            self._release_trailing_pin(entry)
+        reason = None
+        if self.cfg.kernel_output_guards:
+            reason = validate_trailing_score(score, entry.placed)
+        if reason is not None:
+            self._unwind_trailing(entry, reason)
+            return
+        self._consecutive_guard_trips = 0
+        t1 = time.monotonic()
+        tracer.add_span(entry.wave_tid, "trailing", t0, t1)
+        tracer.finish(entry.wave_tid, outcome="committed")
+
+    def _release_trailing_pin(self, entry: "_TrailingReadback") -> None:
+        pin, entry.pin = entry.pin, None
+        if pin is not None:
+            try:
+                pin.release()
+            except Exception:
+                logger.exception("trailing generation pin release failed")
+
+    def _unwind_trailing(
+        self, entry: "_TrailingReadback", reason: str, detail: str = ""
+    ) -> None:
+        """The trailing bulk payload disagrees with (or never reached)
+        the fast index payload the batch already acted on. Quarantine:
+        count the trip, mark every row the fast payload committed into
+        suspect (the anti-entropy auditor re-checks + repairs them from
+        the host masters), force a device snapshot rebuild, and condemn
+        every younger trailing entry (their kernels chained on the same
+        suspect snapshot). If this batch's binds have NOT left the
+        process yet (the pre-bind gate caught it), revert its assumes
+        and requeue — zero wrong bindings; already-bound pods passed the
+        fast-phase row/oracle guards and stay."""
+        entry.quarantined = True
+        metrics.inc("kernel_guard_trips_total", {"reason": reason})
+        logger.error(
+            "trailing readback validation tripped (%s%s): batch "
+            "quarantined, snapshot rebuild forced%s",
+            reason, f" {detail}" if detail else "",
+            "" if entry.binds_issued else "; assumes unwound",
+        )
+        with self.cache.lock:
+            enc = self.cache.encoder
+            for _pi, node_name, _band, _proto in entry.to_bind:
+                row = enc._row_by_name.get(node_name)
+                if row is not None:
+                    enc.suspect_rows.add(row)
+            enc.invalidate_device()
+        if not entry.binds_issued and not entry.gated:
+            for pi, _node, _band, _proto in entry.to_bind:
+                try:
+                    self.cache.forget_pod(pi.pod)
+                except Exception:
+                    logger.exception("trailing unwind forget failed")
+                metrics.inc(COUNTER_WAVE_TRAILING_UNWOUND)
+                tracer.event(pi.trace_id, "wave.trailing_unwound")
+                self.queue.requeue_backoff(pi)
+        tracer.finish(entry.wave_tid, outcome=f"trailing_trip:{reason}")
+        for e in self._trailing:
+            if not e.quarantined:
+                e.quarantined = True
+                metrics.inc(
+                    "kernel_guard_trips_total",
+                    {"reason": "sibling_quarantine"},
+                )
+        self._consecutive_guard_trips += 1
+        if (
+            self._consecutive_guard_trips
+            >= self.cfg.device_loss_disable_after
+        ):
+            logger.error(
+                "%d consecutive kernel guard trips: abandoning the "
+                "device path for the host path",
+                self._consecutive_guard_trips,
+            )
+            self._set_device_down()
+
     def _schedule_batch_wave_once(
         self, pis: List[QueuedPodInfo], moves0: int, trace: Trace, t_start: float
     ) -> None:
         """Launch the wave kernel for this batch; resolve the PREVIOUS
         in-flight batch while this one computes (depth-1 pipeline)."""
+        # consume any trailing bulk payload that already landed BEFORE the
+        # donation below: draining releases the entries' generation pins,
+        # so the steady-state launch donates in place instead of paying a
+        # copy-on-pin snapshot clone every wave
+        if self._trailing:
+            self._drain_trailing(block=False)
         # two padded-batch buckets: ragged tails use a small lattice, bursts
         # the full one. Exactly two jit variants per wave count — each extra
         # bucket is another multi-second XLA compile on first use
@@ -1774,7 +2153,7 @@ class Scheduler:
         else:
             from ..ops.wavelattice import DEFAULT_RTC_SHAPE
 
-            kern = make_wave_kernel_jit(
+            variant = (
                 enc_cfg.v_cap,
                 m_cand,
                 n_waves,
@@ -1784,6 +2163,21 @@ class Scheduler:
                 self._rtc_shape or DEFAULT_RTC_SHAPE,
                 has_pinned,
             )
+            kern = make_wave_kernel_jit(*variant)
+        ticket = None
+        if self.cfg.host_callback_binds and self._mesh is None:
+            # depth-infinity micro-waves: the kernel posts its own fast
+            # index payload through io_callback under this ticket — the
+            # resolve consumes the delivery instead of issuing any sync
+            from ..ops.wavelattice import make_wave_kernel_cb_jit
+
+            cb_kern = make_wave_kernel_cb_jit(*variant)
+            ticket = hostcallback.new_ticket()
+            t_arr = np.int32(ticket)
+
+            def kern(s, b, p, w, k, _cb=cb_kern, _t=t_arr):
+                return _cb(s, b, p, w, k, _t)
+
         self._rng_key, sub = jax.random.split(self._rng_key)
         w_launch = np.asarray(self._weights)
         t_launch0 = time.monotonic()
@@ -1792,6 +2186,8 @@ class Scheduler:
                 kern, snap, eb.batch, ptab, w_launch, sub
             )
         except Exception:
+            if ticket is not None:
+                hostcallback.discard(ticket)
             with self.cache.lock:
                 self.cache.encoder.invalidate_device()
             raise
@@ -1814,7 +2210,7 @@ class Scheduler:
         self._pending.append(
             _InFlightBatch(
                 pis, eb, row_names, res, moves0, trace, t_start, verify_snap,
-                launch_gen, wave_tid, t_launched, w_launch, sub,
+                launch_gen, wave_tid, t_launched, w_launch, sub, ticket,
             )
         )
         metrics.inc("scheduler_wave_batches_total")
@@ -1830,6 +2226,30 @@ class Scheduler:
             # the readback + the host-side bind work below
             keep = 0 if self._pipeline_depth == 1 else 1
             self._resolve_oldest(len(self._pending) - keep)
+        elif self._split_phase and len(self._pending) > 1:
+            # continuous micro-waves: any older wave whose fast index
+            # payload ALREADY landed (async copy started at dispatch, or
+            # the kernel's own io_callback) commits now instead of
+            # waiting for the pipeline to fill — its pods stop paying the
+            # pipeline-fill wait, and the device keeps computing the
+            # newest wave while the host binds. Never the newest: its
+            # device time is what overlaps this host work.
+            n_ready = 0
+            for b in self._pending[:-1]:
+                if not self._fast_payload_ready(b):
+                    break
+                n_ready += 1
+            if n_ready:
+                self._resolve_oldest(n_ready)
+
+    def _fast_payload_ready(self, b: "_InFlightBatch") -> bool:
+        if b.ticket is not None and hostcallback.ready(b.ticket):
+            return True
+        return (
+            _device_ready(b.res.chosen)
+            and _device_ready(b.res.placed)
+            and _device_ready(b.res.deferred)
+        )
 
     def _resolve_pending(self) -> None:
         self._resolve_oldest(len(self._pending))
@@ -1845,24 +2265,33 @@ class Scheduler:
             return
         batches, self._pending = self._pending[:k], self._pending[k:]
         metrics.set_gauge(GAUGE_WAVE_INFLIGHT, float(len(self._pending)))
+        split = self._split_phase
         t_rb0 = time.monotonic()
         with _stage_timer("kernel"):
             try:
                 # transient device/tunnel blips get bounded jittered
                 # retries (the fetched refs are re-gettable — no donation
-                # on the read side) before the loss path takes over
+                # on the read side) before the loss path takes over.
+                # Split mode fetches ONLY the index payload here; the bulk
+                # score trails through _fetch_wave_bulk off this path.
+                fetch = (
+                    self._fetch_wave_index
+                    if split
+                    else self._fetch_wave_results
+                )
                 fetched = call_with_device_retry(
-                    lambda: self._fetch_wave_results(batches),
+                    lambda: fetch(batches),
                     attempts=self.cfg.device_retry_attempts,
                     on_retry=lambda n, e: metrics.inc(
                         "scheduler_device_retries_total",
                         {"stage": "readback"},
                     ),
                 )
-                metrics.inc("scheduler_wave_readbacks_total")
                 self._consecutive_device_loss = 0
             except Exception as e:
                 for b in batches:
+                    if b.ticket is not None:
+                        hostcallback.discard(b.ticket)
                     tracer.finish(b.wave_tid, outcome="readback_failed")
                     for pi in b.pis:
                         tracer.event(pi.trace_id, "readback.failed")
@@ -1923,10 +2352,21 @@ class Scheduler:
                     tracer.event(pi.trace_id, "wave.quarantined")
                     self.queue.readd(pi)
                 continue
+            if split:
+                # fast payload only: score arrives with the trailing bulk
+                # readback — validation/decode below run with score=None
+                arrays = (*arrays, None)
             try:
                 tails.append(self._commit_batch(b, arrays, t_rb1))
-                self._consecutive_guard_trips = 0
-                tracer.finish(b.wave_tid, outcome="committed")
+                if b.trailing is None:
+                    # combined mode — or a split batch that placed
+                    # nothing: the guard story is complete right here.
+                    # With a trailing entry registered, the trip counter
+                    # resets only when the TRAILING validation passes
+                    # (else a poisoned device alternating commit/unwind
+                    # would never latch off).
+                    self._consecutive_guard_trips = 0
+                    tracer.finish(b.wave_tid, outcome="committed")
             except KernelGuardTrip as trip:
                 quarantined = True
                 tracer.finish(b.wave_tid, outcome=f"guard_trip:{trip.reason}")
@@ -2051,6 +2491,18 @@ class Scheduler:
                 "guard", t_rb1, time.monotonic(),
             )
 
+        entry = None
+        if self._split_phase and (
+            to_bind or bool(np.asarray(placed, dtype=bool).any())
+        ):
+            # split-phase trailing half: the bulk score payload validates
+            # off the critical path. Registered BEFORE assume so the
+            # pre-bind gate below can catch an own-batch disagreement
+            # while the assumes are still revertible.
+            entry = p.trailing = self._register_trailing(
+                p.res.score, placed, to_bind, p.launch_gen, p.wave_tid,
+            )
+
         if self.cfg.verify_cycles and to_bind:
             try:
                 self._verify_placements(to_bind, p.snapshot)
@@ -2059,16 +2511,26 @@ class Scheduler:
                 # here would requeue a fully successful batch while the
                 # device snapshot keeps its commits
                 logger.exception("verify_cycles cross-check failed")
-        self._assume_and_bind_bulk(to_bind, t_start, device_synced=True)
-        trace.step("assume+bind")
-        self._record_wave_for_tuner(
-            p.pis,
-            {id(pi): node for pi, node, _b, _pr in to_bind},
-            p.weights,
-            p.rng_key,
-            p.launch_gen,
-            path="wave",
+        self._assume_and_bind_bulk(
+            to_bind, t_start, device_synced=True,
+            trailing_gate=(
+                (lambda: self._trailing_gate(entry))
+                if entry is not None
+                else None
+            ),
         )
+        trace.step("assume+bind")
+        if entry is not None and not entry.quarantined:
+            entry.binds_issued = True
+        if entry is None or not entry.quarantined:
+            self._record_wave_for_tuner(
+                p.pis,
+                {id(pi): node for pi, node, _b, _pr in to_bind},
+                p.weights,
+                p.rng_key,
+                p.launch_gen,
+                path="wave",
+            )
         return fallback_pis, failed
 
     def _record_wave_for_tuner(
@@ -2425,6 +2887,8 @@ class Scheduler:
             metrics.inc(
                 "kernel_guard_trips_total", {"reason": "sibling_quarantine"}
             )
+            if b.ticket is not None:
+                hostcallback.discard(b.ticket)
             tracer.finish(b.wave_tid, outcome="sibling_quarantine")
             for pi in b.pis:
                 tracer.event(pi.trace_id, "wave.quarantined")
@@ -2537,10 +3001,28 @@ class Scheduler:
         call, split out as an injectable seam for the chaos fault
         injector (mirrors _launch_wave_kernel/_fetch_wave_results).
         ``weights`` pins the exact launch vector (the tuner records it
-        for differential replay); None reads the live policy."""
+        for differential replay); None reads the live policy.
+
+        Split-phase mode: only the small chosen-index vector is fetched
+        on the critical path (its device→host copy was started at
+        dispatch); the bulk score tensor streams back behind it and is
+        validated by the trailing machinery — the caller sees score=None
+        and registers a _TrailingReadback."""
         if weights is None:
             weights = np.asarray(self._weights)
         res = kern(snap, batch, weights, key)
+        if self._split_phase:
+            with self.cache.encoder.pin_generation():
+                try:
+                    res.chosen.copy_to_host_async()
+                    res.score.copy_to_host_async()
+                except Exception:
+                    logger.debug(
+                        "async readback start failed", exc_info=True
+                    )
+                metrics.inc(COUNTER_WAVE_BLOCKING_READBACKS)
+                chosen = np.asarray(jax.device_get(res.chosen))
+            return res, chosen, None
         chosen, score = jax.device_get((res.chosen, res.score))
         return res, chosen, score
 
@@ -2713,7 +3195,8 @@ class Scheduler:
             return None
 
     def _assume_and_bind_bulk(
-        self, to_bind: List, t_start: float, device_synced: bool = False
+        self, to_bind: List, t_start: float, device_synced: bool = False,
+        trailing_gate=None,
     ) -> None:
         """Assume + bind a whole wave of placements ((pi, node, band,
         proto) tuples; proto may be None for host-path placements). When
@@ -2753,6 +3236,27 @@ class Scheduler:
              if err is None],
             "assume", t_a0, time.monotonic(),
         )
+        if trailing_gate is not None and trailing_gate():
+            # split-phase last-look: between assume and bind the trailing
+            # bulk payload (ours or an elder sibling's on the same
+            # snapshot chain) arrived and failed validation. The binds
+            # have NOT left the process — revert every assume and requeue
+            # instead of issuing bindings off a condemned fast payload.
+            for (pi, _node, _band, _proto), err in zip(to_bind, errors):
+                if err is not None:
+                    self._handle_failure(
+                        pi, self.queue.moves_snapshot(),
+                        message=err, error=True,
+                    )
+                    continue
+                try:
+                    self.cache.forget_pod(pi.pod)
+                except Exception:
+                    logger.exception("trailing gate unwind forget failed")
+                metrics.inc(COUNTER_WAVE_TRAILING_UNWOUND)
+                tracer.event(pi.trace_id, "wave.trailing_unwound")
+                self.queue.requeue_backoff(pi)
+            return
         simple: List = []
         for (pi, node_name, band, proto), err in zip(to_bind, errors):
             pod = pi.pod

@@ -78,8 +78,17 @@ def corrupt_device_rows(
 
 class DeviceFaultInjector:
     """Wraps one Scheduler's device seams (_launch_wave_kernel /
-    _fetch_wave_results / _run_serial_kernel). Ordinals count calls made
-    AFTER install()."""
+    _fetch_wave_results / _fetch_wave_index / _fetch_wave_bulk /
+    _run_serial_kernel). Ordinals count calls made AFTER install().
+
+    Split-phase mapping: the fast index fetch shares the readback
+    ordinal space with the legacy combined fetch — `fail_readbacks` and
+    `wild_rows_on_readbacks` land there (the chosen-row payload rides
+    the fast path). The score tensor only exists on the TRAILING bulk
+    fetch in split mode, so `nan_scores_on_readbacks` ordinals index
+    bulk calls there, and `fail_trailing_readbacks` kills the trailing
+    fetch itself — the exact late-disagreement the unwind machinery
+    must catch after the fast payload already drove assumes."""
 
     def __init__(
         self,
@@ -89,6 +98,7 @@ class DeviceFaultInjector:
         nan_scores_on_readbacks: Iterable[int] = (),
         wild_rows_on_readbacks: Iterable[int] = (),
         fail_all_serials: bool = False,
+        fail_trailing_readbacks: Iterable[int] = (),
     ):
         self.fail_launches = set(fail_launches)
         self.fail_all_launches = fail_all_launches
@@ -96,8 +106,10 @@ class DeviceFaultInjector:
         self.nan_scores_on_readbacks = set(nan_scores_on_readbacks)
         self.wild_rows_on_readbacks = set(wild_rows_on_readbacks)
         self.fail_all_serials = fail_all_serials
+        self.fail_trailing_readbacks = set(fail_trailing_readbacks)
         self.launch_calls = 0
         self.readback_calls = 0
+        self.bulk_calls = 0
         self.serial_calls = 0
         self.injected = []  # (kind, ordinal) audit trail for assertions
         self._lock = threading.Lock()
@@ -109,9 +121,13 @@ class DeviceFaultInjector:
         self._sched = sched
         self._real_launch = sched._launch_wave_kernel
         self._real_fetch = sched._fetch_wave_results
+        self._real_fetch_index = sched._fetch_wave_index
+        self._real_fetch_bulk = sched._fetch_wave_bulk
         self._real_serial = sched._run_serial_kernel
         sched._launch_wave_kernel = self._launch
         sched._fetch_wave_results = self._fetch
+        sched._fetch_wave_index = self._fetch_index
+        sched._fetch_wave_bulk = self._fetch_bulk
         sched._run_serial_kernel = self._serial
         return self
 
@@ -119,6 +135,8 @@ class DeviceFaultInjector:
         if self._sched is not None:
             self._sched._launch_wave_kernel = self._real_launch
             self._sched._fetch_wave_results = self._real_fetch
+            self._sched._fetch_wave_index = self._real_fetch_index
+            self._sched._fetch_wave_bulk = self._real_fetch_bulk
             self._sched._run_serial_kernel = self._real_serial
             self._sched = None
 
@@ -177,4 +195,54 @@ class DeviceFaultInjector:
                 chosen[np.nonzero(placed)[0][0]] = 2**30
                 self.injected.append(("wild_row", n))
             out.append((chosen, placed, deferred, score))
+        return out
+
+    def _fetch_index(self, batches):
+        """Split-phase FAST seam: index payload only. Shares the
+        readback ordinal space with the legacy combined fetch."""
+        with self._lock:
+            n = self.readback_calls
+            self.readback_calls += 1
+            boom = n in self.fail_readbacks
+            wild = n in self.wild_rows_on_readbacks
+        if boom:
+            self.injected.append(("readback_loss", n))
+            raise DeviceLossError(
+                f"injected: device lost on readback #{n}"
+            )
+        fetched = self._real_fetch_index(batches)
+        out = []
+        for chosen, placed, deferred in fetched:
+            chosen = np.array(chosen)
+            placed = np.array(placed)
+            if wild and placed.any():
+                chosen = chosen.copy()
+                chosen[np.nonzero(placed)[0][0]] = 2**30
+                self.injected.append(("wild_row", n))
+            out.append((chosen, placed, deferred))
+        return out
+
+    def _fetch_bulk(self, entries):
+        """Split-phase TRAILING seam: the bulk score payload, fetched
+        after the fast payload's placements were already acted on."""
+        with self._lock:
+            n = self.bulk_calls
+            self.bulk_calls += 1
+            boom = n in self.fail_trailing_readbacks
+            nan = n in self.nan_scores_on_readbacks
+        if boom:
+            self.injected.append(("trailing_loss", n))
+            raise DeviceLossError(
+                f"injected: device lost on trailing readback #{n}"
+            )
+        scores = self._real_fetch_bulk(entries)
+        out = []
+        for e, score in zip(entries, scores):
+            score = np.array(score)
+            placed = np.asarray(e.placed, dtype=bool)
+            if nan and placed.any():
+                score = score.copy()
+                score[np.nonzero(placed)[0][0]] = np.nan
+                self.injected.append(("nan_score", n))
+            out.append(score)
         return out

@@ -20,6 +20,12 @@ before the next launches) vs the generational default (waves chain in
 flight on donated snapshot generations while audits/what-ifs read pinned
 older generations).
 
+`--split-phase` adds a split-phase-vs-combined readback A/B: the same
+steady-state probe with the r17 split-phase data plane (async index
+readback started at dispatch, bulk score validation trailing off the
+critical path, continuous micro-wave resolve) vs the r16 combined
+blocking readback (split_phase_readback=False).
+
 Usage: python scripts/dataplane_overhead_ab.py [--rate 300] [--pods 400]
 Emits one JSON line; CPU-forced unless BENCH_AB_TPU=1.
 """
@@ -41,7 +47,11 @@ if os.environ.get("BENCH_AB_TPU", "") not in ("1", "true"):
 
 
 def steady_state_arm(
-    defenses: bool, rate: float, n_pods: int, pipeline_depth: int = 0
+    defenses: bool,
+    rate: float,
+    n_pods: int,
+    pipeline_depth: int = 0,
+    split_phase=None,
 ):
     from kubernetes_tpu.perf.harness import run_latency_benchmark
     from kubernetes_tpu.perf.workloads import WORKLOADS
@@ -49,13 +59,17 @@ def steady_state_arm(
 
     if defenses:
         # defaults: everything on; pipeline_depth 0 = auto
-        scfg = KubeSchedulerConfiguration(pipeline_depth=pipeline_depth)
+        scfg = KubeSchedulerConfiguration(
+            pipeline_depth=pipeline_depth,
+            split_phase_readback=split_phase,
+        )
     else:
         scfg = KubeSchedulerConfiguration(
             kernel_output_guards=False,
             guard_sample_per_wave=0,
             antientropy_period_s=0.0,
             pipeline_depth=pipeline_depth,
+            split_phase_readback=split_phase,
         )
     cfg = WORKLOADS["SchedulingPodAffinity/5000"]
     lat = run_latency_benchmark(cfg, rate, n_pods=n_pods, sched_config=scfg)
@@ -65,7 +79,11 @@ def steady_state_arm(
         "pod_p50_ms": round(lat.pod_p50_ms, 3),
         "pod_p90_ms": round(lat.pod_p90_ms, 3),
         "pod_p99_ms": round(lat.pod_p99_ms, 3),
+        "cycle_p50_ms": round(lat.cycle_p50_ms, 3),
         "cycle_p99_ms": round(lat.cycle_p99_ms, 3),
+        "queue_wait_p99_ms": round(lat.queue_wait_p99_ms, 3),
+        "in_flight_p99_ms": round(lat.in_flight_p99_ms, 3),
+        "readbacks_per_bind": round(lat.readbacks_per_bind, 4),
         "pipeline_depth": lat.pipeline_depth,
         "max_waves_inflight": lat.max_waves_inflight,
     }
@@ -160,6 +178,14 @@ def main() -> int:
         help="also A/B the burst-throughput headline (adds ~2 min)",
     )
     ap.add_argument(
+        "--split-phase",
+        action="store_true",
+        help="A/B the split-phase readback + continuous micro-waves "
+        "against the r16 combined readback (split_phase_readback=False: "
+        "one blocking chosen+score fetch per resolve, no early "
+        "micro-wave resolve) — defenses at defaults in both arms",
+    )
+    ap.add_argument(
         "--generational",
         action="store_true",
         help="A/B the generational wave pipeline against the serialized "
@@ -229,6 +255,32 @@ def main() -> int:
             out["pipeline_p99_speedup"] = round(
                 ser["pod_p99_ms"] / gen["pod_p99_ms"], 3
             ) if gen["pod_p99_ms"] else None
+    if args.split_phase:
+        # split-phase (async index readback + trailing bulk validation +
+        # continuous micro-wave resolve) vs the r16 combined blocking
+        # readback. Defenses stay at defaults in both arms; same
+        # alternating best-of discipline. readbacks_per_bind is the
+        # structural check: the split arm should sit well under 1.0.
+        sp_runs, co_runs = [], []
+        for rep in range(max(1, args.reps)):
+            order = [(True, sp_runs), (False, co_runs)]
+            if rep % 2:
+                order.reverse()
+            for split, runs in order:
+                runs.append(
+                    steady_state_arm(
+                        True, args.rate, args.pods, split_phase=split
+                    )
+                )
+        out["split_phase"] = best(sp_runs)
+        out["combined_readback"] = best(co_runs)
+        out["split_phase_runs"] = sp_runs
+        out["combined_readback_runs"] = co_runs
+        sp, co = out["split_phase"], out["combined_readback"]
+        if co["pod_p99_ms"] and sp["pod_p99_ms"]:
+            out["split_phase_p99_speedup"] = round(
+                co["pod_p99_ms"] / sp["pod_p99_ms"], 3
+            )
     if args.burst:
         bon, boff = [], []
         for rep in range(max(1, args.reps)):

@@ -48,6 +48,17 @@ CHAOS_SUITE_FILES = [
 # while readers pin an older generation
 GENERATION_LEASE_SUFFIXES = ("donation_lease",)
 
+# split-phase fast-path readback discipline (PR 17): methods that start
+# an async device->host transfer of kernel outputs. The transfer reads
+# buffers owned by the live snapshot generation, so the call must sit
+# lexically inside a with-region that ties it to the generation
+# lifecycle — the donation lease that launched the kernel (wave path)
+# or an explicit generation pin (serial path). A fast-path readback
+# escaping both races generation retirement: the donor may consume the
+# buffers mid-transfer and the "fast" payload silently reads garbage.
+FAST_READBACK_METHODS = ("copy_to_host_async",)
+FASTPATH_LEASE_SUFFIXES = ("donation_lease", "pin_generation")
+
 # the RETIRED big lock: the process-wide device_lock serialized every
 # donation-bearing device entry point against every reader and is gone
 # from the tree — any `with <...>.device_lock` anywhere is a finding

@@ -22,6 +22,17 @@ generation (or hands the donor a copy-on-pin buffer set). The contract:
   anywhere in the tree is itself a finding (``retired-device-lock``):
   the wave path must never grow the big lock back.
 
+The split-phase corollary (PR 17, ``fastpath-escape``): a fast-path
+readback — any call to a method in config.FAST_READBACK_METHODS, i.e.
+``copy_to_host_async`` on a kernel output — starts an async transfer out
+of buffers the live generation owns. The call must sit inside a
+with-region for one of config.FASTPATH_LEASE_SUFFIXES (the launching
+``donation_lease`` on the wave path, or an explicit ``pin_generation``
+on the serial path); a readback escaping both races generation
+retirement, and the "fast" index payload can silently read buffers a
+later donor already consumed. The alias-safe / holds-generation-lease
+escapes apply the same way they do for donation sites.
+
 Donating callables are discovered, not declared: any name assigned from
 an expression containing a donation keyword joins the module's donating
 set, names assigned from references to donating names propagate
@@ -39,7 +50,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Set, Tuple
 
-from core import Finding, Module, Tree, call_name
+from core import Finding, Module, Tree, call_name, dotted_name
 import config
 
 PASS = "donation"
@@ -181,11 +192,15 @@ def discover(tree: Tree) -> Tuple[Dict[Module, ModTaint], Set[str]]:
 
 
 def _site_ok(
-    mod: Module, node: ast.AST, deferred: List[str]
+    mod: Module, node: ast.AST, deferred: List[str], suffixes=None
 ) -> bool:
     """One donation site: lease-held, alias-safe, or deferred to the
-    enclosing function's call sites (holds-generation-lease)."""
-    if mod.inside_with_lock(node, config.GENERATION_LEASE_SUFFIXES):
+    enclosing function's call sites (holds-generation-lease). Fast-path
+    readback sites pass the wider FASTPATH_LEASE_SUFFIXES (a generation
+    pin ties the transfer to the lifecycle as well as a lease does)."""
+    if suffixes is None:
+        suffixes = config.GENERATION_LEASE_SUFFIXES
+    if mod.inside_with_lock(node, suffixes):
         return True
     func = mod.enclosing_function(node)
     while func is not None:
@@ -255,6 +270,41 @@ def run(tree: Tree) -> List[Finding]:
                             "definition is donation-bearing",
                         )
                     )
+
+    # split-phase fast-path readbacks: the async device->host copy of a
+    # kernel output must stay lexically tied to the generation lifecycle
+    # it reads from — the donation lease that launched the kernel, or a
+    # generation pin. One that escapes both races generation retirement.
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in config.FAST_READBACK_METHODS
+            ):
+                continue
+            if _site_ok(
+                mod, node, deferred, config.FASTPATH_LEASE_SUFFIXES
+            ):
+                continue
+            func = mod.enclosing_function(node)
+            where = func.name if func is not None else "<module>"
+            recv = dotted_name(f.value) or "<expr>"
+            findings.append(
+                Finding(
+                    mod.rel,
+                    node.lineno,
+                    PASS,
+                    f"fastpath-escape:{where}:{recv}",
+                    f"fast-path readback `{recv}.{f.attr}()` outside "
+                    "any donation_lease/pin_generation region: the "
+                    "async transfer races generation retirement (and "
+                    f"`{where}` is not marked alias-safe or "
+                    "holds-generation-lease)",
+                )
+            )
 
     for mod in tree.modules:
         taint = per_mod[mod]

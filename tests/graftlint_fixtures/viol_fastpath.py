@@ -1,0 +1,47 @@
+"""graftlint fixture: split-phase fast-path readback escapes (NOT
+collected by pytest — parsed only, never imported/executed).
+
+Expected findings (tests/test_graftlint.py asserts exactly these):
+  1. fastpath-escape: `res.chosen.copy_to_host_async()` in
+     `escaped_readback` — the donating launch ran inside its donation
+     lease, but the fast-path readback fires AFTER the lease released,
+     outside any generation pin: the async transfer races generation
+     retirement against the next donor.
+
+`leased_readback` (copy inside the launching donation lease) and
+`pinned_readback` (copy inside an explicit pin_generation region) are
+the two sanctioned shapes and must stay clean.
+"""
+
+import functools
+
+import jax
+
+
+def _impl(snap, idx):
+    return snap
+
+
+_kern = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+
+
+def escaped_readback(self, batch):
+    with self.encoder.donation_lease() as dl:
+        res = _kern(dl.snap, batch)
+        dl.result = res
+    res.chosen.copy_to_host_async()  # finding 1: lease already released
+    return res
+
+
+def leased_readback(self, batch):
+    with self.encoder.donation_lease() as dl:
+        res = _kern(dl.snap, batch)
+        res.chosen.copy_to_host_async()  # clean: inside the lease
+        dl.result = res
+    return res
+
+
+def pinned_readback(self, res):
+    with self.encoder.pin_generation():
+        res.score.copy_to_host_async()  # clean: generation pinned
+    return res

@@ -377,6 +377,70 @@ def test_serial_device_path_rides_through_device_loss():
         inj.uninstall()
 
 
+# -- scenario 4b: trailing bulk readback dies AFTER the fast payload ----------
+
+
+def test_trailing_readback_loss_unwinds_assumes_zero_wrong_bindings():
+    """Split-phase late-disagreement drill (r17): every wave's fast
+    index payload lands cleanly and drives assumes, then the trailing
+    bulk readback dies on every attempt (retries included). The
+    pre-bind trailing gate must quarantine each batch BEFORE its binds
+    leave the process — assumes revert (counted), pods requeue —
+    repeated trips latch the device path down, and the host path
+    completes the backlog. Invariants: zero wrong bindings, zero
+    leaked assumes, no oversubscription."""
+    store = ChaosStore()
+    pool = NodeAgentPool(store, housekeeping_interval=0.1)
+    for i in range(6):
+        pool.add_node(f"tb-{i}")
+    n = 30
+    for i in range(n):
+        store.create("pods", make_pod(f"tw-{i}"))
+    trips0 = metrics.counter(
+        "kernel_guard_trips_total", {"reason": "trailing_readback_loss"}
+    )
+    unwound0 = metrics.counter(
+        "scheduler_wave_trailing_unwound_assumes_total"
+    )
+    sched = Scheduler(store, _cfg())
+    inj = DeviceFaultInjector(
+        fail_trailing_readbacks=set(range(64))
+    ).install(sched)
+    pool.start()
+    sched.start()
+    try:
+        assert wait_until(lambda: _bound_count(store) == n, 30), (
+            f"only {_bound_count(store)}/{n} bound after trailing-loss "
+            "quarantine + host-path latch"
+        )
+        assert (
+            metrics.counter(
+                "kernel_guard_trips_total",
+                {"reason": "trailing_readback_loss"},
+            )
+            > trips0
+        ), "trailing readback loss never tripped the guard"
+        assert (
+            metrics.counter("scheduler_wave_trailing_unwound_assumes_total")
+            > unwound0
+        ), "no assumes were unwound by the pre-bind trailing gate"
+        assert any(k == "trailing_loss" for k, _ in inj.injected), (
+            "injector never hit the trailing seam"
+        )
+        assert sched._device_down, (
+            "repeated trailing trips must latch the device path down"
+        )
+        # the core promise: assumes reverted before any bind left the
+        # process, and what DID bind (host path) is resource-sane
+        _no_leaked_assumes(sched)
+        _no_oversubscription(store, cpu_capacity_m=4000)
+        assert_bind_invariants(store)
+    finally:
+        sched.stop()
+        pool.stop()
+        inj.uninstall()
+
+
 # -- cache/encoder divergence regressions (satellites) ------------------------
 
 

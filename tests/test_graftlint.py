@@ -82,6 +82,17 @@ def test_generation_lease_fixture_exact_findings():
     ]
 
 
+def test_fastpath_fixture_exact_findings():
+    """Split-phase fast-path readback discipline: a copy_to_host_async
+    fired after the launching donation lease released (and outside any
+    pin_generation region) is a finding — while the same call inside
+    the lease or inside an explicit generation pin stays clean."""
+    found = donation.run(_tree("viol_fastpath.py"))
+    assert _keys(found) == [
+        "fastpath-escape:escaped_readback:res.chosen",
+    ]
+
+
 # -- pass 2: dispatch-thread blocking calls ----------------------------------
 
 

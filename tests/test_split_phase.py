@@ -1,0 +1,212 @@
+"""Split-phase readback + continuous micro-waves (round 17).
+
+Unit coverage for the data plane pieces the chaos suite exercises under
+faults: the host-callback delivery registry (ticket lifecycle, late
+deliveries after discard), the split-phase wave path end-to-end (fast
+index payload drives assumes, trailing bulk validation drains, all pods
+land), the io_callback delivery variant, the combined-readback parity
+arm, and the config validation for the trailing backlog bound.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.client.apiserver import APIServer
+from kubernetes_tpu.ops import hostcallback
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import KubeSchedulerConfiguration
+from kubernetes_tpu.utils.metrics import metrics
+
+
+# -- hostcallback delivery registry ------------------------------------------
+
+
+def test_ticket_lifecycle_deliver_then_take():
+    t = hostcallback.new_ticket()
+    assert not hostcallback.ready(t)
+    chosen = np.array([0, 2, -1], dtype=np.int32)
+    placed = np.array([True, True, False])
+    deferred = np.array([False, False, False])
+    hostcallback.deliver(np.int32(t), chosen, placed, deferred)
+    assert hostcallback.ready(t)
+    payload = hostcallback.take(t)
+    assert payload is not None
+    got_chosen, got_placed, got_deferred = payload
+    assert np.array_equal(got_chosen, chosen)
+    assert np.array_equal(got_placed, placed)
+    assert np.array_equal(got_deferred, deferred)
+    # the ticket is retired: a second take is a miss, not a replay
+    assert not hostcallback.ready(t)
+    assert hostcallback.take(t) is None
+
+
+def test_discard_drops_late_delivery():
+    before = hostcallback.backlog()
+    t = hostcallback.new_ticket()
+    assert hostcallback.backlog() == before + 1
+    hostcallback.discard(t)
+    assert hostcallback.backlog() == before
+    # the batch died (launch failure / sibling quarantine); a late
+    # callback for its ticket must land on the floor, not leak
+    hostcallback.deliver(
+        np.int32(t),
+        np.array([0], dtype=np.int32),
+        np.array([True]),
+        np.array([False]),
+    )
+    assert not hostcallback.ready(t)
+    assert hostcallback.take(t) is None
+    assert hostcallback.backlog() == before
+
+
+def test_take_timeout_retires_ticket():
+    t = hostcallback.new_ticket()
+    t0 = time.monotonic()
+    assert hostcallback.take(t, timeout=0.05) is None
+    assert time.monotonic() - t0 < 2.0
+    # timeout retires the slot: a delivery arriving after is dropped
+    hostcallback.deliver(
+        np.int32(t),
+        np.array([0], dtype=np.int32),
+        np.array([True]),
+        np.array([False]),
+    )
+    assert hostcallback.take(t) is None
+
+
+# -- end-to-end wave path ----------------------------------------------------
+
+
+def _mk_server(n_nodes=10):
+    server = APIServer()
+    for i in range(n_nodes):
+        server.create(
+            "nodes",
+            v1.Node(
+                metadata=v1.ObjectMeta(name=f"n{i}", namespace=""),
+                status=v1.NodeStatus(
+                    capacity={"cpu": "16", "memory": "64Gi", "pods": "110"}
+                ),
+            ),
+        )
+    return server
+
+
+def _run_pods(server, sched, n_pods, timeout_s=90.0):
+    for i in range(n_pods):
+        server.create(
+            "pods",
+            v1.Pod(
+                metadata=v1.ObjectMeta(name=f"p{i}"),
+                spec=v1.PodSpec(
+                    containers=[v1.Container(requests={"cpu": "100m"})]
+                ),
+            ),
+        )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if server.count("pods", lambda p: bool(p.spec.node_name)) == n_pods:
+            break
+        time.sleep(0.05)
+    assert server.count("pods", lambda p: bool(p.spec.node_name)) == n_pods
+    assert sched.wait_for_idle(30.0)
+
+
+def test_split_phase_binds_all_and_drains_trailing():
+    """The default (auto-on) split-phase path: every pod lands, the
+    trailing validations all consume (counter advances), and idle means
+    an EMPTY trailing backlog — no generation pin outlives its wave."""
+    server = _mk_server()
+    scfg = KubeSchedulerConfiguration(
+        pipeline_depth=2,
+        device_batch_size=16,
+        device_batch_window=0.02,
+        use_mesh=False,
+    )
+    trailing0 = metrics.counter("scheduler_wave_trailing_readbacks_total")
+    unwound0 = metrics.counter(
+        "scheduler_wave_trailing_unwound_assumes_total"
+    )
+    sched = Scheduler(server, scfg)
+    assert sched._split_phase  # None resolves to on
+    sched.start()
+    try:
+        _run_pods(server, sched, 48)
+    finally:
+        sched.stop()
+    assert sched._trailing == []
+    trailing1 = metrics.counter("scheduler_wave_trailing_readbacks_total")
+    assert trailing1 > trailing0, "no trailing bulk validation ran"
+    # a clean run unwinds nothing
+    assert (
+        metrics.counter("scheduler_wave_trailing_unwound_assumes_total")
+        == unwound0
+    )
+    assert metrics.gauge("scheduler_wave_trailing_backlog") in (None, 0.0)
+
+
+def test_split_phase_off_restores_combined_readback():
+    """The A/B baseline arm: split_phase_readback=False must bind
+    everything through the combined readback and never register a
+    trailing entry."""
+    server = _mk_server()
+    scfg = KubeSchedulerConfiguration(
+        pipeline_depth=2,
+        device_batch_size=16,
+        device_batch_window=0.02,
+        use_mesh=False,
+        split_phase_readback=False,
+    )
+    trailing0 = metrics.counter("scheduler_wave_trailing_readbacks_total")
+    sched = Scheduler(server, scfg)
+    assert not sched._split_phase
+    sched.start()
+    try:
+        _run_pods(server, sched, 48)
+    finally:
+        sched.stop()
+    assert (
+        metrics.counter("scheduler_wave_trailing_readbacks_total")
+        == trailing0
+    )
+
+
+@pytest.mark.slow
+def test_host_callback_binds_delivers_through_io_callback():
+    """Depth-infinity micro-waves: with host_callback_binds=True the
+    kernel posts its own fast payload through io_callback — the resolve
+    path consumes deliveries (counter advances) and every pod lands."""
+    server = _mk_server()
+    scfg = KubeSchedulerConfiguration(
+        pipeline_depth=2,
+        device_batch_size=16,
+        device_batch_window=0.02,
+        use_mesh=False,
+        host_callback_binds=True,
+    )
+    hostcb0 = metrics.counter("scheduler_wave_hostcb_deliveries_total")
+    backlog0 = hostcallback.backlog()
+    sched = Scheduler(server, scfg)
+    sched.start()
+    try:
+        _run_pods(server, sched, 32, timeout_s=180.0)
+    finally:
+        sched.stop()
+    assert (
+        metrics.counter("scheduler_wave_hostcb_deliveries_total") > hostcb0
+    ), "no fast payload arrived through the io_callback registry"
+    # every allocated ticket was taken or discarded
+    assert hostcallback.backlog() == backlog0
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_trailing_readback_max_validation():
+    cfg = KubeSchedulerConfiguration(trailing_readback_max=0)
+    with pytest.raises(ValueError, match="trailing_readback_max"):
+        cfg.validate()
+    KubeSchedulerConfiguration(trailing_readback_max=1).validate()
