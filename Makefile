@@ -61,7 +61,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	chaos-serving chaos-preempt chaos-tuner tracing-ab lint-slow lint-static \
+	chaos-serving chaos-preempt chaos-tuner chaos-disk tracing-ab lint-slow lint-static \
 	lint-fast lint
 
 test:
@@ -76,7 +76,7 @@ chaos: lint
 		tests/test_watchcache.py tests/test_chaos_ha.py \
 		tests/test_chaos_net.py tests/test_serving.py \
 		tests/test_chaos_serving.py tests/test_chaos_preempt.py \
-		tests/test_chaos_tuner.py -q
+		tests/test_chaos_tuner.py tests/test_chaos_disk.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -103,6 +103,10 @@ chaos-preempt:
 
 chaos-tuner:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py tests/test_chaos_tuner.py -q
+
+chaos-disk:
+	$(CACHED) $(PY) -m pytest tests/test_chaos_disk.py -q
+	$(PY) scripts/consistency_check.py --selftest
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

@@ -102,6 +102,7 @@ def main() -> int:
             run_latency_benchmark,
             run_preemption_benchmark,
             run_readpath_benchmark,
+            run_durability_benchmark,
             run_serving_benchmark,
             run_tuner_benchmark,
         )
@@ -353,6 +354,28 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
 
+        # durability workload (ISSUE 18): raw WAL economics — group-commit
+        # append throughput with the fsync contract on/off, the fsync
+        # latency distribution the stall watchdog monitors, and cold
+        # recovery time for a 50k-record log (crash-restart MTTR)
+        durability = None
+        try:
+            dres = run_durability_benchmark()
+            durability = {
+                "workload": "Durability/wal-50k-records",
+                "n_records": dres.n_records,
+                "batch": dres.batch,
+                "append_fsync_per_s": dres.append_fsync_per_s,
+                "append_nofsync_per_s": dres.append_nofsync_per_s,
+                "fsync_p50_ms": dres.fsync_p50_ms,
+                "fsync_p99_ms": dres.fsync_p99_ms,
+                "recovery_s": dres.recovery_s,
+                "recovery_records_per_s": dres.recovery_records_per_s,
+                "native_sink": dres.native_sink,
+            }
+        except Exception:
+            traceback.print_exc()
+
         # CPU fallback: attach the round's checkpointed on-TPU artifact (if
         # one landed earlier — the watchdog self-checkpoints every real-TPU
         # pass) so the official round artifact carries the hardware evidence
@@ -444,6 +467,7 @@ def main() -> int:
                 "preemption": preemption,
                 "hetero": hetero,
                 "tuner": tuner,
+                "durability": durability,
                 "steady_state_latency": (
                     {
                         "rate_pods_per_s": round(lat.rate_pods_per_s, 1),
@@ -606,6 +630,17 @@ def main() -> int:
             "pre_flip_promotions": tu.get("pre_flip_promotions"),
             "steady_state_overhead_pct": tu.get("steady_state_overhead_pct"),
             "gym_pass_p99_ms": tu.get("gym_pass_p99_ms"),
+        }
+    du = detail.get("durability") or {}
+    if du:
+        # compact durability line item: appends/s fsync on/off, fsync
+        # p50/p99, and 50k-record recovery time (full detail in file)
+        compact["durability"] = {
+            "append_fsync_per_s": du.get("append_fsync_per_s"),
+            "append_nofsync_per_s": du.get("append_nofsync_per_s"),
+            "fsync_p50_ms": du.get("fsync_p50_ms"),
+            "fsync_p99_ms": du.get("fsync_p99_ms"),
+            "recovery_s": du.get("recovery_s"),
         }
     if "error" in out:
         compact["error"] = out["error"]

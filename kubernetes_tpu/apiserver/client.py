@@ -46,7 +46,12 @@ from ..client.apiserver import (
     TooManyRequests,
 )
 from ..client.leaderelection import FENCE_HEADER, fence_header_value
-from ..runtime.consensus import DegradedWrites, QuorumLost
+from ..runtime.consensus import (
+    DegradedWrites,
+    DiskFailed,
+    DiskPressure,
+    QuorumLost,
+)
 from ..runtime.watch import BOOKMARK, Event, Watcher
 from ..utils.metrics import metrics
 from ..utils.tracing import TRACE_HEADER, trace_for_binding
@@ -379,6 +384,15 @@ class RESTClient:
                 #                     missed quorum: outcome unknown —
                 #                     a blind replay would 409 against
                 #                     its own first attempt; surface it
+                #   "DiskFailed"      the replica's WAL sink is
+                #                     fail-stopped: the gate refused
+                #                     before applying, so replaying is
+                #                     safe — bounded retries ride out a
+                #                     leader failover to a disk-healthy
+                #                     replica
+                #   "DiskPressure"    WAL volume low on space: refused
+                #                     before applying; retry while
+                #                     compaction/reclaim frees space
                 #   no Retry-After    fenced primary (permanent for
                 #                     that process): never hammer it —
                 #                     callers must re-discover the
@@ -397,6 +411,10 @@ class RESTClient:
                         delay = 0.5
                     time.sleep(min(delay, self.degraded_retry_cap_s))
                     continue
+                if err_reason == "DiskFailed":
+                    raise DiskFailed(msg)
+                if err_reason == "DiskPressure":
+                    raise DiskPressure(msg)
                 raise DegradedWrites(msg)
             raise urllib.error.HTTPError(url, status, msg, hdrs, io.BytesIO(raw))
 
@@ -428,6 +446,11 @@ class RESTClient:
     def get_raw(self, path: str) -> dict:
         """GET an arbitrary API path (aggregated APIs like metrics.k8s.io)."""
         return self._request("GET", self.base + path)
+
+    def backup_state(self) -> dict:
+        """Online consistent backup image from the live server
+        (/debug/backup — the `ktpu-backup save --url` path)."""
+        return self.get_raw("/debug/backup")
 
     def close(self) -> None:
         """Drop the idle connection pool (tests / process teardown)."""

@@ -41,7 +41,12 @@ from ..client.apiserver import (
     NotFound,
     NotPrimary,
 )
-from ..runtime.consensus import DegradedWrites, QuorumLost
+from ..runtime.consensus import (
+    DegradedWrites,
+    DiskFailed,
+    DiskPressure,
+    QuorumLost,
+)
 from ..api.validation import ValidationError
 from .auth import AdmissionDenied
 
@@ -139,10 +144,22 @@ class _Handler(BaseHTTPRequestHandler):
         its outcome is unknown — a blind replay of a create would 409
         AlreadyExists against its own first attempt once followers catch
         up, so the client must surface it instead of auto-retrying).
-        Reads and watches keep serving — only mutations land here."""
+        Disk states get their own reasons so clients can tell a replica
+        that will NEVER write again ("DiskFailed": fail-stopped sink,
+        recovery is leader failover) from transient volume pressure
+        ("DiskPressure": lifts when space frees). Reads and watches keep
+        serving — only mutations land here."""
+        if isinstance(e, DiskFailed):
+            reason = "DiskFailed"
+        elif isinstance(e, DiskPressure):
+            reason = "DiskPressure"
+        elif isinstance(e, QuorumLost):
+            reason = "WriteQuorumLost"
+        else:
+            reason = "Degraded"
         self._status_error(
             503,
-            "WriteQuorumLost" if isinstance(e, QuorumLost) else "Degraded",
+            reason,
             str(e),
             retry_after_s=getattr(e, "retry_after_s", 1.0),
         )
@@ -667,6 +684,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/debug/backup":
+            # online consistent backup image (runtime/backup.py writes it
+            # out; `ktpu-backup save --url` is the operator entry). Same
+            # authz gate as /metrics: the image is the whole cluster
+            # state, emphatically not an anonymous surface.
+            if not self._authorize("get", "metrics", None):
+                return
+            return self._json(200, self.store.backup_state())
         if u.path == "/debug/traces":
             # the trace ring's REST view: ?id=<trace_id> for one trace
             # (store-side stamps attached), else slowest-N (?n=, ?kind=).

@@ -17,7 +17,9 @@ chain (authn/authz/admission) is represented by pluggable admit hooks.
 from __future__ import annotations
 
 import copy
+import logging
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -25,6 +27,18 @@ from ..api import serialization, validation
 from ..api.objects import event_copy
 from ..runtime.watch import ADDED, DELETED, MODIFIED, Event, Watcher
 from ..testing.lockgraph import named_lock, track_attrs
+from ..utils.metrics import metrics
+
+logger = logging.getLogger("kubernetes_tpu.apiserver")
+
+# disk-health state the write gate acts on: 0 = ok, 1 = pressure
+# (read-only, lifts with free space), 2 = failed (fail-stop, permanent)
+GAUGE_DISK_STATE = "store_disk_state"
+# recovery found mid-log corruption: serving the longest valid prefix,
+# must resync from a healthy peer before leading
+GAUGE_DISK_CORRUPT = "store_disk_corrupt"
+COUNTER_PRESSURE_ENTRIES = "store_disk_pressure_entries_total"
+COUNTER_COMPACT_FAILURES = "wal_compaction_failures_total"
 
 
 class NotFound(KeyError):
@@ -102,6 +116,22 @@ class APIServer:
         # the crash-only contract of the reference's etcd layer
         self._wal = wal
         self._compacting = threading.Event()
+        self._compact_failures = 0
+        self._compact_backoff_until = 0.0
+        # recovery classified the WAL as mid-log corrupt: state is the
+        # longest valid prefix; a corrupt replica must resync from a
+        # healthy peer (replication snap/catchup) before it may lead
+        self.disk_corrupt = False
+        # optional low-watermark free-space probe (runtime/wal.py
+        # DiskSpaceProbe): checked on the write-admission path so the
+        # store enters disk-pressure read-only BEFORE appends hit ENOSPC
+        # and auto-reopens once space recovers
+        self.disk_probe = None
+        if wal is not None and hasattr(wal, "on_disk_failed"):
+            # the WAL poisons on any write/fsync error, from ANY append
+            # site (mutations, consensus epoch records, compaction
+            # reopen) — mirror it into the write gate immediately
+            wal.on_disk_failed(self._on_wal_disk_failed)
         # optional HA (runtime/replication.py): mutations ship to followers
         # synchronously after the local WAL append. write_gate is the one
         # write-admission authority (runtime/store.py): read_only maps to
@@ -125,10 +155,17 @@ class APIServer:
         an etcd compaction forcing a reflector relist)."""
         from ..runtime.wal import WriteAheadLog
 
-        rv, objects = WriteAheadLog.recover(wal_path)
+        report = WriteAheadLog.recover_report(wal_path)
         srv = cls(watch_history=watch_history, wal=WriteAheadLog(wal_path))
-        srv._rv = rv
-        srv._objects = objects
+        srv._rv = report.rv
+        srv._objects = report.objects
+        if report.corrupt:
+            # mid-log corruption: the state below is the longest valid
+            # prefix, honest but possibly missing acked writes — flag it
+            # so replication refuses to promote this replica until it has
+            # resynced from a healthy peer (Follower disk_corrupt gate)
+            srv.disk_corrupt = True
+            metrics.set_gauge(GAUGE_DISK_CORRUPT, 1.0)
         return srv
 
     def _log(self, verb: str, kind: str, obj: Any) -> None:
@@ -144,7 +181,22 @@ class APIServer:
         if not records:
             return
         if self._wal is not None:
-            self._wal.append_batch(records)
+            try:
+                self._wal.append_batch(records)
+            except OSError as e:
+                # the record is NOT durable, so the client must not see an
+                # ack — but the in-memory mutation already applied and is
+                # READABLE, so watchers must still learn of it (same
+                # reasoning as the ship() failure below). Then surface the
+                # disk-classified degraded error: DiskPressure (ENOSPC,
+                # retryable once space frees) or DiskFailed (sink
+                # fail-stop; the write gate goes read-only for good).
+                for rv, verb, kind, obj in records:
+                    ev_type = {"create": ADDED, "delete": DELETED}.get(
+                        verb, MODIFIED
+                    )
+                    self._notify(kind, Event(ev_type, copy.deepcopy(obj), rv))
+                raise self._classify_disk_error(e) from e
             self._maybe_compact()
         if self.replicator is not None:
             try:
@@ -164,8 +216,79 @@ class APIServer:
                     self._notify(kind, Event(ev_type, copy.deepcopy(obj), rv))
                 raise
 
+    def _on_wal_disk_failed(self, why: str) -> None:
+        """WAL fail-stop callback (fired under the wal lock: flag flips
+        only, never call back into the WAL or take the store lock)."""
+        self.write_gate.set_disk_failed(why)
+        metrics.set_gauge(GAUGE_DISK_STATE, 2.0)
+
+    def _classify_disk_error(self, e: OSError) -> Exception:
+        """The fail-stop seam: every WAL-append OSError on the mutation
+        path routes through here to flip the write gate and become the
+        matching retryable DegradedWrites subclass."""
+        from ..runtime.consensus import DiskFailed, DiskPressure
+        from ..runtime.wal import DiskFull
+
+        if isinstance(e, DiskFull):
+            self._enter_disk_pressure(f"WAL append hit ENOSPC: {e}")
+            return DiskPressure(str(e))
+        self.write_gate.set_disk_failed(str(e))
+        metrics.set_gauge(GAUGE_DISK_STATE, 2.0)
+        return DiskFailed(
+            f"WAL append failed; store is read-only (fail-stop): {e}"
+        )
+
+    def _enter_disk_pressure(self, why: str) -> None:
+        self.write_gate.set_disk_pressure(True)
+        metrics.inc(COUNTER_PRESSURE_ENTRIES)
+        metrics.set_gauge(GAUGE_DISK_STATE, 1.0)
+        logger.warning("store entering disk-pressure read-only: %s", why)
+        if self.disk_probe is None and self._wal is not None:
+            # nothing would ever clear the gate otherwise: arm a default
+            # probe over the WAL volume so recovery is observed
+            from ..runtime.wal import DiskSpaceProbe
+
+            self.disk_probe = DiskSpaceProbe(self._wal.log_path)
+        if self.disk_probe is not None:
+            # sync the probe's hysteresis with the gate: an ENOSPC-driven
+            # entry (quota exhaustion, a full volume the watermark never
+            # saw coming) must still clear through the probe's recovery
+            # transition — otherwise the gate sticks even after space
+            # frees, because check() only reports a recovery AFTER an
+            # observed entry
+            self.disk_probe.under_pressure = True
+        # compaction as reclaim: a snapshot + log rewrite usually SHRINKS
+        # the volume (the log holds every record since the last snapshot)
+        if self._wal is not None and not self._compacting.is_set():
+            self._compacting.set()
+            threading.Thread(
+                target=self._compact_async, daemon=True, name="wal-reclaim"
+            ).start()
+
+    def _check_disk_pressure(self) -> None:
+        """Write-admission-path probe: enter read-only BEFORE appends fail
+        with ENOSPC; auto-reopen when free space recovers (the probe has
+        hysteresis and rate-limits its own statvfs)."""
+        probe = self.disk_probe
+        if probe is None:
+            return
+        state = probe.check()
+        if state is True and not self.write_gate.disk_pressure:
+            self._enter_disk_pressure(
+                f"free space below low watermark ({probe.low_bytes} B)"
+            )
+        elif state is False and self.write_gate.disk_pressure:
+            self.write_gate.set_disk_pressure(False)
+            if not self.write_gate.disk_failed:
+                metrics.set_gauge(GAUGE_DISK_STATE, 0.0)
+            logger.info("disk pressure cleared: store writable again")
+
     def _maybe_compact(self) -> None:
-        if self._wal.due() and not self._compacting.is_set():
+        if (
+            self._wal.due()
+            and not self._compacting.is_set()
+            and time.monotonic() >= self._compact_backoff_until
+        ):
             # compaction runs OFF the mutation path: serializing + fsyncing
             # the whole store under the server lock would stall every API
             # call for seconds at kubemark scale (the reference compacts in
@@ -184,8 +307,50 @@ class APIServer:
                     for kind, store in self._objects.items()
                 }
             self._wal.write_snapshot(rv, objects)
+            self._compact_failures = 0
+        except OSError:
+            # failed compaction must never wedge the append path (the WAL
+            # reopens its own sink) NOR retry hot: count it and back off —
+            # due() stays true, so the next write past the backoff retries
+            self._compact_failures += 1
+            backoff = min(2.0 ** self._compact_failures, 60.0)
+            self._compact_backoff_until = time.monotonic() + backoff
+            metrics.inc(COUNTER_COMPACT_FAILURES)
+            logger.exception(
+                "WAL compaction failed (failure %d in a row); next retry "
+                "in %.0fs",
+                self._compact_failures,
+                backoff,
+            )
         finally:
             self._compacting.clear()
+
+    def backup_state(self) -> dict:
+        """One-lock-consistent online backup image: the full object state
+        at rv plus the consensus commit index and fencing term
+        (runtime/backup.py writes it out; restore bumps the term so every
+        pre-backup BindFence is structurally rejected)."""
+        with self._lock:
+            rv = self._rv
+            objects = {
+                kind: [serialization.encode(o) for o in store.values()]
+                for kind, store in self._objects.items()
+            }
+        commit = rv
+        term = 1
+        rep = self.replicator
+        if rep is not None:
+            term = int(getattr(rep, "term", 1))
+            cons = getattr(rep, "consensus", None)
+            if cons is not None:
+                commit = min(int(cons.commit_index), rv)
+        return {
+            "format": "ktpu-backup-v1",
+            "rv": rv,
+            "commit": commit,
+            "term": term,
+            "objects": objects,
+        }
 
     # -- helpers ------------------------------------------------------------
 
@@ -244,9 +409,13 @@ class APIServer:
     def _check_writable(self) -> None:
         if self.write_gate.fenced:
             raise NotPrimary("store fenced: a newer primary holds the lease")
-        # degraded read-only (consensus quorum lost): raises the retryable
-        # DegradedWrites BEFORE any mutation is applied — reads and
-        # watches are never gated
+        # disk-pressure probe runs on the admission path so the store goes
+        # read-only BEFORE appends fail and reopens when space recovers
+        # (clients retrying a DiskPressure 503 drive the re-check)
+        self._check_disk_pressure()
+        # degraded read-only (consensus quorum lost / disk states): raises
+        # the retryable DegradedWrites BEFORE any mutation is applied —
+        # reads and watches are never gated
         self.write_gate.check_degraded()
 
     def create(self, kind: str, obj: Any) -> Any:

@@ -52,6 +52,9 @@ COUNTER_RELEASES = "leader_election_releases_total"
 # lease writes skipped because the store was degraded / fenced: the holder
 # keeps leading and retries within renew_deadline
 COUNTER_DEGRADED_SKIPS = "leader_election_degraded_renew_skips_total"
+# a leader whose local disk failed released its lease so a disk-healthy
+# replica promotes inside retry-periods (the fail-stop step-down)
+COUNTER_DISK_STEPDOWNS = "leader_election_disk_stepdowns_total"
 
 
 @dataclass
@@ -155,13 +158,23 @@ class LeaderElector:
         on_started_leading: Callable[[], None],
         on_stopped_leading: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        disk_health: Optional[Callable[[], bool]] = None,
     ):
+        """`disk_health` (when given) gates leadership on local disk
+        state — wire it to the local store's write gate, e.g.
+        ``lambda: store.write_gate.disk_healthy``. A candidate with a
+        failed disk refuses to acquire; a LEADER whose disk fails
+        releases the lease immediately (not a passive renew-deadline
+        lapse), so a disk-healthy standby promotes inside retry-periods.
+        This is the cluster-level half of the WAL's fail-stop: the
+        process cannot durably log, so it must not lead."""
         config.validate()
         self._server = server
         self._cfg = config
         self._on_started = on_started_leading
         self._on_stopped = on_stopped_leading
         self._clock = clock
+        self._disk_health = disk_health
         self._stop = threading.Event()
         self._is_leader = threading.Event()
         self._observed_renew = 0.0
@@ -321,8 +334,21 @@ class LeaderElector:
         )
         return True
 
+    def _disk_healthy(self) -> bool:
+        if self._disk_health is None:
+            return True
+        try:
+            return bool(self._disk_health())
+        except Exception:
+            logger.exception("disk_health probe raised; treating as failed")
+            return False
+
     def _acquire(self) -> bool:
         while not self._stop.is_set():
+            if not self._disk_healthy():
+                # a fail-stopped disk cannot durably log: never lead
+                self._stop.wait(self._cfg.retry_period)
+                continue
             if self._try_acquire_or_renew():
                 self._observed_renew = self._clock()
                 metrics.inc(COUNTER_ACQUISITIONS)
@@ -332,6 +358,22 @@ class LeaderElector:
 
     def _renew_loop(self) -> None:
         while not self._stop.is_set():
+            if not self._disk_healthy():
+                # fail-stop step-down: ACTIVELY release instead of letting
+                # the lease lapse — the standby acquires on its next
+                # retry_period poll, not after renew_deadline. The lease
+                # store itself is still writable (it is the disk-healthy
+                # quorum's store; only OUR replica's sink died).
+                metrics.inc(COUNTER_DISK_STEPDOWNS)
+                logger.error(
+                    "local disk failed while leading: releasing lease "
+                    "%s/%s so a disk-healthy replica can promote",
+                    self._cfg.lock_namespace,
+                    self._cfg.lock_name,
+                )
+                self.release()
+                self._release_on_stop = False  # already released
+                return  # leadership lost
             deadline = self._observed_renew + self._cfg.renew_deadline
             renewed = False
             while self._clock() < deadline and not self._stop.is_set():

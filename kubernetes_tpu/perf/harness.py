@@ -1272,3 +1272,104 @@ def run_tuner_benchmark(
         gym_pass_p50_ms=round(p50 * 1e3, 2),
         gym_pass_p99_ms=round(p99 * 1e3, 2),
     )
+
+
+@dataclass
+class DurabilityBenchResult:
+    """The `durability` bench workload: raw WAL economics (ISSUE 18).
+
+    Group-committed append throughput with the fsync contract on and
+    off, the fsync latency distribution the stall watchdog monitors, and
+    cold recovery time for a large log — the numbers that size the
+    store's write path and its crash-restart MTTR."""
+
+    n_records: int
+    batch: int
+    append_fsync_per_s: float
+    append_nofsync_per_s: float
+    fsync_p50_ms: float
+    fsync_p99_ms: float
+    recovery_s: float
+    recovery_records_per_s: float
+    recovered_rv: int
+    native_sink: bool
+
+
+def run_durability_benchmark(
+    n_records: int = 50_000, batch: int = 64, fsync_records: int = 2_000
+) -> DurabilityBenchResult:
+    """Benchmark the WAL on a scratch directory: (1) `n_records` appends
+    in `batch`-record group commits with fsync OFF (page-cache ceiling),
+    (2) cold recovery of that log, (3) `fsync_records` appends with
+    fsync ON plus the wal_fsync_duration_seconds p50/p99 over exactly
+    this run's observations. Pods carry a realistic container spec so
+    record size matches the scheduler's write mix."""
+    import shutil
+    import tempfile
+
+    from ..api import objects as v1
+    from ..runtime.wal import HIST_FSYNC, WriteAheadLog
+
+    def pod(i: int) -> Pod:
+        p = Pod(
+            metadata=v1.ObjectMeta(name=f"bench-{i}"),
+            spec=v1.PodSpec(
+                containers=[v1.Container(requests={"cpu": "100m"})]
+            ),
+        )
+        p.metadata.resource_version = i + 1
+        return p
+
+    def append_run(wal: WriteAheadLog, count: int, rv0: int = 0) -> float:
+        t0 = time.monotonic()
+        for start in range(0, count, batch):
+            n = min(batch, count - start)
+            wal.append_batch([  # graftlint: walseam-exempt(scratch bench WAL: nothing is acked against it and a sink failure must crash the bench loudly)
+                (rv0 + start + k + 1, "create", "pods", pod(start + k))
+                for k in range(n)
+            ])
+        return count / max(time.monotonic() - t0, 1e-9)
+
+    tmp = tempfile.mkdtemp(prefix="ktpu-durability-")
+    try:
+        # arm 1: fsync off — the group-commit/encode ceiling
+        wal = WriteAheadLog(tmp + "/nofsync", compact_every=n_records * 2,
+                            fsync=False)
+        nofsync_rate = append_run(wal, n_records)
+        native = wal._native is not None
+        wal.close()
+
+        # arm 2: cold recovery of the 50k-record log (crash-restart MTTR)
+        t0 = time.monotonic()
+        rv, _objects = WriteAheadLog.recover(tmp + "/nofsync")
+        recovery_s = max(time.monotonic() - t0, 1e-9)
+
+        # arm 3: fsync on — the durability contract's real price, with
+        # the latency histogram scoped to exactly this run
+        h0 = metrics.histogram(HIST_FSYNC)
+        n0 = h0.count if h0 is not None else 0
+        wal = WriteAheadLog(tmp + "/fsync", compact_every=n_records * 2,
+                            fsync=True)
+        fsync_rate = append_run(wal, fsync_records)
+        wal.close()
+        h = metrics.histogram(HIST_FSYNC)
+        p50, p99 = (
+            h.quantiles_since(n0, [0.5, 0.99])
+            if h is not None
+            else (0.0, 0.0)
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return DurabilityBenchResult(
+        n_records=n_records,
+        batch=batch,
+        append_fsync_per_s=round(fsync_rate, 1),
+        append_nofsync_per_s=round(nofsync_rate, 1),
+        fsync_p50_ms=round(p50 * 1e3, 3),
+        fsync_p99_ms=round(p99 * 1e3, 3),
+        recovery_s=round(recovery_s, 3),
+        recovery_records_per_s=round(rv / recovery_s, 1),
+        recovered_rv=rv,
+        native_sink=native,
+    )

@@ -1,0 +1,213 @@
+"""Fenced online backup / disaster restore for the API-server store.
+
+Backup is an ONLINE consistent image: one lock acquisition captures the
+full object state at a single resourceVersion, plus the consensus commit
+index and the replication term (``APIServer.backup_state``). Nothing
+stops serving while it runs — the lock hold is the same order as a big
+LIST.
+
+Restore is FENCED. A restored cluster is a new epoch: clients, schedulers
+and ex-leaders from before the disaster may still be running with state
+(and fencing tokens) minted against the old one. Restoring bytes alone
+would let them write — the classic split-brain-after-restore. So restore:
+
+  * bumps every lease's ``lease_transitions`` and clears its holder, so
+    every pre-restore ``BindFence`` is STRUCTURALLY rejected by the
+    store's fence check (identity and transition count both mismatch) —
+    no grace periods, no wall clocks;
+  * bumps the replication term past the backup's, durably (an
+    ``append_commit`` record), so a zombie ex-primary that reconnects is
+    fenced by the raft higher-term rule before it can ship a frame.
+
+The image format is versioned JSON (``ktpu-backup-v1``) written with
+tmp + fsync + atomic rename — a torn backup file is impossible, only an
+old-or-new one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict
+
+from ..api import serialization
+from ..utils.metrics import metrics
+from .wal import LOG_SUFFIX, SNAPSHOT_SUFFIX, WriteAheadLog, parse_wal_line
+
+logger = logging.getLogger("kubernetes_tpu.runtime.backup")
+
+BACKUP_FORMAT = "ktpu-backup-v1"
+
+COUNTER_BACKUPS = "store_backups_total"
+COUNTER_RESTORES = "store_restores_total"
+# leases fenced (holder cleared + transitions bumped) during restores —
+# equals the number of pre-restore BindFence tokens structurally voided
+COUNTER_RESTORE_FENCED = "store_restore_fenced_leases_total"
+
+__all__ = [
+    "BACKUP_FORMAT",
+    "backup_from_server",
+    "backup_from_wal",
+    "load_backup",
+    "write_backup",
+    "restore_into",
+]
+
+
+def write_backup(image: Dict[str, Any], path: str) -> str:
+    """Durably write a backup image: tmp + fsync + atomic rename, the
+    same crash discipline as the WAL's snapshot publish."""
+    if image.get("format") != BACKUP_FORMAT:
+        raise ValueError(
+            f"not a {BACKUP_FORMAT} image: format={image.get('format')!r}"
+        )
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(image, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    metrics.inc(COUNTER_BACKUPS)
+    logger.info(
+        "backup written: %s (rv=%d commit=%d term=%d, %d kinds)",
+        path, image["rv"], image["commit"], image["term"],
+        len(image["objects"]),
+    )
+    return path
+
+
+def backup_from_server(server, path: str) -> Dict[str, Any]:
+    """Online backup of a LIVE server (one-lock-consistent image)."""
+    image = server.backup_state()
+    write_backup(image, path)
+    return image
+
+
+def backup_from_wal(wal_path: str, path: str) -> Dict[str, Any]:
+    """Offline backup from a (stopped) server's WAL directory — the
+    disaster case where no live server exists to snapshot. Recovery
+    semantics are identical to a crash restart: torn tails truncate,
+    mid-log corruption stops replay at the longest valid prefix (and is
+    surfaced in the image so the operator knows the backup may miss
+    acked writes)."""
+    report = WriteAheadLog.recover_report(wal_path)
+    term = _max_logged_term(wal_path)
+    image = {
+        "format": BACKUP_FORMAT,
+        "rv": report.rv,
+        "commit": report.commit or report.rv,
+        "term": term,
+        "objects": {
+            kind: [serialization.encode(o) for o in store.values()]
+            for kind, store in report.objects.items()
+        },
+    }
+    if report.corrupt:
+        image["source_corrupt"] = True
+        logger.error(
+            "offline backup of %s: source WAL was mid-log corrupt — the "
+            "image holds the longest valid prefix (rv=%d) and may be "
+            "missing acknowledged writes", wal_path, report.rv,
+        )
+    write_backup(image, path)
+    return image
+
+
+def _max_logged_term(wal_path: str) -> int:
+    """Highest replication term recorded in the log's commit records
+    (1 when the store never ran in consensus mode)."""
+    term = 1
+    try:
+        with open(wal_path + LOG_SUFFIX, encoding="utf-8") as f:
+            for line in f:
+                rec = parse_wal_line(line.rstrip("\n"))
+                if rec is not None and rec.get("verb") == "commit":
+                    term = max(term, int(rec.get("term", 1)))
+    except OSError:
+        pass
+    return term
+
+
+def load_backup(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        image = json.load(f)
+    if image.get("format") != BACKUP_FORMAT:
+        raise ValueError(
+            f"{path}: not a {BACKUP_FORMAT} image "
+            f"(format={image.get('format')!r})"
+        )
+    return image
+
+
+def restore_into(
+    image: Dict[str, Any], wal_path: str, force: bool = False
+) -> Dict[str, Any]:
+    """Materialize a backup image as a FRESH fenced WAL at ``wal_path``.
+
+    Refuses to clobber an existing log unless ``force`` (restoring over
+    live state is the operator's most expensive typo). Returns a summary
+    dict: {rv, term, fenced_leases, objects}.
+
+    Fencing: every lease in the image has its holder cleared and its
+    transition count bumped, and the replication term is bumped past the
+    image's — see the module docstring for why both are load-bearing.
+    """
+    log_path = wal_path + LOG_SUFFIX
+    if not force and os.path.exists(log_path) and os.path.getsize(log_path):
+        raise FileExistsError(
+            f"{log_path} exists and is non-empty; pass force=True to "
+            "overwrite it with the restored image"
+        )
+
+    rv = int(image["rv"])
+    old_term = int(image.get("term", 1))
+    new_term = old_term + 1
+
+    objects: Dict[str, list] = {}
+    fenced = 0
+    for kind, docs in image["objects"].items():
+        decoded = []
+        for data in docs:
+            obj = serialization.decode(kind, data)
+            if kind == "leases":
+                # void every pre-restore BindFence: wrong holder AND
+                # wrong transition count — structural rejection, no
+                # reliance on lease expiry wall-clocks
+                obj.holder_identity = ""
+                obj.lease_transitions = int(obj.lease_transitions) + 1
+                obj.renew_time = 0.0
+                fenced += 1
+            decoded.append(obj)
+        objects[kind] = decoded
+
+    if force:
+        for suffix in (LOG_SUFFIX, SNAPSHOT_SUFFIX):
+            try:
+                os.unlink(wal_path + suffix)
+            except FileNotFoundError:
+                pass
+
+    wal = WriteAheadLog(wal_path)
+    try:
+        wal.write_snapshot(rv, objects)
+        # durable epoch bump: a recovering replica learns the post-
+        # restore term from this record, and any zombie ex-primary at
+        # old_term is fenced by the higher-term rule on first contact
+        wal.append_commit(rv, rv, new_term, "restore")  # graftlint: walseam-exempt(restore target: nothing serves from this WAL yet, a failed restore must abort loudly and propagate)
+    finally:
+        wal.close()
+
+    metrics.inc(COUNTER_RESTORES)
+    metrics.inc(COUNTER_RESTORE_FENCED, by=float(fenced))
+    logger.warning(
+        "restored %s from backup image: rv=%d term %d->%d, %d leases "
+        "fenced (all pre-restore bind tokens are now invalid)",
+        wal_path, rv, old_term, new_term, fenced,
+    )
+    return {
+        "rv": rv,
+        "term": new_term,
+        "fenced_leases": fenced,
+        "objects": sum(len(v) for v in objects.values()),
+    }

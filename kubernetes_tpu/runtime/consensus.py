@@ -91,6 +91,22 @@ class QuorumLost(DegradedWrites):
     Raising it also flips the store into degraded read-only mode."""
 
 
+class DiskFailed(DegradedWrites):
+    """Write rejected: this replica's WAL sink hit a write/fsync error and
+    is fail-stopped (runtime/wal.py SinkFailed — the fsyncgate discipline:
+    a failed fsync is never retried). Permanent for THIS process; the
+    503 + Retry-After is still honest because a leader with a failed disk
+    releases its lease and a disk-healthy replica promotes, so retries
+    land somewhere writable."""
+
+
+class DiskPressure(DegradedWrites):
+    """Write rejected: the WAL volume is under disk pressure (low-watermark
+    probe tripped, or an append hit ENOSPC and was rolled back). Lifts
+    automatically when free space recovers — compaction is attempted as
+    reclaim — so this IS plainly retryable."""
+
+
 class RecordBuffer:
     """Bounded in-memory tail of the leader's replicated log, for
     commit-index resync: a reconnecting follower at rv R gets the

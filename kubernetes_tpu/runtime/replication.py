@@ -97,6 +97,11 @@ from ..client.apiserver import NotPrimary  # noqa: F401  (re-export)
 
 logger = logging.getLogger("kubernetes_tpu.runtime.replication")
 
+# a DiskCorrupt replica (mid-log WAL corruption at recovery) finished a
+# full snap/catchup resync from the leader and is promotable again
+COUNTER_CORRUPT_HEALS = "store_disk_corrupt_heals_total"
+GAUGE_DISK_CORRUPT = "store_disk_corrupt"
+
 
 def _send(f, frame: dict) -> None:
     f.write((json.dumps(frame, default=str) + "\n").encode())
@@ -656,10 +661,21 @@ class Follower:
         node_id: int = 0,
         heartbeat_s: float = 0.2,
         ack_timeout_s: float = 0.75,
+        disk_corrupt: bool = False,
     ):
         self.primary_addr = primary_addr
         self.lease_s = lease_s
         self.wal = wal
+        # disk_corrupt: this replica's WAL recovery found MID-LOG
+        # corruption (wal.RecoveryReport.corrupt) — its state is an honest
+        # prefix but may be missing acked writes, so it must not promote
+        # until a snap/catchup resync from the leader has healed it.
+        # disk_failed flips when OUR OWN wal appends start failing: the
+        # replica keeps tailing (in-memory reads stay correct) but is
+        # barred from promotion — a leader that cannot durably log is not
+        # a leader.
+        self.disk_corrupt = bool(disk_corrupt)
+        self.disk_failed = False
         self.on_promote = on_promote
         self.peers = list(peers) if peers else []
         self.cluster_size = cluster_size
@@ -828,6 +844,7 @@ class Follower:
                     self._apply_snapshot(frame["snap"])
                     self._synced.set()
                     self._ejected.clear()  # full snapshot: stale no more
+                    self._mark_disk_healed("snapshot")
                     # ack the handshake state: the leader's commit index
                     # needs to know we durably hold it (a reconnect during
                     # degraded mode lifts it through exactly this ack)
@@ -843,6 +860,10 @@ class Follower:
                     self._apply_records(cu.get("recs", []))
                     self._synced.set()
                     self._ejected.clear()
+                    # a corrupt replica's hello carried its valid-prefix
+                    # rv; this catchup re-appended the missing suffix to
+                    # the (already-truncated) WAL — the log is whole again
+                    self._mark_disk_healed("catchup")
                     _send(wfile, {"ack": self.rv})
                 elif "recs" in frame:
                     if int(frame.get("term", 0)) < self.term:
@@ -872,6 +893,20 @@ class Follower:
                 sock.close()
             except OSError:
                 pass
+
+    def _mark_disk_healed(self, how: str) -> None:
+        """A full resync (snap, or catchup onto the repaired valid-prefix
+        WAL) replaced/completed our state from the leader: the DiskCorrupt
+        promotion bar lifts."""
+        if not self.disk_corrupt:
+            return
+        self.disk_corrupt = False
+        metrics.inc(COUNTER_CORRUPT_HEALS)
+        metrics.set_gauge(GAUGE_DISK_CORRUPT, 0.0)
+        logger.warning(
+            "disk-corrupt replica healed via %s resync at rv=%d: "
+            "promotable again", how, self.rv,
+        )
 
     def _learn_commit(self, frame: dict) -> None:
         """Track the leader's piggybacked commit index (recs/hb carry it
@@ -952,12 +987,26 @@ class Follower:
                 elif obj is not None:
                     d[obj.metadata.key] = obj
                 wal_batch.append((rv, verb, kind, obj))
-        if self.wal is not None and wal_batch:
+        if self.wal is not None and wal_batch and not self.disk_failed:
             # replica durability: promotion after OUR crash recovers from
             # this WAL exactly like a primary restart; compaction is the
             # follower's own job (the primary's doesn't cross the wire)
-            self.wal.append_batch(wal_batch)
-            self._maybe_compact()
+            try:
+                self.wal.append_batch(wal_batch)
+                self._maybe_compact()
+            except OSError as e:
+                # OUR disk died, not the stream. Fail-stop the durability
+                # side only: in-memory state stays correct (reads and watch
+                # fan-out keep working) but this replica can never again
+                # vouch for durability, so promotion is barred permanently
+                # and we stop touching the WAL — appending to a failed sink
+                # would just re-raise forever.
+                self.disk_failed = True
+                logger.error(
+                    "follower WAL append failed (disk fail-stop): %s — "
+                    "replica continues serving in-memory but is barred "
+                    "from promotion", e,
+                )
         if wal_batch and self._observers:
             # observers get COPIES: the stored objects are live replica
             # state (a promotion shares self.objects with the promoted
@@ -1156,6 +1205,11 @@ class Follower:
             ticks += 1
             if self._ejected.is_set():
                 continue  # stale replica: no promotion until re-synced
+            if self.disk_corrupt or self.disk_failed:
+                # a replica whose WAL was mid-log corrupt (until a resync
+                # heals it) or whose disk fail-stopped (permanent) must
+                # never become primary: its durability story is a lie
+                continue
             if not self._synced.is_set() or self.rv <= 0:
                 continue  # nothing real to promote yet (advisor r4 high)
             last = self._last_seen
@@ -1279,20 +1333,25 @@ class Follower:
         """Become primary at `term` (an election-won term; defaults to
         term+1 for the legacy/operator paths), building a live APIServer
         from the replica. Idempotent; returns the promoted server.
-        Refuses (returns None) when this replica has never synced or was
-        ejected from the sync set — promoting it would serve empty/stale
-        state over real durable writes — unless force=True (operator
-        override)."""
+        Refuses (returns None) when this replica has never synced, was
+        ejected from the sync set, recovered a mid-log-corrupt WAL that
+        hasn't been healed by a resync yet, or fail-stopped its disk —
+        promoting any of those would serve wrong/stale state over real
+        durable writes — unless force=True (operator override)."""
         with self._lock:
             if self._promoted is not None:
                 return self._promoted
             if not force and (
-                not self._synced.is_set() or self.rv <= 0 or self._ejected.is_set()
+                not self._synced.is_set() or self.rv <= 0
+                or self._ejected.is_set()
+                or self.disk_corrupt or self.disk_failed
             ):
                 logger.error(
-                    "refusing promotion: synced=%s rv=%d ejected=%s (use "
-                    "force=True to override)",
+                    "refusing promotion: synced=%s rv=%d ejected=%s "
+                    "disk_corrupt=%s disk_failed=%s (use force=True to "
+                    "override)",
                     self._synced.is_set(), self.rv, self._ejected.is_set(),
+                    self.disk_corrupt, self.disk_failed,
                 )
                 return None
             from ..client.apiserver import APIServer

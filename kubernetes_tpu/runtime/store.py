@@ -19,7 +19,11 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.metrics import metrics
+
 IndexFunc = Callable[[Any], List[str]]
+
+COUNTER_DISK_REJECTS = "store_disk_writes_rejected_total"
 
 
 class WriteGate:
@@ -35,18 +39,60 @@ class WriteGate:
     def __init__(self):
         self.fenced = False
         self._consensus = None
+        # disk fail-stop (permanent for the process: the WAL sink poisoned
+        # itself on a write/fsync error) vs disk pressure (transient: low
+        # free space / ENOSPC, lifts when space recovers)
+        self.disk_failed = False
+        self.disk_failed_reason = ""
+        self.disk_pressure = False
 
     def attach_consensus(self, coordinator) -> None:
         """Arm the degraded-mode gate (runtime/replication.py attach())."""
         self._consensus = coordinator
 
+    def set_disk_failed(self, reason: str) -> None:
+        """Fail-stop: the WAL sink is dead; this store never writes again
+        (mirrors the WAL's own poison — there is no clear path)."""
+        self.disk_failed = True
+        self.disk_failed_reason = reason
+
+    def set_disk_pressure(self, value: bool) -> None:
+        self.disk_pressure = bool(value)
+
+    @property
+    def disk_healthy(self) -> bool:
+        """Leadership eligibility: a leader with a failed disk must release
+        its lease (client/leaderelection.py disk_health wiring). Pressure
+        does NOT disqualify — it lifts; a poisoned sink never does."""
+        return not self.disk_failed
+
     @property
     def degraded(self) -> bool:
         c = self._consensus
-        return bool(c is not None and c.degraded)
+        return bool(
+            self.disk_failed
+            or self.disk_pressure
+            or (c is not None and c.degraded)
+        )
 
     def check_degraded(self) -> None:
-        """Raise consensus.DegradedWrites when the quorum is lost."""
+        """Raise the matching DegradedWrites subclass when writes must be
+        refused: disk fail-stop, disk pressure, then quorum state."""
+        if self.disk_failed:
+            from .consensus import DiskFailed
+
+            metrics.inc(COUNTER_DISK_REJECTS)
+            raise DiskFailed(
+                f"store disk failed (WAL sink fail-stop): {self.disk_failed_reason}"
+            )
+        if self.disk_pressure:
+            from .consensus import DiskPressure
+
+            metrics.inc(COUNTER_DISK_REJECTS)
+            raise DiskPressure(
+                "store under disk pressure: WAL volume low on space "
+                "(read-only until space recovers)"
+            )
         c = self._consensus
         if c is not None:
             c.check_writable()
@@ -55,6 +101,10 @@ class WriteGate:
         """One-line state for debug dumps (SIGUSR2 debugger)."""
         if self.fenced:
             return "fenced (higher-term primary exists)"
+        if self.disk_failed:
+            return f"disk-failed read-only ({self.disk_failed_reason})"
+        if self.disk_pressure:
+            return "disk-pressure read-only (low free space)"
         if self.degraded:
             return "degraded read-only (write quorum lost)"
         return "open"

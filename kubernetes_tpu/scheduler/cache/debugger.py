@@ -141,6 +141,10 @@ class CacheDebugger:
         if tuner:
             lines.append("Dump of policy-gym (self-tuning scheduler) state:")
             lines.extend(tuner)
+        disk = disk_health_lines()
+        if disk:
+            lines.append("Dump of WAL / disk-fault state:")
+            lines.extend(disk)
         from ...utils import tracing as tracing_mod
 
         lines.append("Dump of per-pod scheduling traces (slowest first):")
@@ -206,6 +210,46 @@ def ridethrough_health_lines() -> List[str]:
             lines.append(
                 metrics.format_series_line(name, labels, value, annotation)
             )
+    return lines
+
+
+def disk_health_lines() -> List[str]:
+    """The durability gauges and counters (runtime/wal.py publishes sink
+    fail-stop / fsync-stall / corruption state under ``wal_``, the store
+    publishes its disk read-only state and free-space probe under
+    ``store_disk_``) rendered for the SIGUSR2 dump: a store that went
+    read-only for disk reasons — failed sink, ENOSPC, corrupt recovery —
+    is diagnosable from one signal with no log access. Empty when this
+    process runs no WAL-backed store."""
+    from ...utils.metrics import metrics
+
+    lines: List[str] = []
+    for prefix in ("wal_", "store_disk_"):
+        for name, labels, value in metrics.snapshot_gauges(prefix):
+            annotation = ""
+            if name == "wal_sink_failed":
+                annotation = (
+                    "FAIL-STOPPED (writes 503 until failover)"
+                    if value else "healthy"
+                )
+            elif name == "store_disk_state":
+                annotation = {
+                    0.0: "ok",
+                    1.0: "DISK PRESSURE (read-only, auto-reopens)",
+                    2.0: "DISK FAILED (read-only, permanent)",
+                }.get(value, "?")
+            elif name in ("wal_recovered_corrupt", "store_disk_corrupt"):
+                annotation = (
+                    "CORRUPT (refusing promotion until resynced)"
+                    if value else "clean"
+                )
+            elif name == "wal_fsync_stalled":
+                annotation = "STALLED" if value else "ok"
+            lines.append(
+                metrics.format_series_line(name, labels, value, annotation)
+            )
+        for name, labels, value in metrics.snapshot_counters(prefix):
+            lines.append(metrics.format_series_line(name, labels, value, ""))
     return lines
 
 

@@ -45,6 +45,7 @@ import metrics_contract
 import pragmas
 import threads
 import tracingpass
+import walseam
 
 BASELINE = os.path.join(_HERE, "baseline.txt")
 
@@ -58,6 +59,7 @@ PASSES = (
     ("fenceseam", lambda tree, root: fenceseam.run(tree)),
     ("guardedby", lambda tree, root: guardedby.run(tree, root)),
     ("tracing", lambda tree, root: tracingpass.run(tree)),
+    ("walseam", lambda tree, root: walseam.run(tree)),
     ("threads", lambda tree, root: threads.run(tree)),
     ("pragmas", lambda tree, root: pragmas.run(tree)),
 )

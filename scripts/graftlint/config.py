@@ -36,6 +36,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_serving.py",
     "tests/test_chaos_preempt.py",
     "tests/test_chaos_tuner.py",
+    "tests/test_chaos_disk.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -150,6 +151,11 @@ DUMP_REQUIRED_FAMILIES = (
     "restclient_",
     "follower_read_",
     "tuner_",
+    # the durability surface: WAL sink fail-stop / corruption / fsync
+    # stall state and the store's disk read-only + free-space gauges — a
+    # store that went read-only for disk reasons must be SIGUSR2-visible
+    "wal_",
+    "store_disk_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------
@@ -269,6 +275,7 @@ AUDITED_PRAGMAS = (
     "allow-blocking",
     "degraded-ok",
     "fence-exempt",
+    "walseam-exempt",
     "alias-safe",
     "unguarded",
     "guarded-by",
@@ -290,6 +297,25 @@ FENCE_SEAM_FUNCS = ("_bind_pods_fenced",)
 # method names that are bind writes when called on a store-ish receiver
 # (WRITE_RECEIVERS above)
 FENCE_BIND_METHODS = {"bind_pod", "bind_pods"}
+
+# -- pass 8: WAL-append fail-stop seam ----------------------------------------
+
+# method names that are WAL appends when called on a WAL receiver. The
+# durability contract is fail-stop (runtime/wal.py): these raise
+# SinkFailed/DiskFull (OSError subclasses) and the CALL SITE must decide
+# what the un-durable in-memory state means there — see walseam.py.
+WAL_APPEND_METHODS = {"append", "append_batch", "append_commit"}
+
+# receiver trailing names that identify a WriteAheadLog handle (dotted
+# or bare — a local named `wal` is a WAL; there is no ambiguity to guard
+# against the way bare store receivers need the parameter rule)
+WAL_RECEIVERS = {"wal", "_wal"}
+
+# functions (qualified names) that ARE the fail-stop seam: the one place
+# a raw append is allowed without a lexical OSError handler, because the
+# function's whole job is classifying the failure (un-ack the client,
+# flip the write gate, classify DiskPressure vs DiskFailed)
+WAL_FAILSTOP_SEAMS = ("APIServer._log_batch",)
 
 # -- pass 7: tracing span lifecycle -------------------------------------------
 

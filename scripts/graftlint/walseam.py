@@ -1,0 +1,163 @@
+"""Pass 8: the WAL-append fail-stop seam.
+
+The durability contract (runtime/wal.py) is fail-stop: a write/fsync
+error on the log poisons the sink and every later append raises — the
+fsyncgate lesson is that "catch, retry, carry on" silently drops the
+record that was never durable while the client already saw an ack. So
+an append that can raise OSError (SinkFailed / DiskFull subclass it)
+must be handled AT THE CALL SITE by code that knows what the in-memory
+state means there: the store's seam un-acks the client and flips the
+write gate (``APIServer._log_batch``), the follower bars itself from
+promotion, the consensus epoch logger degrades gracefully. A bare
+append call anywhere else either crashes a dispatch thread or — worse —
+gets swallowed by a broad handler upstream that acks the write anyway.
+
+Every call of a config.WAL_APPEND_METHODS name (``append`` /
+``append_batch`` / ``append_commit``) on a WAL receiver
+(config.WAL_RECEIVERS — dotted or bare, locals count: a WAL handle is a
+WAL handle) anywhere in the scanned packages is a finding UNLESS:
+
+  * the enclosing function is a blessed seam (config.WAL_FAILSTOP_SEAMS,
+    matched by qualified name); or
+  * the call sits lexically inside a ``try`` whose handlers catch
+    OSError (or SinkFailed / DiskFull / Exception); or
+  * the call is marked ``# graftlint: walseam-exempt(reason)`` — e.g.
+    a restore tool writing a WAL nothing serves from yet. The reason is
+    mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from core import Finding, Module, Tree, dotted_name
+import config
+
+PASS = "walseam"
+
+# exception names whose handlers count as handling a WAL append failure
+# (SinkFailed and DiskFull subclass OSError; IOError is its alias)
+WAL_OSERROR_HANDLERS = {
+    "OSError",
+    "IOError",
+    "SinkFailed",
+    "DiskFull",
+    "Exception",
+    "BaseException",
+}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return ["BaseException"]  # bare except
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for e in exprs:
+        d = dotted_name(e)
+        if d:
+            names.append(d.rsplit(".", 1)[-1])
+    return names
+
+
+def _handled(mod: Module, call: ast.Call) -> bool:
+    """Is the call lexically inside a try whose handlers catch an
+    OSError-compatible name? (The call must be in the try BODY — a call
+    in an except/finally block of the same try is not protected by it.)"""
+    node: ast.AST = call
+    for anc in mod.ancestors(call):
+        if isinstance(anc, ast.Try):
+            in_body = any(
+                node is stmt or _contains(stmt, node) for stmt in anc.body
+            )
+            if in_body and any(
+                n in WAL_OSERROR_HANDLERS
+                for h in anc.handlers
+                for n in _handler_names(h)
+            ):
+                return True
+        node = anc
+    return False
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+def _marked_exempt(mod: Module, call: ast.Call) -> Optional[bool]:
+    """True = marked with reason; False = marked WITHOUT reason (itself a
+    finding); None = unmarked. Same placement rules as fence-exempt."""
+    lines = list(
+        range(call.lineno, getattr(call, "end_lineno", call.lineno) + 1)
+    )
+    func = mod.enclosing_function(call)
+    pragmas = [
+        p
+        for ln in lines
+        for p in mod.pragmas.get(ln, ())
+        if p.directive == "walseam-exempt"
+    ]
+    if not pragmas and func is not None:
+        body_start = func.body[0].lineno if func.body else func.lineno
+        for ln in range(func.lineno, body_start):
+            pragmas.extend(
+                p
+                for p in mod.pragmas.get(ln, ())
+                if p.directive == "walseam-exempt"
+            )
+    if not pragmas:
+        return None
+    for p in pragmas:
+        p.consumed = True
+    return all(p.reason for p in pragmas)
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod, call in tree.walk_calls():
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr not in config.WAL_APPEND_METHODS:
+            continue
+        recv = dotted_name(f.value)
+        if not recv or recv.rsplit(".", 1)[-1] not in config.WAL_RECEIVERS:
+            continue
+        func = mod.enclosing_function(call)
+        cls = mod.enclosing_class(call)
+        where = (
+            f"{cls.name}.{func.name}"
+            if cls is not None and func is not None
+            else (func.name if func is not None else "<module>")
+        )
+        if where in config.WAL_FAILSTOP_SEAMS:
+            continue
+        if _handled(mod, call):
+            continue
+        marked = _marked_exempt(mod, call)
+        if marked is True:
+            continue
+        if marked is False:
+            findings.append(
+                Finding(
+                    mod.rel, call.lineno, PASS,
+                    f"no-reason:{where}:{f.attr}",
+                    f"walseam-exempt pragma on `{recv}.{f.attr}` in "
+                    f"`{where}` needs a reason",
+                )
+            )
+            continue
+        findings.append(
+            Finding(
+                mod.rel, call.lineno, PASS,
+                f"unhandled-append:{where}:{f.attr}",
+                f"WAL append `{recv}.{f.attr}` in `{where}` neither "
+                "handles OSError nor is a blessed fail-stop seam "
+                "(config.WAL_FAILSTOP_SEAMS): a sink failure here either "
+                "kills the calling thread or gets acked upstream as if "
+                "durable — handle SinkFailed/DiskFull/OSError at the "
+                "call site or mark walseam-exempt(reason)",
+            )
+        )
+    return findings

@@ -259,11 +259,16 @@ def test_degraded_epoch_transitions_hit_the_wal(tmp_path):
     late = Follower(listener.address, lease_s=60.0).start()
     assert late.wait_synced(5.0)
     assert _wait(lambda: not primary.write_gate.degraded, timeout=5.0)
+    from kubernetes_tpu.runtime.wal import parse_wal_line
+
     wal_text = open(str(tmp_path / "primary") + ".wal").read()
+    records = [
+        parse_wal_line(line) for line in wal_text.splitlines() if line
+    ]
     events = [
-        json.loads(line)["event"]
-        for line in wal_text.splitlines()
-        if line and json.loads(line).get("verb") == "commit"
+        rec["event"]
+        for rec in records
+        if rec is not None and rec.get("verb") == "commit"
     ]
     assert "degraded" in events and "restored" in events
     rv, _objects, commit = WriteAheadLog.recover_full(str(tmp_path / "primary"))
