@@ -28,6 +28,11 @@
 #                         storm with a frontend AND the read-serving follower
 #                         SIGKILLed — zero acked-write loss, zero stale
 #                         consistent reads, watchers resume with zero relists
+#   make chaos-defrag     descheduler chaos: churn fragments the fleet, the
+#                         verified consolidation loop provably reduces node
+#                         count and $/h with zero acked-bind loss, zero PDB
+#                         violations, zero gangs below min-member; forced
+#                         mid-plan drift aborts + uncordon-rolls-back
 #   make chaos-tuner      policy-gym chaos: workload-mix flip re-convergence,
 #                         kill-leader mid-shadow (no double promotion, the
 #                         new leader adopts the persisted vector), NaN
@@ -61,8 +66,8 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
-	chaos-serving chaos-preempt chaos-tuner chaos-disk tracing-ab lint-slow lint-static \
-	lint-fast lint
+	chaos-serving chaos-preempt chaos-tuner chaos-disk chaos-defrag \
+	tracing-ab lint-slow lint-static lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -76,7 +81,8 @@ chaos: lint
 		tests/test_watchcache.py tests/test_chaos_ha.py \
 		tests/test_chaos_net.py tests/test_serving.py \
 		tests/test_chaos_serving.py tests/test_chaos_preempt.py \
-		tests/test_chaos_tuner.py tests/test_chaos_disk.py -q
+		tests/test_chaos_tuner.py tests/test_chaos_disk.py \
+		tests/test_chaos_defrag.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -107,6 +113,10 @@ chaos-tuner:
 chaos-disk:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_disk.py -q
 	$(PY) scripts/consistency_check.py --selftest
+
+chaos-defrag:
+	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py \
+		tests/test_chaos_defrag.py -q
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

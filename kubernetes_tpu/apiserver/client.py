@@ -531,19 +531,47 @@ class RESTClient:
             "pods", namespace, f"{name}/exec", {"command": list(command)}
         )
 
-    def evict_pod(self, namespace: str, name: str) -> None:
+    def evict_pod(
+        self, namespace: str, name: str, retries_429: int = 2
+    ) -> None:
         """pods/{name}/eviction over REST; a PDB/ratelimit refusal (429)
-        maps back to TooManyRequests like the in-process store."""
-        try:
-            self._request(
-                "POST",
-                self._url("pods", namespace, f"{name}/eviction"),
-                {"podName": name, "podNamespace": namespace},
-            )
-        except urllib.error.HTTPError as e:
-            if e.code == 429:
-                raise TooManyRequests(str(e)) from None
-            raise
+        maps back to TooManyRequests like the in-process store.
+
+        429s carrying a Retry-After header are honored (previously the
+        first refusal gave up outright): up to ``retries_429`` paced
+        retries sleep out the server's hint — a disruption-controller
+        budget resync away from succeeding — each capped at
+        degraded_retry_cap_s like the 503 path. A refusal that survives
+        the retries (or carries no hint) raises TooManyRequests with the
+        hint attached as ``retry_after_s``, so a paced drainer (the
+        descheduler's wave loop) can schedule its next attempt instead
+        of hammering."""
+        attempt = 0
+        while True:
+            try:
+                self._request(
+                    "POST",
+                    self._url("pods", namespace, f"{name}/eviction"),
+                    {"podName": name, "podNamespace": namespace},
+                )
+                return
+            except urllib.error.HTTPError as e:
+                if e.code != 429:
+                    raise
+                raw_hint = (e.headers or {}).get("Retry-After")
+                delay = None
+                if raw_hint is not None:
+                    try:
+                        delay = float(raw_hint)
+                    except ValueError:
+                        delay = 1.0
+                if delay is not None and attempt < retries_429:
+                    attempt += 1
+                    time.sleep(min(delay, self.degraded_retry_cap_s))
+                    continue
+                err = TooManyRequests(str(e))
+                err.retry_after_s = delay
+                raise err from None
 
     # -- watch ---------------------------------------------------------------
 

@@ -1106,7 +1106,17 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     self.store.evict_pod(ns or "default", pod_name)
                 except TooManyRequests as e:
-                    return self._status_error(429, "TooManyRequests", str(e))
+                    # Retry-After rides along (eviction.go returns the
+                    # DisruptedPods-style backoff hint): a paced drainer
+                    # (descheduler wave, kubectl drain loop) should wait
+                    # for the disruption controller's next budget resync
+                    # instead of giving up on the first 429
+                    return self._status_error(
+                        429,
+                        "TooManyRequests",
+                        str(e),
+                        retry_after_s=getattr(e, "retry_after_s", 1.0),
+                    )
                 return self._json(201, {"kind": "Status", "status": "Success"})
             if resource == "selfsubjectaccessreviews":
                 # authz introspection (SelfSubjectAccessReview): evaluate

@@ -89,6 +89,7 @@ class ClusterAutoscaler:
         eviction_burst: int = 5,
         provision_register_timeout_s: float = 30.0,
         cost_aware: bool = True,
+        eviction_budget=None,
     ):
         self.server = server
         self.scheduler = scheduler
@@ -99,7 +100,12 @@ class ClusterAutoscaler:
         self.util_threshold = scale_down_util_threshold
         self.unneeded_passes = scale_down_unneeded_passes
         self.register_timeout = provision_register_timeout_s
-        self.limiter = EvictionLimiter(eviction_qps, eviction_burst)
+        # eviction_budget: the process-wide shared bucket (controller/
+        # evictionbudget.py) when this process also runs nodelifecycle /
+        # preemption / the descheduler; private bucket otherwise
+        self.limiter = eviction_budget or EvictionLimiter(
+            eviction_qps, eviction_burst
+        )
         self.sim = WhatIfSimulator(
             scheduler.cache,
             hard_pod_affinity_weight=scheduler.cfg.hard_pod_affinity_weight,
@@ -472,7 +478,7 @@ class ClusterAutoscaler:
             )
             return
         for pod in victims:
-            if not self.limiter.try_acquire():
+            if not self.limiter.try_acquire(actor="autoscaler"):
                 return  # token bucket dry: resume next pass
             try:
                 self.server.delete(

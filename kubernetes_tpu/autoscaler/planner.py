@@ -147,10 +147,14 @@ class WhatIfSimulator:
         virtual_nodes: List[v1.Node],
         mask_node: Optional[str] = None,
         kind: str = "scale_up",
+        mask_nodes: Optional[List[str]] = None,
     ) -> Optional[SimResult]:
         """One what-if pass: pods × (real + virtual − masked) rows through
-        the production kernel. None when the overlay has no room or the
-        masked node is unknown."""
+        the production kernel. None when the overlay has no room or a
+        masked node is unknown. mask_nodes masks SEVERAL rows at once
+        (the descheduler's evict-set simulation — whatif_overlay always
+        took a row list; mask_node stays as the single-node spelling the
+        scale-down path uses)."""
         pods = pods[: self.max_pods]
         if virtual_nodes:
             biased = []
@@ -159,15 +163,18 @@ class WhatIfSimulator:
                 c.spec.taints = list(c.spec.taints) + [VIRTUAL_BIAS_TAINT]
                 biased.append(c)
             virtual_nodes = biased
+        masked_names = list(mask_nodes or [])
+        if mask_node is not None:
+            masked_names.append(mask_node)
         t0 = time.monotonic()
         with self.cache.lock:
             enc = self.cache.encoder
             mask_rows: List[int] = []
-            if mask_node is not None:
-                r = enc.row_of(mask_node)
+            for mn in masked_names:
+                r = enc.row_of(mn)
                 if r < 0:
                     return None
-                mask_rows = [r]
+                mask_rows.append(r)
             # encode FIRST: predicate/eterm interning can grow capacities,
             # which must settle before the overlay snapshot is built
             eb = encode_pod_batch(enc, pods, pad_to=self._pad(len(pods)))
@@ -328,15 +335,19 @@ class DrainVerdict:
     replaced: int = 0  # resident pods the simulation re-placed
 
 
-def simulate_drain(
+def simulate_drain_set(
     sim: WhatIfSimulator,
-    node_name: str,
+    node_names: List[str],
     resident: List[v1.Pod],
+    kind: str = "scale_down",
 ) -> DrainVerdict:
-    """Scale-down what-if: would every resident pod re-place with this
-    node's row masked out? DaemonSet-owned pods are excluded (they die
-    with the node by design). Any pod the kernel cannot represent OR
-    cannot re-place fails the verdict — the caller must then NOT drain."""
+    """Drain-set what-if: would every resident pod of the WHOLE set
+    re-place with all of those rows masked out in one overlay? DaemonSet-
+    owned pods are excluded (they die with their node by design). Any pod
+    the kernel cannot represent OR cannot re-place fails the verdict —
+    the caller must then NOT drain. The single-node scale-down path
+    (simulate_drain) and the descheduler's multi-node consolidation plans
+    share this exact verdict, so "is this eviction safe" has one answer."""
     movable = []
     for p in resident:
         if any(r.kind == "DaemonSet" for r in p.metadata.owner_references):
@@ -359,9 +370,7 @@ def simulate_drain(
                 f"width ({sim.max_pods})"
             ),
         )
-    res = sim.simulate(
-        movable, [], mask_node=node_name, kind="scale_down"
-    )
+    res = sim.simulate(movable, [], mask_nodes=list(node_names), kind=kind)
     if res is None:
         return DrainVerdict(ok=False, reason="node unknown to the snapshot")
     if bool(res.fallback.any()):
@@ -378,3 +387,13 @@ def simulate_drain(
             replaced=len(movable) - unplaced,
         )
     return DrainVerdict(ok=True, replaced=len(movable))
+
+
+def simulate_drain(
+    sim: WhatIfSimulator,
+    node_name: str,
+    resident: List[v1.Pod],
+) -> DrainVerdict:
+    """Scale-down what-if for ONE node (the autoscaler's spelling of the
+    shared drain-set verdict)."""
+    return simulate_drain_set(sim, [node_name], resident)

@@ -98,6 +98,8 @@ def run(
     autoscaler_kwargs: Optional[dict] = None,
     watch_cache: bool = True,
     debug_port: Optional[int] = None,
+    deschedule: bool = False,
+    descheduler_kwargs: Optional[dict] = None,
 ) -> Scheduler:
     """app.Run (server.go:142): health endpoints → informers → leader
     election (optional) → scheduling loops. autoscaler_catalog (a
@@ -143,14 +145,42 @@ def run(
     CacheDebugger(sched).listen_for_signal()
 
     stop = threading.Event()
+    # ONE process-wide eviction token bucket: nodelifecycle drains,
+    # autoscaler scale-down, preemption victim deletes, and descheduler
+    # consolidation all draw from the same qps+burst — three storms can't
+    # triple the eviction rate (controller/evictionbudget.py)
+    from ..controller.evictionbudget import EvictionBudget
+
+    a_kwargs = dict(autoscaler_kwargs or {})
+    budget = a_kwargs.get("eviction_budget") or EvictionBudget(
+        a_kwargs.get("eviction_qps", 10.0),
+        a_kwargs.get("eviction_burst", 5),
+    )
+    a_kwargs["eviction_budget"] = budget
+    sched.eviction_budget = budget
     autoscaler = None
     if autoscaler_catalog is not None:
         from ..autoscaler import ClusterAutoscaler
 
         autoscaler = ClusterAutoscaler(
-            server, sched, autoscaler_catalog, **(autoscaler_kwargs or {})
+            server, sched, autoscaler_catalog, **a_kwargs
         )
         sched._autoscaler = autoscaler
+    descheduler = None
+    if deschedule:
+        # the descheduler follows scheduler leadership exactly like the
+        # autoscaler, shares its eviction budget, and talks to the RAW
+        # store (evictions and cordons are fenced writes, never cached)
+        from ..descheduler import Descheduler
+
+        descheduler = Descheduler(
+            server,
+            sched,
+            budget,
+            catalog=autoscaler_catalog,
+            **(descheduler_kwargs or {}),
+        )
+        sched._descheduler = descheduler
     tuner = None
     if cfg.tune_policy:
         # the policy gym follows leadership like the autoscaler: only the
@@ -166,6 +196,8 @@ def run(
         sched.start()
         if autoscaler is not None:
             autoscaler.start()
+        if descheduler is not None:
+            descheduler.start()
         if tuner is not None:
             tuner.start()
         live.set()
@@ -184,6 +216,8 @@ def run(
             sched.promote(fence=elector.fence())
             if autoscaler is not None:
                 autoscaler.start()
+            if descheduler is not None:
+                descheduler.start()
             if tuner is not None:
                 tuner.start()
             ready.set()
@@ -195,6 +229,8 @@ def run(
             live.clear()
             if tuner is not None:
                 tuner.stop()
+            if descheduler is not None:
+                descheduler.stop()
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
@@ -232,6 +268,8 @@ def run(
                     elector_thread.join(timeout=5.0)
             if tuner is not None:
                 tuner.stop()
+            if descheduler is not None:
+                descheduler.stop()
             if autoscaler is not None:
                 autoscaler.stop()
             sched.stop()
@@ -287,6 +325,15 @@ def main(argv=None) -> int:
         help="enable the kernel-driven cluster autoscaler with a shape "
         "catalog: semicolon-separated 'name:cpu,memory,maxPods,maxSize' "
         "entries (e.g. 'small:4,32Gi,110,100;big:32,256Gi,110,20')",
+    )
+    parser.add_argument(
+        "--deschedule",
+        action="store_true",
+        default=False,
+        help="run the verified descheduler: consolidation plans proven on "
+        "the what-if overlay before any eviction, executed in budgeted "
+        "waves with drift re-simulation, PDB re-checks, gang quorum, and "
+        "uncordon rollback (shares the process-wide eviction budget)",
     )
     parser.add_argument(
         "--score-policy",
@@ -369,6 +416,7 @@ def main(argv=None) -> int:
         autoscaler_catalog=catalog,
         watch_cache=not args.no_watch_cache,
         debug_port=args.debug_port,
+        deschedule=args.deschedule,
     )
     return 0
 

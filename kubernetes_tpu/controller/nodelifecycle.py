@@ -37,6 +37,7 @@ from ..client.apiserver import NotFound, NotPrimary
 from ..kubemark.hollow_node import NODE_LEASE_NS
 from ..runtime.consensus import DegradedWrites
 from ..utils.metrics import metrics
+from .evictionbudget import EvictionBudget
 
 logger = logging.getLogger("kubernetes_tpu.controller.nodelifecycle")
 
@@ -57,36 +58,12 @@ COUNTER_READY_WRITES_DEFERRED = "node_lifecycle_ready_writes_deferred_total"
 COUNTER_STORE_WRITE_FAILURES = "node_lifecycle_store_write_failures_total"
 
 
-class EvictionLimiter:
-    """Token bucket over NODES: at most ``qps`` node evictions per second
-    with ``burst`` headroom (the rate of the reference's
-    RateLimitedTimedQueue, flowcontrol.NewTokenBucketRateLimiter)."""
-
-    def __init__(self, qps: float = 10.0, burst: int = 5):
-        if qps <= 0:
-            raise ValueError(f"eviction qps must be > 0, got {qps}")
-        self.qps = qps
-        self.burst = max(1, burst)
-        self._tokens = float(self.burst)
-        self._last = time.monotonic()
-        self._lock = threading.Lock()
-
-    def try_acquire(self, now: float = None) -> bool:
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            self._tokens = min(
-                float(self.burst), self._tokens + (now - self._last) * self.qps
-            )
-            self._last = now
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
-                return True
-            return False
-
-    @property
-    def tokens(self) -> float:
-        with self._lock:
-            return self._tokens
+class EvictionLimiter(EvictionBudget):
+    """Back-compat alias for the PR-3 token bucket, now extracted into
+    controller/evictionbudget.EvictionBudget so the node lifecycle
+    controller, the scheduler's preemption victim deletes, and the
+    descheduler can spend ONE shared budget (three private buckets would
+    let a combined storm triple the configured eviction rate)."""
 
 
 class NodeLifecycleController:
@@ -99,13 +76,17 @@ class NodeLifecycleController:
         eviction_limiter_qps: float = 10.0,
         eviction_limiter_burst: int = 5,
         partial_disruption_threshold: float = 0.55,
+        eviction_budget: EvictionBudget = None,
     ):
         self.server = server
         self.monitor_period = node_monitor_period
         self.grace_period = node_monitor_grace_period
         self.eviction_timeout = pod_eviction_timeout
         self.partial_disruption_threshold = partial_disruption_threshold
-        self.limiter = EvictionLimiter(
+        # eviction_budget: a process-wide shared bucket (injected by the
+        # process wiring when preemption/descheduler coexist); the
+        # private-limiter default preserves standalone behavior
+        self.limiter = eviction_budget or EvictionLimiter(
             eviction_limiter_qps, eviction_limiter_burst
         )
         self._not_ready_since: Dict[str, float] = {}
@@ -201,7 +182,7 @@ class NodeLifecycleController:
                 ]
                 if not victims:
                     continue
-                if not self.limiter.try_acquire():
+                if not self.limiter.try_acquire(actor="nodelifecycle"):
                     metrics.inc(COUNTER_EVICTIONS_DEFERRED)
                     continue
                 self._evict_pods(name, victims)

@@ -1865,6 +1865,34 @@ class SnapshotEncoder:
         the next flush.)"""
         self._install_generation(snap, shared_with_base=True)
 
+    # -- utilization / stranding columns (descheduler + tuner) ---------------
+
+    def utilization_stats(self) -> "UtilizationStats":
+        """Per-row utilization and stranded-capacity columns from the host
+        masters — the fragmentation-score inputs (tuner/scoring.
+        fragmentation_score) and the descheduler's candidate signal, read
+        straight off the same aggregates the kernel's resource columns
+        are scattered from (no second bookkeeping to drift). Pure numpy
+        over the masters; caller holds the cache lock."""
+        alloc = self.m_alloc.astype(np.int64)
+        req = self.m_req.astype(np.int64)
+        safe_alloc = np.maximum(alloc, 1)
+        free = alloc - req
+        # per-row utilization: max over resources of requested/allocatable
+        # (the CA's node-utilization measure, matching the autoscaler's
+        # host-side _utilization up to encoding quantization)
+        util = np.where(alloc > 0, req / safe_alloc, 0.0).max(
+            axis=1, initial=0.0
+        )
+        return UtilizationStats(
+            valid=np.asarray(self.m_valid, bool).copy(),
+            unschedulable=np.asarray(self.m_unsched, bool).copy(),
+            used_any=(req > 0).any(axis=1) & np.asarray(self.m_valid, bool),
+            util=np.asarray(util, np.float64),
+            free_frac=np.clip(free / safe_alloc, 0.0, 1.0).mean(axis=1),
+            cost_milli=self.m_cost.astype(np.int64).copy(),
+        )
+
     # -- what-if simulation overlay (autoscaler) -----------------------------
 
     def free_row_indices(self) -> List[int]:
@@ -1964,6 +1992,21 @@ class SnapshotEncoder:
                     idx_d, updates_d = jax.device_put((idx, updates))
                 out = _scatter_rows_safe(out, idx_d, updates_d)
         return out, rows
+
+
+class UtilizationStats(NamedTuple):
+    """Per-row utilization/stranding columns (SnapshotEncoder.
+    utilization_stats): [N]-aligned with row_names. free_frac is the
+    mean free/allocatable fraction per row — the stranded-capacity unit
+    the fragmentation score sums; util is the CA-style max-over-resources
+    requested/allocatable the descheduler thresholds candidates on."""
+
+    valid: np.ndarray  # [N] bool — row holds a live node
+    unschedulable: np.ndarray  # [N] bool — cordoned
+    used_any: np.ndarray  # [N] bool — valid and hosting any request
+    util: np.ndarray  # [N] float — max req/alloc over resources
+    free_frac: np.ndarray  # [N] float — mean free/alloc over resources
+    cost_milli: np.ndarray  # [N] int64 — $/h * 1000 (0 unlabeled)
 
 
 # Fields of DeviceSnapshot that are NOT [N, ...] row-major (global metadata

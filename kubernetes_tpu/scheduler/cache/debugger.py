@@ -104,6 +104,15 @@ class CacheDebugger:
         if auto:
             lines.append("Dump of cluster-autoscaler state:")
             lines.extend(auto)
+        from ...controller.evictionbudget import eviction_budget_health_lines
+        from ...descheduler.controller import descheduler_health_lines
+
+        defrag = descheduler_health_lines() + eviction_budget_health_lines()
+        if defrag:
+            lines.append(
+                "Dump of descheduler / shared eviction-budget state:"
+            )
+            lines.extend(defrag)
         from ...apiserver.cacher import readpath_health_lines
 
         readpath = readpath_health_lines()

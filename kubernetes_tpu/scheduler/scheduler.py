@@ -483,6 +483,13 @@ class Scheduler:
         # refresh loop state (keeps the HBM snapshot tracking informer
         # churn while no scheduling loop runs)
         self._bind_fence = None
+        # process-wide shared eviction budget (controller/evictionbudget.
+        # EvictionBudget), injected by the process wiring when this
+        # scheduler coexists with other evictors: preemption victim
+        # deletes then spend the SAME bucket as nodelifecycle drains and
+        # descheduler waves. None (default) = unthrottled preemption, the
+        # pre-budget behavior every bench and single-evictor rig keeps.
+        self.eviction_budget = None
         self._ha_identity = "scheduler-0"
         self._standby_stop = threading.Event()
         self._standby_thread: Optional[threading.Thread] = None
@@ -1316,6 +1323,32 @@ class Scheduler:
                 f"transition {getattr(lease, 'lease_transitions', None)} "
                 f"(caller's token: {f.identity!r} at {f.transitions})"
             )
+
+    def check_eviction_fence(self) -> None:
+        """Public fence seam for out-of-pipeline evictors (the
+        descheduler): plain pod deletes/evictions are store writes with
+        no atomic fence validation, so a consolidation wave re-reads the
+        lease through the same best-effort pre-check preemption victim
+        deletes use. Raises LeaderFenced when this replica's grant was
+        superseded; no-op when no fence is armed (single-replica rigs)."""
+        self._check_fence_live()
+
+    def fragmentation_score(self) -> float:
+        """Stranded-capacity fragmentation of the LIVE fleet: free
+        capacity sitting on partially-used nodes / total free capacity,
+        from the encoder's host masters (ops/encoding.utilization_stats)
+        through the same arithmetic the tuner scores replayed waves with
+        (tuner/scoring.fragmentation_score). Published as the
+        scheduler_fragmentation_score gauge — the descheduler's planning
+        signal and the policy gym's consolidation actuator: one
+        definition, three consumers."""
+        from ..tuner.scoring import fragmentation_score as _frag
+
+        with self.cache.lock:
+            st = self.cache.encoder.utilization_stats()
+        score = _frag(st.free_frac, st.used_any, st.valid)
+        metrics.set_gauge("scheduler_fragmentation_score", score)
+        return score
 
     def _on_fenced_binds(self, entries) -> None:
         """We are a zombie ex-leader: a newer grant exists and the store
@@ -3815,6 +3848,17 @@ class Scheduler:
             return ""
         with tracer.span(tid, "preempt.delete", victims=len(victims)):
             for victim in victims:
+                if (
+                    self.eviction_budget is not None
+                    and not self.eviction_budget.try_acquire(actor="preemption")
+                ):
+                    # shared eviction budget dry: abort the attempt — the
+                    # preemptor pod stays pending and retries; pressing on
+                    # would let a preemption storm ride over the cluster's
+                    # configured eviction rate alongside nodelifecycle and
+                    # descheduler spends
+                    metrics.inc("scheduler_preemption_budget_deferred_total")
+                    return ""
                 try:
                     self.server.delete(
                         "pods", victim.metadata.namespace, victim.metadata.name

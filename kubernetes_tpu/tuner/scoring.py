@@ -33,6 +33,24 @@ COST_WEIGHT = 0.20
 ENERGY_WEIGHT = 0.10
 
 
+def fragmentation_score(
+    free_frac: np.ndarray, used_any: np.ndarray, valid: np.ndarray
+) -> float:
+    """Stranded-capacity fragmentation in [0, 1]: free capacity sitting
+    on PARTIALLY used nodes / total free capacity. A consolidating
+    placement leaves whole nodes empty (gang-sized holes survive); a
+    smearing one strands its slack. ONE definition shared by the gym's
+    outcome scoring (score_assignment), the live-fleet gauge
+    (Scheduler.fragmentation_score), and the descheduler's planning
+    signal — inputs come from SnapshotEncoder.utilization_stats or the
+    gym's overlay columns, both derived from the same masters."""
+    valid = np.asarray(valid, bool)
+    stranded_mask = np.asarray(used_any, bool) & valid
+    total_free = float(free_frac[valid].sum())
+    stranded = float(free_frac[stranded_mask].sum())
+    return stranded / total_free if total_free > 0 else 0.0
+
+
 @dataclass
 class WaveOutcome:
     """Scored outcome of one (replayed or production) wave placement."""
@@ -177,16 +195,14 @@ def score_assignment(ov: OverlaySnapshot, chosen: np.ndarray) -> WaveOutcome:
         np.subtract.at(
             free, chosen[placed_mask], ov.req[placed_mask].astype(np.int64)
         )
-    # stranded-capacity fragmentation: free capacity sitting on PARTIALLY
-    # used nodes / total free. A consolidating policy leaves whole nodes
-    # empty (gang-sized holes survive); a smearing one strands its slack
+    # stranded-capacity fragmentation through the SHARED definition
+    # (fragmentation_score above — the descheduler and the live gauge
+    # consume the same arithmetic)
     nv = ov.node_valid
     alloc = np.maximum(ov.alloc, 1)
     used_any = (free < ov.alloc).any(axis=1) & nv
     free_frac = np.clip(free / alloc, 0.0, 1.0).mean(axis=1)
-    total_free = float(free_frac[nv].sum())
-    stranded = float(free_frac[used_any].sum())
-    fragmentation = stranded / total_free if total_free > 0 else 0.0
+    fragmentation = fragmentation_score(free_frac, used_any, nv)
 
     cost_norm = energy_norm = 0.0
     if placed:

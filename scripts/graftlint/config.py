@@ -37,6 +37,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_preempt.py",
     "tests/test_chaos_tuner.py",
     "tests/test_chaos_disk.py",
+    "tests/test_chaos_defrag.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -156,6 +157,11 @@ DUMP_REQUIRED_FAMILIES = (
     # store that went read-only for disk reasons must be SIGUSR2-visible
     "wal_",
     "store_disk_",
+    # verified consolidation: the descheduler's plan/abort/wave counters
+    # and the process-wide eviction token bucket it shares with
+    # nodelifecycle, autoscaler scale-down, and preemption
+    "descheduler_",
+    "eviction_budget_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------
@@ -167,6 +173,7 @@ DEGRADED_DIRS = (
     "kubernetes_tpu/autoscaler",
     "kubernetes_tpu/kubelet",
     "kubernetes_tpu/tuner",
+    "kubernetes_tpu/descheduler",
 )
 
 # method names that are store writes when called on a store-ish receiver
