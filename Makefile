@@ -33,6 +33,11 @@
 #                         count and $/h with zero acked-bind loss, zero PDB
 #                         violations, zero gangs below min-member; forced
 #                         mid-plan drift aborts + uncordon-rolls-back
+#   make chaos-relay      watch-relay chaos: relay worker SIGKILLed mid-storm
+#                         (clients resume at last rv, zero lost/dup ledger
+#                         deliveries), ring overflow evicts slow clients
+#                         without blocking dispatch, SIGSTOPped primary —
+#                         relay keeps serving buffered frames + bookmarks
 #   make chaos-tuner      policy-gym chaos: workload-mix flip re-convergence,
 #                         kill-leader mid-shadow (no double promotion, the
 #                         new leader adopts the persisted vector), NaN
@@ -67,7 +72,7 @@ CACHED = JAX_COMPILATION_CACHE_DIR=$(JAX_CACHE)
 .PHONY: test bench bench-cpu tpu-experiments dryrun verify chaos \
 	chaos-device chaos-autoscaler chaos-readpath chaos-ha chaos-net \
 	chaos-serving chaos-preempt chaos-tuner chaos-disk chaos-defrag \
-	tracing-ab lint-slow lint-static lint-fast lint
+	chaos-relay tracing-ab lint-slow lint-static lint-fast lint
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
@@ -82,7 +87,7 @@ chaos: lint
 		tests/test_chaos_net.py tests/test_serving.py \
 		tests/test_chaos_serving.py tests/test_chaos_preempt.py \
 		tests/test_chaos_tuner.py tests/test_chaos_disk.py \
-		tests/test_chaos_defrag.py -q
+		tests/test_chaos_defrag.py tests/test_chaos_relay.py -q
 	$(PY) scripts/consistency_check.py --selftest
 
 chaos-device:
@@ -117,6 +122,9 @@ chaos-disk:
 chaos-defrag:
 	$(CACHED) $(PY) -m pytest tests/test_chaos_warmup.py \
 		tests/test_chaos_defrag.py -q
+
+chaos-relay:
+	$(CACHED) $(PY) -m pytest tests/test_relay.py tests/test_chaos_relay.py -q
 
 tracing-ab:
 	JAX_PLATFORMS=cpu $(PY) scripts/tracing_overhead_ab.py

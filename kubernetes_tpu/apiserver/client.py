@@ -32,6 +32,7 @@ import socket
 import threading
 import time
 import urllib.error
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
@@ -68,6 +69,13 @@ GAUGE_POOL_SIZE = "restclient_pool_size"
 # a balancer this is what lets a watcher ride a frontend death with zero
 # informer-visible relists (the replacement frontend's cache replays)
 COUNTER_WATCH_RECONNECTS = "restclient_watch_reconnects_total"  # {reason}
+# HTTP/1.1 pipelining (idempotent GETs only): requests sent back-to-back
+# on one pooled connection, responses drained in order; requeues count
+# requests pushed back after a mid-pipeline transport error (labels:
+# first_in_flight = the one request that classified as retryable,
+# unattempted = requests behind it that were never answered)
+COUNTER_PIPELINED = "restclient_pipelined_requests_total"
+COUNTER_PIPELINE_REQUEUES = "restclient_pipeline_requeues_total"  # {reason}
 
 # replay safety: methods whose transparent one-shot retry after a reused
 # connection died cannot double-apply. Deliberately NOT send-phase-gated
@@ -98,6 +106,64 @@ class _NoDelayHTTPConnection(http.client.HTTPConnection):
             pass
 
 
+class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
+    """TLS variant: the Nagle/delayed-ACK stall applies identically under
+    TLS (the record layer rides the same two-write pattern)."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+class _NoCloseReader:
+    """A file proxy whose close() is a no-op: HTTPResponse closes its fp
+    once a response is fully read, but pipelined responses SHARE one
+    buffered reader (a per-response makefile could prefetch the next
+    response's bytes and lose them) — the window owns the close."""
+
+    __slots__ = ("_fp",)
+
+    def __init__(self, fp):
+        self._fp = fp
+
+    def close(self):
+        pass
+
+    def flush(self):
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self._fp, name)
+
+
+def _tls_client_context(tls_ca: Optional[str]):
+    """Client-side TLS context: verify against the given CA bundle, or —
+    the fleet-internal default, where the relay/frontend certs are
+    self-signed test material — encrypt without verification (the bench
+    measures handshake+record crypto cost either way)."""
+    import ssl
+
+    if tls_ca:
+        return ssl.create_default_context(cafile=tls_ca)
+    ctx = ssl._create_unverified_context()
+    return ctx
+
+
+def _new_connection(
+    scheme: str, host: str, port: int, timeout: float,
+    tls_ctx=None, tls_ca: Optional[str] = None,
+) -> http.client.HTTPConnection:
+    if scheme == "https":
+        return _NoDelayHTTPSConnection(
+            host, port, timeout=timeout,
+            context=tls_ctx or _tls_client_context(tls_ca),
+        )
+    return _NoDelayHTTPConnection(host, port, timeout=timeout)
+
+
 class HTTPConnectionPool:
     """Bounded per-host idle pool of persistent http.client connections.
 
@@ -108,12 +174,23 @@ class HTTPConnectionPool:
     by a stream. Thread-safe; the pool never blocks a caller waiting for
     a slot — the bound is on IDLE sockets kept, not on concurrency."""
 
-    def __init__(self, max_idle_per_host: int = 8, timeout: float = 30.0):
+    def __init__(
+        self,
+        max_idle_per_host: int = 8,
+        timeout: float = 30.0,
+        tls_ca: Optional[str] = None,
+    ):
         self.max_idle_per_host = max_idle_per_host
         self.timeout = timeout
         self._lock = threading.Lock()
-        self._idle: Dict[Tuple[str, int], List[http.client.HTTPConnection]] = {}
+        # keyed by (scheme, host, port): an https socket is never handed
+        # to a plaintext request and vice versa
+        self._idle: Dict[
+            Tuple[str, str, int], List[http.client.HTTPConnection]
+        ] = {}
         self._idle_count = 0
+        self._tls_ca = tls_ca
+        self._tls_ctx = None  # built lazily on the first https acquire
 
     @staticmethod
     def _stale(conn: http.client.HTTPConnection) -> bool:
@@ -130,11 +207,11 @@ class HTTPConnectionPool:
         return bool(readable or errored)
 
     def acquire(
-        self, host: str, port: int
+        self, host: str, port: int, scheme: str = "http"
     ) -> Tuple[http.client.HTTPConnection, bool]:
         """(connection, reused): reused=True means it already carried at
         least one request on this socket (retry policy branches on it)."""
-        key = (host, port)
+        key = (scheme, host, port)
         while True:
             with self._lock:
                 idle = self._idle.get(key)
@@ -152,13 +229,17 @@ class HTTPConnectionPool:
                 continue
             metrics.inc(COUNTER_CONN_REUSED)
             return conn, True
-        conn = _NoDelayHTTPConnection(host, port, timeout=self.timeout)
+        if scheme == "https" and self._tls_ctx is None:
+            self._tls_ctx = _tls_client_context(self._tls_ca)
+        conn = _new_connection(
+            scheme, host, port, self.timeout, tls_ctx=self._tls_ctx
+        )
         metrics.inc(COUNTER_CONN_OPENED)
         return conn, False
 
-    def release(self, host: str, port: int, conn) -> None:
+    def release(self, host: str, port: int, conn, scheme: str = "http") -> None:
         with self._lock:
-            idle = self._idle.setdefault((host, port), [])
+            idle = self._idle.setdefault((scheme, host, port), [])
             if len(idle) >= self.max_idle_per_host:
                 pass  # over the idle bound: close below instead
             else:
@@ -215,14 +296,16 @@ class RESTClient:
         degraded_retries: int = 3,
         degraded_retry_cap_s: float = 2.0,
         pool_connections: int = 8,
+        tls_ca: Optional[str] = None,
     ):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.degraded_retries = degraded_retries
         self.degraded_retry_cap_s = degraded_retry_cap_s
+        self.tls_ca = tls_ca
         self._headers: dict = {}
         self.pool: Optional[HTTPConnectionPool] = (
-            HTTPConnectionPool(pool_connections, timeout=timeout)
+            HTTPConnectionPool(pool_connections, timeout=timeout, tls_ca=tls_ca)
             if pool_connections
             else None
         )
@@ -240,14 +323,17 @@ class RESTClient:
             path += f"/{name}"
         return self.base + path
 
-    def _acquire(self, host: str, port: int):
+    def _acquire(self, host: str, port: int, scheme: str = "http"):
         if self.pool is not None:
-            return self.pool.acquire(host, port)
-        conn = _NoDelayHTTPConnection(host, port, timeout=self.timeout)
+            return self.pool.acquire(host, port, scheme)
+        conn = _new_connection(
+            scheme, host, port, self.timeout, tls_ca=self.tls_ca
+        )
         metrics.inc(COUNTER_CONN_OPENED)
         return conn, False
 
-    def _park(self, host: str, port: int, conn, resp) -> None:
+    def _park(self, host: str, port: int, conn, resp,
+              scheme: str = "http") -> None:
         """Return a connection after a fully-read response: back to the
         pool when the response allows reuse, closed otherwise."""
         if self.pool is None or resp.will_close:
@@ -256,7 +342,7 @@ class RESTClient:
             except OSError:
                 pass
             return
-        self.pool.release(host, port, conn)
+        self.pool.release(host, port, conn, scheme)
 
     def _discard(self, conn) -> None:
         if self.pool is not None:
@@ -289,14 +375,16 @@ class RESTClient:
         read-back reconciler resolves it, never a blind replay).
         Fresh-connection failures never retry here."""
         u = urlsplit(url)
-        host, port = u.hostname or "127.0.0.1", u.port or 80
+        scheme = u.scheme or "http"
+        host = u.hostname or "127.0.0.1"
+        port = u.port or (443 if scheme == "https" else 80)
         path = u.path + (f"?{u.query}" if u.query else "")
         hdrs = dict(headers or {})
         if self.pool is None:
             hdrs.setdefault("Connection", "close")
         retried = False
         while True:
-            conn, reused = self._acquire(host, port)
+            conn, reused = self._acquire(host, port, scheme)
             try:
                 conn.request(method, path, body=data, headers=hdrs)
                 resp = conn.getresponse()
@@ -326,7 +414,7 @@ class RESTClient:
             except OSError:
                 self._discard(conn)
                 raise
-            self._park(host, port, conn, resp)
+            self._park(host, port, conn, resp, scheme)
             return resp.status, resp.reason, resp.headers, body
 
     def _request_raw(
@@ -426,6 +514,180 @@ class RESTClient:
         headers: Optional[dict] = None,
     ) -> dict:
         return json.loads(self._request_raw(method, url, body, headers) or b"{}")
+
+    # -- HTTP/1.1 pipelining (idempotent GETs only) --------------------------
+
+    def _http_error_for(self, url, status, reason, hdrs, raw) -> Exception:
+        """The _request_raw error taxonomy as a one-shot classifier (no
+        degraded-503 sleep/retry loop: the pipeline path surfaces the
+        typed error and lets the caller decide)."""
+        payload = {}
+        try:
+            payload = json.loads(raw.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            pass
+        msg = payload.get("message", f"HTTP Error {status}: {reason}")
+        err_reason = payload.get("reason", "")
+        if status == 404:
+            return NotFound(msg)
+        if status == 409:
+            if err_reason == "AlreadyExists":
+                return AlreadyExists(msg)
+            if err_reason == "LeaderFenced":
+                return LeaderFenced(msg)
+            return Conflict(msg)
+        if status == 410:
+            return Expired(msg)
+        if status == 503:
+            if hdrs.get("Retry-After") is None:
+                return NotPrimary(msg)
+            if err_reason == "WriteQuorumLost":
+                return QuorumLost(msg)
+            if err_reason == "DiskFailed":
+                return DiskFailed(msg)
+            if err_reason == "DiskPressure":
+                return DiskPressure(msg)
+            return DegradedWrites(msg)
+        return urllib.error.HTTPError(url, status, msg, hdrs, io.BytesIO(raw))
+
+    def pipelined_get_raw(
+        self,
+        urls: List[str],
+        headers: Optional[dict] = None,
+        depth: int = 8,
+    ) -> List[bytes]:
+        """K idempotent GETs pipelined on one pooled connection.
+
+        Requests go out back-to-back in windows of ``depth`` and the
+        responses drain IN ORDER off the same socket — one connection,
+        one round trip of latency for the whole window instead of K.
+
+        Mid-pipeline transport error contract: only the FIRST in-flight
+        request (sent, unanswered, no response bytes consumed for it)
+        may classify as retryable — it gets the same one-shot
+        reused-connection retry a plain GET gets; every request behind
+        it was never attempted by the server as far as we can prove, so
+        those requeue unattempted WITHOUT consuming retry budget. Bind
+        POSTs never ride this path (`_classify_bind_transport` keeps
+        writes strictly one-at-a-time).
+
+        Responses within one window share a single buffered reader:
+        a per-response ``makefile`` could prefetch bytes belonging to
+        the NEXT response and lose them with the file object.
+        """
+        results: List[Optional[bytes]] = [None] * len(urls)
+        pending = deque(enumerate(urls))
+        retried: set = set()
+        base_hdrs = {**self._headers, **(headers or {})}
+        while pending:
+            window = []
+            while pending and len(window) < depth:
+                window.append(pending.popleft())
+            u = urlsplit(window[0][1])
+            scheme = u.scheme or "http"
+            host = u.hostname or "127.0.0.1"
+            port = u.port or (443 if scheme == "https" else 80)
+            conn, reused = self._acquire(host, port, scheme)
+            completed = 0
+            fp = None
+            try:
+                if conn.sock is None:
+                    conn.connect()
+                sock = conn.sock
+                out = []
+                for _idx, url in window:
+                    pu = urlsplit(url)
+                    path = pu.path + (f"?{pu.query}" if pu.query else "")
+                    lines = [
+                        f"GET {path} HTTP/1.1",
+                        f"Host: {host}:{port}",
+                        "Accept-Encoding: identity",
+                    ]
+                    lines += [f"{k}: {v}" for k, v in base_hdrs.items()]
+                    out.append(
+                        ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+                    )
+                sock.sendall(b"".join(out))
+                metrics.inc(COUNTER_PIPELINED, by=len(window))
+                fp = sock.makefile("rb")
+                shared = _NoCloseReader(fp)
+                last_resp = None
+                early_close = False
+                for j, (idx, url) in enumerate(window):
+                    resp = http.client.HTTPResponse(sock, method="GET")
+                    resp.fp.close()
+                    resp.fp = shared  # shared reader: see docstring
+                    resp.begin()
+                    body = resp.read()
+                    if not (200 <= resp.status < 300):
+                        raise self._http_error_for(
+                            url, resp.status, resp.reason, resp.headers, body
+                        )
+                    results[idx] = body
+                    completed = j + 1
+                    last_resp = resp
+                    if resp.will_close and j + 1 < len(window):
+                        # the server is closing after this response: the
+                        # unanswered tail requeues unattempted
+                        tail = window[j + 1:]
+                        for item in reversed(tail):
+                            pending.appendleft(item)
+                        metrics.inc(
+                            COUNTER_PIPELINE_REQUEUES,
+                            {"reason": "unattempted"}, by=len(tail),
+                        )
+                        early_close = True
+                        break
+                if early_close or last_resp is None or last_resp.will_close:
+                    self._discard(conn)
+                else:
+                    self._park(host, port, conn, last_resp, scheme)
+            except (OSError, http.client.HTTPException) as e:
+                self._discard(conn)
+                in_flight = window[completed:]
+                if not in_flight:
+                    raise
+                first, rest = in_flight[0], in_flight[1:]
+                for item in reversed(rest):
+                    pending.appendleft(item)
+                if rest:
+                    metrics.inc(
+                        COUNTER_PIPELINE_REQUEUES,
+                        {"reason": "unattempted"}, by=len(rest),
+                    )
+                # only the first in-flight request classifies as
+                # retryable — and only with the plain GET's one-shot
+                # reused-connection policy
+                if reused and first[0] not in retried:
+                    retried.add(first[0])
+                    pending.appendleft(first)
+                    metrics.inc(
+                        COUNTER_PIPELINE_REQUEUES,
+                        {"reason": "first_in_flight"},
+                    )
+                    continue
+                if isinstance(e, http.client.HTTPException) and not isinstance(
+                    e, OSError
+                ):
+                    raise OSError(str(e)) from e
+                raise
+            finally:
+                if fp is not None:
+                    try:
+                        fp.close()
+                    except OSError:
+                        pass
+        return results  # type: ignore[return-value]
+
+    def get_many(
+        self, kind: str, namespace: str, names: List[str], depth: int = 8
+    ) -> List[Any]:
+        """Pipelined typed point-gets: K objects in ~one round trip."""
+        urls = [self._url(kind, namespace, n) for n in names]
+        return [
+            codec.decode(kind, json.loads(raw or b"{}"))
+            for raw in self.pipelined_get_raw(urls, depth=depth)
+        ]
 
     def get_text(self, resource: str, namespace: str, name: str) -> str:
         """Plain-text GET of a subresource (pods/{name}/log): shared

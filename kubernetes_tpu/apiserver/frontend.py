@@ -242,11 +242,23 @@ def serve_frontend(
     timeout: float = 30.0,
     pool_connections: int = 8,
     warm_kinds: Tuple[str, ...] = FRONTEND_WARM_KINDS,
+    relay_workers: int = 0,
+    relay_port: int = 0,
+    relay_kinds: Tuple[str, ...] = ("pods",),
+    relay_hollow_clients: int = 0,
     **serve_kwargs,
 ):
     """One stateless REST frontend over a remote primary. Returns
     (server, port, client) — the full rest.py façade with its own watch
-    cache, every upstream byte on pooled persistent connections."""
+    cache, every upstream byte on pooled persistent connections.
+
+    relay_workers > 0 attaches the watch-relay tier
+    (kubernetes_tpu/relay/): this frontend's cacher publishes each
+    relay_kinds frame once into shared memory and N SO_REUSEPORT worker
+    processes own the watch-client fan-out on ``relay_port``. The
+    handle hangs off ``srv.relay``; tls_cert/tls_key in serve_kwargs
+    flow to both the REST port and the relay workers, so the whole
+    serving hop is TLS or none of it is."""
     from .client import RESTClient
     from .rest import serve
 
@@ -257,6 +269,25 @@ def serve_frontend(
     if srv.cacher is not None:
         for kind in warm_kinds:
             srv.cacher.cache_for(kind)
+    srv.relay = None
+    if relay_workers:
+        if srv.cacher is None:
+            raise ValueError("the watch relay requires the watch cache")
+        from ..relay import start_relay
+
+        tls_cert = serve_kwargs.get("tls_cert")
+        tls_key = serve_kwargs.get("tls_key")
+        scheme = "https" if tls_cert and tls_key else "http"
+        srv.relay = start_relay(
+            srv.cacher,
+            f"{scheme}://127.0.0.1:{bound}",
+            kinds=relay_kinds,
+            n_workers=relay_workers,
+            port=relay_port,
+            tls_cert=tls_cert,
+            tls_key=tls_key,
+            hollow_clients=relay_hollow_clients,
+        )
     return srv, bound, client
 
 

@@ -930,9 +930,43 @@ class _Handler(BaseHTTPRequestHandler):
                     + b"\n"
                 )
 
-        # everything from the header write on lives inside the
-        # try/finally: a client that dropped before the headers flush
-        # must still unwind the watcher and the stream gauge
+        # a watch client never speaks again after its request, so a
+        # READABLE connection means EOF (orderly close) or garbage —
+        # either way the stream is over. Peeking costs one syscall per
+        # idle poll and turns "gauge leaks until the next heartbeat
+        # tick" into detection within _WATCH_POLL_S. TLS sockets can't
+        # MSG_PEEK through the record layer; they rely on the heartbeat.
+        import select as _select
+        import socket as _socket
+
+        def client_gone() -> bool:
+            sock = self.connection
+            try:
+                import ssl as _ssl
+
+                if isinstance(sock, _ssl.SSLSocket):
+                    return False
+                readable, _, errored = _select.select([sock], [], [sock], 0)
+                if errored:
+                    return True
+                if not readable:
+                    return False
+                return sock.recv(1, _socket.MSG_PEEK) == b""
+            except (OSError, ValueError):
+                return True
+
+        # gauge unwind: exactly once, AT the failure site when a write
+        # fails (the regression in ISSUE 20: waiting for finally meant
+        # an abrupt disconnect mid-frame held the gauge until the next
+        # heartbeat tick on other code paths), in finally otherwise
+        gauge_open = True
+
+        def gauge_close() -> None:
+            nonlocal gauge_open
+            if gauge_open:
+                gauge_open = False
+                self.server.watch_streams_adjust(resource, -1)
+
         try:
             self.send_response(200)
             self.send_header(
@@ -947,6 +981,8 @@ class _Handler(BaseHTTPRequestHandler):
                     if watcher.stopped:
                         break
                     self._release_watch_seat()  # queue drained: init over
+                    if client_gone():
+                        break
                     # idle heartbeat: a stream with no events still emits
                     # a bookmark every bookmark_period_s, so a half-open
                     # TCP client (silently dropped connection) fails the
@@ -977,7 +1013,9 @@ class _Handler(BaseHTTPRequestHandler):
                 write_event(ev)
                 last_rv_sent = max(last_rv_sent, ev.resource_version)
         except (BrokenPipeError, ConnectionResetError, OSError):
-            pass
+            # decrement on the write-failure path itself: the stream is
+            # observably dead the moment a frame write fails
+            gauge_close()
         finally:
             try:
                 # terminate the chunked body: without the trailer a
@@ -989,7 +1027,7 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
             watcher.stop()
-            self.server.watch_streams_adjust(resource, -1)
+            gauge_close()
 
     def _handle_POST(self):
         if self._maybe_proxy():
@@ -1297,8 +1335,25 @@ class APIServerHTTP(ThreadingHTTPServer):
         bookmark_period_s: float = 2.0,
         watch_cache_window: int = 0,
         freshness_timeout_s: float = 5.0,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ):
         super().__init__(addr, _Handler)
+        # TLS on the serving hop: wrap the LISTENING socket with the
+        # handshake DEFERRED — accept() hands back an un-handshaken
+        # SSLSocket and the handshake happens on the handler thread's
+        # first read, so a slow (or hostile) handshaker can never stall
+        # the accept loop (the same never-block-the-dispatcher contract
+        # the relay workers live under)
+        self.tls = bool(tls_cert and tls_key)
+        if self.tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self.socket = ctx.wrap_socket(
+                self.socket, server_side=True, do_handshake_on_connect=False
+            )
         self.store = store
         self.authenticator = authenticator  # None = insecure port semantics
         self.authorizer = authorizer
@@ -1372,10 +1427,13 @@ def serve(
     bookmark_period_s: float = 2.0,
     watch_cache_window: int = 0,
     freshness_timeout_s: float = 5.0,
+    tls_cert: Optional[str] = None,
+    tls_key: Optional[str] = None,
 ) -> Tuple[APIServerHTTP, int, APIServer]:
     """Start the façade on a background thread; returns (server, port, store).
     max_in_flight=0 disables the in-flight limiter. watch_cache=False
-    falls back to per-client store watches (the pre-cacher read path)."""
+    falls back to per-client store watches (the pre-cacher read path).
+    tls_cert+tls_key turn the port into an https listener."""
     store = store or APIServer()
     srv = APIServerHTTP(
         ("0.0.0.0", port),
@@ -1389,6 +1447,8 @@ def serve(
         bookmark_period_s=bookmark_period_s,
         watch_cache_window=watch_cache_window,
         freshness_timeout_s=freshness_timeout_s,
+        tls_cert=tls_cert,
+        tls_key=tls_key,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, srv.server_address[1], store

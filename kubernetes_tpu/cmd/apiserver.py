@@ -37,7 +37,18 @@ def main(argv=None) -> int:
     parser.add_argument("--node-id", type=int, default=1)
     parser.add_argument("--repl-port", type=int, default=0)
     parser.add_argument("--cluster-size", type=int, default=0)
+    # TLS on the serving hop: both given -> the REST port (and the relay
+    # workers, in frontend mode) serve https
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
+    # watch-relay tier (frontend mode only, kubernetes_tpu/relay/):
+    # --relay-workers N spawns N SO_REUSEPORT fan-out workers over a
+    # shared-memory frame ring fed by this frontend's watch cache
+    parser.add_argument("--relay-workers", type=int, default=0)
+    parser.add_argument("--relay-port", type=int, default=0)
     args = parser.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        parser.error("--tls-cert and --tls-key must be given together")
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 4 else logging.INFO
     )
@@ -56,11 +67,24 @@ def main(argv=None) -> int:
         watch_cache=bool(args.watch_cache),
         watch_cache_window=args.watch_cache_window,
         bookmark_period_s=args.bookmark_period,
+        tls_cert=args.tls_cert or None,
+        tls_key=args.tls_key or None,
     )
     if args.frontend_of:
         from ..apiserver.frontend import serve_frontend
 
-        srv, port, _client = serve_frontend(args.frontend_of, **serve_kwargs)
+        srv, port, _client = serve_frontend(
+            args.frontend_of,
+            relay_workers=args.relay_workers,
+            relay_port=args.relay_port,
+            **serve_kwargs,
+        )
+        if getattr(srv, "relay", None) is not None:
+            log.info(
+                "watch relay on :%d (%d workers%s)",
+                srv.relay.port, args.relay_workers,
+                ", tls" if srv.relay.tls else "",
+            )
         log.info(
             "serving /api/v1 on :%d (stateless frontend of %s)",
             port, args.frontend_of,

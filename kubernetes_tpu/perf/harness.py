@@ -850,6 +850,345 @@ def run_serving_benchmark(
 
 
 @dataclass
+class RelayServingBenchResult:
+    """The relay `serving` bench workload (ISSUE 20): a million-watcher
+    TLS fan-out through the shared-memory watch relay. A primary plus
+    n_frontends frontend processes run as real OS processes; each
+    frontend publishes frames once into its ring and relay_workers
+    SO_REUSEPORT worker processes carry the hollow watcher load, with a
+    handful of REAL TLS watch clients sampled through a balancer for
+    honest end-to-end latency percentiles. CPU seconds are per process
+    so the flatness claim (frontend pays per FRAME, not per client) is
+    checkable across watcher scales."""
+
+    n_frontends: int
+    n_relay_workers: int  # total across frontends
+    n_watchers: int  # hollow + real, as registered by the workers
+    n_real_clients: int
+    n_events: int
+    n_binds: int
+    tls: bool
+    duration_s: float
+    bind_p50_ms: float
+    bind_p99_ms: float
+    watch_p50_ms: float  # bind POST -> real TLS client sees the MODIFIED
+    watch_p99_ms: float
+    fanout_deliveries: int  # conservative: events x watchers (no bookmarks)
+    fanout_deliveries_per_s: float
+    deliveries_measured: int  # worker-counter delta (includes bookmarks)
+    evicted_slow: int
+    shed: int
+    frontend_cpu_s: List[float]  # per frontend process, storm window only
+    worker_cpu_s: List[float]  # per relay worker process, storm window
+
+
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process from /proc (Linux), seconds."""
+    import os
+
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+        hz = os.sysconf("SC_CLK_TCK")
+        return (int(fields[11]) + int(fields[12])) / hz
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+def run_relay_serving_benchmark(
+    n_watchers: int = 1_000_000,
+    n_frontends: int = 2,
+    relay_workers: int = 2,
+    n_real_clients: int = 32,
+    n_pods: int = 100,
+    tls: bool = True,
+    timeout_s: float = 600.0,
+) -> RelayServingBenchResult:
+    """Million-client serving through the watch relay, TLS end to end.
+
+    Topology: primary apiserver -> n_frontends stateless frontends (each
+    with --relay-workers fan-out processes over its shared-memory ring)
+    -> hollow watchers in the workers plus n_real_clients genuine TLS
+    watch streams through a LoadBalancerProxy over the relay ports.
+    The bench drives n_pods creates + binds through the frontend REST
+    hop (also TLS), then waits until every worker's dispatch has fanned
+    the last bound rv out to all its clients. Deliveries are counted
+    frames x subscribers — the economics the relay exists for."""
+    import json as _json
+    import math
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from ..api.objects import Binding, Container, Node, NodeSpec, NodeStatus, ObjectMeta, PodSpec
+    from ..apiserver.client import RESTClient
+    from ..runtime.watch import BOOKMARK
+    from ..testing.netchaos import LoadBalancerProxy
+
+    cert = key = ""
+    if tls:
+        from ..testing.tlsutil import ensure_self_signed
+
+        cert, key = ensure_self_signed()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    tmp_paths: List[str] = []
+
+    def spawn(args, tag):
+        err = tempfile.NamedTemporaryFile(
+            "w+", prefix=f"relay-bench-{tag}-", suffix=".log", delete=False
+        )
+        tmp_paths.append(err.name)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.testing.netchaos_procs",
+             *args],
+            cwd=repo, stdout=subprocess.PIPE, stderr=err, text=True, env=env,
+        )
+        err.close()
+        procs.append(p)
+        lines: List[str] = []
+
+        def read():
+            for line in p.stdout:
+                lines.append(line.strip())
+
+        threading.Thread(target=read, daemon=True).start()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            ready = [l for l in lines if l.startswith("READY")]
+            if ready:
+                return p, ready[0].split()
+            if p.poll() is not None:
+                raise RuntimeError(f"{tag} exited rc={p.returncode}")
+            time.sleep(0.05)
+        raise TimeoutError(f"{tag} never became ready")
+
+    # round the hollow split UP so worker-level floor division never
+    # undershoots the requested watcher count
+    target_hollow = max(0, n_watchers - n_real_clients)
+    per_frontend = math.ceil(target_hollow / n_frontends)
+    per_frontend = math.ceil(per_frontend / max(relay_workers, 1)) * max(
+        relay_workers, 1
+    )
+    scheme = "https" if tls else "http"
+    lb = rlb = None
+    client = None
+    real_clients: List = []
+    real_watchers: List = []
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False) as lf:
+            ledger = lf.name
+        tmp_paths.append(ledger)
+        _p, ready = spawn(
+            ["apiserver", "--port", "0", "--ledger", ledger], "primary"
+        )
+        primary_url = f"http://127.0.0.1:{int(ready[2])}"
+        fe_pids: List[int] = []
+        fe_ports: List[int] = []
+        stats_ports: List[int] = []
+        relay_ports: List[int] = []
+        for i in range(n_frontends):
+            fargs = [
+                "frontend", "--primary", primary_url,
+                "--relay-workers", str(relay_workers),
+                "--relay-hollow", str(per_frontend),
+            ]
+            if tls:
+                fargs += ["--tls-cert", cert, "--tls-key", key]
+            p, r = spawn(fargs, f"frontend-{i}")
+            fe_pids.append(p.pid)
+            fe_ports.append(int(r[2]))
+            stats_ports.append(int(r[3]))
+            relay_ports.append(int(r[4]))
+        lb = LoadBalancerProxy([("127.0.0.1", p) for p in fe_ports]).start()
+        rlb = LoadBalancerProxy(
+            [("127.0.0.1", p) for p in relay_ports]
+        ).start()
+        client = RESTClient(f"{scheme}://127.0.0.1:{lb.port}", timeout=30.0)
+        client.create(
+            "nodes",
+            Node(
+                metadata=ObjectMeta(name="bench-n1", namespace=""),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={"cpu": "512", "memory": "2Ti", "pods": 100000}
+                ),
+            ),
+        )
+
+        def stats(port):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ) as r:
+                return _json.loads(r.read())
+
+        # real TLS watch clients through the relay balancer: each one is
+        # a genuine https stream terminated by a relay worker; they time
+        # bind POST -> observed MODIFIED for end-to-end percentiles
+        bind_t0: dict = {}
+        wlat: List[float] = []
+        wlock = threading.Lock()
+
+        def drain(w, remaining):
+            while remaining[0] > 0:
+                ev = w.get(timeout=5.0)
+                if ev is None:
+                    if w.stopped:
+                        return
+                    continue
+                if ev.type == BOOKMARK:
+                    continue
+                name = ev.object.metadata.name
+                if getattr(ev.object.spec, "node_name", "") and name in bind_t0:
+                    with wlock:
+                        wlat.append(time.monotonic() - bind_t0[name])
+                    remaining[0] -= 1
+
+        for _ in range(n_real_clients):
+            c = RESTClient(f"{scheme}://127.0.0.1:{rlb.port}", timeout=30.0)
+            real_clients.append(c)
+            real_watchers.append(c.watch("pods", 0))
+        remainders = [[n_pods] for _ in real_watchers]
+        for w, rem in zip(real_watchers, remainders):
+            threading.Thread(target=drain, args=(w, rem), daemon=True).start()
+
+        # pre-storm baselines: idle bookmark heartbeats already tick the
+        # hollow counters, and frontends burned CPU warming up
+        base = [stats(p) for p in stats_ports]
+        base_delivered = sum(s["delivered"] for s in base)
+        base_evicted = sum(s["evicted_slow"] for s in base)
+        base_shed = sum(s["shed"] for s in base)
+        base_fe_cpu = [_proc_cpu_s(pid) for pid in fe_pids]
+        base_w_cpu = {
+            w["pid"]: w["cpu_s"] for s in base for w in s["per_worker"]
+        }
+        actual_hollow = sum(s["hollow"] for s in base)
+
+        t0 = time.monotonic()
+        bind_lat: List[float] = []
+        for i in range(n_pods):
+            client.create(
+                "pods",
+                Pod(
+                    metadata=ObjectMeta(name=f"rsv-{i}", namespace="default"),
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": "1m"})]
+                    ),
+                ),
+            )
+        for i in range(n_pods):
+            b = Binding(
+                pod_name=f"rsv-{i}", pod_namespace="default",
+                target_node="bench-n1",
+            )
+            bind_t0[f"rsv-{i}"] = time.monotonic()
+            errs = client.bind_pods([b])
+            if errs[0] is None:
+                bind_lat.append(time.monotonic() - bind_t0[f"rsv-{i}"])
+        n_events = 2 * n_pods
+        final_rv = client.get(
+            "pods", "default", f"rsv-{n_pods - 1}"
+        ).metadata.resource_version
+
+        # storm over when every worker's dispatch has fanned the final
+        # bound rv out (hollow counters update in the same dispatch pass)
+        deadline = time.monotonic() + timeout_s
+        snaps = base
+        while time.monotonic() < deadline:
+            snaps = [stats(p) for p in stats_ports]
+            if all(
+                w["kinds"].get("pods", {}).get("last_rv", 0) >= final_rv
+                for s in snaps
+                for w in s["per_worker"]
+            ):
+                break
+            time.sleep(0.2)
+        duration = time.monotonic() - t0
+        fe_cpu = [
+            _proc_cpu_s(pid) - b0 for pid, b0 in zip(fe_pids, base_fe_cpu)
+        ]
+        w_cpu = [
+            w["cpu_s"] - base_w_cpu.get(w["pid"], 0.0)
+            for s in snaps
+            for w in s["per_worker"]
+        ]
+        # honest percentile drain: give the sampled real streams a
+        # moment to observe the tail of the storm
+        drain_deadline = time.monotonic() + 30.0
+        while time.monotonic() < drain_deadline:
+            if all(rem[0] <= 0 for rem in remainders):
+                break
+            time.sleep(0.1)
+        n_watchers_actual = actual_hollow + n_real_clients
+        deliveries = n_events * n_watchers_actual
+        measured = sum(s["delivered"] for s in snaps) - base_delivered
+        blat = sorted(bind_lat)
+        wl = sorted(wlat)
+        return RelayServingBenchResult(
+            n_frontends=n_frontends,
+            n_relay_workers=n_frontends * relay_workers,
+            n_watchers=n_watchers_actual,
+            n_real_clients=n_real_clients,
+            n_events=n_events,
+            n_binds=len(bind_lat),
+            tls=tls,
+            duration_s=duration,
+            bind_p50_ms=(blat[len(blat) // 2] * 1e3) if blat else 0.0,
+            bind_p99_ms=(
+                blat[min(int(0.99 * len(blat)), len(blat) - 1)] * 1e3
+                if blat
+                else 0.0
+            ),
+            watch_p50_ms=(wl[len(wl) // 2] * 1e3) if wl else 0.0,
+            watch_p99_ms=(
+                wl[min(int(0.99 * len(wl)), len(wl) - 1)] * 1e3
+                if wl
+                else 0.0
+            ),
+            fanout_deliveries=deliveries,
+            fanout_deliveries_per_s=(
+                deliveries / duration if duration else 0.0
+            ),
+            deliveries_measured=int(measured),
+            evicted_slow=int(
+                sum(s["evicted_slow"] for s in snaps) - base_evicted
+            ),
+            shed=int(sum(s["shed"] for s in snaps) - base_shed),
+            frontend_cpu_s=[round(c, 3) for c in fe_cpu],
+            worker_cpu_s=[round(c, 3) for c in w_cpu],
+        )
+    finally:
+        for w in real_watchers:
+            w.stop()
+        for c in real_clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if client is not None:
+            client.close()
+        if lb is not None:
+            lb.stop()
+        if rlb is not None:
+            rlb.stop()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for path in tmp_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+@dataclass
 class PreemptionBenchResult:
     """The `preemption` bench workload: a high-priority burst over a FULL
     cluster — every placement requires displacing lower-priority victims.

@@ -129,6 +129,15 @@ class CacheDebugger:
                 "read) state:"
             )
             lines.extend(serving)
+        from ...relay import relay_health_lines
+
+        relay = relay_health_lines()
+        if relay:
+            lines.append(
+                "Dump of serving-relay (shared-memory frame ring / "
+                "fan-out worker) state:"
+            )
+            lines.extend(relay)
         from ..preemption import preemption_health_lines
 
         preempt = preemption_health_lines()

@@ -217,13 +217,57 @@ def _serve_stats(stats_fn) -> int:
     return dbg.server_address[1]
 
 
+def _relay_fleet_stats(relay, kind: str):
+    """Stats closure aggregating the relay worker fleet for /bench-stats:
+    client/delivery totals summed across workers, per-process CPU seconds
+    kept per worker so benches can prove CPU stays flat vs watcher count."""
+
+    def stats():
+        from ..utils.metrics import metrics
+
+        per_worker = relay.worker_stats()
+        frames = metrics.counter(
+            "relay_frames_published_total", {"kind": kind}
+        )
+        return {
+            "relay_port": relay.port,
+            "workers": len(per_worker),
+            "frames_published": frames,
+            "clients": sum(w.get("clients", 0) for w in per_worker),
+            "hollow": sum(w.get("hollow", 0) for w in per_worker),
+            "delivered": sum(w.get("delivered", 0) for w in per_worker),
+            "evicted_slow": sum(w.get("evicted_slow", 0) for w in per_worker),
+            "shed": sum(w.get("shed", 0) for w in per_worker),
+            "worker_cpu_s": [w.get("cpu_s", 0.0) for w in per_worker],
+            "per_worker": per_worker,
+        }
+
+    return stats
+
+
 def run_frontend(
-    primary: str, port: int, hollow_watchers: int, watch_kind: str
+    primary: str,
+    port: int,
+    hollow_watchers: int,
+    watch_kind: str,
+    relay_workers: int = 0,
+    relay_port: int = 0,
+    relay_hollow: int = 0,
+    tls_cert: str = "",
+    tls_key: str = "",
 ) -> None:
     from ..apiserver.frontend import serve_frontend
 
     srv, bound, _client = serve_frontend(
-        primary, port=port, bookmark_period_s=0.5
+        primary,
+        port=port,
+        bookmark_period_s=0.5,
+        relay_workers=relay_workers,
+        relay_port=relay_port,
+        relay_kinds=(watch_kind,),
+        relay_hollow_clients=relay_hollow,
+        tls_cert=tls_cert or None,
+        tls_key=tls_key or None,
     )
     stats_port = 0
     if hollow_watchers:
@@ -231,7 +275,11 @@ def run_frontend(
             srv.cacher, watch_kind, hollow_watchers
         )
         stats_port = _serve_stats(stats_fn)
-    print(f"READY frontend {bound} {stats_port}", flush=True)
+    elif srv.relay is not None:
+        stats_port = _serve_stats(_relay_fleet_stats(srv.relay, watch_kind))
+    rport = srv.relay.port if srv.relay is not None else 0
+    # trailing tokens are ignored by pre-relay READY parsers
+    print(f"READY frontend {bound} {stats_port} {rport}", flush=True)
     threading.Event().wait()
 
 
@@ -471,6 +519,11 @@ def main(argv=None) -> int:
     fr.add_argument("--port", type=int, default=0)
     fr.add_argument("--hollow-watchers", type=int, default=0)
     fr.add_argument("--watch-kind", default="pods")
+    fr.add_argument("--relay-workers", type=int, default=0)
+    fr.add_argument("--relay-port", type=int, default=0)
+    fr.add_argument("--relay-hollow", type=int, default=0)
+    fr.add_argument("--tls-cert", default="")
+    fr.add_argument("--tls-key", default="")
     fo = sub.add_parser("follower")
     fo.add_argument("--primary", required=True)
     fo.add_argument("--repl-host", default="127.0.0.1")
@@ -495,7 +548,15 @@ def main(argv=None) -> int:
         )
     elif args.role == "frontend":
         run_frontend(
-            args.primary, args.port, args.hollow_watchers, args.watch_kind
+            args.primary,
+            args.port,
+            args.hollow_watchers,
+            args.watch_kind,
+            relay_workers=args.relay_workers,
+            relay_port=args.relay_port,
+            relay_hollow=args.relay_hollow,
+            tls_cert=args.tls_cert,
+            tls_key=args.tls_key,
         )
     elif args.role == "follower":
         run_follower(

@@ -38,6 +38,7 @@ CHAOS_SUITE_FILES = [
     "tests/test_chaos_tuner.py",
     "tests/test_chaos_disk.py",
     "tests/test_chaos_defrag.py",
+    "tests/test_chaos_relay.py",
 ]
 
 # -- pass 1: donation safety -------------------------------------------------
@@ -97,6 +98,15 @@ DISPATCH_ROOTS = (
     # thread, stop on arbitrary callers including dispatch threads
     "Watcher.push",
     "Watcher.stop",
+    # watch relay (kubernetes_tpu/relay/): the publisher pump feeds the
+    # shared-memory ring from the cache fan-out, and each worker's
+    # dispatch loop fans ring frames out to every connected client —
+    # one blocking call in either stalls the whole kind (publisher) or
+    # every client of the worker (dispatch). Intake/state-sync threads
+    # are per-connection and MAY block; they are not reachable from
+    # these roots.
+    "RelayPublisher._pump",
+    "RelayWorker._dispatch",
 )
 
 # extra reachability edges the same-module call graph can't see
@@ -162,6 +172,11 @@ DUMP_REQUIRED_FAMILIES = (
     # nodelifecycle, autoscaler scale-down, and preemption
     "descheduler_",
     "eviction_budget_",
+    # the watch-relay tier: ring head/floor, publish/eviction/resync
+    # counters, and worker fleet state must be SIGUSR2-visible — relay
+    # workers are separate processes, so these publisher-side series are
+    # the frontend's only in-process view of the tier
+    "relay_",
 )
 
 # -- pass 4: degraded-write handling -----------------------------------------

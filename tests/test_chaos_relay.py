@@ -1,0 +1,336 @@
+"""Relay-tier chaos (ISSUE 20, `make chaos-relay`): the million-client
+serving path under worker death, ring overflow, and a frozen primary.
+
+The storm shapes:
+  * a relay worker SIGKILLed MID-STORM: every connected client resumes
+    at its last rv through the SO_REUSEPORT siblings / the respawned
+    worker, and the per-client ledger shows ZERO lost and ZERO
+    duplicated event deliveries;
+  * ring overflow with a deliberately slow client: the publisher never
+    blocks (it laps), dispatch never blocks (bounded send buffers), the
+    slow client is EVICTED, and a healthy client riding the same worker
+    sees the entire storm;
+  * primary SIGSTOPped: the relay keeps serving its buffered frame
+    window to resuming clients and keeps idle streams alive with
+    bookmark heartbeats — worker liveness never depends on upstream
+    liveness.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from test_chaos_net import _Proc
+
+from kubernetes_tpu.api.objects import Container, ObjectMeta, Pod, PodSpec
+from kubernetes_tpu.apiserver.client import RESTClient
+from kubernetes_tpu.apiserver.frontend import serve_frontend
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.relay import start_relay
+from kubernetes_tpu.runtime.watch import ADDED, BOOKMARK
+
+pytestmark = pytest.mark.slow
+
+
+def make_pod(name, ns="default", note=None):
+    meta = ObjectMeta(name=name, namespace=ns)
+    if note is not None:
+        meta.annotations = {"chaos/padding": note}
+    return Pod(
+        metadata=meta,
+        spec=PodSpec(containers=[Container(requests={"cpu": "1m"})]),
+    )
+
+
+def wait_until(cond, timeout=30.0, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+class _Ledger:
+    """Per-client delivery ledger: name -> times seen. Zero-loss means
+    every name present; zero-dup means every count is exactly 1."""
+
+    def __init__(self, watcher):
+        self.w = watcher
+        self.counts = Counter()
+        self.bookmarks = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        while not self.w.stopped:
+            ev = self.w.get(timeout=0.2)
+            if ev is None:
+                continue
+            with self._lock:
+                if ev.type == BOOKMARK:
+                    self.bookmarks += 1
+                elif ev.type == ADDED:
+                    self.counts[ev.object.metadata.name] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return Counter(self.counts), self.bookmarks
+
+
+def test_worker_sigkill_mid_storm_zero_lost_zero_dup():
+    srv, port, _store = serve(port=0, bookmark_period_s=0.5)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    handle = None
+    ledgers = []
+    clients = []
+    try:
+        client.create("pods", make_pod("pre-seed"))
+        handle = start_relay(
+            srv.cacher,
+            f"http://127.0.0.1:{port}",
+            kinds=("pods",),
+            n_workers=2,
+            ring_capacity=1 << 20,
+            bookmark_period_s=0.3,
+        )
+        base = srv.cacher.cache_for("pods").current_rv
+        url = f"http://127.0.0.1:{handle.port}"
+        for _ in range(6):
+            rc = RESTClient(url, timeout=10.0)
+            clients.append(rc)
+            ledgers.append(_Ledger(rc.watch("pods", from_version=base)))
+
+        n_pods = 60
+        storm_err = []
+
+        def storm():
+            try:
+                for i in range(n_pods):
+                    client.create("pods", make_pod(f"storm-{i}"))
+                    time.sleep(0.01)
+            except Exception as e:  # surfaces in the main thread assert
+                storm_err.append(e)
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        # SIGKILL one of the two workers mid-storm; its accept share and
+        # half the connected clients shed to the sibling instantly, then
+        # the respawn rebuilds the retained window from the ring floor
+        time.sleep(0.4)
+        handle.kill_worker(0, sig=signal.SIGKILL)
+        time.sleep(0.3)
+        handle.respawn_worker(0)
+        t.join(timeout=60)
+        assert not storm_err, storm_err
+
+        want = {f"storm-{i}" for i in range(n_pods)}
+
+        def complete():
+            return all(
+                want <= set(led.snapshot()[0]) for led in ledgers
+            )
+
+        assert wait_until(complete, 60.0), [
+            len(want - set(led.snapshot()[0])) for led in ledgers
+        ]
+        # the ledger verdict: zero lost (above), zero duplicated
+        for led in ledgers:
+            counts, _bm = led.snapshot()
+            dups = {n: c for n, c in counts.items() if n in want and c != 1}
+            assert not dups, dups
+        # nobody's stream silently died
+        assert all(not led.w.stopped for led in ledgers)
+    finally:
+        for led in ledgers:
+            led.w.stop()
+        for rc in clients:
+            rc.close()
+        if handle is not None:
+            handle.stop()
+        client.close()
+        srv.shutdown()
+
+
+def test_ring_overflow_evicts_slow_client_without_blocking_dispatch():
+    srv, port, _store = serve(port=0, bookmark_period_s=0.5)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    handle = None
+    slow = None
+    fast_rc = None
+    fast = None
+    try:
+        client.create("pods", make_pod("ovf-seed"))
+        # tiny ring + tight per-client budget: the storm's fat frames
+        # overflow both, and neither may stall the pipeline
+        # ring sized to hold ~8 fat frames: the full storm overflows it
+        # several times over (floor advances), but slowly enough that a
+        # KEEPING-UP reader never laps — only the deaf client falls out
+        handle = start_relay(
+            srv.cacher,
+            f"http://127.0.0.1:{port}",
+            kinds=("pods",),
+            n_workers=1,
+            ring_capacity=1 << 18,
+            max_pending_bytes=64 << 10,
+            bookmark_period_s=0.3,
+        )
+        base = srv.cacher.cache_for("pods").current_rv
+        base_floor = handle.publisher.rings["pods"].floor_rv()
+        url = f"http://127.0.0.1:{handle.port}"
+
+        # the slow client: a real watch stream that never reads past the
+        # response headers, with a tiny receive window so backpressure
+        # reaches the worker's non-blocking sends quickly
+        slow = socket.socket()
+        slow.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+        slow.connect(("127.0.0.1", handle.port))
+        slow.sendall(
+            f"GET /api/v1/pods?watch=1&resourceVersion={base} HTTP/1.1\r\n"
+            f"Host: 127.0.0.1\r\n\r\n".encode()
+        )
+        assert slow.recv(64)  # stream established, then we go deaf
+
+        fast_rc = RESTClient(url, timeout=10.0)
+        fast = rc_watch = fast_rc.watch("pods", from_version=base)
+        seen = set()
+
+        def drain(target_n):
+            ev = rc_watch.get(timeout=0.2)
+            while ev is not None:
+                if ev.type == ADDED:
+                    seen.add(ev.object.metadata.name)
+                ev = rc_watch.get(timeout=0)
+            return len(seen) >= target_n
+
+        n_pods = 48
+        pad = "x" * (32 << 10)  # ~32 KiB frames
+        t_send = []
+        for i in range(n_pods):
+            s0 = time.monotonic()
+            client.create("pods", make_pod(f"fat-{i}", note=pad))
+            t_send.append(time.monotonic() - s0)
+            time.sleep(0.02)  # paced: ring turnover stays above the
+            # dispatch poll period, so only the DEAF client falls behind
+
+        # dispatch stayed live: the healthy client sees the whole storm
+        assert wait_until(lambda: drain(n_pods), 45.0), len(seen)
+        # the publisher lapped the tiny ring (floor advanced) instead of
+        # blocking the frontend — and creates never degraded to seconds
+        assert handle.publisher.rings["pods"].floor_rv() > max(
+            base_floor, base
+        )
+        assert max(t_send) < 5.0, max(t_send)
+
+        # the deaf stream got evicted, not waited on
+        def evicted():
+            stats = handle.worker_stats()
+            return stats and sum(s["evicted_slow"] for s in stats) >= 1
+
+        assert wait_until(evicted, 30.0), handle.worker_stats()
+    finally:
+        if fast is not None:
+            fast.stop()
+        if fast_rc is not None:
+            fast_rc.close()
+        if slow is not None:
+            slow.close()
+        if handle is not None:
+            handle.stop()
+        client.close()
+        srv.shutdown()
+
+
+def test_primary_sigstop_relay_serves_buffered_frames_and_bookmarks(
+    tmp_path,
+):
+    primary = _Proc(
+        [
+            "apiserver",
+            "--port", "0",
+            "--ledger", str(tmp_path / "relay_chaos_ledger.jsonl"),
+        ],
+        "primary",
+    )
+    srv = None
+    handle = None
+    client = None
+    rc_a = rc_b = None
+    w_a = w_b = None
+    stopped = False
+    try:
+        primary_port = int(primary.wait_ready().split()[2])
+        primary_url = f"http://127.0.0.1:{primary_port}"
+        srv, fe_port, client = serve_frontend(
+            primary_url, port=0, bookmark_period_s=0.5
+        )
+        handle = start_relay(
+            srv.cacher,
+            f"http://127.0.0.1:{fe_port}",
+            kinds=("pods",),
+            n_workers=1,
+            ring_capacity=1 << 20,
+            bookmark_period_s=0.3,
+        )
+        base = srv.cacher.cache_for("pods").current_rv
+        for i in range(10):
+            client.create("pods", make_pod(f"buf-{i}"))
+        url = f"http://127.0.0.1:{handle.port}"
+
+        # client A is live BEFORE the freeze and has seen the storm
+        rc_a = RESTClient(url, timeout=10.0)
+        w_a = rc_a.watch("pods", from_version=base)
+        led_a = _Ledger(w_a)
+        assert wait_until(
+            lambda: len(led_a.snapshot()[0]) >= 10, 30.0
+        ), led_a.snapshot()
+
+        # freeze the PRIMARY: upstream is now a black hole (connections
+        # hang, nothing times out quickly — the worst kind of dead)
+        os.kill(primary.proc.pid, signal.SIGSTOP)
+        stopped = True
+        time.sleep(0.5)
+
+        # a NEW client resuming inside the window gets the buffered
+        # frames from the worker's retained history — no upstream touch
+        rc_b = RESTClient(url, timeout=10.0)
+        w_b = rc_b.watch("pods", from_version=base)
+        led_b = _Ledger(w_b)
+        assert wait_until(
+            lambda: len(led_b.snapshot()[0]) >= 10, 30.0
+        ), led_b.snapshot()
+        counts, _ = led_b.snapshot()
+        assert all(c == 1 for c in counts.values()), counts
+
+        # idle streams stay alive on worker-clocked bookmark heartbeats
+        # while the primary is frozen
+        _, bm0 = led_a.snapshot()
+        assert wait_until(
+            lambda: led_a.snapshot()[1] >= bm0 + 2, 15.0
+        ), "bookmarks stalled with primary frozen"
+        assert not w_a.stopped and not w_b.stopped
+    finally:
+        if stopped:
+            try:
+                os.kill(primary.proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+        for w in (w_a, w_b):
+            if w is not None:
+                w.stop()
+        for rc in (rc_a, rc_b):
+            if rc is not None:
+                rc.close()
+        if handle is not None:
+            handle.stop()
+        if client is not None:
+            client.close()
+        if srv is not None:
+            srv.shutdown()
+        primary.kill()

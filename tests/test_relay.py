@@ -1,0 +1,589 @@
+"""Watch-relay tier units + serving-hop satellites (ISSUE 20).
+
+Covered here (tier-1 fast; the storm/chaos shapes live in
+tests/test_chaos_relay.py):
+  * the shared-memory frame ring: publish/read ordering, pad-record
+    wraparound, the floor/410 eviction contract (event evictions advance
+    the floor, bookmark/pad/resync evictions don't), slow-reader lapping
+    with floor resync, oversized-frame rejection;
+  * the publisher: floor syncs to the cache rv at subscribe, live
+    events land in the ring exactly once as watchcodec frames;
+  * watchcodec resume edges: two kinds interleaving at the same rv keep
+    distinct memoized frames; resume exactly AT the ring floor replays,
+    one-before 410s; a bookmark-only idle stream survives a relay
+    worker restart by resuming at its last bookmark rv;
+  * HTTP/1.1 pipelining in the pooled RESTClient: in-order drain on one
+    connection, typed error taxonomy through the pipeline, and the
+    mid-pipeline transport-error contract (only the FIRST in-flight
+    request may retry; the tail requeues unattempted);
+  * the watch-stream gauge decrements at the write-failure site on
+    abrupt disconnect (not "eventually, at the next heartbeat");
+  * TLS on the serving hop: https REST + watch + pipelined gets.
+"""
+
+import io
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.client import (
+    COUNTER_PIPELINE_REQUEUES,
+    COUNTER_PIPELINED,
+    RESTClient,
+)
+from kubernetes_tpu.apiserver.rest import serve
+from kubernetes_tpu.apiserver import watchcodec
+from kubernetes_tpu.client.apiserver import Expired, NotFound
+from kubernetes_tpu.relay import RelayPublisher, start_relay
+from kubernetes_tpu.relay.ring import (
+    BOOKMARK_TYPE,
+    FrameRing,
+    RESYNC_TYPE,
+    RingReader,
+)
+from kubernetes_tpu.runtime.watch import ADDED, BOOKMARK, Event
+from kubernetes_tpu.testing import tlsutil
+from kubernetes_tpu.utils.metrics import metrics
+
+
+def make_pod(name, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={"cpu": "1m"})]),
+    )
+
+
+def make_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={"cpu": "64", "pods": 500}),
+    )
+
+
+def wait_until(cond, timeout=10.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+def frame(rv, payload=b"", ftype=b"A"):
+    return ftype + payload
+
+
+# -- frame ring ---------------------------------------------------------------
+
+
+def test_ring_publish_read_roundtrip():
+    ring = FrameRing.create(capacity=1 << 14)
+    try:
+        for rv in range(1, 6):
+            ring.publish(rv, b"A" + bytes([rv]) * 10)
+        reader = RingReader(ring)
+        frames, lapped = reader.read_new()
+        assert not lapped
+        assert [(rv, t) for _s, rv, t, _f in frames] == [
+            (rv, b"A") for rv in range(1, 6)
+        ]
+        assert frames[2][3] == b"A" + bytes([3]) * 10
+        # incremental: nothing new until the next publish
+        assert reader.read_new() == ([], False)
+        ring.publish(6, b"M" + b"x")
+        frames, _ = reader.read_new()
+        assert [(rv, t) for _s, rv, t, _f in frames] == [(6, b"M")]
+    finally:
+        ring.close()
+
+
+def test_ring_pad_wraparound_is_invisible():
+    ring = FrameRing.create(capacity=512)
+    try:
+        reader = RingReader(ring)
+        seen = []
+        # frame size chosen to leave awkward tails so PAD records and
+        # boundary skips both happen while the reader keeps up
+        for rv in range(1, 40):
+            ring.publish(rv, b"A" + b"p" * 57)
+            frames, lapped = reader.read_new()
+            assert not lapped
+            seen.extend(f[1] for f in frames)
+        assert seen == list(range(1, 40))
+    finally:
+        ring.close()
+
+
+def test_ring_floor_advances_on_event_eviction_only():
+    ring = FrameRing.create(capacity=512)
+    try:
+        # bookmark/resync evictions must not advance the 410 boundary:
+        # fill with control records only — floor_rv stays put
+        for rv in range(1, 30):
+            ring.publish(rv, BOOKMARK_TYPE + b"b" * 40)
+        ring.publish(30, RESYNC_TYPE + b"")
+        assert ring.floor_rv() == 0
+        # event evictions advance it to evicted rv + 1 (KindCache's rule)
+        for rv in range(31, 60):
+            ring.publish(rv, b"A" + b"e" * 40)
+        floor_seq, _cum, floor_rv = ring.floor()
+        assert floor_rv > 0
+        # a fresh reader enters AT the floor and the oldest retained
+        # event has rv >= floor_rv (everything below is truly gone)
+        frames, lapped = RingReader(ring).read_new()
+        assert not lapped
+        event_rvs = [rv for _s, rv, t, _f in frames if t == b"A"]
+        assert event_rvs and min(event_rvs) >= floor_rv
+        assert event_rvs[-1] == 59
+    finally:
+        ring.close()
+
+
+def test_ring_slow_reader_laps_and_resyncs_to_floor():
+    ring = FrameRing.create(capacity=512)
+    try:
+        reader = RingReader(ring)
+        for rv in range(1, 100):
+            ring.publish(rv, b"A" + b"z" * 50)
+        frames, lapped = reader.read_new()
+        assert lapped  # fell a full ring behind: caller must shed clients
+        assert reader.lapped_total >= 1
+        rvs = [rv for _s, rv, _t, _f in frames]
+        assert rvs == sorted(rvs) and rvs[-1] == 99
+        # once resynced the cursor tracks the head again
+        ring.publish(100, b"A" + b"z" * 50)
+        frames, lapped = reader.read_new()
+        assert not lapped and [f[1] for f in frames] == [100]
+    finally:
+        ring.close()
+
+
+def test_ring_rejects_oversized_frame():
+    ring = FrameRing.create(capacity=1 << 10)
+    try:
+        with pytest.raises(ValueError):
+            ring.publish(1, b"A" + b"x" * 2048)
+    finally:
+        ring.close()
+
+
+# -- publisher ----------------------------------------------------------------
+
+
+def test_publisher_floor_syncs_to_cache_rv_then_streams_live():
+    srv, port, _store = serve(port=0, bookmark_period_s=30.0)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=5.0)
+    pub = None
+    try:
+        for i in range(3):
+            client.create("pods", make_pod(f"pub-{i}"))
+        base = srv.cacher.cache_for("pods").current_rv
+        pub = RelayPublisher(srv.cacher, ["pods"], ring_capacity=1 << 16)
+        ring = pub.rings["pods"]
+        # the rv=0 replay is skipped; the ring base is the cache rv
+        assert wait_until(lambda: ring.floor_rv() >= base, 5.0)
+        reader = RingReader(ring)
+        client.create("pods", make_pod("pub-live"))
+        got = []
+
+        def drain():
+            got.extend(reader.read_new()[0])
+            return any(t == b"A" for _s, _rv, t, _f in got)
+
+        assert wait_until(drain, 5.0), got
+        # opening frame is the base bookmark, then the live event, whose
+        # frame is the watchcodec wire (decodes back to the pod)
+        assert got[0][2] == BOOKMARK_TYPE and got[0][1] == base
+        ev = [f for f in got if f[2] == b"A"][0]
+        typ, rv, obj = watchcodec.read_frame(io.BytesIO(ev[3]))
+        assert typ == ADDED and rv == ev[1] and obj.metadata.name == "pub-live"
+    finally:
+        if pub is not None:
+            pub.stop()
+        client.close()
+        srv.shutdown()
+
+
+# -- watchcodec resume edges (satellite 3) ------------------------------------
+
+
+def test_event_frame_memoization_two_kinds_interleaved_same_rv():
+    """Two kinds can carry the SAME rv (per-kind rv spaces): the frame
+    memo lives on the Event instance, so interleaving kinds at equal rv
+    must never cross-serve bytes."""
+    pod = make_pod("memo-pod")
+    pod.metadata.resource_version = 7
+    node = make_node("memo-node")
+    node.metadata.resource_version = 7
+    ev_pod = Event(ADDED, pod, 7)
+    ev_node = Event(ADDED, node, 7)
+    # interleave: pod, node, pod again (memo hit), node again (memo hit)
+    f_pod = watchcodec.event_frame(ev_pod)
+    f_node = watchcodec.event_frame(ev_node)
+    assert f_pod != f_node
+    assert watchcodec.event_frame(ev_pod) is f_pod
+    assert watchcodec.event_frame(ev_node) is f_node
+    _t, rv, obj = watchcodec.read_frame(io.BytesIO(f_pod))
+    assert (rv, obj.metadata.name) == (7, "memo-pod")
+    _t, rv, obj = watchcodec.read_frame(io.BytesIO(f_node))
+    assert (rv, obj.metadata.name) == (7, "memo-node")
+
+
+@pytest.fixture(scope="module")
+def relay_stack():
+    """In-process frontend REST server + a 1-worker relay over its
+    cacher. Module-scoped: worker spawn costs a process start."""
+    srv, port, _store = serve(port=0, bookmark_period_s=0.5)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=5.0)
+    # non-empty cache BEFORE the publisher subscribes: the ring floor
+    # must land at the cache rv, giving the 410 boundary a real value
+    for i in range(3):
+        client.create("pods", make_pod(f"seed-{i}"))
+    handle = start_relay(
+        srv.cacher,
+        f"http://127.0.0.1:{port}",
+        kinds=("pods",),
+        n_workers=1,
+        ring_capacity=1 << 18,
+        bookmark_period_s=0.3,
+    )
+    url = f"http://127.0.0.1:{handle.port}"
+    yield srv, client, handle, url
+    handle.stop()
+    client.close()
+    srv.shutdown()
+
+
+def test_relay_resume_exactly_at_floor_replays_one_before_expires(
+    relay_stack,
+):
+    srv, client, handle, url = relay_stack
+    floor = handle.publisher.rings["pods"].floor_rv()
+    assert floor > 1
+    client.create("pods", make_pod("floor-a"))
+    client.create("pods", make_pod("floor-b"))
+    rc = RESTClient(url, timeout=5.0)
+    try:
+        # exactly AT the floor: a live stream replaying everything > floor
+        w = rc.watch("pods", from_version=floor)
+        names = set()
+
+        def both():
+            ev = w.get(timeout=0.2)
+            while ev is not None:
+                if ev.type == ADDED:
+                    names.add(ev.object.metadata.name)
+                ev = w.get(timeout=0)
+            return {"floor-a", "floor-b"} <= names
+
+        assert wait_until(both, 10.0), names
+        w.stop()
+        # one before the floor: Expired — the relist contract
+        with pytest.raises(Expired):
+            rc.watch("pods", from_version=floor - 1)
+    finally:
+        rc.close()
+
+
+def test_relay_initial_sync_then_live_tail(relay_stack):
+    srv, client, handle, url = relay_stack
+    rc = RESTClient(url, timeout=5.0)
+    try:
+        w = rc.watch("pods", from_version=0)
+        seen = set()
+
+        def drain_into(target):
+            ev = w.get(timeout=0.2)
+            while ev is not None:
+                if ev.type == ADDED:
+                    seen.add(ev.object.metadata.name)
+                ev = w.get(timeout=0)
+            return target <= seen
+
+        # rv=0: full state through the worker's upstream sync path
+        assert wait_until(
+            lambda: drain_into({"seed-0", "seed-1", "seed-2"}), 10.0
+        ), seen
+        # then the live tail through the shared-memory ring
+        client.create("pods", make_pod("live-tail"))
+        assert wait_until(lambda: drain_into({"live-tail"}), 10.0), seen
+        w.stop()
+    finally:
+        rc.close()
+
+
+def test_relay_bookmark_only_idle_stream_survives_worker_restart(
+    relay_stack,
+):
+    """Satellite 3, third edge: a stream that has only ever seen
+    bookmarks resumes across a relay worker death at its last bookmark
+    rv — no events existed to lose, and the stream must neither 410 nor
+    silently die."""
+    srv, client, handle, url = relay_stack
+    rc = RESTClient(url, timeout=5.0)
+    try:
+        rv0 = srv.cacher.cache_for("pods").current_rv
+        w = rc.watch("pods", from_version=rv0)
+        marks = []
+
+        def got_bookmark(n):
+            ev = w.get(timeout=0.2)
+            while ev is not None:
+                if ev.type == BOOKMARK:
+                    marks.append(ev.resource_version)
+                ev = w.get(timeout=0)
+            return len(marks) >= n
+
+        assert wait_until(lambda: got_bookmark(1), 10.0)
+        assert not w.stopped
+        pre = max(marks)
+        # SIGKILL the only worker mid-idle; respawn; the client's pump
+        # reconnects at its last rv (a bookmark rv) transparently
+        handle.kill_worker(0, sig=9)
+        handle.respawn_worker(0)
+        n_before = len(marks)
+        assert wait_until(lambda: got_bookmark(n_before + 1), 15.0)
+        assert not w.stopped
+        assert max(marks) >= pre  # never regresses the resume position
+        w.stop()
+    finally:
+        rc.close()
+
+
+# -- HTTP/1.1 pipelining (satellite 1) ----------------------------------------
+
+
+@pytest.fixture
+def rest():
+    srv, port, store = serve(port=0, bookmark_period_s=0.5)
+    client = RESTClient(f"http://127.0.0.1:{port}", timeout=5.0)
+    yield client, store, port
+    client.close()
+    srv.shutdown()
+
+
+def test_pipelined_gets_drain_in_order_on_one_connection(rest):
+    client, _store, _port = rest
+    for i in range(9):
+        client.create("pods", make_pod(f"pl-{i}"))
+    p0 = metrics.counter(COUNTER_PIPELINED)
+    objs = client.get_many(
+        "pods", "default", [f"pl-{i}" for i in range(9)], depth=4
+    )
+    assert [o.metadata.name for o in objs] == [f"pl-{i}" for i in range(9)]
+    assert metrics.counter(COUNTER_PIPELINED) - p0 == 9
+    # the pipelined connection goes back to the pool and plain requests
+    # keep using it
+    assert client.pool.size() >= 1
+    assert client.get("pods", "default", "pl-0").metadata.name == "pl-0"
+
+
+def test_pipelined_error_taxonomy(rest):
+    client, _store, _port = rest
+    client.create("pods", make_pod("pl-there"))
+    with pytest.raises(NotFound):
+        client.get_many("pods", "default", ["pl-there", "pl-missing"])
+
+
+class _ScriptedPipelineServer:
+    """Raw HTTP/1.1 server that answers `per_conn` GETs per connection
+    then closes ABRUPTLY (no Connection: close) — the transport-error
+    edge a real server's crash mid-pipeline produces."""
+
+    def __init__(self, per_conn):
+        self.per_conn = per_conn
+        self.request_paths = []
+        self._lock = threading.Lock()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn, args=(c,), daemon=True).start()
+
+    def _conn(self, c):
+        c.settimeout(5.0)
+        buf = b""
+        served = 0
+        try:
+            while served < self.per_conn:
+                while b"\r\n\r\n" not in buf:
+                    d = c.recv(65536)
+                    if not d:
+                        return
+                    buf += d
+                req, buf = buf.split(b"\r\n\r\n", 1)
+                with self._lock:
+                    self.request_paths.append(
+                        req.split(b"\r\n")[0].decode().split()[1]
+                    )
+                c.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+                served += 1
+        except OSError:
+            pass
+        finally:
+            c.close()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_pipeline_midstream_death_retries_first_in_flight_only():
+    """Reused connection dies mid-window: the first unanswered request
+    gets the one-shot reused-connection retry; everything behind it
+    requeues UNATTEMPTED (no retry budget burned), and all results
+    still come back correct."""
+    server = _ScriptedPipelineServer(per_conn=2)
+    client = RESTClient(f"http://127.0.0.1:{server.port}", timeout=5.0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # warm the pool: 1 of the connection's 2 responses spent
+        assert client.pipelined_get_raw([f"{base}/warm"]) == [b"ok"]
+        f0 = metrics.counter(
+            COUNTER_PIPELINE_REQUEUES, {"reason": "first_in_flight"}
+        )
+        u0 = metrics.counter(
+            COUNTER_PIPELINE_REQUEUES, {"reason": "unattempted"}
+        )
+        out = client.pipelined_get_raw(
+            [f"{base}/a", f"{base}/b", f"{base}/c"], depth=3
+        )
+        assert out == [b"ok", b"ok", b"ok"]
+        got_f = metrics.counter(
+            COUNTER_PIPELINE_REQUEUES, {"reason": "first_in_flight"}
+        ) - f0
+        got_u = metrics.counter(
+            COUNTER_PIPELINE_REQUEUES, {"reason": "unattempted"}
+        ) - u0
+        assert got_f == 1, (got_f, got_u)
+        assert got_u >= 1
+        # the server answered each path exactly once: the retried
+        # request was provably unanswered on its first transmission
+        answered = server.request_paths
+        assert sorted(answered) == ["/a", "/b", "/c", "/warm"], answered
+    finally:
+        client.close()
+        server.close()
+
+
+def test_pipeline_fresh_connection_death_raises_not_retries():
+    """On a FRESH (non-reused) connection the first in-flight request
+    must NOT silently retry — the failure surfaces to the caller, same
+    as the plain-GET policy."""
+    server = _ScriptedPipelineServer(per_conn=1)
+    client = RESTClient(f"http://127.0.0.1:{server.port}", timeout=5.0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(OSError):
+            client.pipelined_get_raw([f"{base}/x", f"{base}/y"], depth=2)
+        assert server.request_paths == ["/x"]
+    finally:
+        client.close()
+        server.close()
+
+
+# -- watch-stream gauge on abrupt disconnect (satellite 2) --------------------
+
+
+def test_watch_gauge_drops_on_abrupt_disconnect_before_heartbeat():
+    """Regression (ISSUE 20): a client vanishing mid-stream used to
+    leak apiserver_watch_streams until the next heartbeat tick. With a
+    30s bookmark period the gauge must still drop within ~2s — the
+    decrement happens at the failure site / eager EOF probe, not at
+    the next scheduled write."""
+    srv, port, _store = serve(port=0, bookmark_period_s=30.0)
+    try:
+        g0 = metrics.gauge("apiserver_watch_streams", {"resource": "pods"}) or 0
+        raw = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        raw.sendall(
+            b"GET /api/v1/pods?watch=1&resourceVersion=0 HTTP/1.1\r\n"
+            b"Host: 127.0.0.1\r\n\r\n"
+        )
+        # stream established (response headers arrive)
+        assert raw.recv(1)
+        assert wait_until(
+            lambda: (
+                metrics.gauge(
+                    "apiserver_watch_streams", {"resource": "pods"}
+                )
+                or 0
+            )
+            > g0,
+            5.0,
+        )
+        raw.close()  # abrupt: no events in flight, heartbeat 30s away
+        assert wait_until(
+            lambda: (
+                metrics.gauge(
+                    "apiserver_watch_streams", {"resource": "pods"}
+                )
+                or 0
+            )
+            <= g0,
+            3.0,
+        ), "gauge leaked until heartbeat"
+    finally:
+        srv.shutdown()
+
+
+# -- TLS on the serving hop ---------------------------------------------------
+
+
+@pytest.mark.skipif(
+    not tlsutil.openssl_available(), reason="openssl binary not available"
+)
+def test_tls_rest_watch_and_pipeline_roundtrip():
+    cert, key = tlsutil.ensure_self_signed()
+    srv, port, _store = serve(
+        port=0, bookmark_period_s=0.5, tls_cert=cert, tls_key=key
+    )
+    client = RESTClient(f"https://127.0.0.1:{port}", timeout=5.0)
+    try:
+        for i in range(4):
+            client.create("pods", make_pod(f"tls-{i}"))
+        objs = client.get_many(
+            "pods", "default", [f"tls-{i}" for i in range(4)]
+        )
+        assert [o.metadata.name for o in objs] == [f"tls-{i}" for i in range(4)]
+        w = client.watch("pods", from_version=0)
+        seen = set()
+
+        def all_seen():
+            ev = w.get(timeout=0.2)
+            while ev is not None:
+                if ev.type == ADDED:
+                    seen.add(ev.object.metadata.name)
+                ev = w.get(timeout=0)
+            return len(seen) >= 4
+
+        assert wait_until(all_seen, 10.0), seen
+        w.stop()
+        # CA-verified path: the self-signed cert IS the CA
+        vc = RESTClient(
+            f"https://127.0.0.1:{port}", timeout=5.0, tls_ca=cert
+        )
+        assert vc.get("pods", "default", "tls-0").metadata.name == "tls-0"
+        vc.close()
+    finally:
+        client.close()
+        srv.shutdown()
